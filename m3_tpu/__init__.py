@@ -1,0 +1,11 @@
+"""m3_tpu — a TPU-native metrics platform with the capabilities of m3db/m3.
+
+Subpackages mirror the reference platform's layer map (see SURVEY.md):
+encoding (M3TSZ codec), storage (TSDB engine), index (inverted index),
+query (PromQL/Graphite engines), aggregator (streaming rollups),
+metrics (domain model: policies/rules/pipelines), cluster (placement/KV),
+msg (acked pub/sub), client (quorum session), ops (TPU kernels),
+parallel (mesh/sharding), models (service assemblies), utils.
+"""
+
+__version__ = "0.1.0"

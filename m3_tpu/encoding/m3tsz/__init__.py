@@ -1,0 +1,6 @@
+"""M3TSZ codec: scalar reference implementation + batched TPU kernels."""
+
+from m3_tpu.encoding.m3tsz.decoder import Datapoint, ReaderIterator, decode
+from m3_tpu.encoding.m3tsz.encoder import Encoder
+
+__all__ = ["Datapoint", "Encoder", "ReaderIterator", "decode"]

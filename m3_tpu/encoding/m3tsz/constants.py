@@ -1,0 +1,144 @@
+"""M3TSZ wire-format constants.
+
+Bit-compatible with the reference scheme
+(/root/reference/src/dbnode/encoding/m3tsz/m3tsz.go:28-62 and
+/root/reference/src/dbnode/encoding/scheme.go:28-58).
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+from dataclasses import dataclass
+
+from m3_tpu.utils.xtime import TimeUnit
+
+# Value XOR opcodes.
+OPCODE_ZERO_VALUE_XOR = 0x0
+OPCODE_CONTAINED_VALUE_XOR = 0x2
+OPCODE_UNCONTAINED_VALUE_XOR = 0x3
+
+# Int-optimization opcodes.
+OPCODE_ZERO_SIG = 0x0
+OPCODE_NON_ZERO_SIG = 0x1
+NUM_SIG_BITS = 6
+OPCODE_NO_UPDATE_SIG = 0x0
+OPCODE_UPDATE_SIG = 0x1
+OPCODE_UPDATE = 0x0
+OPCODE_NO_UPDATE = 0x1
+OPCODE_UPDATE_MULT = 0x1
+OPCODE_NO_UPDATE_MULT = 0x0
+OPCODE_POSITIVE = 0x0
+OPCODE_NEGATIVE = 0x1
+OPCODE_REPEAT = 0x1
+OPCODE_NO_REPEAT = 0x0
+OPCODE_FLOAT_MODE = 0x1
+OPCODE_INT_MODE = 0x0
+
+SIG_DIFF_THRESHOLD = 3
+SIG_REPEAT_THRESHOLD = 5
+
+MAX_MULT = 6
+NUM_MULT_BITS = 3
+MAX_OPT_INT = 10.0**13
+MULTIPLIERS = [10.0**i for i in range(MAX_MULT + 1)]
+
+MAX_INT = float(2**63 - 1)
+MIN_INT = float(-(2**63))
+
+# Marker scheme: 9-bit opcode 0x100 + 2-bit marker value.
+MARKER_OPCODE = 0x100
+NUM_MARKER_OPCODE_BITS = 9
+NUM_MARKER_VALUE_BITS = 2
+MARKER_END_OF_STREAM = 0
+MARKER_ANNOTATION = 1
+MARKER_TIME_UNIT = 2
+
+
+@dataclass(frozen=True)
+class TimeBucket:
+    opcode: int
+    num_opcode_bits: int
+    num_value_bits: int
+
+    @property
+    def min(self) -> int:
+        return -(1 << (self.num_value_bits - 1))
+
+    @property
+    def max(self) -> int:
+        return (1 << (self.num_value_bits - 1)) - 1
+
+
+@dataclass(frozen=True)
+class TimeEncodingScheme:
+    zero_bucket: TimeBucket
+    buckets: tuple[TimeBucket, ...]
+    default_bucket: TimeBucket
+
+
+def _make_scheme(value_bits: tuple[int, ...], default_value_bits: int) -> TimeEncodingScheme:
+    buckets = []
+    num_opcode_bits = 1
+    opcode = 0
+    for i, nvb in enumerate(value_bits):
+        opcode = (1 << (i + 1)) | opcode
+        buckets.append(TimeBucket(opcode, num_opcode_bits + 1, nvb))
+        num_opcode_bits += 1
+    default = TimeBucket(opcode | 0x1, num_opcode_bits, default_value_bits)
+    return TimeEncodingScheme(TimeBucket(0x0, 1, 0), tuple(buckets), default)
+
+
+_BUCKET_VALUE_BITS = (7, 9, 12)
+
+TIME_ENCODING_SCHEMES: dict[TimeUnit, TimeEncodingScheme] = {
+    TimeUnit.SECOND: _make_scheme(_BUCKET_VALUE_BITS, 32),
+    TimeUnit.MILLISECOND: _make_scheme(_BUCKET_VALUE_BITS, 32),
+    TimeUnit.MICROSECOND: _make_scheme(_BUCKET_VALUE_BITS, 64),
+    TimeUnit.NANOSECOND: _make_scheme(_BUCKET_VALUE_BITS, 64),
+}
+
+
+def float_to_bits(v: float) -> int:
+    return struct.unpack(">Q", struct.pack(">d", v))[0]
+
+
+def bits_to_float(b: int) -> float:
+    return struct.unpack(">d", struct.pack(">Q", b & ((1 << 64) - 1)))[0]
+
+
+def convert_to_int_float(v: float, cur_max_mult: int) -> tuple[float, int, bool]:
+    """Try to express v as int * 10^-mult.
+
+    Returns (value, multiplier, is_float). Mirrors convertToIntFloat
+    (reference m3tsz/m3tsz.go:78-119) including its rounding tolerance.
+    """
+    if cur_max_mult == 0 and v < MAX_INT:
+        f, r = math.modf(v)
+        if f == 0:
+            return v, 0, False
+    if cur_max_mult > MAX_MULT:
+        raise ValueError("supplied multiplier is invalid")
+
+    sign = -1.0 if v < 0 else 1.0
+    for mult in range(cur_max_mult, MAX_MULT + 1):
+        val = v * MULTIPLIERS[mult] * sign
+        if val >= MAX_OPT_INT:
+            break
+        r, i = math.modf(val)
+        if r == 0:
+            return sign * i, mult, False
+        elif r < 0.1:
+            if math.nextafter(val, 0) <= i:
+                return sign * i, mult, False
+        elif r > 0.9:
+            nxt = i + 1
+            if math.nextafter(val, nxt) >= nxt:
+                return sign * nxt, mult, False
+    return v, 0, True
+
+
+def convert_from_int_float(val: float, mult: int) -> float:
+    if mult == 0:
+        return val
+    return val / MULTIPLIERS[mult]

@@ -1,0 +1,285 @@
+"""M3TSZ decoder — host-side scalar reference implementation.
+
+Decodes streams produced by the reference encoder or by this package's
+encoders (scalar and TPU); semantics mirror the reference reader iterator
+(/root/reference/src/dbnode/encoding/m3tsz/{iterator,timestamp_iterator}.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from m3_tpu.encoding.m3tsz import constants as c
+from m3_tpu.utils.bitstream import IStream, sign_extend
+from m3_tpu.utils.xtime import (
+    TimeUnit,
+    from_normalized,
+    initial_time_unit,
+    unit_is_valid,
+    unit_value_ns,
+)
+
+_NUM_MARKER_BITS = c.NUM_MARKER_OPCODE_BITS + c.NUM_MARKER_VALUE_BITS
+
+
+def read_varint(stream: IStream) -> int:
+    """Zigzag LEB128 varint (Go encoding/binary.Varint)."""
+    uv = 0
+    shift = 0
+    while True:
+        b = stream.read_byte()
+        uv |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (uv >> 1) if not uv & 1 else -((uv + 1) >> 1)
+
+
+@dataclass
+class Datapoint:
+    timestamp_ns: int
+    value: float
+    unit: TimeUnit = TimeUnit.NONE
+    annotation: bytes = b""
+
+
+@dataclass
+class _TimestampIterator:
+    default_time_unit: TimeUnit = TimeUnit.SECOND
+    prev_time: int = 0
+    prev_time_delta: int = 0
+    prev_annotation: bytes = b""
+    time_unit: TimeUnit = TimeUnit.NONE
+    time_unit_changed: bool = False
+    done: bool = False
+    scheme: object = None
+    cur_annotation: bytes = field(default=b"", repr=False)
+    has_read_first: bool = False
+
+    def read_timestamp(self, stream: IStream) -> bool:
+        """Advance one timestamp; returns True if this was the first read.
+
+        Uses an explicit first-read flag rather than the reference's
+        ``PrevTime != 0`` check (timestamp_iterator.go:62), which
+        misclassifies a datapoint landing exactly on the unix epoch; behavior
+        is identical for every other stream.
+        """
+        self.cur_annotation = b""
+        if self.has_read_first:
+            dod = self._read_marker_or_dod(stream)
+            if not self.done:
+                self.prev_time_delta += dod
+                self.prev_time += self.prev_time_delta
+            first = False
+        else:
+            self._read_first_timestamp(stream)
+            self.has_read_first = True
+            first = True
+        if self.time_unit_changed:
+            self.prev_time_delta = 0
+            self.time_unit_changed = False
+        return first
+
+    def _read_first_timestamp(self, stream: IStream) -> None:
+        # First time is a signed 64-bit unix-nano (may be pre-1970).
+        start = sign_extend(stream.read_bits(64), 64)
+        if self.time_unit == TimeUnit.NONE:
+            self.time_unit = initial_time_unit(start, self.default_time_unit)
+        self.scheme = c.TIME_ENCODING_SCHEMES.get(self.time_unit)
+        dod = self._read_marker_or_dod(stream)
+        if not self.done:
+            self.prev_time_delta += dod
+        self.prev_time = start + self.prev_time_delta
+
+    def _read_marker_or_dod(self, stream: IStream) -> int:
+        try:
+            opcode_and_value = stream.peek_bits(_NUM_MARKER_BITS)
+        except EOFError:
+            return self._read_dod(stream)
+        opcode = opcode_and_value >> c.NUM_MARKER_VALUE_BITS
+        if opcode != c.MARKER_OPCODE:
+            return self._read_dod(stream)
+        marker = opcode_and_value & ((1 << c.NUM_MARKER_VALUE_BITS) - 1)
+        if marker == c.MARKER_END_OF_STREAM:
+            stream.read_bits(_NUM_MARKER_BITS)
+            self.done = True
+            return 0
+        elif marker == c.MARKER_ANNOTATION:
+            stream.read_bits(_NUM_MARKER_BITS)
+            self._read_annotation(stream)
+            return self._read_marker_or_dod(stream)
+        elif marker == c.MARKER_TIME_UNIT:
+            stream.read_bits(_NUM_MARKER_BITS)
+            self._read_time_unit(stream)
+            return self._read_marker_or_dod(stream)
+        return self._read_dod(stream)
+
+    def _read_annotation(self, stream: IStream) -> None:
+        n = read_varint(stream) + 1
+        if n <= 0:
+            raise ValueError(f"expected annotation length to be > 0, got {n}")
+        ant = stream.read_bytes(n)
+        self.prev_annotation = ant
+        self.cur_annotation = ant
+
+    def _read_time_unit(self, stream: IStream) -> None:
+        tu = stream.read_byte()
+        if unit_is_valid(tu) and TimeUnit(tu) != self.time_unit:
+            self.time_unit_changed = True
+            self.scheme = c.TIME_ENCODING_SCHEMES.get(TimeUnit(tu))
+        self.time_unit = TimeUnit(tu)
+
+    def _read_dod(self, stream: IStream) -> int:
+        if self.time_unit_changed:
+            # Full 64-bit delta-of-delta in nanos after a unit change.
+            self.scheme = c.TIME_ENCODING_SCHEMES.get(self.time_unit)
+            return sign_extend(stream.read_bits(64), 64)
+        scheme = self.scheme
+        if scheme is None:
+            raise ValueError(f"no time encoding scheme for unit {self.time_unit}")
+        cb = stream.read_bits(1)
+        if cb == scheme.zero_bucket.opcode:
+            return 0
+        for bucket in scheme.buckets:
+            cb = (cb << 1) | stream.read_bits(1)
+            if cb == bucket.opcode:
+                dod = sign_extend(stream.read_bits(bucket.num_value_bits), bucket.num_value_bits)
+                return from_normalized(dod, unit_value_ns(self.time_unit))
+        nvb = scheme.default_bucket.num_value_bits
+        dod = sign_extend(stream.read_bits(nvb), nvb)
+        return from_normalized(dod, unit_value_ns(self.time_unit))
+
+
+class ReaderIterator:
+    """Iterates datapoints out of a single M3TSZ stream."""
+
+    def __init__(
+        self,
+        data: bytes,
+        int_optimized: bool = True,
+        default_time_unit: TimeUnit = TimeUnit.SECOND,
+    ) -> None:
+        self._stream = IStream(data)
+        self._ts = _TimestampIterator(default_time_unit=default_time_unit)
+        self._int_optimized = int_optimized
+        self._is_float = False
+        self._int_val = 0.0
+        self._mult = 0
+        self._sig = 0
+        self._prev_float_bits = 0
+        self._prev_xor = 0
+        self._float_not_first = False
+
+    def __iter__(self):
+        if self._stream.remaining_bits == 0:
+            return
+        while True:
+            first = self._ts.read_timestamp(self._stream)
+            if self._ts.done:
+                return
+            if first:
+                self._read_first_value()
+            else:
+                self._read_next_value()
+            if not self._int_optimized or self._is_float:
+                value = c.bits_to_float(self._prev_float_bits)
+            else:
+                value = c.convert_from_int_float(self._int_val, self._mult)
+            yield Datapoint(
+                timestamp_ns=self._ts.prev_time,
+                value=value,
+                unit=self._ts.time_unit,
+                annotation=self._ts.cur_annotation,
+            )
+
+    # -- float XOR stream --
+
+    def _read_full_float(self) -> None:
+        bits = self._stream.read_bits(64)
+        self._prev_float_bits = bits
+        self._prev_xor = bits
+
+    def _read_next_float(self) -> None:
+        if not self._stream.read_bits(1):
+            self._prev_xor = 0
+            return
+        if self._stream.read_bits(1) == 0:  # contained
+            prev_leading = 64 - self._prev_xor.bit_length() if self._prev_xor else 64
+            prev_trailing = (
+                ((self._prev_xor & -self._prev_xor).bit_length() - 1) if self._prev_xor else 0
+            )
+            num_meaningful = 64 - prev_leading - prev_trailing
+            bits = self._stream.read_bits(num_meaningful)
+            self._prev_xor = bits << prev_trailing
+        else:  # uncontained
+            lead_and_len = self._stream.read_bits(12)
+            num_leading = (lead_and_len >> 6) & 0x3F
+            num_meaningful = (lead_and_len & 0x3F) + 1
+            bits = self._stream.read_bits(num_meaningful)
+            num_trailing = 64 - num_leading - num_meaningful
+            self._prev_xor = bits << num_trailing
+        self._prev_float_bits ^= self._prev_xor
+
+    # -- value decode --
+
+    def _read_first_value(self) -> None:
+        if not self._int_optimized:
+            self._read_full_float()
+            return
+        if self._stream.read_bits(1) == c.OPCODE_FLOAT_MODE:
+            self._read_full_float()
+            self._is_float = True
+            return
+        self._read_int_sig_mult()
+        self._read_int_val_diff()
+
+    def _read_next_value(self) -> None:
+        if not self._int_optimized:
+            self._read_next_float()
+            return
+        if self._stream.read_bits(1) == c.OPCODE_UPDATE:
+            if self._stream.read_bits(1) == c.OPCODE_REPEAT:
+                return
+            if self._stream.read_bits(1) == c.OPCODE_FLOAT_MODE:
+                self._read_full_float()
+                self._is_float = True
+                return
+            self._read_int_sig_mult()
+            self._read_int_val_diff()
+            self._is_float = False
+            return
+        if self._is_float:
+            self._read_next_float()
+            return
+        self._read_int_val_diff()
+
+    def _read_int_sig_mult(self) -> None:
+        if self._stream.read_bits(1) == c.OPCODE_UPDATE_SIG:
+            if self._stream.read_bits(1) == c.OPCODE_ZERO_SIG:
+                self._sig = 0
+            else:
+                self._sig = self._stream.read_bits(c.NUM_SIG_BITS) + 1
+        if self._stream.read_bits(1) == c.OPCODE_UPDATE_MULT:
+            self._mult = self._stream.read_bits(c.NUM_MULT_BITS)
+            if self._mult > c.MAX_MULT:
+                raise ValueError("invalid multiplier")
+
+    def _read_int_val_diff(self) -> None:
+        if self._sig == 64:
+            sign = 1.0 if self._stream.read_bits(1) == c.OPCODE_NEGATIVE else -1.0
+            self._int_val += sign * float(self._stream.read_bits(self._sig))
+            return
+        bits = self._stream.read_bits(self._sig + 1)
+        sign = -1.0
+        if (bits >> self._sig) == c.OPCODE_NEGATIVE:
+            sign = 1.0
+            bits ^= 1 << self._sig
+        self._int_val += sign * float(bits)
+
+
+def decode(
+    data: bytes,
+    int_optimized: bool = True,
+    default_time_unit: TimeUnit = TimeUnit.SECOND,
+) -> list[Datapoint]:
+    return list(ReaderIterator(data, int_optimized, default_time_unit))

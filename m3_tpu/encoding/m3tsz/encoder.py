@@ -1,0 +1,344 @@
+"""M3TSZ encoder — host-side scalar reference implementation.
+
+Produces streams bit-identical to the reference encoder
+(/root/reference/src/dbnode/encoding/m3tsz/{encoder,timestamp_encoder,
+float_encoder_iterator,int_sig_bits_tracker}.go): delta-of-delta timestamps
+with per-unit bucket schemes and special markers, XOR float compression, and
+the float->scaled-int optimization.
+
+This scalar path is the semantic ground truth that the batched TPU kernels
+(m3_tpu.encoding.m3tsz.tpu) are property-tested against; it also serves the
+control plane for small/one-off encodes where device dispatch would dominate.
+"""
+
+from __future__ import annotations
+
+from m3_tpu.encoding.m3tsz import constants as c
+from m3_tpu.utils.bitstream import OStream, leading_zeros64, num_sig, trailing_zeros64
+from m3_tpu.utils.xtime import (
+    TimeUnit,
+    initial_time_unit,
+    to_normalized,
+    unit_is_valid,
+    unit_value_ns,
+)
+
+
+def write_varint(os: OStream, v: int) -> None:
+    """Zigzag LEB128 varint (Go encoding/binary.PutVarint)."""
+    uv = 2 * v if v >= 0 else -2 * v - 1
+    while uv >= 0x80:
+        os.write_byte((uv & 0x7F) | 0x80)
+        uv >>= 7
+    os.write_byte(uv)
+
+
+class TimestampEncoder:
+    """Delta-of-delta timestamp stream state."""
+
+    def __init__(self, start_ns: int, time_unit: TimeUnit) -> None:
+        self.prev_time = start_ns
+        self.prev_time_delta = 0
+        self.prev_annotation = b""
+        self.time_unit = initial_time_unit(start_ns, time_unit)
+        self.time_unit_encoded_manually = False
+        self.has_written_first = False
+
+    def write_time(self, os: OStream, t_ns: int, annotation: bytes, unit: TimeUnit) -> None:
+        if not self.has_written_first:
+            # First time is always raw nanos: start may not be unit-aligned.
+            os.write_bits(self.prev_time & ((1 << 64) - 1), 64)
+            self.has_written_first = True
+        self._write_next_time(os, t_ns, annotation, unit)
+
+    def _write_next_time(self, os: OStream, t_ns: int, annotation: bytes, unit: TimeUnit) -> None:
+        self._write_annotation(os, annotation)
+        tu_changed = self._maybe_write_time_unit_change(os, unit)
+
+        time_delta = t_ns - self.prev_time
+        self.prev_time = t_ns
+        if tu_changed or self.time_unit_encoded_manually:
+            # Unit changed: full 64-bit delta-of-delta in nanos, then reset the
+            # delta since it may not be a multiple of the new unit.
+            dod = time_delta - self.prev_time_delta
+            os.write_bits(dod & ((1 << 64) - 1), 64)
+            self.prev_time_delta = 0
+            self.time_unit_encoded_manually = False
+            return
+        self._write_dod(os, self.prev_time_delta, time_delta, unit)
+        self.prev_time_delta = time_delta
+
+    def write_time_unit(self, os: OStream, unit: TimeUnit) -> None:
+        os.write_byte(int(unit))
+        self.time_unit = unit
+        self.time_unit_encoded_manually = True
+
+    def _maybe_write_time_unit_change(self, os: OStream, unit: TimeUnit) -> bool:
+        if not unit_is_valid(unit) or unit == self.time_unit:
+            return False
+        write_special_marker(os, c.MARKER_TIME_UNIT)
+        self.write_time_unit(os, unit)
+        return True
+
+    def _write_annotation(self, os: OStream, annotation: bytes) -> None:
+        if not annotation or annotation == self.prev_annotation:
+            return
+        write_special_marker(os, c.MARKER_ANNOTATION)
+        write_varint(os, len(annotation) - 1)
+        os.write_bytes(annotation)
+        self.prev_annotation = bytes(annotation)
+
+    def _write_dod(self, os: OStream, prev_delta: int, cur_delta: int, unit: TimeUnit) -> None:
+        u = unit_value_ns(unit)
+        dod = to_normalized(cur_delta - prev_delta, u)
+        if unit in (TimeUnit.MILLISECOND, TimeUnit.SECOND):
+            if not -(1 << 31) <= dod < (1 << 31):
+                raise OverflowError(f"deltaOfDelta {dod} overflows 32 bits for unit {unit}")
+        scheme = c.TIME_ENCODING_SCHEMES.get(TimeUnit(unit))
+        if scheme is None:
+            raise ValueError(f"no time encoding scheme for unit {unit}")
+        if dod == 0:
+            zb = scheme.zero_bucket
+            os.write_bits(zb.opcode, zb.num_opcode_bits)
+            return
+        for b in scheme.buckets:
+            if b.min <= dod <= b.max:
+                os.write_bits(b.opcode, b.num_opcode_bits)
+                os.write_bits(dod & ((1 << b.num_value_bits) - 1), b.num_value_bits)
+                return
+        db = scheme.default_bucket
+        os.write_bits(db.opcode, db.num_opcode_bits)
+        os.write_bits(dod & ((1 << db.num_value_bits) - 1), db.num_value_bits)
+
+
+def write_special_marker(os: OStream, marker: int) -> None:
+    os.write_bits(c.MARKER_OPCODE, c.NUM_MARKER_OPCODE_BITS)
+    os.write_bits(marker, c.NUM_MARKER_VALUE_BITS)
+
+
+class FloatXOREncoder:
+    """Gorilla-style XOR float stream state."""
+
+    def __init__(self) -> None:
+        self.prev_xor = 0
+        self.prev_float_bits = 0
+        self.not_first = False
+
+    def write_full_float(self, os: OStream, bits: int) -> None:
+        self.prev_float_bits = bits
+        self.prev_xor = bits
+        os.write_bits(bits, 64)
+        self.not_first = True
+
+    def write_next_float(self, os: OStream, bits: int) -> None:
+        xor = self.prev_float_bits ^ bits
+        self._write_xor(os, xor)
+        self.prev_xor = xor
+        self.prev_float_bits = bits
+
+    def _write_xor(self, os: OStream, cur_xor: int) -> None:
+        if cur_xor == 0:
+            os.write_bits(c.OPCODE_ZERO_VALUE_XOR, 1)
+            return
+        prev_leading, prev_trailing = leading_zeros64(self.prev_xor), trailing_zeros64(self.prev_xor)
+        cur_leading, cur_trailing = leading_zeros64(cur_xor), trailing_zeros64(cur_xor)
+        if cur_leading >= prev_leading and cur_trailing >= prev_trailing:
+            os.write_bits(c.OPCODE_CONTAINED_VALUE_XOR, 2)
+            os.write_bits(cur_xor >> prev_trailing, 64 - prev_leading - prev_trailing)
+            return
+        os.write_bits(c.OPCODE_UNCONTAINED_VALUE_XOR, 2)
+        os.write_bits(cur_leading, 6)
+        num_meaningful = 64 - cur_leading - cur_trailing
+        os.write_bits(num_meaningful - 1, 6)
+        os.write_bits(cur_xor >> cur_trailing, num_meaningful)
+
+
+class IntSigBitsTracker:
+    """Significant-bit width tracker for int diffs
+    (reference m3tsz/int_sig_bits_tracker.go)."""
+
+    def __init__(self) -> None:
+        self.num_sig = 0
+        self.cur_highest_lower_sig = 0
+        self.num_lower_sig = 0
+
+    def write_int_val_diff(self, os: OStream, val_bits: int, neg: bool) -> None:
+        os.write_bit(c.OPCODE_NEGATIVE if neg else c.OPCODE_POSITIVE)
+        os.write_bits(val_bits, self.num_sig)
+
+    def write_int_sig(self, os: OStream, sig: int) -> None:
+        if self.num_sig != sig:
+            os.write_bit(c.OPCODE_UPDATE_SIG)
+            if sig == 0:
+                os.write_bit(c.OPCODE_ZERO_SIG)
+            else:
+                os.write_bit(c.OPCODE_NON_ZERO_SIG)
+                os.write_bits(sig - 1, c.NUM_SIG_BITS)
+        else:
+            os.write_bit(c.OPCODE_NO_UPDATE_SIG)
+        self.num_sig = sig
+
+    def track_new_sig(self, sig: int) -> int:
+        new_sig = self.num_sig
+        if sig > self.num_sig:
+            new_sig = sig
+        elif self.num_sig - sig >= c.SIG_DIFF_THRESHOLD:
+            if self.num_lower_sig == 0:
+                self.cur_highest_lower_sig = sig
+            elif sig > self.cur_highest_lower_sig:
+                self.cur_highest_lower_sig = sig
+            self.num_lower_sig += 1
+            if self.num_lower_sig >= c.SIG_REPEAT_THRESHOLD:
+                new_sig = self.cur_highest_lower_sig
+                self.num_lower_sig = 0
+        else:
+            self.num_lower_sig = 0
+        return new_sig
+
+
+class Encoder:
+    """Single-series M3TSZ stream encoder."""
+
+    def __init__(
+        self,
+        start_ns: int,
+        int_optimized: bool = True,
+        default_time_unit: TimeUnit = TimeUnit.SECOND,
+    ) -> None:
+        self._os = OStream()
+        self._ts = TimestampEncoder(start_ns, default_time_unit)
+        self._float = FloatXOREncoder()
+        self._sig = IntSigBitsTracker()
+        self._int_optimized = int_optimized
+        self._int_val = 0.0
+        self._max_mult = 0
+        self._is_float = False
+        self.num_encoded = 0
+
+    def encode(
+        self,
+        t_ns: int,
+        value: float,
+        unit: TimeUnit = TimeUnit.SECOND,
+        annotation: bytes = b"",
+    ) -> None:
+        self._ts.write_time(self._os, t_ns, annotation, unit)
+        if self.num_encoded == 0:
+            self._write_first_value(value)
+        else:
+            self._write_next_value(value)
+        self.num_encoded += 1
+
+    def _write_first_value(self, v: float) -> None:
+        if not self._int_optimized:
+            self._float.write_full_float(self._os, c.float_to_bits(v))
+            return
+        val, mult, is_float = c.convert_to_int_float(v, 0)
+        # Values whose integer form needs > 63 bits can't take int mode: the
+        # sig-bits field caps at 64 and the stream would be undecodable.
+        if not is_float and abs(val) >= c.MAX_INT:
+            val, is_float = v, True
+        if is_float:
+            self._os.write_bit(c.OPCODE_FLOAT_MODE)
+            self._float.write_full_float(self._os, c.float_to_bits(v))
+            self._is_float = True
+            self._max_mult = mult
+            return
+        self._os.write_bit(c.OPCODE_INT_MODE)
+        self._int_val = val
+        neg_diff = True
+        if val < 0:
+            neg_diff = False
+            val = -val
+        val_bits = int(val)
+        sig = num_sig(val_bits)
+        self._write_int_sig_mult(sig, mult, False)
+        self._sig.write_int_val_diff(self._os, val_bits, neg_diff)
+
+    def _write_next_value(self, v: float) -> None:
+        if not self._int_optimized:
+            self._float.write_next_float(self._os, c.float_to_bits(v))
+            return
+        val, mult, is_float = c.convert_to_int_float(v, self._max_mult)
+        if not is_float and abs(val) >= c.MAX_INT:
+            val, is_float = v, True
+        val_diff = 0.0
+        if not is_float:
+            val_diff = self._int_val - val
+        if is_float or val_diff >= c.MAX_INT or val_diff <= c.MIN_INT:
+            self._write_float_val(c.float_to_bits(val), mult)
+            return
+        self._write_int_val(val, mult, is_float, val_diff)
+
+    def _write_float_val(self, bits: int, mult: int) -> None:
+        if not self._is_float:
+            self._os.write_bit(c.OPCODE_UPDATE)
+            self._os.write_bit(c.OPCODE_NO_REPEAT)
+            self._os.write_bit(c.OPCODE_FLOAT_MODE)
+            self._float.write_full_float(self._os, bits)
+            self._is_float = True
+            self._max_mult = mult
+            return
+        if bits == self._float.prev_float_bits:
+            self._os.write_bit(c.OPCODE_UPDATE)
+            self._os.write_bit(c.OPCODE_REPEAT)
+            return
+        self._os.write_bit(c.OPCODE_NO_UPDATE)
+        self._float.write_next_float(self._os, bits)
+
+    def _write_int_val(self, val: float, mult: int, is_float: bool, val_diff: float) -> None:
+        if val_diff == 0 and is_float == self._is_float and mult == self._max_mult:
+            self._os.write_bit(c.OPCODE_UPDATE)
+            self._os.write_bit(c.OPCODE_REPEAT)
+            return
+        neg = False
+        if val_diff < 0:
+            neg = True
+            val_diff = -val_diff
+        val_diff_bits = int(val_diff)
+        sig = num_sig(val_diff_bits)
+        new_sig = self._sig.track_new_sig(sig)
+        is_float_changed = is_float != self._is_float
+        if mult > self._max_mult or self._sig.num_sig != new_sig or is_float_changed:
+            self._os.write_bit(c.OPCODE_UPDATE)
+            self._os.write_bit(c.OPCODE_NO_REPEAT)
+            self._os.write_bit(c.OPCODE_INT_MODE)
+            self._write_int_sig_mult(new_sig, mult, is_float_changed)
+            self._sig.write_int_val_diff(self._os, val_diff_bits, neg)
+            self._is_float = False
+        else:
+            self._os.write_bit(c.OPCODE_NO_UPDATE)
+            self._sig.write_int_val_diff(self._os, val_diff_bits, neg)
+        self._int_val = val
+
+    def _write_int_sig_mult(self, sig: int, mult: int, float_changed: bool) -> None:
+        self._sig.write_int_sig(self._os, sig)
+        if mult > self._max_mult:
+            self._os.write_bit(c.OPCODE_UPDATE_MULT)
+            self._os.write_bits(mult, c.NUM_MULT_BITS)
+            self._max_mult = mult
+        elif self._sig.num_sig == sig and self._max_mult == mult and float_changed:
+            self._os.write_bit(c.OPCODE_UPDATE_MULT)
+            self._os.write_bits(self._max_mult, c.NUM_MULT_BITS)
+        else:
+            self._os.write_bit(c.OPCODE_NO_UPDATE_MULT)
+
+    def stream(self) -> bytes:
+        """Finalized stream: data capped with the end-of-stream marker."""
+        if self._os.bit_length == 0:
+            return b""
+        raw, pos = self._os.raw()
+        tail = OStream()
+        if pos not in (0, 8):
+            tail.write_bits(raw[-1] >> (8 - pos), pos)
+            head = raw[:-1]
+        else:
+            head = raw
+        write_special_marker(tail, c.MARKER_END_OF_STREAM)
+        return head + tail.bytes_padded()
+
+    @property
+    def last_value(self) -> float:
+        if self._is_float or not self._int_optimized:
+            return c.bits_to_float(self._float.prev_float_bits)
+        return self._int_val

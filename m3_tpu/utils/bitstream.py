@@ -1,0 +1,133 @@
+"""MSB-first bit streams.
+
+Semantics match the reference OStream/IStream
+(/root/reference/src/dbnode/encoding/{ostream,istream}.go): bits are packed
+most-significant-first into bytes; WriteBits writes the low `n` bits of the
+value, most significant of those first.
+
+This is the host-side (control plane) implementation; the batched TPU
+encode/decode kernels in m3_tpu.encoding.m3tsz.tpu operate on whole tensors
+of series at once and produce the identical bit layout.
+"""
+
+from __future__ import annotations
+
+_MASK64 = (1 << 64) - 1
+
+
+class OStream:
+    """Append-only bit output stream."""
+
+    __slots__ = ("_acc", "_nbits", "_buf")
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+        self._acc = 0  # partial byte accumulator (< 8 bits), MSB-aligned int
+        self._nbits = 0  # number of valid bits in _acc (0..7)
+
+    def write_bit(self, v: int) -> None:
+        self.write_bits(v & 1, 1)
+
+    def write_bits(self, v: int, n: int) -> None:
+        if n == 0:
+            return
+        v &= (1 << n) - 1
+        acc = (self._acc << n) | v
+        nbits = self._nbits + n
+        while nbits >= 8:
+            nbits -= 8
+            self._buf.append((acc >> nbits) & 0xFF)
+        self._acc = acc & ((1 << nbits) - 1)
+        self._nbits = nbits
+
+    def write_byte(self, v: int) -> None:
+        self.write_bits(v & 0xFF, 8)
+
+    def write_bytes(self, bs: bytes) -> None:
+        if self._nbits == 0:
+            self._buf.extend(bs)
+        else:
+            for b in bs:
+                self.write_bits(b, 8)
+
+    @property
+    def bit_length(self) -> int:
+        return len(self._buf) * 8 + self._nbits
+
+    def raw(self) -> tuple[bytes, int]:
+        """(complete bytes + possibly-partial last byte, bit pos in last byte).
+
+        pos follows the reference convention: 8 when the last byte is full,
+        1..7 when partial (partial bits are MSB-aligned, zero padded).
+        """
+        if self._nbits == 0:
+            return bytes(self._buf), 8 if self._buf else 0
+        return bytes(self._buf) + bytes([(self._acc << (8 - self._nbits)) & 0xFF]), self._nbits
+
+    def bytes_padded(self) -> bytes:
+        """Stream contents zero-padded to a byte boundary."""
+        return self.raw()[0]
+
+
+class IStream:
+    """Bit input stream over bytes."""
+
+    __slots__ = ("_data", "_bitpos", "_nbits")
+
+    def __init__(self, data: bytes) -> None:
+        self._data = data
+        self._bitpos = 0
+        self._nbits = len(data) * 8
+
+    @property
+    def remaining_bits(self) -> int:
+        return self._nbits - self._bitpos
+
+    def read_bits(self, n: int) -> int:
+        v = self.peek_bits(n)
+        self._bitpos += n
+        return v
+
+    def peek_bits(self, n: int) -> int:
+        if self._bitpos + n > self._nbits:
+            raise EOFError("bit stream exhausted")
+        start = self._bitpos
+        end = start + n
+        first_byte = start >> 3
+        last_byte = (end + 7) >> 3
+        chunk = int.from_bytes(self._data[first_byte:last_byte], "big")
+        total_bits = (last_byte - first_byte) * 8
+        chunk >>= total_bits - (end - first_byte * 8)
+        return chunk & ((1 << n) - 1)
+
+    def read_bit(self) -> int:
+        return self.read_bits(1)
+
+    def read_byte(self) -> int:
+        return self.read_bits(8)
+
+    def read_bytes(self, n: int) -> bytes:
+        return bytes(self.read_bits(8) for _ in range(n))
+
+
+def leading_zeros64(v: int) -> int:
+    if v == 0:
+        return 64
+    return 64 - v.bit_length()
+
+
+def trailing_zeros64(v: int) -> int:
+    if v == 0:
+        return 0  # matches reference LeadingAndTrailingZeros(0) = (64, 0)
+    return (v & -v).bit_length() - 1
+
+
+def num_sig(v: int) -> int:
+    """Number of significant bits (reference encoding/encoding.go:29)."""
+    return v.bit_length()
+
+
+def sign_extend(v: int, n: int) -> int:
+    """Interpret the low n bits of v as an n-bit two's-complement integer."""
+    sign_bit = 1 << (n - 1)
+    return (v & (sign_bit - 1)) - (v & sign_bit)

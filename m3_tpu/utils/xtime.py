@@ -1,0 +1,77 @@
+"""Time units and conversions.
+
+Wire-compatible with the reference time unit enum
+(/root/reference/src/x/time/unit.go:31-41): the byte values written into
+M3TSZ streams for time-unit changes must match so that streams are
+bit-identical with the reference encoder.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class TimeUnit(enum.IntEnum):
+    """Time unit enum; integer values are the wire format."""
+
+    NONE = 0
+    SECOND = 1
+    MILLISECOND = 2
+    MICROSECOND = 3
+    NANOSECOND = 4
+    MINUTE = 5
+    HOUR = 6
+    DAY = 7
+    YEAR = 8
+
+
+_UNIT_NANOS = {
+    TimeUnit.NONE: 0,
+    TimeUnit.SECOND: 1_000_000_000,
+    TimeUnit.MILLISECOND: 1_000_000,
+    TimeUnit.MICROSECOND: 1_000,
+    TimeUnit.NANOSECOND: 1,
+    TimeUnit.MINUTE: 60 * 1_000_000_000,
+    TimeUnit.HOUR: 3600 * 1_000_000_000,
+    TimeUnit.DAY: 24 * 3600 * 1_000_000_000,
+    TimeUnit.YEAR: 365 * 24 * 3600 * 1_000_000_000,
+}
+
+
+def unit_value_ns(unit: TimeUnit) -> int:
+    """Duration of one unit in nanoseconds. Raises for NONE."""
+    v = _UNIT_NANOS[TimeUnit(unit)]
+    if v == 0:
+        raise ValueError("time unit NONE has no duration")
+    return v
+
+
+def unit_is_valid(unit: int) -> bool:
+    try:
+        u = TimeUnit(unit)
+    except ValueError:
+        return False
+    return u != TimeUnit.NONE
+
+
+def to_normalized(duration_ns: int, unit_ns: int) -> int:
+    """Truncating division like Go's time.Duration / time.Duration."""
+    # Go integer division truncates toward zero; Python // floors.
+    q = abs(duration_ns) // unit_ns
+    return q if duration_ns >= 0 else -q
+
+
+def from_normalized(normalized: int, unit_ns: int) -> int:
+    return normalized * unit_ns
+
+
+def initial_time_unit(start_ns: int, unit: TimeUnit) -> TimeUnit:
+    """A unit is usable from the start only if start is a multiple of it.
+
+    Mirrors initialTimeUnit (reference m3tsz/timestamp_encoder.go:248-259).
+    """
+    if not unit_is_valid(unit):
+        return TimeUnit.NONE
+    if start_ns % unit_value_ns(unit) == 0:
+        return TimeUnit(unit)
+    return TimeUnit.NONE
