@@ -1,0 +1,84 @@
+"""Round benchmark: batched M3TSZ encode+decode round-trip throughput.
+
+Workload mirrors BASELINE.md config #1 (100k-series M3TSZ round-trip) scaled
+to a single dispatch: B series x T datapoints encoded to storage blocks and
+decoded back, on whatever device JAX selects (real TPU under the driver).
+
+Baseline: the reference publishes no absolute throughput numbers
+(BASELINE.md — its Go micro-benchmarks are harnesses only) and no Go
+toolchain exists in this image to run them; we use 10M datapoints/sec as the
+single-core Go M3TSZ encode estimate (~100ns/datapoint, typical for
+bit-packing codecs of this shape) and report vs_baseline against it.
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_DP_PER_SEC = 10_000_000.0  # estimated single-core Go CPU path
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from m3_tpu.encoding.m3tsz import tpu
+    from m3_tpu.utils.xtime import TimeUnit
+
+    rng = np.random.default_rng(0)
+    B, T = 8192, 120  # ~1M datapoints per dispatch
+    start = np.full(B, 1_600_000_000_000_000_000, dtype=np.int64)
+    times = start[:, None] + np.cumsum(
+        rng.integers(1, 60, (B, T)).astype(np.int64) * 10**9, axis=1
+    )
+    values = rng.normal(100.0, 25.0, (B, T))
+    n_points = np.full(B, T, dtype=np.int32)
+    cap = None  # encode_bits' default capacity covers the true worst case
+
+    jt = jnp.asarray(times)
+    jv = jnp.asarray(values.view(np.uint64))
+    js = jnp.asarray(start)
+    jn = jnp.asarray(n_points)
+
+    def roundtrip():
+        blocks = tpu.encode_bits(jt, jv, js, jn, TimeUnit.SECOND, cap)
+        dec = tpu.decode(blocks.words, TimeUnit.SECOND, max_points=T)
+        return blocks, dec
+
+    # compile + correctness check
+    blocks, dec = roundtrip()
+    jax.block_until_ready((blocks.words, dec.times))
+    ok = bool(
+        (np.asarray(dec.times)[:, :T] == times).all()
+        and (np.asarray(dec.values)[:, :T] == values).all()
+        and not bool(blocks.overflow)
+    )
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        blocks, dec = roundtrip()
+    jax.block_until_ready((blocks.words, dec.times))
+    dt = (time.perf_counter() - t0) / iters
+
+    dp_per_sec = B * T / dt
+    print(
+        json.dumps(
+            {
+                "metric": "m3tsz encode+decode roundtrip throughput"
+                + ("" if ok else " (CORRECTNESS FAILED)"),
+                "value": round(dp_per_sec / 1e6, 3),
+                "unit": "M datapoints/sec",
+                "vs_baseline": round(dp_per_sec / BASELINE_DP_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,418 @@
+"""Batched M3TSZ encode/decode as JAX/XLA kernels.
+
+The scalar codec (encoder.py/decoder.py) processes one datapoint at a time;
+these kernels process a whole (series x timestep) block per dispatch:
+
+- **encode**: two-pass vectorized bit-packing — compute every datapoint's
+  field bit-lengths elementwise, prefix-sum them into bit offsets, assemble
+  each datapoint's payload in a 192-bit register, then scatter-add the
+  (disjoint) bit pieces into the output word tensor. Because every bit is
+  produced by exactly one datapoint, integer add == bitwise or.
+- **decode**: lax.scan over timesteps (the format is inherently sequential
+  per stream) vmapped over series — throughput comes from the batch axis.
+
+Streams are bit-identical to the scalar encoder configured with
+int_optimized=False and a fixed time unit (the storage engine's block-write
+configuration for device-resident blocks). Annotations, time-unit changes,
+and the int optimization stay on the scalar/host path; this mirrors the
+reference's split where the hot loop handles the common shape
+(/root/reference/src/dbnode/encoding/m3tsz/float_encoder_iterator.go) and
+markers are rare control-plane events.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from m3_tpu.ops.bits import (
+    I64,
+    U64,
+    bits_to_f64,
+    clz64,
+    ctz64,
+    f64_to_bits,
+    mask_low,
+    read_window,
+    reg3_insert,
+    reg3_shift_right_to4,
+    shl,
+    shr,
+    sign_extend64,
+)
+from m3_tpu.utils.xtime import TimeUnit, unit_value_ns
+
+_EOS_FIELD = jnp.uint64(0x100 << 2)  # 9-bit marker opcode + 2-bit EOS value
+_EOS_LEN = jnp.uint64(11)
+
+# Max bits one datapoint can occupy: timestamp default bucket (4+64) +
+# uncontained XOR (2+6+6+64).
+MAX_BITS_PER_DP = 146
+
+
+class EncodedBlocks(NamedTuple):
+    """Batch of encoded streams as device tensors."""
+
+    words: jnp.ndarray  # [B, W] uint64, MSB-first bit stream
+    bit_lengths: jnp.ndarray  # [B] uint64, total bits incl. EOS marker
+    # True if any series exceeded capacity_words OR its start was not
+    # aligned to the encode unit (either way the streams are unusable —
+    # re-encode with more capacity / an aligned block start).
+    overflow: jnp.ndarray  # [] bool
+
+
+def _dod_fields(dod_units: jnp.ndarray, default_value_bits: int):
+    """Per-element timestamp field (hi, lo, len) for a delta-of-delta.
+
+    Bucket scheme per /root/reference/src/dbnode/encoding/scheme.go:44-52:
+    0 -> '0'; 7/9/12-bit buckets with opcodes 10/110/1110; default 1111 +
+    32 or 64 bits.
+    """
+    d = dod_units
+    zero = d == 0
+    fits = lambda n: (d >= -(1 << (n - 1))) & (d <= (1 << (n - 1)) - 1)  # noqa: E731
+    in7, in9, in12 = fits(7), fits(9), fits(12)
+
+    db = default_value_bits
+    ud = d.astype(U64)
+    # Select (len, value) by bucket; value = opcode followed by dod bits.
+    length = jnp.where(
+        zero,
+        jnp.uint64(1),
+        jnp.where(in7, jnp.uint64(9), jnp.where(in9, jnp.uint64(12), jnp.where(in12, jnp.uint64(16), jnp.uint64(4 + db)))),
+    )
+    val7 = (jnp.uint64(0b10) << 7) | (ud & mask_low(7))
+    val9 = (jnp.uint64(0b110) << 9) | (ud & mask_low(9))
+    val12 = (jnp.uint64(0b1110) << 12) | (ud & mask_low(12))
+    if db == 32:
+        val_def_hi = jnp.zeros_like(ud)
+        val_def_lo = (jnp.uint64(0b1111) << 32) | (ud & mask_low(32))
+    else:
+        val_def_hi = jnp.full_like(ud, jnp.uint64(0b1111))
+        val_def_lo = ud
+    lo = jnp.where(
+        zero, jnp.uint64(0), jnp.where(in7, val7, jnp.where(in9, val9, jnp.where(in12, val12, val_def_lo)))
+    )
+    hi = jnp.where(zero | in7 | in9 | in12, jnp.uint64(0), val_def_hi)
+    return hi, lo, length
+
+
+def _xor_fields(xor: jnp.ndarray, prev_xor: jnp.ndarray):
+    """Per-element XOR value field (hi, lo, len).
+
+    Zero / contained / uncontained opcodes per the reference float codec
+    (/root/reference/src/dbnode/encoding/m3tsz/float_encoder_iterator.go:82-103).
+    """
+    pl, pt = clz64(prev_xor), ctz64(prev_xor)
+    cl, ct = clz64(xor), ctz64(xor)
+    zero = xor == 0
+    contained = (cl >= pl) & (ct >= pt) & ~zero
+
+    # contained: '10' + xor >> prev_trailing in (64 - pl - pt) bits
+    m_prev = jnp.uint64(64) - pl - pt
+    c_lo_val = shr(xor, pt)
+    c_len = jnp.uint64(2) + m_prev
+    # field value = (0b10 << m_prev) | mantissa; may reach 66 bits
+    c_hi = shr(jnp.uint64(0b10), jnp.uint64(64) - m_prev)
+    c_lo = shl(jnp.uint64(0b10), m_prev) | c_lo_val
+
+    # uncontained: '11' + 6-bit leading + 6-bit (m-1) + m bits
+    m = jnp.uint64(64) - cl - ct
+    top = (jnp.uint64(0b11) << 12) | (cl << 6) | (m - jnp.uint64(1))  # 14 bits
+    mant = shr(xor, ct)
+    u_len = jnp.uint64(14) + m
+    u_lo = shl(top, m) | mant
+    u_hi = shr(top, jnp.uint64(64) - m)
+
+    length = jnp.where(zero, jnp.uint64(1), jnp.where(contained, c_len, u_len))
+    lo = jnp.where(zero, jnp.uint64(0), jnp.where(contained, c_lo, u_lo))
+    hi = jnp.where(zero, jnp.uint64(0), jnp.where(contained, c_hi, u_hi))
+    return hi, lo, length
+
+
+def _trunc_div(a: jnp.ndarray, b: int) -> jnp.ndarray:
+    """Go-style truncating integer division (toward zero)."""
+    q = jnp.abs(a) // b
+    return jnp.where(a < 0, -q, q).astype(I64)
+
+
+def encode(
+    times: jnp.ndarray,
+    values: jnp.ndarray,  # [B, T] float64
+    start: jnp.ndarray,
+    n_points: jnp.ndarray,
+    unit: TimeUnit = TimeUnit.SECOND,
+    capacity_words: int | None = None,
+) -> EncodedBlocks:
+    """Encode from float64 values.
+
+    Host/CPU convenience wrapper: the TPU X64 rewriter implements the
+    u64->f64 bitcast but NOT the f64->u64 direction (probed on v5e), so the
+    jitted kernel (encode_bits) takes pre-bitcast uint64 value bits — a free
+    numpy view on the host ingest path, and the device-resident
+    representation the storage engine keeps anyway. decode's u64->f64
+    direction runs fine on-device.
+    """
+    import numpy as np
+
+    unit_ns = unit_value_ns(unit)
+    if (np.asarray(start) % unit_ns != 0).any():
+        raise ValueError(
+            f"block start must be aligned to the encode unit ({unit.name}); "
+            "the batched kernel never writes time-unit-change markers"
+        )
+    if isinstance(values, jnp.ndarray) and values.devices() and next(
+        iter(values.devices())
+    ).platform not in ("cpu",):
+        vb = f64_to_bits(values)  # works only where bitcast f64->u64 exists
+    else:
+        vb = jnp.asarray(np.asarray(values, dtype=np.float64).view(np.uint64))
+    return encode_bits(times, vb, start, n_points, unit, capacity_words)
+
+
+@functools.partial(jax.jit, static_argnames=("unit", "capacity_words"))
+def encode_bits(
+    times: jnp.ndarray,  # [B, T] int64 unix nanos
+    value_bits: jnp.ndarray,  # [B, T] uint64 IEEE-754 bit patterns
+    start: jnp.ndarray,  # [B] int64 block start unix nanos
+    n_points: jnp.ndarray,  # [B] int32 valid points per series
+    unit: TimeUnit = TimeUnit.SECOND,
+    capacity_words: int | None = None,
+) -> EncodedBlocks:
+    """Batched M3TSZ float-mode encode of B series with up to T points each."""
+    B, T = times.shape  # noqa: N806
+    unit_ns = unit_value_ns(unit)
+    default_bits = 32 if unit in (TimeUnit.SECOND, TimeUnit.MILLISECOND) else 64
+    if capacity_words is None:
+        capacity_words = (64 + MAX_BITS_PER_DP * T + 11 + 63) // 64
+
+    times = times.astype(I64)
+    idx = jnp.arange(T)
+    valid = idx[None, :] < n_points[:, None]
+
+    # --- timestamp fields ---
+    prev_t = jnp.concatenate([start[:, None].astype(I64), times[:, :-1]], axis=1)
+    dt = times - prev_t
+    prev_dt = jnp.concatenate([jnp.zeros((B, 1), I64), dt[:, :-1]], axis=1)
+    dod_ns = dt - prev_dt
+    dod_units = _trunc_div(dod_ns, unit_ns)
+    ts_hi, ts_lo, ts_len = _dod_fields(dod_units, default_bits)
+
+    # --- value fields ---
+    vb = value_bits.astype(U64)
+    prev_vb = jnp.concatenate([jnp.zeros((B, 1), U64), vb[:, :-1]], axis=1)
+    xor = vb ^ prev_vb
+    # prev_xor chain: prev_xor[i] = xor[i-1]; xor[0] == vb[0] which is
+    # exactly the prevXOR state after the first (full) float write.
+    prev_xor = jnp.concatenate([jnp.zeros((B, 1), U64), xor[:, :-1]], axis=1)
+    x_hi, x_lo, x_len = _xor_fields(xor, prev_xor)
+    # first datapoint: raw 64-bit float
+    is_first = idx[None, :] == 0
+    v_hi = jnp.where(is_first, jnp.uint64(0), x_hi)
+    v_lo = jnp.where(is_first, vb, x_lo)
+    v_len = jnp.where(is_first, jnp.uint64(64), x_len)
+
+    # --- layout ---
+    dp_len = jnp.where(valid, ts_len + v_len, jnp.uint64(0))
+    # bit offset of each dp: 64-bit start prefix + exclusive cumsum
+    csum = jnp.cumsum(dp_len, axis=1)
+    offsets = jnp.uint64(64) + csum - dp_len
+    end_off = jnp.uint64(64) + csum[:, -1] if T > 0 else jnp.full((B,), 64, U64)
+    total_bits = end_off + _EOS_LEN
+    # A start that isn't a multiple of the unit would make the scalar
+    # encoder emit a time-unit-change marker (initial_time_unit -> NONE);
+    # this kernel never writes markers, so flag the batch as unusable.
+    misaligned = jnp.any(start.astype(I64) % unit_ns != 0)
+    overflow = jnp.any(total_bits > jnp.uint64(capacity_words * 64)) | misaligned
+
+    # --- payload assembly & scatter ---
+    zero_reg = (jnp.zeros((B, T), U64),) * 3
+    reg = reg3_insert(zero_reg, jnp.uint64(0), ts_hi, ts_lo, ts_len)
+    reg = reg3_insert(reg, ts_len, v_hi, v_lo, v_len)
+    r = offsets & jnp.uint64(63)
+    pieces = reg3_shift_right_to4(reg, r)
+    w0 = (offsets >> jnp.uint64(6)).astype(jnp.int32)
+
+    words = jnp.zeros((B, capacity_words), U64)
+    # 64-bit start prefix occupies word 0 of every series.
+    words = words.at[:, 0].set(start.astype(I64).astype(U64))
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    for k, piece in enumerate(pieces):
+        words = words.at[b_idx, w0 + k].add(jnp.where(valid, piece, jnp.uint64(0)), mode="drop")
+
+    # --- EOS marker ---
+    eos_reg = reg3_insert(
+        (jnp.zeros((B,), U64),) * 3, jnp.uint64(0), jnp.zeros((B,), U64), _EOS_FIELD, _EOS_LEN
+    )
+    eos_pieces = reg3_shift_right_to4(eos_reg, end_off & jnp.uint64(63))
+    ew0 = (end_off >> jnp.uint64(6)).astype(jnp.int32)
+    bb = jnp.arange(B)
+    for k, piece in enumerate(eos_pieces):
+        words = words.at[bb, ew0 + k].add(piece, mode="drop")
+
+    return EncodedBlocks(words=words, bit_lengths=total_bits, overflow=overflow)
+
+
+class DecodedBlocks(NamedTuple):
+    times: jnp.ndarray  # [B, T] int64
+    values: jnp.ndarray  # [B, T] float64
+    valid: jnp.ndarray  # [B, T] bool
+    n_points: jnp.ndarray  # [B] int32
+    # True per series if a non-EOS special marker (annotation / time-unit
+    # change) was hit: such streams carry host-path features and must be
+    # decoded by the scalar decoder instead.
+    error: jnp.ndarray  # [B] bool
+
+
+@functools.partial(jax.jit, static_argnames=("unit", "max_points"))
+def decode(
+    words: jnp.ndarray,  # [B, W] uint64
+    unit: TimeUnit = TimeUnit.SECOND,
+    max_points: int = 1024,
+) -> DecodedBlocks:
+    """Batched M3TSZ float-mode decode: scan over points, vmapped over
+    series."""
+    unit_ns = unit_value_ns(unit)
+    default_bits = 32 if unit in (TimeUnit.SECOND, TimeUnit.MILLISECOND) else 64
+
+    def decode_one(series_words: jnp.ndarray):
+        start = sign_extend64(series_words[0], jnp.uint64(64))
+
+        def step(carry, i):
+            off, prev_time, prev_dt, prev_bits, prev_xor, done, err = carry
+            win = read_window(series_words, off)
+
+            # special marker: 9-bit opcode 0x100 at the cursor; value 0 is
+            # end-of-stream, anything else (annotation/time-unit change) is
+            # a host-path feature this kernel doesn't decode -> error.
+            is_marker = shr(win, jnp.uint64(55)) == jnp.uint64(0x100)
+            marker_val = shr(win, jnp.uint64(53)) & jnp.uint64(3)
+            is_eos = is_marker & (marker_val == 0)
+            err = err | (is_marker & (marker_val != 0) & ~done)
+            is_eos = is_eos | (is_marker & (marker_val != 0))
+
+            # --- delta-of-delta ---
+            b1 = shr(win, jnp.uint64(63))
+            p2 = shr(win, jnp.uint64(62))
+            p3 = shr(win, jnp.uint64(61))
+            p4 = shr(win, jnp.uint64(60))
+            zero = b1 == 0
+            in7 = p2 == jnp.uint64(0b10)
+            in9 = p3 == jnp.uint64(0b110)
+            in12 = p4 == jnp.uint64(0b1110)
+            d7 = sign_extend64(shr(win, jnp.uint64(55)), jnp.uint64(7))
+            d9 = sign_extend64(shr(win, jnp.uint64(52)), jnp.uint64(9))
+            d12 = sign_extend64(shr(win, jnp.uint64(48)), jnp.uint64(12))
+            if default_bits == 32:
+                ddef = sign_extend64(shr(win, jnp.uint64(28)), jnp.uint64(32))
+            else:
+                win2 = read_window(series_words, off + jnp.uint64(4))
+                ddef = sign_extend64(win2, jnp.uint64(64))
+            dod_u = jnp.where(
+                zero, 0, jnp.where(in7, d7, jnp.where(in9, d9, jnp.where(in12, d12, ddef)))
+            ).astype(I64)
+            ts_len = jnp.where(
+                zero,
+                jnp.uint64(1),
+                jnp.where(
+                    in7,
+                    jnp.uint64(9),
+                    jnp.where(in9, jnp.uint64(12), jnp.where(in12, jnp.uint64(16), jnp.uint64(4 + default_bits))),
+                ),
+            )
+            new_dt = prev_dt + dod_u * unit_ns
+            new_time = prev_time + new_dt
+
+            # --- value ---
+            voff = off + ts_len
+            vwin = read_window(series_words, voff)
+            first = i == 0
+            vb1 = shr(vwin, jnp.uint64(63))
+            vb2 = shr(vwin, jnp.uint64(62)) & jnp.uint64(1)
+            xz = vb1 == 0
+            contained = (vb1 == 1) & (vb2 == 0)
+            # Mantissas can extend past a 64-bit window anchored at the
+            # opcode (fields reach 78 bits), so each is read from a window
+            # anchored at its own start.
+            pl, pt = clz64(prev_xor), ctz64(prev_xor)
+            m_prev = jnp.uint64(64) - pl - pt
+            c_mant = shr(read_window(series_words, voff + jnp.uint64(2)), jnp.uint64(64) - m_prev)
+            c_xor = shl(c_mant, pt)
+            c_len = jnp.uint64(2) + m_prev
+            lead = shr(vwin, jnp.uint64(56)) & jnp.uint64(0x3F)
+            mm = (shr(vwin, jnp.uint64(50)) & jnp.uint64(0x3F)) + jnp.uint64(1)
+            u_mant = shr(read_window(series_words, voff + jnp.uint64(14)), jnp.uint64(64) - mm)
+            trail = jnp.uint64(64) - lead - mm
+            u_xor = shl(u_mant, trail)
+            u_len = jnp.uint64(14) + mm
+            xor = jnp.where(xz, jnp.uint64(0), jnp.where(contained, c_xor, u_xor))
+            x_len = jnp.where(xz, jnp.uint64(1), jnp.where(contained, c_len, u_len))
+
+            new_bits = jnp.where(first, vwin, prev_bits ^ xor)
+            new_xor = jnp.where(first, vwin, xor)
+            v_len = jnp.where(first, jnp.uint64(64), x_len)
+
+            ok = ~done & ~is_eos
+            out_t = jnp.where(ok, new_time, 0)
+            out_v = jnp.where(ok, new_bits, jnp.uint64(0))
+            carry = (
+                jnp.where(ok, off + ts_len + v_len, off),
+                jnp.where(ok, new_time, prev_time),
+                jnp.where(ok, new_dt, prev_dt),
+                jnp.where(ok, new_bits, prev_bits),
+                jnp.where(ok, new_xor, prev_xor),
+                done | is_eos,
+                err,
+            )
+            return carry, (out_t, out_v, ok)
+
+        init = (
+            jnp.uint64(64),
+            start,
+            jnp.int64(0),
+            jnp.uint64(0),
+            jnp.uint64(0),
+            jnp.bool_(False),
+            jnp.bool_(False),
+        )
+        carry, (ts, vs, ok) = lax.scan(step, init, jnp.arange(max_points))
+        return ts, vs, ok, carry[-1]
+
+    ts, vs, ok, err = jax.vmap(decode_one)(words)
+    return DecodedBlocks(
+        times=ts,
+        values=bits_to_f64(vs),
+        valid=ok,
+        n_points=ok.sum(axis=1).astype(jnp.int32),
+        error=err,
+    )
+
+
+def blocks_to_bytes(blocks: EncodedBlocks) -> list[bytes]:
+    """Materialize encoded device blocks as per-series byte strings
+    (host-side, for persistence/interop with the scalar codec)."""
+    words = jax.device_get(blocks.words)
+    bits = jax.device_get(blocks.bit_lengths)
+    out = []
+    for row, nbits in zip(words, bits):
+        nbytes = (int(nbits) + 7) // 8
+        raw = b"".join(int(w).to_bytes(8, "big") for w in row[: (nbytes + 7) // 8])
+        out.append(raw[:nbytes])
+    return out
+
+
+def bytes_to_words(streams: list[bytes], capacity_words: int | None = None) -> jnp.ndarray:
+    """Pack byte streams into a [B, W] uint64 word tensor for decode."""
+    if capacity_words is None:
+        capacity_words = max((len(s) + 7) // 8 for s in streams) if streams else 1
+    import numpy as np
+
+    arr = np.zeros((len(streams), capacity_words), dtype=np.uint64)
+    for i, s in enumerate(streams):
+        padded = s + b"\x00" * (-len(s) % 8)
+        arr[i, : len(padded) // 8] = np.frombuffer(padded, dtype=">u8").astype(np.uint64)
+    return jnp.asarray(arr)

@@ -1,0 +1,96 @@
+"""Flagship compute pipeline: fused ingest -> encode -> aggregate step.
+
+This is the device-side "forward step" of the platform: a batch of raw
+datapoints (shard x series x timestep grid) is M3TSZ-encoded for storage and
+simultaneously rolled up into windowed aggregates (count/sum/min/max/last),
+the same work the reference splits between the dbnode write path
+(/root/reference/src/dbnode/storage/series/buffer.go:290) and the aggregator
+elem consume path
+(/root/reference/src/aggregator/aggregator/elem_base.go:130-161) — here both
+happen in one fused XLA program over device-resident tensors.
+
+Multi-chip: series are sharded over the mesh 'shard' axis (the analog of M3's
+murmur3-mod virtual shards, SURVEY.md §2.10); cross-shard rollups reduce with
+psum over ICI instead of forwarding partial aggregates over TCP.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from m3_tpu.encoding.m3tsz import tpu as m3tsz_tpu
+from m3_tpu.ops.bits import bits_to_f64
+from m3_tpu.utils.xtime import TimeUnit
+
+
+class IngestResult(NamedTuple):
+    blocks: m3tsz_tpu.EncodedBlocks  # encoded storage blocks
+    agg: dict  # per-series windowed aggregates
+
+
+def window_aggregate(times, values, n_points, start, window_ns: int, n_windows: int):
+    """Roll datapoints into fixed windows per series.
+
+    Window w of series b covers [start[b] + w*window_ns, +window_ns); each
+    datapoint scatter-reduces into its (series, window) cell, so the whole
+    rollup is a handful of vectorized segment reductions — the device-grid
+    equivalent of the reference's per-elem lockstep accumulators
+    (/root/reference/src/aggregator/aggregation/counter.go:31-139).
+
+    Returns dict of [B, n_windows] arrays: count/sum/min/max/last. Empty
+    windows have count 0 and NaN min/max/last. Datapoints past the window
+    grid are dropped (count them upstream via the block rotation policy).
+    """
+    B, T = times.shape
+    idx = jnp.arange(T)
+    valid = idx[None, :] < n_points[:, None]
+    w = ((times - start[:, None].astype(times.dtype)) // window_ns).astype(jnp.int32)
+    w = jnp.where(valid & (w >= 0) & (w < n_windows), w, n_windows)  # drop slot
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+
+    shape = (B, n_windows + 1)
+    ones = jnp.where(valid, 1, 0)
+    v = values
+    count = jnp.zeros(shape, jnp.int32).at[b_idx, w].add(ones)
+    total = jnp.zeros(shape, v.dtype).at[b_idx, w].add(jnp.where(valid, v, 0.0))
+    vmin = jnp.full(shape, jnp.inf, v.dtype).at[b_idx, w].min(jnp.where(valid, v, jnp.inf))
+    vmax = jnp.full(shape, -jnp.inf, v.dtype).at[b_idx, w].max(jnp.where(valid, v, -jnp.inf))
+    # last = value at the latest timestamp per window; timestamps ascend per
+    # series, so the max in-window column index identifies it.
+    last_col = jnp.full(shape, -1, jnp.int32).at[b_idx, w].max(jnp.where(valid, idx[None, :], -1))
+    last = jnp.take_along_axis(v, jnp.maximum(last_col[:, :n_windows], 0), axis=1)
+
+    count = count[:, :n_windows]
+    empty = count == 0
+    nan = jnp.nan
+    return {
+        "count": count,
+        "sum": total[:, :n_windows],
+        "min": jnp.where(empty, nan, vmin[:, :n_windows]),
+        "max": jnp.where(empty, nan, vmax[:, :n_windows]),
+        "last": jnp.where(empty, nan, last),
+    }
+
+
+@functools.partial(
+    jax.jit, static_argnames=("unit", "capacity_words", "window_ns", "n_windows")
+)
+def ingest_step(
+    times: jnp.ndarray,  # [B, T] int64
+    value_bits: jnp.ndarray,  # [B, T] uint64 IEEE-754 bits
+    start: jnp.ndarray,  # [B] int64
+    n_points: jnp.ndarray,  # [B] int32
+    unit: TimeUnit = TimeUnit.SECOND,
+    capacity_words: int | None = None,
+    window_ns: int = 60_000_000_000,
+    n_windows: int = 16,
+):
+    """One fused ingest step: encode blocks + windowed rollup."""
+    blocks = m3tsz_tpu.encode_bits(times, value_bits, start, n_points, unit, capacity_words)
+    values = bits_to_f64(value_bits)
+    agg = window_aggregate(times, values, n_points, start, window_ns, n_windows)
+    return blocks, agg

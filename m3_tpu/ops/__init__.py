@@ -1,0 +1,15 @@
+"""TPU kernel library: batched bit-packing, windowed aggregation, postings
+bitmap algebra, and PromQL temporal ops.
+
+IMPORT SIDE EFFECT: this package enables jax_enable_x64 process-wide on
+import. The codec and timestamp kernels fundamentally require 64-bit
+integers (unix-nano timestamps, IEEE-754 bit patterns), so every m3_tpu
+compute module depends on it. If you embed m3_tpu inside another JAX
+application, import m3_tpu (or set jax_enable_x64) before creating arrays,
+and be aware that Python floats will now default to float64 — annotate
+dtypes explicitly in the host application.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,8 @@
+#!/bin/bash
+# CPU-only test runner: clears PALLAS_AXON_POOL_IPS so the axon
+# sitecustomize doesn't dial the TPU relay at interpreter startup (hangs
+# every python process when the tunnel is down), and forces the CPU
+# platform with an 8-device virtual mesh for sharding tests.
+exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m pytest tests/ -q "$@"

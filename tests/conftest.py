@@ -8,7 +8,9 @@ a real chip.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the axon sitecustomize exports JAX_PLATFORMS=axon at interpreter
+# startup, so setdefault would lose; tests must not burn TPU compile time.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,254 @@
+"""Batched TPU M3TSZ kernel tests: bit-exactness vs the scalar codec.
+
+Strategy per SURVEY.md §4/§7: the scalar codec is the semantic ground truth
+(itself validated byte-identical against reference-encoded golden data);
+the batched kernels must produce identical bytes and decode identically.
+Runs on CPU (conftest forces JAX_PLATFORMS=cpu).
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from m3_tpu.encoding.m3tsz import Encoder, tpu  # noqa: E402
+from m3_tpu.encoding.m3tsz import decode as scalar_decode  # noqa: E402
+from m3_tpu.utils.xtime import TimeUnit  # noqa: E402
+
+START = 1_600_000_000_000_000_000
+
+
+def run_batch(times, values, start, n_points, unit):
+    """Encode on device, cross-check bytes vs scalar, decode on device."""
+    B, T = times.shape
+    blocks = tpu.encode(
+        jnp.asarray(times), values, jnp.asarray(start), jnp.asarray(n_points), unit
+    )
+    assert not bool(blocks.overflow)
+    streams = tpu.blocks_to_bytes(blocks)
+    for i in range(B):
+        enc = Encoder(int(start[i]), int_optimized=False, default_time_unit=unit)
+        for t, v in zip(times[i][: n_points[i]], values[i][: n_points[i]]):
+            enc.encode(int(t), float(v), unit)
+        assert enc.stream() == streams[i], f"series {i} bytes differ from scalar encoder"
+    dec = tpu.decode(blocks.words, unit, max_points=T + 4)
+    dt, dv, dn = np.asarray(dec.times), np.asarray(dec.values), np.asarray(dec.n_points)
+    for i in range(B):
+        k = n_points[i]
+        assert dn[i] == k
+        np.testing.assert_array_equal(dt[i, :k], times[i, :k])
+        for j in range(k):
+            assert dv[i, j] == values[i, j] or (
+                np.isnan(dv[i, j]) and np.isnan(values[i, j])
+            )
+    return streams
+
+
+@pytest.fixture
+def mk(rng):
+    def make(B, T, delta_fn, value_fn, n_points=None):
+        start = np.full(B, START, dtype=np.int64)
+        times = start[:, None] + np.cumsum(delta_fn((B, T)), axis=1).astype(np.int64)
+        values = value_fn((B, T)).astype(np.float64)
+        n = np.full(B, T, dtype=np.int32) if n_points is None else n_points
+        return times, values, start, n
+
+    return make
+
+
+class TestEncodeDecodeParity:
+    def test_gauge_seconds(self, rng, mk):
+        args = mk(8, 60, lambda s: rng.integers(1, 60, s) * 10**9, lambda s: rng.normal(100, 25, s))
+        run_batch(*args, TimeUnit.SECOND)
+
+    def test_random_nanos(self, rng, mk):
+        args = mk(
+            8, 50,
+            lambda s: rng.integers(1, 10**10, s),
+            lambda s: rng.normal(size=s) * (10.0 ** rng.integers(-8, 8, s)),
+        )
+        run_batch(*args, TimeUnit.NANOSECOND)
+
+    def test_sparse_milliseconds(self, rng, mk):
+        args = mk(
+            4, 40,
+            lambda s: rng.integers(1, 10**4, s) * 10**6,
+            lambda s: np.where(rng.random(s) < 0.3, 0.0, rng.normal(size=s)),
+        )
+        run_batch(*args, TimeUnit.MILLISECOND)
+
+    def test_constant_values(self, rng, mk):
+        args = mk(4, 30, lambda s: rng.integers(1, 3, s) * 10**9, lambda s: np.full(s, 7.25))
+        run_batch(*args, TimeUnit.SECOND)
+
+    def test_ragged_batch(self, rng, mk):
+        n = np.array([5, 20, 1, 13], dtype=np.int32)
+        args = mk(4, 20, lambda s: rng.integers(1, 60, s) * 10**9, lambda s: rng.normal(size=s), n)
+        run_batch(*args, TimeUnit.SECOND)
+
+    def test_special_float_values(self, rng, mk):
+        vals = np.array(
+            [[0.0, -0.0, np.inf, -np.inf, np.nan, 1e-300, 1e300, 1.0, 1.0, 2.0]] * 2
+        )
+        args = mk(2, 10, lambda s: rng.integers(1, 5, s) * 10**9, lambda s: vals)
+        run_batch(*args, TimeUnit.SECOND)
+
+    def test_large_dod_default_bucket(self, rng, mk):
+        args = mk(2, 12, lambda s: rng.integers(1, 10**6, s) * 10**9, lambda s: rng.normal(size=s))
+        run_batch(*args, TimeUnit.SECOND)
+
+    def test_microseconds_aligned(self, rng, mk):
+        args = mk(2, 12, lambda s: rng.integers(1, 10**10, s) * 1000, lambda s: rng.normal(size=s))
+        run_batch(*args, TimeUnit.MICROSECOND)
+
+    def test_single_point_series(self, rng, mk):
+        args = mk(3, 1, lambda s: rng.integers(1, 60, s) * 10**9, lambda s: rng.normal(size=s))
+        run_batch(*args, TimeUnit.SECOND)
+
+
+class TestInterop:
+    def test_scalar_decoder_reads_tpu_streams(self, rng, mk):
+        times, values, start, n = mk(
+            4, 30, lambda s: rng.integers(1, 60, s) * 10**9, lambda s: rng.normal(size=s)
+        )
+        blocks = tpu.encode(
+            jnp.asarray(times), values, jnp.asarray(start), jnp.asarray(n), TimeUnit.SECOND
+        )
+        for i, stream in enumerate(tpu.blocks_to_bytes(blocks)):
+            dps = scalar_decode(stream, int_optimized=False)
+            assert [d.timestamp_ns for d in dps] == list(times[i])
+            assert [d.value for d in dps] == list(values[i])
+
+    def test_tpu_decoder_reads_scalar_streams(self, rng):
+        B, T = 4, 25
+        start = np.full(B, START, dtype=np.int64)
+        times = start[:, None] + np.cumsum(rng.integers(1, 60, (B, T)) * 10**9, axis=1)
+        values = rng.normal(size=(B, T))
+        streams = []
+        for i in range(B):
+            enc = Encoder(int(start[i]), int_optimized=False)
+            for t, v in zip(times[i], values[i]):
+                enc.encode(int(t), float(v), TimeUnit.SECOND)
+            streams.append(enc.stream())
+        words = tpu.bytes_to_words(streams)
+        dec = tpu.decode(words, TimeUnit.SECOND, max_points=T + 2)
+        np.testing.assert_array_equal(np.asarray(dec.n_points), T)
+        np.testing.assert_array_equal(np.asarray(dec.times)[:, :T], times)
+        np.testing.assert_array_equal(np.asarray(dec.values)[:, :T], values)
+
+    def test_truncation_lossiness_matches_scalar(self, rng):
+        # Non-unit-aligned timestamps truncate identically on both paths.
+        B, T = 2, 10
+        start = np.full(B, START, dtype=np.int64)
+        times = start[:, None] + np.cumsum(rng.integers(1, 10**13, (B, T)), axis=1)
+        values = rng.normal(size=(B, T))
+        n = np.full(B, T, dtype=np.int32)
+        blocks = tpu.encode(
+            jnp.asarray(times), values, jnp.asarray(start), jnp.asarray(n), TimeUnit.MICROSECOND
+        )
+        dec = tpu.decode(blocks.words, TimeUnit.MICROSECOND, max_points=T + 2)
+        for i, stream in enumerate(tpu.blocks_to_bytes(blocks)):
+            dps = scalar_decode(stream, int_optimized=False, default_time_unit=TimeUnit.MICROSECOND)
+            assert [d.timestamp_ns for d in dps] == list(np.asarray(dec.times)[i, :T])
+
+
+class TestCapacityOverflow:
+    def test_overflow_flag(self, rng):
+        B, T = 2, 50
+        start = np.full(B, START, dtype=np.int64)
+        times = start[:, None] + np.cumsum(rng.integers(1, 10**10, (B, T)), axis=1)
+        values = rng.normal(size=(B, T))
+        n = np.full(B, T, dtype=np.int32)
+        blocks = tpu.encode(
+            jnp.asarray(times), values, jnp.asarray(start), jnp.asarray(n),
+            TimeUnit.NANOSECOND, capacity_words=4,
+        )
+        assert bool(blocks.overflow)
+
+
+class TestErrorSurfacing:
+    def test_unaligned_start_raises_on_host_path(self, rng, mk):
+        times, values, start, n = mk(
+            2, 5, lambda s: rng.integers(1, 5, s) * 10**9, lambda s: rng.normal(size=s)
+        )
+        start = start + 1  # not second-aligned
+        times = times + 1
+        with pytest.raises(ValueError, match="aligned"):
+            tpu.encode(jnp.asarray(times), values, jnp.asarray(start), jnp.asarray(n),
+                       TimeUnit.SECOND)
+
+    def test_unaligned_start_sets_overflow_flag(self, rng, mk):
+        times, values, start, n = mk(
+            2, 5, lambda s: rng.integers(1, 5, s) * 10**9, lambda s: rng.normal(size=s)
+        )
+        blocks = tpu.encode_bits(
+            jnp.asarray(times + 1), jnp.asarray(values.view(np.uint64)),
+            jnp.asarray(start + 1), jnp.asarray(n), TimeUnit.SECOND,
+        )
+        assert bool(blocks.overflow)
+
+    def test_marker_stream_sets_error(self, rng):
+        # scalar stream with an annotation marker -> TPU decode flags error
+        enc = Encoder(START, int_optimized=False)
+        enc.encode(START + 10**9, 1.0, TimeUnit.SECOND, b"note")
+        enc.encode(START + 2 * 10**9, 2.0, TimeUnit.SECOND)
+        words = tpu.bytes_to_words([enc.stream()])
+        dec = tpu.decode(words, TimeUnit.SECOND, max_points=4)
+        assert bool(np.asarray(dec.error)[0])
+
+    def test_clean_stream_no_error(self, rng, mk):
+        args = mk(2, 5, lambda s: rng.integers(1, 5, s) * 10**9, lambda s: rng.normal(size=s))
+        blocks = tpu.encode(jnp.asarray(args[0]), args[1], jnp.asarray(args[2]),
+                            jnp.asarray(args[3]), TimeUnit.SECOND)
+        dec = tpu.decode(blocks.words, TimeUnit.SECOND, max_points=8)
+        assert not np.asarray(dec.error).any()
+
+
+class TestIngestPipeline:
+    def test_windowed_rollup(self, rng):
+        from m3_tpu.models.pipeline import ingest_step
+
+        B, T = 4, 30
+        start = np.full(B, START, dtype=np.int64)
+        times = start[:, None] + np.cumsum(
+            rng.integers(1, 30, (B, T)) * 10**9, axis=1
+        )
+        values = rng.normal(size=(B, T))
+        n = np.array([30, 17, 0, 30], dtype=np.int32)
+        window_ns = 60 * 10**9
+        n_windows = 16
+        blocks, agg = ingest_step(
+            jnp.asarray(times), jnp.asarray(values.view(np.uint64)),
+            jnp.asarray(start), jnp.asarray(n),
+            TimeUnit.SECOND, None, window_ns, n_windows,
+        )
+        count = np.asarray(agg["count"])
+        total = np.asarray(agg["sum"])
+        vmin, vmax = np.asarray(agg["min"]), np.asarray(agg["max"])
+        last = np.asarray(agg["last"])
+        assert count.shape == (B, n_windows)
+        for b in range(B):
+            for w in range(n_windows):
+                lo = START + w * window_ns
+                sel = [j for j in range(n[b])
+                       if lo <= times[b, j] < lo + window_ns]
+                assert count[b, w] == len(sel)
+                if sel:
+                    np.testing.assert_allclose(total[b, w], values[b, sel].sum())
+                    assert vmin[b, w] == values[b, sel].min()
+                    assert vmax[b, w] == values[b, sel].max()
+                    assert last[b, w] == values[b, sel[-1]]
+                else:
+                    assert np.isnan(last[b, w]) and np.isnan(vmin[b, w])
+
+    def test_empty_series_aggregates_are_nan(self, rng):
+        from m3_tpu.models.pipeline import window_aggregate
+
+        times = np.full((1, 4), START + 10**9, dtype=np.int64)
+        values = np.full((1, 4), 123.0)
+        out = window_aggregate(
+            jnp.asarray(times), jnp.asarray(values), jnp.asarray([0]),
+            jnp.asarray([START]), 60 * 10**9, 4,
+        )
+        assert np.asarray(out["count"]).sum() == 0
+        assert np.isnan(np.asarray(out["last"])).all()
