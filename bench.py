@@ -30,18 +30,15 @@ def main() -> None:
     from m3_tpu.encoding.m3tsz import tpu
     from m3_tpu.utils.xtime import TimeUnit
 
-    rng = np.random.default_rng(0)
+    from __graft_entry__ import _example_batch
+
     B, T = 8192, 120  # ~1M datapoints per dispatch
-    start = np.full(B, 1_600_000_000_000_000_000, dtype=np.int64)
-    times = start[:, None] + np.cumsum(
-        rng.integers(1, 60, (B, T)).astype(np.int64) * 10**9, axis=1
-    )
-    values = rng.normal(100.0, 25.0, (B, T))
-    n_points = np.full(B, T, dtype=np.int32)
+    times, vbits, start, n_points = _example_batch(B=B, T=T)
+    values = vbits.view(np.float64)
     cap = None  # encode_bits' default capacity covers the true worst case
 
     jt = jnp.asarray(times)
-    jv = jnp.asarray(values.view(np.uint64))
+    jv = jnp.asarray(vbits)
     js = jnp.asarray(start)
     jn = jnp.asarray(n_points)
 

@@ -35,7 +35,6 @@ from m3_tpu.ops.bits import (
     bits_to_f64,
     clz64,
     ctz64,
-    f64_to_bits,
     mask_low,
     read_window,
     reg3_insert,
@@ -165,12 +164,10 @@ def encode(
             f"block start must be aligned to the encode unit ({unit.name}); "
             "the batched kernel never writes time-unit-change markers"
         )
-    if isinstance(values, jnp.ndarray) and values.devices() and next(
-        iter(values.devices())
-    ).platform not in ("cpu",):
-        vb = f64_to_bits(values)  # works only where bitcast f64->u64 exists
-    else:
-        vb = jnp.asarray(np.asarray(values, dtype=np.float64).view(np.uint64))
+    # Always bitcast on the host: the f64->u64 direction is unimplemented by
+    # the TPU X64 rewriter, so device-resident callers should hold bits and
+    # call encode_bits directly instead of round-tripping through floats.
+    vb = jnp.asarray(np.asarray(values, dtype=np.float64).view(np.uint64))
     return encode_bits(times, vb, start, n_points, unit, capacity_words)
 
 
@@ -228,6 +225,12 @@ def encode_bits(
     # this kernel never writes markers, so flag the batch as unusable.
     misaligned = jnp.any(start.astype(I64) % unit_ns != 0)
     overflow = jnp.any(total_bits > jnp.uint64(capacity_words * 64)) | misaligned
+    if default_bits == 32:
+        # The scalar encoder raises when a dod exceeds the 32-bit default
+        # bucket for s/ms units (timestamp_encoder semantics); the batch
+        # kernel can't raise mid-trace, so flag the batch unusable instead.
+        in32 = (dod_units >= -(1 << 31)) & (dod_units <= (1 << 31) - 1)
+        overflow = overflow | jnp.any(valid & ~in32)
 
     # --- payload assembly & scatter ---
     zero_reg = (jnp.zeros((B, T), U64),) * 3

@@ -17,19 +17,12 @@ psum over ICI instead of forwarding partial aggregates over TCP.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
-
 import jax
 import jax.numpy as jnp
 
 from m3_tpu.encoding.m3tsz import tpu as m3tsz_tpu
 from m3_tpu.ops.bits import bits_to_f64
 from m3_tpu.utils.xtime import TimeUnit
-
-
-class IngestResult(NamedTuple):
-    blocks: m3tsz_tpu.EncodedBlocks  # encoded storage blocks
-    agg: dict  # per-series windowed aggregates
 
 
 def window_aggregate(times, values, n_points, start, window_ns: int, n_windows: int):

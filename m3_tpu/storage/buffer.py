@@ -1,0 +1,189 @@
+"""Columnar in-memory write buffer.
+
+The reference buffers writes per series object with one encoder per
+out-of-order stream (/root/reference/src/dbnode/storage/series/buffer.go:77,
+1261), merging on flush. TPU-first redesign: a shard keeps one append-only
+struct-of-arrays log per block window (series idx / time / value bits);
+writes are O(1) host appends and the whole window seals to compressed
+blocks in a single batched device encode — the insert-queue batching
+pattern (storage/shard_insert_queue.go) applied to the buffer itself.
+Out-of-order and duplicate writes are resolved at seal time by a stable
+sort + last-write-wins dedup, equivalent to the reference's merge of
+multiple encoders at flush.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+_GROW = 1024
+
+
+class _ColumnLog:
+    """Growable (series_idx, time, value_bits) append log."""
+
+    __slots__ = ("sidx", "times", "vbits", "n")
+
+    def __init__(self) -> None:
+        self.sidx = np.empty(_GROW, dtype=np.int32)
+        self.times = np.empty(_GROW, dtype=np.int64)
+        self.vbits = np.empty(_GROW, dtype=np.uint64)
+        self.n = 0
+
+    def append(self, sidx: int, t_ns: int, vbits: int) -> None:
+        if self.n == len(self.sidx):
+            cap = len(self.sidx) * 2
+            self.sidx = np.resize(self.sidx, cap)
+            self.times = np.resize(self.times, cap)
+            self.vbits = np.resize(self.vbits, cap)
+        self.sidx[self.n] = sidx
+        self.times[self.n] = t_ns
+        self.vbits[self.n] = vbits
+        self.n += 1
+
+    def view(self):
+        return self.sidx[: self.n], self.times[: self.n], self.vbits[: self.n]
+
+
+@dataclass
+class SealedWindow:
+    """One block window grouped into a padded (series x point) batch."""
+
+    block_start: int
+    series_indices: np.ndarray  # [B] int32 buffer-level series indices
+    times: np.ndarray  # [B, T] int64 (padded)
+    value_bits: np.ndarray  # [B, T] uint64 (padded)
+    n_points: np.ndarray  # [B] int32
+    starts: np.ndarray = field(default=None)  # [B] int64, all == block_start
+
+    @property
+    def n_series(self) -> int:
+        return len(self.series_indices)
+
+
+class ShardBuffer:
+    """Per-shard buffer: series registry + one column log per block window."""
+
+    def __init__(self, block_size_ns: int) -> None:
+        self._block_size_ns = block_size_ns
+        self._series: dict[bytes, int] = {}
+        self.series_ids: list[bytes] = []
+        self.series_tags: list[bytes] = []  # encoded tag blobs
+        self._logs: dict[int, _ColumnLog] = {}
+
+    # -- write path --
+
+    def series_index(self, series_id: bytes, encoded_tags: bytes = b"") -> int:
+        idx = self._series.get(series_id)
+        if idx is None:
+            idx = len(self.series_ids)
+            self._series[series_id] = idx
+            self.series_ids.append(series_id)
+            self.series_tags.append(encoded_tags)
+        return idx
+
+    def write(self, series_id: bytes, t_ns: int, vbits: int, encoded_tags: bytes = b"") -> int:
+        """Returns the buffer-level series index (stable for this buffer)."""
+        idx = self.series_index(series_id, encoded_tags)
+        bs = t_ns - (t_ns % self._block_size_ns)
+        log = self._logs.get(bs)
+        if log is None:
+            log = self._logs[bs] = _ColumnLog()
+        log.append(idx, t_ns, vbits)
+        return idx
+
+    # -- read path --
+
+    def read(self, series_id: bytes, start_ns: int, end_ns: int):
+        """All buffered (t, vbits) for a series in [start, end), merged
+        across block windows, deduped last-write-wins."""
+        idx = self._series.get(series_id)
+        if idx is None:
+            return np.empty(0, np.int64), np.empty(0, np.uint64)
+        ts_parts, vb_parts = [], []
+        for bs, log in self._logs.items():
+            if bs + self._block_size_ns <= start_ns or bs >= end_ns:
+                continue
+            sidx, times, vbits = log.view()
+            sel = sidx == idx
+            ts_parts.append(times[sel])
+            vb_parts.append(vbits[sel])
+        if not ts_parts:
+            return np.empty(0, np.int64), np.empty(0, np.uint64)
+        times = np.concatenate(ts_parts)
+        vbits = np.concatenate(vb_parts)
+        order = np.argsort(times, kind="stable")
+        times, vbits = times[order], vbits[order]
+        # last write wins per timestamp
+        keep = np.ones(len(times), bool)
+        keep[:-1] = times[1:] != times[:-1]
+        times, vbits = times[keep], vbits[keep]
+        sel = (times >= start_ns) & (times < end_ns)
+        return times[sel], vbits[sel]
+
+    # -- seal/flush path --
+
+    def block_starts(self) -> list[int]:
+        return sorted(self._logs)
+
+    def points_in(self, block_start: int) -> int:
+        log = self._logs.get(block_start)
+        return log.n if log else 0
+
+    def seal(self, block_start: int, drop: bool = True) -> SealedWindow | None:
+        """Group one block window into a padded batch for device encode.
+
+        Stable-sorts by (series, time), dedupes last-write-wins, pads to the
+        max points of any series in the window.
+        """
+        log = self._logs.get(block_start)
+        if log is None or log.n == 0:
+            return None
+        sidx, times, vbits = (a.copy() for a in log.view())
+        order = np.lexsort((np.arange(len(sidx)), times, sidx))
+        sidx, times, vbits = sidx[order], times[order], vbits[order]
+        # dedupe: same series + same timestamp -> keep the last append
+        keep = np.ones(len(sidx), bool)
+        if len(sidx) > 1:
+            same = (sidx[1:] == sidx[:-1]) & (times[1:] == times[:-1])
+            keep[:-1] = ~same
+        sidx, times, vbits = sidx[keep], times[keep], vbits[keep]
+
+        uniq, counts = np.unique(sidx, return_counts=True)
+        B, T = len(uniq), int(counts.max())
+        out_t = np.zeros((B, T), np.int64)
+        out_v = np.zeros((B, T), np.uint64)
+        row = np.repeat(np.arange(B), counts)
+        col = np.arange(len(sidx)) - np.repeat(
+            np.concatenate([[0], np.cumsum(counts)[:-1]]), counts
+        )
+        out_t[row, col] = times
+        out_v[row, col] = vbits
+        # pad timestamps past n_points monotonically so the encoder's
+        # masked lanes still see sane deltas
+        pad_mask = np.arange(T)[None, :] >= counts[:, None]
+        out_t = np.where(pad_mask, out_t.max(axis=1, keepdims=True), out_t)
+        if drop:
+            del self._logs[block_start]
+        return SealedWindow(
+            block_start=block_start,
+            series_indices=uniq.astype(np.int32),
+            times=out_t,
+            value_bits=out_v,
+            n_points=counts.astype(np.int32),
+            starts=np.full(B, block_start, dtype=np.int64),
+        )
+
+    def expire_before(self, cutoff_block_start: int) -> int:
+        dropped = 0
+        for bs in list(self._logs):
+            if bs < cutoff_block_start:
+                dropped += self._logs[bs].n
+                del self._logs[bs]
+        return dropped
+
+    @property
+    def n_series(self) -> int:
+        return len(self.series_ids)

@@ -1,0 +1,126 @@
+"""Commit log: write-ahead durability for the in-memory buffer.
+
+Role parity with the reference WAL (/root/reference/src/dbnode/persist/fs/
+commitlog: batched writes drained by one writer, chunked format with
+digests, rotation + snapshot-based truncation). Here the queue is a
+host-side byte buffer flushed on size/explicit fsync; the chunk format is:
+
+  chunk:  u32 magic, u32 payload_len, u32 adler32(payload), payload
+  entry:  u8 kind
+          kind 0 (register): u32 sidx, u32 id_len + id, u32 tags_len + tags
+          kind 1 (write):    u32 sidx, i64 time_ns, u64 value_bits, u8 unit
+Series are registered once per log file and then referenced by index,
+mirroring the reference's commit-log series registry.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+
+_MAGIC = 0xC0881706
+
+
+@dataclass
+class CommitLogEntry:
+    series_id: bytes
+    encoded_tags: bytes
+    time_ns: int
+    value_bits: int
+    unit: int
+
+
+class CommitLogWriter:
+    def __init__(self, path: str, flush_every_bytes: int = 1 << 20):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._f = open(path, "ab")
+        self._buf = bytearray()
+        self._series: dict[bytes, int] = {}
+        self._flush_every = flush_every_bytes
+        self.path = path
+
+    def write(self, series_id: bytes, encoded_tags: bytes, time_ns: int,
+              value_bits: int, unit: int) -> None:
+        sidx = self._series.get(series_id)
+        if sidx is None:
+            sidx = len(self._series)
+            self._series[series_id] = sidx
+            self._buf += struct.pack(">BI", 0, sidx)
+            self._buf += struct.pack(">I", len(series_id)) + series_id
+            self._buf += struct.pack(">I", len(encoded_tags)) + encoded_tags
+        self._buf += struct.pack(">BIqQB", 1, sidx, time_ns, value_bits, unit)
+        if len(self._buf) >= self._flush_every:
+            self.flush()
+
+    def flush(self, fsync: bool = False) -> None:
+        if not self._buf:
+            return
+        payload = bytes(self._buf)
+        self._buf.clear()
+        header = struct.pack(">III", _MAGIC, len(payload), zlib.adler32(payload))
+        self._f.write(header + payload)
+        self._f.flush()
+        if fsync:
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self.flush(fsync=True)
+        self._f.close()
+
+
+def replay(path: str) -> list[CommitLogEntry]:
+    """Replay a commit log; torn trailing chunks are skipped (the tail of a
+    crashed process), corrupt interior chunks raise."""
+    entries: list[CommitLogEntry] = []
+    if not os.path.exists(path):
+        return entries
+    with open(path, "rb") as f:
+        raw = f.read()
+    series: dict[int, tuple[bytes, bytes]] = {}
+    off = 0
+    while off + 12 <= len(raw):
+        magic, plen, digest = struct.unpack_from(">III", raw, off)
+        if magic != _MAGIC:
+            raise ValueError(f"bad commitlog chunk magic at {off}")
+        if off + 12 + plen > len(raw):
+            break  # torn tail chunk from a crash: ignore
+        payload = raw[off + 12 : off + 12 + plen]
+        if zlib.adler32(payload) != digest:
+            if off + 12 + plen == len(raw):
+                break  # torn tail
+            raise ValueError(f"corrupt commitlog chunk at {off}")
+        off += 12 + plen
+        p = 0
+        while p < len(payload):
+            kind, sidx = struct.unpack_from(">BI", payload, p)
+            p += 5
+            if kind == 0:
+                (idlen,) = struct.unpack_from(">I", payload, p)
+                p += 4
+                sid = payload[p : p + idlen]
+                p += idlen
+                (tlen,) = struct.unpack_from(">I", payload, p)
+                p += 4
+                tags = payload[p : p + tlen]
+                p += tlen
+                series[sidx] = (sid, tags)
+            elif kind == 1:
+                t_ns, vbits, unit = struct.unpack_from(">qQB", payload, p)
+                p += 17
+                sid, tags = series[sidx]
+                entries.append(CommitLogEntry(sid, tags, t_ns, vbits, unit))
+            else:
+                raise ValueError(f"unknown commitlog entry kind {kind}")
+    return entries
+
+
+def log_files(directory: str) -> list[str]:
+    if not os.path.isdir(directory):
+        return []
+    return sorted(
+        os.path.join(directory, n)
+        for n in os.listdir(directory)
+        if n.startswith("commitlog-") and n.endswith(".db")
+    )

@@ -1,0 +1,144 @@
+"""Database: namespaces + commitlog + bootstrap + tick orchestration.
+
+Role parity with the reference storage.Database
+(/root/reference/src/dbnode/storage/database.go:99 — Write:795,
+ReadEncoded:1068, Bootstrap:1140) and the mediator tick/flush loop
+(storage/mediator.go:79-160), collapsed into explicit open/write/read/
+tick calls driven by the host control plane.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from m3_tpu.storage import commitlog
+from m3_tpu.storage.namespace import Namespace
+from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions
+from m3_tpu.storage.sharding import ShardSet
+from m3_tpu.utils.xtime import TimeUnit
+
+
+@dataclass
+class Datapoint:
+    timestamp_ns: int
+    value: float
+
+
+def _f64_to_bits(v: float) -> int:
+    return int(np.float64(v).view(np.uint64))
+
+
+class Database:
+    """Single-node database ("local" topology mode of the reference)."""
+
+    def __init__(self, path: str, db_opts: DatabaseOptions | None = None):
+        self.path = path
+        self.opts = db_opts or DatabaseOptions()
+        self.namespaces: dict[str, Namespace] = {}
+        self._commitlogs: dict[str, commitlog.CommitLogWriter] = {}
+        self._open = False
+        self._shard_set = ShardSet(self.opts.n_shards)
+
+    # -- lifecycle --
+
+    @property
+    def fs_root(self) -> str:
+        return os.path.join(self.path, "data")
+
+    def commitlog_dir(self, namespace: str) -> str:
+        return os.path.join(self.path, "commitlog", namespace)
+
+    def create_namespace(self, name: str, opts: NamespaceOptions | None = None) -> Namespace:
+        if name in self.namespaces:
+            return self.namespaces[name]
+        ns = Namespace(name, opts or NamespaceOptions(), self.opts, self._shard_set,
+                       self.fs_root)
+        self.namespaces[name] = ns
+        if ns.opts.writes_to_commitlog and self._open:
+            self._open_commitlog(name)
+        return ns
+
+    def _open_commitlog(self, namespace: str) -> None:
+        d = self.commitlog_dir(namespace)
+        path = os.path.join(d, f"commitlog-{int(time.time()*1e9)}.db")
+        self._commitlogs[namespace] = commitlog.CommitLogWriter(
+            path, self.opts.commitlog_flush_every_bytes
+        )
+
+    def open(self) -> None:
+        """Open + bootstrap: filesets first, then commitlog replay on top
+        (the fs -> commitlog bootstrapper order of the reference's default
+        pipeline, storage/bootstrap/bootstrapper/README.md)."""
+        self._open = True
+        for name, ns in self.namespaces.items():
+            if ns.opts.bootstrap_enabled:
+                ns.bootstrap_from_fs()
+                self._replay_commitlogs(name, ns)
+            if ns.opts.writes_to_commitlog:
+                self._open_commitlog(name)
+
+    def _replay_commitlogs(self, name: str, ns: Namespace) -> None:
+        for path in commitlog.log_files(self.commitlog_dir(name)):
+            for e in commitlog.replay(path):
+                # skip datapoints already covered by a flushed volume
+                shard = ns.shard_for(e.series_id)
+                bs = ns.opts.retention.block_start(e.time_ns)
+                if bs in shard._filesets:
+                    continue
+                shard.write(e.series_id, e.time_ns, e.value_bits, e.encoded_tags)
+
+    def close(self) -> None:
+        for log in self._commitlogs.values():
+            log.close()
+        self._commitlogs.clear()
+        self._open = False
+
+    # -- write/read --
+
+    def write(self, namespace: str, series_id: bytes, t_ns: int, value: float,
+              encoded_tags: bytes = b"") -> None:
+        ns = self.namespaces[namespace]
+        vbits = _f64_to_bits(value)
+        log = self._commitlogs.get(namespace)
+        if log is not None:
+            log.write(series_id, encoded_tags, t_ns, vbits, int(ns.opts.write_time_unit))
+        ns.write(series_id, t_ns, vbits, encoded_tags)
+
+    def read(self, namespace: str, series_id: bytes, start_ns: int, end_ns: int
+             ) -> list[Datapoint]:
+        ns = self.namespaces[namespace]
+        times, vbits = ns.read(series_id, start_ns, end_ns)
+        values = vbits.view(np.float64)
+        return [Datapoint(int(t), float(v)) for t, v in zip(times, values)]
+
+    # -- maintenance --
+
+    def tick(self, now_ns: int | None = None) -> dict:
+        """One mediator cycle: warm flush of cold windows + retention expiry
+        + commitlog rotation after a successful flush."""
+        now_ns = now_ns if now_ns is not None else time.time_ns()
+        flushed = expired = 0
+        for name, ns in self.namespaces.items():
+            n = ns.flush(now_ns)
+            flushed += n
+            expired += ns.expire(now_ns)
+            if n and name in self._commitlogs:
+                # flushed windows are durable in filesets; rotate the log so
+                # replay cost stays bounded (reference: snapshot + rotate)
+                self._commitlogs[name].close()
+                self._open_commitlog(name)
+        return {"flushed": flushed, "expired": expired}
+
+    def flush_all(self, now_ns: int | None = None) -> int:
+        """Force-flush every buffered window regardless of buffer_past."""
+        flushed = 0
+        for ns in self.namespaces.values():
+            for shard in ns.shards.values():
+                for bs in shard.buffer.block_starts():
+                    if shard.flush(bs):
+                        flushed += 1
+        return flushed

@@ -1,0 +1,266 @@
+"""Immutable fileset files.
+
+File-set parity with the reference's per-(namespace, shard, blockstart,
+volume) layout — info/data/index/summaries/bloomfilter/digest/checkpoint
+files (suffix inventory /root/reference/src/dbnode/persist/fs/fs.go:26-56),
+with the checkpoint written last so partial flushes are detectable
+(SURVEY.md §5 checkpoint/resume). Formats are this framework's own compact
+binary encodings, not the reference msgpack codec.
+
+Layout on disk:
+  <root>/<namespace>/<shard>/fileset-<blockstart>-<volume>-<suffix>.db
+
+  info:       JSON header (block_start, block_size, volume, counts)
+  data:       concatenated per-series M3TSZ streams
+  index:      sorted entries: u32 id_len + id, u32 tags_len + tags,
+              u64 offset, u64 length  (offset/length into data)
+  summaries:  every Nth index entry: u32 id_len + id, u64 index_offset
+  bloom:      u32 n_hashes, u64 n_bits, bitset bytes (murmur3 k-hash)
+  digest:     JSON of adler32 digests of each file
+  checkpoint: adler32 of the digest file; existence == fileset complete
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from bisect import bisect_left
+from dataclasses import dataclass
+
+from m3_tpu.utils.hash import murmur3_32
+
+SUFFIXES = ("info", "data", "index", "summaries", "bloom", "digest", "checkpoint")
+_SUMMARY_EVERY = 32
+
+
+def fileset_path(root: str, namespace: str, shard: int, block_start: int, volume: int,
+                 suffix: str) -> str:
+    return os.path.join(
+        root, namespace, str(shard), f"fileset-{block_start}-{volume}-{suffix}.db"
+    )
+
+
+class BloomFilter:
+    def __init__(self, n_items: int, bits_per_item: int = 10):
+        self.n_bits = max(64, n_items * bits_per_item)
+        self.n_hashes = 7
+        self.bits = bytearray((self.n_bits + 7) // 8)
+
+    def _positions(self, key: bytes):
+        h1 = murmur3_32(key, 0)
+        h2 = murmur3_32(key, 0x9747B28C)
+        for i in range(self.n_hashes):
+            yield (h1 + i * h2) % self.n_bits
+
+    def add(self, key: bytes) -> None:
+        for p in self._positions(key):
+            self.bits[p >> 3] |= 1 << (p & 7)
+
+    def may_contain(self, key: bytes) -> bool:
+        return all(self.bits[p >> 3] & (1 << (p & 7)) for p in self._positions(key))
+
+    def to_bytes(self) -> bytes:
+        return struct.pack(">IQ", self.n_hashes, self.n_bits) + bytes(self.bits)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "BloomFilter":
+        n_hashes, n_bits = struct.unpack_from(">IQ", data, 0)
+        bf = cls.__new__(cls)
+        bf.n_hashes = n_hashes
+        bf.n_bits = n_bits
+        bf.bits = bytearray(data[12:])
+        return bf
+
+
+@dataclass
+class IndexEntry:
+    series_id: bytes
+    encoded_tags: bytes
+    offset: int
+    length: int
+
+
+class FilesetWriter:
+    """Writes one complete fileset; checkpoint file lands last."""
+
+    def __init__(self, root: str, namespace: str, shard: int, block_start: int,
+                 block_size_ns: int, volume: int = 0):
+        self.root = root
+        self.namespace = namespace
+        self.shard = shard
+        self.block_start = block_start
+        self.block_size_ns = block_size_ns
+        self.volume = volume
+        self._entries: list[IndexEntry] = []
+        self._data = bytearray()
+
+    def write_series(self, series_id: bytes, encoded_tags: bytes, stream: bytes) -> None:
+        self._entries.append(
+            IndexEntry(series_id, encoded_tags, len(self._data), len(stream))
+        )
+        self._data += stream
+
+    def _path(self, suffix: str) -> str:
+        return fileset_path(
+            self.root, self.namespace, self.shard, self.block_start, self.volume, suffix
+        )
+
+    def close(self) -> dict:
+        os.makedirs(os.path.dirname(self._path("info")), exist_ok=True)
+        self._entries.sort(key=lambda e: e.series_id)
+
+        index = bytearray()
+        summaries = bytearray()
+        bloom = BloomFilter(max(1, len(self._entries)))
+        for i, e in enumerate(self._entries):
+            if i % _SUMMARY_EVERY == 0:
+                summaries += struct.pack(">I", len(e.series_id)) + e.series_id
+                summaries += struct.pack(">Q", len(index))
+            index += struct.pack(">I", len(e.series_id)) + e.series_id
+            index += struct.pack(">I", len(e.encoded_tags)) + e.encoded_tags
+            index += struct.pack(">QQ", e.offset, e.length)
+            bloom.add(e.series_id)
+
+        info = json.dumps(
+            {
+                "block_start": self.block_start,
+                "block_size_ns": self.block_size_ns,
+                "volume": self.volume,
+                "n_series": len(self._entries),
+                "data_length": len(self._data),
+            }
+        ).encode()
+
+        files = {
+            "info": info,
+            "data": bytes(self._data),
+            "index": bytes(index),
+            "summaries": bytes(summaries),
+            "bloom": bloom.to_bytes(),
+        }
+        digests = {}
+        for suffix, payload in files.items():
+            with open(self._path(suffix), "wb") as f:
+                f.write(payload)
+            digests[suffix] = zlib.adler32(payload)
+        digest_payload = json.dumps(digests).encode()
+        with open(self._path("digest"), "wb") as f:
+            f.write(digest_payload)
+        # checkpoint last: its presence marks the fileset complete
+        with open(self._path("checkpoint"), "wb") as f:
+            f.write(struct.pack(">I", zlib.adler32(digest_payload)))
+        return digests
+
+
+class FilesetReader:
+    """Reads a complete fileset: bloom -> index binary search -> data slice."""
+
+    def __init__(self, root: str, namespace: str, shard: int, block_start: int,
+                 volume: int = 0, verify: bool = True):
+        self.root = root
+        self.namespace = namespace
+        self.shard = shard
+        self.block_start = block_start
+        self.volume = volume
+
+        if not os.path.exists(self._path("checkpoint")):
+            raise FileNotFoundError(
+                f"fileset incomplete (no checkpoint): shard={shard} bs={block_start}"
+            )
+        with open(self._path("info"), "rb") as f:
+            self.info = json.loads(f.read())
+        self.block_size_ns = self.info["block_size_ns"]
+        with open(self._path("digest"), "rb") as f:
+            digest_payload = f.read()
+        if verify:
+            with open(self._path("checkpoint"), "rb") as f:
+                (want,) = struct.unpack(">I", f.read(4))
+            if zlib.adler32(digest_payload) != want:
+                raise ValueError("digest file corrupt (checkpoint mismatch)")
+            digests = json.loads(digest_payload)
+            for suffix in ("info", "data", "index", "summaries", "bloom"):
+                with open(self._path(suffix), "rb") as f:
+                    if zlib.adler32(f.read()) != digests[suffix]:
+                        raise ValueError(f"{suffix} file corrupt (digest mismatch)")
+
+        with open(self._path("bloom"), "rb") as f:
+            self.bloom = BloomFilter.from_bytes(f.read())
+        with open(self._path("index"), "rb") as f:
+            raw = f.read()
+        self._ids: list[bytes] = []
+        self._tags: list[bytes] = []
+        self._spans: list[tuple[int, int]] = []
+        off = 0
+        while off < len(raw):
+            (idlen,) = struct.unpack_from(">I", raw, off)
+            off += 4
+            sid = raw[off : off + idlen]
+            off += idlen
+            (tlen,) = struct.unpack_from(">I", raw, off)
+            off += 4
+            tags = raw[off : off + tlen]
+            off += tlen
+            data_off, data_len = struct.unpack_from(">QQ", raw, off)
+            off += 16
+            self._ids.append(sid)
+            self._tags.append(tags)
+            self._spans.append((data_off, data_len))
+        self._data_file = open(self._path("data"), "rb")
+
+    def _path(self, suffix: str) -> str:
+        return fileset_path(
+            self.root, self.namespace, self.shard, self.block_start, self.volume, suffix
+        )
+
+    @property
+    def n_series(self) -> int:
+        return len(self._ids)
+
+    def series_ids(self) -> list[bytes]:
+        return list(self._ids)
+
+    def read(self, series_id: bytes) -> bytes | None:
+        """Stream bytes for a series, or None. Bloom gate then bisect."""
+        if not self.bloom.may_contain(series_id):
+            return None
+        i = bisect_left(self._ids, series_id)
+        if i >= len(self._ids) or self._ids[i] != series_id:
+            return None
+        off, length = self._spans[i]
+        self._data_file.seek(off)
+        return self._data_file.read(length)
+
+    def read_at(self, i: int) -> tuple[bytes, bytes, bytes]:
+        """(id, encoded_tags, stream) for index position i."""
+        off, length = self._spans[i]
+        self._data_file.seek(off)
+        return self._ids[i], self._tags[i], self._data_file.read(length)
+
+    def tags_of(self, series_id: bytes) -> bytes | None:
+        i = bisect_left(self._ids, series_id)
+        if i < len(self._ids) and self._ids[i] == series_id:
+            return self._tags[i]
+        return None
+
+    def close(self) -> None:
+        self._data_file.close()
+
+
+def list_filesets(root: str, namespace: str, shard: int) -> list[tuple[int, int]]:
+    """Complete (block_start, volume) pairs for a shard, ascending; keeps
+    only the max volume per block_start."""
+    d = os.path.join(root, namespace, str(shard))
+    if not os.path.isdir(d):
+        return []
+    best: dict[int, int] = {}
+    for name in os.listdir(d):
+        if not name.startswith("fileset-") or not name.endswith("-checkpoint.db"):
+            continue
+        parts = name[len("fileset-") : -len(".db")].split("-")
+        if len(parts) != 3:
+            continue
+        bs, vol = int(parts[0]), int(parts[1])
+        best[bs] = max(best.get(bs, -1), vol)
+    return sorted(best.items())

@@ -1,0 +1,69 @@
+"""Namespace: a retention tier owning all local shards.
+
+Role parity with the reference dbNamespace
+(/root/reference/src/dbnode/storage/namespace.go:702,736,800).
+"""
+
+from __future__ import annotations
+
+from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions
+from m3_tpu.storage.shard import Shard
+from m3_tpu.storage.sharding import ShardSet
+
+
+class Namespace:
+    def __init__(
+        self,
+        name: str,
+        opts: NamespaceOptions,
+        db_opts: DatabaseOptions,
+        shard_set: ShardSet,
+        fs_root: str,
+    ):
+        self.name = name
+        self.opts = opts
+        self.db_opts = db_opts
+        self.shard_set = shard_set
+        self.shards: dict[int, Shard] = {
+            sid: Shard(sid, name, opts, db_opts, fs_root)
+            for sid in shard_set.shard_ids
+        }
+
+    def shard_for(self, series_id: bytes) -> Shard:
+        sid = self.shard_set.lookup(series_id)
+        shard = self.shards.get(sid)
+        if shard is None:
+            raise KeyError(f"shard {sid} not owned by this node")
+        return shard
+
+    def write(self, series_id: bytes, t_ns: int, value_bits: int,
+              encoded_tags: bytes = b"") -> None:
+        self.shard_for(series_id).write(series_id, t_ns, value_bits, encoded_tags)
+
+    def read(self, series_id: bytes, start_ns: int, end_ns: int):
+        return self.shard_for(series_id).read(series_id, start_ns, end_ns)
+
+    def flush(self, now_ns: int) -> int:
+        if not self.opts.flush_enabled:
+            return 0
+        n = 0
+        for shard in self.shards.values():
+            for bs in shard.flushable_block_starts(now_ns):
+                if shard.flush(bs):
+                    n += 1
+        return n
+
+    def expire(self, now_ns: int) -> int:
+        return sum(s.expire(now_ns) for s in self.shards.values())
+
+    def bootstrap_from_fs(self) -> int:
+        n = sum(s.bootstrap_from_fs() for s in self.shards.values())
+        for s in self.shards.values():
+            s.bootstrapped = True
+        return n
+
+    def series_ids(self) -> set[bytes]:
+        out: set[bytes] = set()
+        for s in self.shards.values():
+            out |= s.series_ids()
+        return out

@@ -1,0 +1,52 @@
+"""Storage engine options.
+
+Parity targets: namespace retention/block-size options
+(/root/reference/src/dbnode/namespace/types.go:36,215,254) and the
+series-buffer past/future acceptance windows
+(/root/reference/src/dbnode/storage/series/buffer.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from m3_tpu.utils.xtime import TimeUnit
+
+NANOS_PER_SECOND = 1_000_000_000
+
+
+@dataclass(frozen=True)
+class RetentionOptions:
+    retention_ns: int = 48 * 3600 * NANOS_PER_SECOND
+    block_size_ns: int = 2 * 3600 * NANOS_PER_SECOND
+    buffer_past_ns: int = 10 * 60 * NANOS_PER_SECOND
+    buffer_future_ns: int = 2 * 60 * NANOS_PER_SECOND
+
+    def block_start(self, t_ns: int) -> int:
+        return t_ns - (t_ns % self.block_size_ns)
+
+
+@dataclass(frozen=True)
+class IndexOptions:
+    enabled: bool = True
+    block_size_ns: int = 2 * 3600 * NANOS_PER_SECOND
+
+
+@dataclass(frozen=True)
+class NamespaceOptions:
+    retention: RetentionOptions = field(default_factory=RetentionOptions)
+    index: IndexOptions = field(default_factory=IndexOptions)
+    write_time_unit: TimeUnit = TimeUnit.SECOND
+    bootstrap_enabled: bool = True
+    flush_enabled: bool = True
+    writes_to_commitlog: bool = True
+    cold_writes_enabled: bool = False
+    snapshot_enabled: bool = True
+
+
+@dataclass(frozen=True)
+class DatabaseOptions:
+    n_shards: int = 8
+    # device batch geometry for seal/flush encodes
+    max_points_per_block: int = 4096
+    commitlog_flush_every_bytes: int = 1 << 20

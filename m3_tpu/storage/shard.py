@@ -1,0 +1,223 @@
+"""Shard: columnar buffer + immutable fileset volumes for one virtual shard.
+
+Role parity with the reference dbShard (write/read orchestration, flush,
+retention expiry — /root/reference/src/dbnode/storage/shard.go:869-896,
+1085); the per-series object tree is replaced by the columnar ShardBuffer
+and batched device encodes (SURVEY.md §7.2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from m3_tpu.storage.buffer import ShardBuffer
+from m3_tpu.storage.fileset import FilesetReader, FilesetWriter, list_filesets
+from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions
+
+
+class Shard:
+    def __init__(
+        self,
+        shard_id: int,
+        namespace: str,
+        opts: NamespaceOptions,
+        db_opts: DatabaseOptions,
+        fs_root: str,
+    ):
+        self.shard_id = shard_id
+        self.namespace = namespace
+        self.opts = opts
+        self.db_opts = db_opts
+        self.fs_root = fs_root
+        self.buffer = ShardBuffer(opts.retention.block_size_ns)
+        self._filesets: dict[int, FilesetReader] = {}  # block_start -> reader
+        self.bootstrapped = False
+
+    # -- write --
+
+    def write(self, series_id: bytes, t_ns: int, value_bits: int,
+              encoded_tags: bytes = b"") -> int:
+        return self.buffer.write(series_id, t_ns, value_bits, encoded_tags)
+
+    # -- read --
+
+    def read(self, series_id: bytes, start_ns: int, end_ns: int):
+        """Merged (times, value_bits) from flushed volumes + buffer."""
+        from m3_tpu.encoding.m3tsz import decode as scalar_decode
+
+        parts_t, parts_v = [], []
+        for bs, reader in self._filesets.items():
+            if bs + reader.block_size_ns <= start_ns or bs >= end_ns:
+                continue
+            stream = reader.read(series_id)
+            if stream:
+                dps = scalar_decode(
+                    stream, int_optimized=False,
+                    default_time_unit=self.opts.write_time_unit,
+                )
+                if dps:
+                    parts_t.append(np.array([d.timestamp_ns for d in dps], np.int64))
+                    parts_v.append(
+                        np.array(
+                            [np.float64(d.value) for d in dps], np.float64
+                        ).view(np.uint64)
+                    )
+        bt, bv = self.buffer.read(series_id, start_ns, end_ns)
+        if len(bt):
+            parts_t.append(bt)
+            parts_v.append(bv)
+        if not parts_t:
+            return np.empty(0, np.int64), np.empty(0, np.uint64)
+        times = np.concatenate(parts_t)
+        vbits = np.concatenate(parts_v)
+        # stable sort keeps append order within equal timestamps; buffer was
+        # appended last, so last-write(-location)-wins keeps buffer values
+        order = np.argsort(times, kind="stable")
+        times, vbits = times[order], vbits[order]
+        keep = np.ones(len(times), bool)
+        keep[:-1] = times[1:] != times[:-1]
+        times, vbits = times[keep], vbits[keep]
+        sel = (times >= start_ns) & (times < end_ns)
+        return times[sel], vbits[sel]
+
+    def series_ids(self) -> set[bytes]:
+        ids = set(self.buffer.series_ids)
+        for reader in self._filesets.values():
+            ids.update(reader.series_ids())
+        return ids
+
+    # -- flush --
+
+    def flushable_block_starts(self, now_ns: int) -> list[int]:
+        r = self.opts.retention
+        out = []
+        for bs in self.buffer.block_starts():
+            if bs + r.block_size_ns + r.buffer_past_ns <= now_ns:
+                out.append(bs)
+        return out
+
+    def flush(self, block_start: int) -> bool:
+        """Seal the window, batch-encode on device, write a fileset volume.
+
+        If a volume already exists for the window (cold-path reflush), its
+        series are decoded, merged with the buffer's, and a higher volume is
+        written — the role of the reference's fs merger (persist/fs/merger.go).
+        """
+        import jax.numpy as jnp
+
+        from m3_tpu.encoding.m3tsz import decode as scalar_decode
+        from m3_tpu.encoding.m3tsz import tpu as m3tsz_tpu
+
+        sealed = self.buffer.seal(block_start)
+        if sealed is None:
+            return False
+
+        ids = [self.buffer.series_ids[i] for i in sealed.series_indices]
+        tags = [self.buffer.series_tags[i] for i in sealed.series_indices]
+        times = sealed.times
+        vbits = sealed.value_bits
+        n_points = sealed.n_points
+
+        prev = self._filesets.get(block_start)
+        volume = 0
+        extra: list[tuple[bytes, bytes, bytes]] = []  # untouched old series
+        if prev is not None:
+            volume = prev.volume + 1
+            merged_t, merged_v, merged_n = [], [], []
+            new_ids = {sid: k for k, sid in enumerate(ids)}
+            for i in range(prev.n_series):
+                sid, stags, stream = prev.read_at(i)
+                if sid not in new_ids:
+                    extra.append((sid, stags, stream))
+                    continue
+                k = new_ids[sid]
+                dps = scalar_decode(
+                    stream, int_optimized=False,
+                    default_time_unit=self.opts.write_time_unit,
+                )
+                old_t = np.array([d.timestamp_ns for d in dps], np.int64)
+                old_v = np.array([d.value for d in dps], np.float64).view(np.uint64)
+                nt = np.concatenate([old_t, times[k, : n_points[k]]])
+                nv = np.concatenate([old_v, vbits[k, : n_points[k]]])
+                order = np.argsort(nt, kind="stable")
+                nt, nv = nt[order], nv[order]
+                keep = np.ones(len(nt), bool)
+                keep[:-1] = nt[1:] != nt[:-1]
+                merged_t.append(nt[keep])
+                merged_v.append(nv[keep])
+                merged_n.append(k)
+            if merged_n:
+                width = max(times.shape[1], max(len(t) for t in merged_t))
+                if width > times.shape[1]:
+                    pad = width - times.shape[1]
+                    times = np.pad(times, ((0, 0), (0, pad)), constant_values=block_start)
+                    vbits = np.pad(vbits, ((0, 0), (0, pad)))
+                for k, nt, nv in zip(merged_n, merged_t, merged_v):
+                    times[k, : len(nt)] = nt
+                    vbits[k, : len(nv)] = nv
+                    times[k, len(nt):] = nt[-1]
+                    n_points[k] = len(nt)
+
+        blocks = m3tsz_tpu.encode_bits(
+            jnp.asarray(times),
+            jnp.asarray(vbits),
+            jnp.asarray(sealed.starts),
+            jnp.asarray(n_points),
+            self.opts.write_time_unit,
+        )
+        if bool(blocks.overflow):
+            raise RuntimeError(
+                f"flush encode overflow: shard={self.shard_id} bs={block_start}"
+            )
+        streams = m3tsz_tpu.blocks_to_bytes(blocks)
+
+        writer = FilesetWriter(
+            self.fs_root, self.namespace, self.shard_id, block_start,
+            self.opts.retention.block_size_ns, volume,
+        )
+        for sid, stags, stream in zip(ids, tags, streams):
+            writer.write_series(sid, stags, stream)
+        for sid, stags, stream in extra:
+            writer.write_series(sid, stags, stream)
+        writer.close()
+
+        if prev is not None:
+            prev.close()
+        self._filesets[block_start] = FilesetReader(
+            self.fs_root, self.namespace, self.shard_id, block_start, volume
+        )
+        return True
+
+    # -- bootstrap --
+
+    def bootstrap_from_fs(self) -> int:
+        n = 0
+        for block_start, volume in list_filesets(self.fs_root, self.namespace, self.shard_id):
+            try:
+                reader = FilesetReader(
+                    self.fs_root, self.namespace, self.shard_id, block_start, volume
+                )
+            except (FileNotFoundError, ValueError):
+                continue  # incomplete or corrupt volume: ignore
+            self._filesets[block_start] = reader
+            n += 1
+        return n
+
+    # -- maintenance --
+
+    def expire(self, now_ns: int) -> int:
+        """Drop block volumes + buffered windows past retention."""
+        r = self.opts.retention
+        cutoff = r.block_start(now_ns - r.retention_ns)
+        dropped = 0
+        for bs in list(self._filesets):
+            if bs < cutoff:
+                self._filesets[bs].close()
+                del self._filesets[bs]
+                dropped += 1
+        self.buffer.expire_before(cutoff)
+        return dropped
+
+    @property
+    def flushed_block_starts(self) -> list[int]:
+        return sorted(self._filesets)

@@ -1,0 +1,29 @@
+"""Shard routing: murmur3(id) % N virtual shards.
+
+Parity: /root/reference/src/dbnode/sharding/shardset.go:76,158-175.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from m3_tpu.utils.hash import murmur3_32
+
+DEFAULT_SEED = 42
+
+
+@dataclass(frozen=True)
+class ShardSet:
+    n_shards: int
+    shard_ids: tuple[int, ...] = field(default=None)
+    seed: int = DEFAULT_SEED
+
+    def __post_init__(self):
+        if self.shard_ids is None:
+            object.__setattr__(self, "shard_ids", tuple(range(self.n_shards)))
+
+    def lookup(self, series_id: bytes) -> int:
+        return murmur3_32(series_id, self.seed) % self.n_shards
+
+    def owns(self, shard: int) -> bool:
+        return shard in self.shard_ids

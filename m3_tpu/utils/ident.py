@@ -1,0 +1,51 @@
+"""Series identity: IDs and tag sets, plus the tag wire codec.
+
+Equivalent roles to the reference's src/x/ident (IDs/tags) and
+src/x/serialize (tag wire format, serialize/types.go:37-108): a compact
+length-prefixed binary encoding used in fileset index entries and on the
+wire. Layout: u16 count, then per tag (u16 len + name, u16 len + value).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable
+
+HEADER_MAGIC = 0x4D33  # "M3"
+
+
+def encode_tags(tags: Iterable[tuple[bytes, bytes]]) -> bytes:
+    tags = list(tags)
+    out = bytearray(struct.pack(">HH", HEADER_MAGIC, len(tags)))
+    for name, value in tags:
+        out += struct.pack(">H", len(name)) + name
+        out += struct.pack(">H", len(value)) + value
+    return bytes(out)
+
+
+def decode_tags(data: bytes) -> list[tuple[bytes, bytes]]:
+    magic, count = struct.unpack_from(">HH", data, 0)
+    if magic != HEADER_MAGIC:
+        raise ValueError(f"bad tag header magic {magic:#x}")
+    off = 4
+    tags = []
+    for _ in range(count):
+        (nlen,) = struct.unpack_from(">H", data, off)
+        off += 2
+        name = data[off : off + nlen]
+        off += nlen
+        (vlen,) = struct.unpack_from(">H", data, off)
+        off += 2
+        value = data[off : off + vlen]
+        off += vlen
+        tags.append((name, value))
+    return tags
+
+
+def tags_to_id(metric_name: bytes, tags: Iterable[tuple[bytes, bytes]]) -> bytes:
+    """Canonical series ID from metric name + sorted tags (the role of
+    metric/id/m3 tag-aware IDs in the reference)."""
+    parts = [metric_name]
+    for name, value in sorted(tags):
+        parts.append(name + b"=" + value)
+    return b"|".join(parts)

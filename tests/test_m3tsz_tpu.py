@@ -252,3 +252,17 @@ class TestIngestPipeline:
         )
         assert np.asarray(out["count"]).sum() == 0
         assert np.isnan(np.asarray(out["last"])).all()
+
+
+class TestDodOverflowFlag:
+    def test_32bit_dod_overflow_sets_flag(self, rng):
+        # a zero timestamp mixed into unix-nano data blows the 32-bit
+        # default bucket for SECOND unit; scalar raises, batch flags
+        times = np.array([[START + 10**9, 0, START + 3 * 10**9]], dtype=np.int64)
+        values = np.zeros((1, 3))
+        blocks = tpu.encode_bits(
+            jnp.asarray(times), jnp.asarray(values.view(np.uint64)),
+            jnp.asarray(np.array([START], np.int64)), jnp.asarray(np.array([3], np.int32)),
+            TimeUnit.SECOND,
+        )
+        assert bool(blocks.overflow)

@@ -1,0 +1,271 @@
+"""Storage engine tests: buffer, fileset, commitlog, shard, database.
+
+Mirrors the reference's unit-test tiers for the storage path (SURVEY.md §4):
+write/read round-trips, flush + bootstrap-from-fs, commitlog replay after
+crash, out-of-order/duplicate resolution, retention expiry.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from m3_tpu.storage import commitlog
+from m3_tpu.storage.buffer import ShardBuffer
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.fileset import BloomFilter, FilesetReader, FilesetWriter, list_filesets
+from m3_tpu.storage.options import (
+    DatabaseOptions,
+    NamespaceOptions,
+    RetentionOptions,
+)
+from m3_tpu.utils.ident import decode_tags, encode_tags, tags_to_id
+
+HOUR = 3600 * 10**9
+START = 1_599_998_400_000_000_000  # multiple of 2h: aligned block start
+
+
+def bits(v: float) -> int:
+    return int(np.float64(v).view(np.uint64))
+
+
+def small_opts() -> NamespaceOptions:
+    return NamespaceOptions(
+        retention=RetentionOptions(
+            retention_ns=24 * HOUR,
+            block_size_ns=2 * HOUR,
+            buffer_past_ns=10 * 60 * 10**9,
+        )
+    )
+
+
+class TestShardBuffer:
+    def test_write_read(self):
+        buf = ShardBuffer(2 * HOUR)
+        buf.write(b"a", START + 10**9, bits(1.0))
+        buf.write(b"a", START + 3 * 10**9, bits(2.0))
+        buf.write(b"b", START + 10**9, bits(9.0))
+        t, v = buf.read(b"a", START, START + HOUR)
+        assert list(t) == [START + 10**9, START + 3 * 10**9]
+        assert list(v.view(np.float64)) == [1.0, 2.0]
+
+    def test_out_of_order_and_duplicates(self):
+        buf = ShardBuffer(2 * HOUR)
+        buf.write(b"a", START + 5 * 10**9, bits(5.0))
+        buf.write(b"a", START + 1 * 10**9, bits(1.0))
+        buf.write(b"a", START + 5 * 10**9, bits(50.0))  # dup: last wins
+        t, v = buf.read(b"a", START, START + HOUR)
+        assert list(t) == [START + 10**9, START + 5 * 10**9]
+        assert list(v.view(np.float64)) == [1.0, 50.0]
+
+    def test_seal_groups_and_dedupes(self):
+        buf = ShardBuffer(2 * HOUR)
+        buf.write(b"a", START + 2 * 10**9, bits(2.0))
+        buf.write(b"b", START + 1 * 10**9, bits(1.0))
+        buf.write(b"a", START + 1 * 10**9, bits(0.5))
+        buf.write(b"a", START + 2 * 10**9, bits(3.0))  # dup of first
+        sealed = buf.seal(START)
+        assert sealed.n_series == 2
+        a = list(sealed.series_indices).index(buf.series_index(b"a"))
+        assert sealed.n_points[a] == 2
+        np.testing.assert_array_equal(
+            sealed.times[a, :2], [START + 10**9, START + 2 * 10**9]
+        )
+        assert sealed.value_bits[a, 1] == bits(3.0)
+        # sealed window is gone from the buffer
+        assert buf.points_in(START) == 0
+
+    def test_multiple_block_windows(self):
+        buf = ShardBuffer(2 * HOUR)
+        buf.write(b"a", START + 10**9, bits(1.0))
+        buf.write(b"a", START + 2 * HOUR + 10**9, bits(2.0))
+        assert buf.block_starts() == [START, START + 2 * HOUR]
+
+
+class TestFileset:
+    def test_write_read_roundtrip(self, tmp_path):
+        w = FilesetWriter(str(tmp_path), "ns", 3, START, 2 * HOUR)
+        w.write_series(b"abc", encode_tags([(b"host", b"h1")]), b"STREAM-A")
+        w.write_series(b"zzz", b"", b"STREAM-Z")
+        w.close()
+        r = FilesetReader(str(tmp_path), "ns", 3, START)
+        assert r.n_series == 2
+        assert r.read(b"abc") == b"STREAM-A"
+        assert r.read(b"zzz") == b"STREAM-Z"
+        assert r.read(b"nope") is None
+        assert decode_tags(r.tags_of(b"abc")) == [(b"host", b"h1")]
+
+    def test_missing_checkpoint_rejected(self, tmp_path):
+        w = FilesetWriter(str(tmp_path), "ns", 0, START, 2 * HOUR)
+        w.write_series(b"a", b"", b"x")
+        w.close()
+        os.remove(
+            os.path.join(str(tmp_path), "ns", "0", f"fileset-{START}-0-checkpoint.db")
+        )
+        with pytest.raises(FileNotFoundError):
+            FilesetReader(str(tmp_path), "ns", 0, START)
+        assert list_filesets(str(tmp_path), "ns", 0) == []
+
+    def test_corrupt_data_detected(self, tmp_path):
+        w = FilesetWriter(str(tmp_path), "ns", 0, START, 2 * HOUR)
+        w.write_series(b"a", b"", b"payload")
+        w.close()
+        p = os.path.join(str(tmp_path), "ns", "0", f"fileset-{START}-0-data.db")
+        with open(p, "r+b") as f:
+            f.write(b"X")
+        with pytest.raises(ValueError, match="corrupt"):
+            FilesetReader(str(tmp_path), "ns", 0, START)
+
+    def test_bloom_filter(self):
+        bf = BloomFilter(100)
+        keys = [f"k{i}".encode() for i in range(100)]
+        for k in keys:
+            bf.add(k)
+        assert all(bf.may_contain(k) for k in keys)
+        fp = sum(bf.may_contain(f"other{i}".encode()) for i in range(1000))
+        assert fp < 50  # ~1% expected at 10 bits/item
+        bf2 = BloomFilter.from_bytes(bf.to_bytes())
+        assert all(bf2.may_contain(k) for k in keys)
+
+
+class TestCommitLog:
+    def test_write_replay(self, tmp_path):
+        p = str(tmp_path / "cl" / "commitlog-1.db")
+        w = commitlog.CommitLogWriter(p)
+        w.write(b"a", encode_tags([(b"x", b"y")]), START, bits(1.5), 1)
+        w.write(b"a", b"", START + 10**9, bits(2.5), 1)
+        w.write(b"b", b"", START, bits(9.0), 1)
+        w.close()
+        entries = commitlog.replay(p)
+        assert len(entries) == 3
+        assert entries[0].series_id == b"a"
+        assert decode_tags(entries[0].encoded_tags) == [(b"x", b"y")]
+        assert entries[1].value_bits == bits(2.5)
+        assert entries[2].series_id == b"b"
+
+    def test_torn_tail_ignored(self, tmp_path):
+        p = str(tmp_path / "cl" / "commitlog-1.db")
+        w = commitlog.CommitLogWriter(p)
+        w.write(b"a", b"", START, bits(1.0), 1)
+        w.flush()
+        w.write(b"b", b"", START, bits(2.0), 1)
+        w.close()
+        raw = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(raw[:-3])  # simulate crash mid-write
+        entries = commitlog.replay(p)
+        assert [e.series_id for e in entries] == [b"a"]
+
+
+def make_db(tmp_path, **kw) -> Database:
+    db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4, **kw))
+    db.create_namespace("default", small_opts())
+    db.open()
+    return db
+
+
+class TestDatabase:
+    def test_write_read_buffer_only(self, tmp_path):
+        db = make_db(tmp_path)
+        sid = tags_to_id(b"cpu", [(b"host", b"h1")])
+        db.write("default", sid, START + 10**9, 0.5)
+        db.write("default", sid, START + 2 * 10**9, 1.5)
+        dps = db.read("default", sid, START, START + HOUR)
+        assert [(d.timestamp_ns, d.value) for d in dps] == [
+            (START + 10**9, 0.5),
+            (START + 2 * 10**9, 1.5),
+        ]
+        db.close()
+
+    def test_flush_and_read_from_fileset(self, tmp_path):
+        db = make_db(tmp_path)
+        ids = [f"series-{i}".encode() for i in range(20)]
+        for i, sid in enumerate(ids):
+            for j in range(10):
+                db.write("default", sid, START + j * 60 * 10**9, float(i * 100 + j))
+        # tick "now" far enough past the block end to trigger warm flush
+        now = START + 2 * HOUR + HOUR
+        stats = db.tick(now)
+        assert stats["flushed"] >= 1
+        # buffers are drained into filesets; reads hit the volumes
+        for i, sid in enumerate(ids):
+            dps = db.read("default", sid, START, START + 2 * HOUR)
+            assert len(dps) == 10
+            assert dps[3].value == i * 100 + 3
+        db.close()
+
+    def test_bootstrap_from_fs_after_restart(self, tmp_path):
+        db = make_db(tmp_path)
+        sid = b"persisted"
+        for j in range(5):
+            db.write("default", sid, START + j * 60 * 10**9, float(j))
+        db.tick(START + 3 * HOUR)
+        db.close()
+
+        db2 = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+        db2.create_namespace("default", small_opts())
+        db2.open()
+        dps = db2.read("default", sid, START, START + HOUR)
+        assert [d.value for d in dps] == [0.0, 1.0, 2.0, 3.0, 4.0]
+        db2.close()
+
+    def test_commitlog_replay_recovers_unflushed(self, tmp_path):
+        db = make_db(tmp_path)
+        sid = b"wal-series"
+        db.write("default", sid, START + 10**9, 42.0)
+        # crash: no flush, no clean close; but force the log to disk
+        db._commitlogs["default"].flush()
+        db._commitlogs["default"]._f.close()
+
+        db2 = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+        db2.create_namespace("default", small_opts())
+        db2.open()
+        dps = db2.read("default", sid, START, START + HOUR)
+        assert [(d.timestamp_ns, d.value) for d in dps] == [(START + 10**9, 42.0)]
+        db2.close()
+
+    def test_merge_buffer_and_fileset_reads(self, tmp_path):
+        db = make_db(tmp_path)
+        sid = b"mixed"
+        db.write("default", sid, START + 10**9, 1.0)
+        db.tick(START + 3 * HOUR)  # flush first point
+        late = START + 2 * 10**9
+        db.write("default", sid, late, 2.0)  # cold write into flushed window
+        dps = db.read("default", sid, START, START + HOUR)
+        assert [d.value for d in dps] == [1.0, 2.0]
+        db.close()
+
+    def test_cold_reflush_merges_volumes(self, tmp_path):
+        db = make_db(tmp_path)
+        sid = b"cold"
+        db.write("default", sid, START + 10**9, 1.0)
+        db.flush_all()
+        db.write("default", sid, START + 2 * 10**9, 2.0)
+        db.flush_all()  # second volume merges old + new
+        shard = db.namespaces["default"].shard_for(sid)
+        assert shard._filesets[START].volume == 1
+        dps = db.read("default", sid, START, START + HOUR)
+        assert [d.value for d in dps] == [1.0, 2.0]
+        db.close()
+
+    def test_retention_expiry(self, tmp_path):
+        db = make_db(tmp_path)
+        sid = b"old"
+        db.write("default", sid, START + 10**9, 1.0)
+        db.flush_all()
+        far_future = START + 48 * HOUR
+        db.tick(far_future)
+        assert db.read("default", sid, START, START + HOUR) == []
+        db.close()
+
+    def test_out_of_order_across_flush_boundary(self, tmp_path):
+        db = make_db(tmp_path)
+        sid = b"ooo"
+        db.write("default", sid, START + 5 * 10**9, 5.0)
+        db.write("default", sid, START + 1 * 10**9, 1.0)
+        db.write("default", sid, START + 5 * 10**9, 50.0)  # dup last wins
+        db.flush_all()
+        dps = db.read("default", sid, START, START + HOUR)
+        assert [(d.timestamp_ns - START) // 10**9 for d in dps] == [1, 5]
+        assert [d.value for d in dps] == [1.0, 50.0]
+        db.close()
