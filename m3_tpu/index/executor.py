@@ -1,0 +1,82 @@
+"""Query executor over segments.
+
+Role parity with the reference search executor + searchers
+(/root/reference/src/m3ninx/search/executor/executor.go and
+search/searcher/conjunction.go:78-111): leaves resolve to postings per
+segment; conjunctions intersect (negations become AND-NOT), disjunctions
+union; multi-segment results concatenate with per-segment doc-id bases.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from m3_tpu.index import postings as P
+from m3_tpu.index.query import (
+    AllQuery,
+    ConjunctionQuery,
+    DisjunctionQuery,
+    FieldQuery,
+    NegationQuery,
+    Query,
+    RegexpQuery,
+    TermQuery,
+)
+from m3_tpu.index.segment import Segment
+
+
+def search_segment(seg: Segment, query: Query) -> np.ndarray:
+    """Postings of one segment matching the query."""
+    if isinstance(query, AllQuery):
+        return seg.postings_all()
+    if isinstance(query, TermQuery):
+        return seg.postings_term(query.field_name, query.value)
+    if isinstance(query, RegexpQuery):
+        return seg.postings_regexp(query.field_name, query.compiled())
+    if isinstance(query, FieldQuery):
+        return seg.postings_field(query.field_name)
+    if isinstance(query, NegationQuery):
+        return P.difference(seg.postings_all(), search_segment(seg, query.inner))
+    if isinstance(query, ConjunctionQuery):
+        positives: list[np.ndarray] = []
+        negatives: list[np.ndarray] = []
+        for q in query.queries:
+            if isinstance(q, NegationQuery):
+                negatives.append(search_segment(seg, q.inner))
+            else:
+                positives.append(search_segment(seg, q))
+        if positives:
+            positives.sort(key=len)
+            acc = positives[0]
+            for p in positives[1:]:
+                if len(acc) == 0:
+                    return P.EMPTY
+                acc = P.intersect(acc, p)
+        else:
+            acc = seg.postings_all()
+        for n in negatives:
+            if len(acc) == 0:
+                return P.EMPTY
+            acc = P.difference(acc, n)
+        return acc
+    if isinstance(query, DisjunctionQuery):
+        return P.union_many([search_segment(seg, q) for q in query.queries])
+    raise TypeError(f"unknown query type {type(query)}")
+
+
+def search(segments: list[Segment], query: Query, limit: int | None = None):
+    """Execute over segments; yields (series_id, fields) deduped by series
+    (later segments win nothing — first hit is kept)."""
+    seen: set[bytes] = set()
+    out = []
+    for seg in segments:
+        ids = search_segment(seg, query)
+        for doc_id in ids:
+            doc = seg.docs[int(doc_id)]
+            if doc.series_id in seen:
+                continue
+            seen.add(doc.series_id)
+            out.append(doc)
+            if limit is not None and len(out) >= limit:
+                return out
+    return out

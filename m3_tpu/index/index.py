@@ -1,0 +1,118 @@
+"""Namespace index: time-partitioned reverse index over segments.
+
+Role parity with the reference nsIndex
+(/root/reference/src/dbnode/storage/index.go:623,1482,1524): index blocks
+partitioned by block start; inserts land in a mutable segment per block and
+compact into sealed immutable segments (the mutable->FST compaction,
+storage/index/mutable_segments.go); queries evaluate over every block
+overlapping the time range and dedupe series; aggregate queries surface
+field names/values for label APIs.
+"""
+
+from __future__ import annotations
+
+import re
+
+from m3_tpu.index.executor import search
+from m3_tpu.index.query import Query
+from m3_tpu.index.segment import MutableSegment, Segment, merge_segments
+
+
+class IndexBlock:
+    def __init__(self) -> None:
+        self.mutable = MutableSegment()
+        self.sealed: list[Segment] = []
+        self._cache: Segment | None = None  # sealed view of `mutable`
+        self._cache_docs = 0
+
+    def insert(self, series_id: bytes, fields) -> None:
+        before = self.mutable.n_docs
+        self.mutable.insert(series_id, fields)
+        if self.mutable.n_docs != before:
+            self._cache = None  # new doc invalidates the sealed view
+
+    def segments(self) -> list[Segment]:
+        segs = list(self.sealed)
+        if self.mutable.n_docs:
+            if self._cache is None or self._cache_docs != self.mutable.n_docs:
+                self._cache = self.mutable.seal()
+                self._cache_docs = self.mutable.n_docs
+            segs.append(self._cache)
+        return segs
+
+    def compact(self) -> None:
+        """Fold the mutable segment (and fragmented sealed ones) into one
+        immutable segment."""
+        segs = self.segments()
+        if not segs:
+            return
+        self.sealed = [merge_segments(segs)] if len(segs) > 1 else segs
+        self.mutable = MutableSegment()
+        self._cache = None
+
+
+class NamespaceIndex:
+    def __init__(self, block_size_ns: int):
+        self.block_size_ns = block_size_ns
+        self._blocks: dict[int, IndexBlock] = {}
+
+    def _block_for(self, t_ns: int) -> IndexBlock:
+        bs = t_ns - (t_ns % self.block_size_ns)
+        blk = self._blocks.get(bs)
+        if blk is None:
+            blk = self._blocks[bs] = IndexBlock()
+        return blk
+
+    def insert(self, series_id: bytes, fields: list[tuple[bytes, bytes]], t_ns: int) -> None:
+        self._block_for(t_ns).insert(series_id, fields)
+
+    def _overlapping(self, start_ns: int, end_ns: int) -> list[IndexBlock]:
+        out = []
+        for bs, blk in sorted(self._blocks.items()):
+            if bs + self.block_size_ns <= start_ns or bs >= end_ns:
+                continue
+            out.append(blk)
+        return out
+
+    def query(self, query: Query, start_ns: int, end_ns: int, limit: int | None = None):
+        """Docs whose series matched in any overlapping index block."""
+        segments = []
+        for blk in self._overlapping(start_ns, end_ns):
+            segments.extend(blk.segments())
+        return search(segments, query, limit)
+
+    def aggregate_field_names(self, start_ns: int, end_ns: int) -> list[bytes]:
+        names: set[bytes] = set()
+        for blk in self._overlapping(start_ns, end_ns):
+            for seg in blk.segments():
+                names.update(seg.field_names())
+        return sorted(names)
+
+    def aggregate_field_values(
+        self, field: bytes, start_ns: int, end_ns: int,
+        pattern: str | None = None,
+    ) -> list[bytes]:
+        rx = re.compile(pattern.encode()) if pattern else None
+        values: set[bytes] = set()
+        for blk in self._overlapping(start_ns, end_ns):
+            for seg in blk.segments():
+                for v in seg.terms(field):
+                    if rx is None or rx.fullmatch(v):
+                        values.add(v)
+        return sorted(values)
+
+    def compact(self) -> None:
+        for blk in self._blocks.values():
+            blk.compact()
+
+    def expire_before(self, cutoff_ns: int) -> int:
+        dropped = 0
+        for bs in list(self._blocks):
+            if bs + self.block_size_ns <= cutoff_ns:
+                del self._blocks[bs]
+                dropped += 1
+        return dropped
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self._blocks)

@@ -1,0 +1,55 @@
+"""Postings lists: sorted uint32 document-id arrays.
+
+Role parity with the reference postings abstraction
+(/root/reference/src/m3ninx/postings/types.go:46-109) and its roaring-bitmap
+implementation. Host-side set algebra runs on sorted numpy arrays (the
+control-plane path); large batched query evaluation lowers to dense bitmap
+tensors on device (m3_tpu.ops.bitmaps) where AND/OR/ANDNOT become vectorized
+word ops — the TPU replacement for roaring container loops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+EMPTY = np.empty(0, dtype=np.uint32)
+
+
+def from_list(ids) -> np.ndarray:
+    return np.unique(np.asarray(ids, dtype=np.uint32))
+
+
+def intersect(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.intersect1d(a, b, assume_unique=True)
+
+
+def union(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.union1d(a, b)
+
+
+def difference(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.setdiff1d(a, b, assume_unique=True)
+
+
+def union_many(lists: list[np.ndarray]) -> np.ndarray:
+    if not lists:
+        return EMPTY
+    if len(lists) == 1:
+        return lists[0]
+    return np.unique(np.concatenate(lists))
+
+
+def to_bitmap(p: np.ndarray, n_docs: int) -> np.ndarray:
+    """Dense u64 word bitmap [ceil(n/64)] for device algebra."""
+    words = np.zeros((n_docs + 63) // 64, dtype=np.uint64)
+    if len(p):
+        w = p // 64
+        bit = np.uint64(1) << (p % 64).astype(np.uint64)
+        np.bitwise_or.at(words, w, bit)
+    return words
+
+
+def from_bitmap(words: np.ndarray) -> np.ndarray:
+    """Sorted ids from a dense u64 word bitmap (little-endian hosts)."""
+    bits = np.unpackbits(np.ascontiguousarray(words).view(np.uint8), bitorder="little")
+    return np.nonzero(bits)[0].astype(np.uint32)

@@ -1,0 +1,93 @@
+"""Boolean query AST + tag matchers.
+
+Role parity with the reference search AST
+(/root/reference/src/m3ninx/search/types.go:43-58 and search/searcher/*):
+term/regexp/field/all leaves composed by conjunction (with negation folded
+into AND-NOT) and disjunction. Matchers carry the PromQL =, !=, =~, !~
+semantics used by the query layer.
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+from dataclasses import dataclass, field
+
+
+class Query:
+    pass
+
+
+@dataclass(frozen=True)
+class TermQuery(Query):
+    field_name: bytes
+    value: bytes
+
+
+@dataclass(frozen=True)
+class RegexpQuery(Query):
+    field_name: bytes
+    pattern: str  # anchored full-match semantics
+
+    def compiled(self) -> re.Pattern:
+        return re.compile(self.pattern.encode() if isinstance(self.pattern, str) else self.pattern)
+
+
+@dataclass(frozen=True)
+class FieldQuery(Query):
+    field_name: bytes
+
+
+@dataclass(frozen=True)
+class AllQuery(Query):
+    pass
+
+
+@dataclass(frozen=True)
+class NegationQuery(Query):
+    inner: Query
+
+
+@dataclass(frozen=True)
+class ConjunctionQuery(Query):
+    queries: tuple = field(default_factory=tuple)
+
+
+@dataclass(frozen=True)
+class DisjunctionQuery(Query):
+    queries: tuple = field(default_factory=tuple)
+
+
+class MatchType(enum.Enum):
+    EQUAL = "="
+    NOT_EQUAL = "!="
+    REGEXP = "=~"
+    NOT_REGEXP = "!~"
+
+
+@dataclass(frozen=True)
+class Matcher:
+    """One PromQL-style label matcher."""
+
+    match_type: MatchType
+    name: bytes
+    value: bytes
+
+    def to_query(self) -> Query:
+        if self.match_type == MatchType.EQUAL:
+            return TermQuery(self.name, self.value)
+        if self.match_type == MatchType.NOT_EQUAL:
+            return NegationQuery(TermQuery(self.name, self.value))
+        if self.match_type == MatchType.REGEXP:
+            return RegexpQuery(self.name, self.value.decode())
+        return NegationQuery(RegexpQuery(self.name, self.value.decode()))
+
+
+def matchers_to_query(matchers: list[Matcher]) -> Query:
+    """PromQL vector selector -> conjunction query."""
+    if not matchers:
+        return AllQuery()
+    qs = tuple(m.to_query() for m in matchers)
+    if len(qs) == 1:
+        return qs[0]
+    return ConjunctionQuery(qs)

@@ -1,0 +1,199 @@
+"""Index segments: mutable (accepting inserts) and sealed (immutable).
+
+Role parity with the reference's mem segment + FST segment pair
+(/root/reference/src/m3ninx/index/segment/mem/segment.go,
+segment/fst/segment.go:130-180): a mutable segment is a concurrent-insert
+terms dictionary; sealing produces an immutable segment with sorted term
+dictionaries per field (the FST's role — ordered term lookup + range scan)
+and postings as sorted id arrays. Regex queries scan the sorted vocabulary
+of one field (the automaton-intersection role) and union the matching
+postings.
+"""
+
+from __future__ import annotations
+
+import re
+import struct
+from bisect import bisect_left
+
+import numpy as np
+
+from m3_tpu.index import postings as P
+
+
+class Document:
+    """Indexed document: series id + (name, value) fields."""
+
+    __slots__ = ("doc_id", "series_id", "fields")
+
+    def __init__(self, doc_id: int, series_id: bytes, fields: list[tuple[bytes, bytes]]):
+        self.doc_id = doc_id
+        self.series_id = series_id
+        self.fields = fields
+
+
+class MutableSegment:
+    """Insert-optimized segment: field -> value -> growable id list."""
+
+    def __init__(self) -> None:
+        self._terms: dict[bytes, dict[bytes, list[int]]] = {}
+        self._docs: list[Document] = []
+        self._by_series: dict[bytes, int] = {}
+
+    def insert(self, series_id: bytes, fields: list[tuple[bytes, bytes]]) -> int:
+        """Insert once per series id; returns the doc id."""
+        existing = self._by_series.get(series_id)
+        if existing is not None:
+            return existing
+        doc_id = len(self._docs)
+        doc = Document(doc_id, series_id, list(fields))
+        self._docs.append(doc)
+        self._by_series[series_id] = doc_id
+        for name, value in fields:
+            self._terms.setdefault(name, {}).setdefault(value, []).append(doc_id)
+        return doc_id
+
+    @property
+    def n_docs(self) -> int:
+        return len(self._docs)
+
+    def seal(self) -> "Segment":
+        fields = {}
+        for name, values in self._terms.items():
+            vocab = sorted(values)
+            plists = [P.from_list(values[v]) for v in vocab]
+            fields[name] = (vocab, plists)
+        return Segment(fields, list(self._docs))
+
+
+class Segment:
+    """Immutable sealed segment: sorted vocab + postings per field."""
+
+    def __init__(self, fields: dict, docs: list[Document]):
+        # fields: name -> (sorted [values], [postings arrays])
+        self._fields = fields
+        self.docs = docs
+
+    @property
+    def n_docs(self) -> int:
+        return len(self.docs)
+
+    def field_names(self) -> list[bytes]:
+        return sorted(self._fields)
+
+    def terms(self, field: bytes) -> list[bytes]:
+        f = self._fields.get(field)
+        return list(f[0]) if f else []
+
+    def postings_term(self, field: bytes, value: bytes) -> np.ndarray:
+        f = self._fields.get(field)
+        if not f:
+            return P.EMPTY
+        vocab, plists = f
+        i = bisect_left(vocab, value)
+        if i < len(vocab) and vocab[i] == value:
+            return plists[i]
+        return P.EMPTY
+
+    def postings_regexp(self, field: bytes, pattern: re.Pattern) -> np.ndarray:
+        """Union of postings whose term fully matches the pattern — the
+        vocabulary scan standing in for FST-automaton intersection."""
+        f = self._fields.get(field)
+        if not f:
+            return P.EMPTY
+        vocab, plists = f
+        hits = [plists[i] for i, v in enumerate(vocab) if pattern.fullmatch(v)]
+        return P.union_many(hits)
+
+    def postings_field(self, field: bytes) -> np.ndarray:
+        """All docs having the field at any value."""
+        f = self._fields.get(field)
+        if not f:
+            return P.EMPTY
+        return P.union_many(list(f[1]))
+
+    def postings_all(self) -> np.ndarray:
+        return np.arange(len(self.docs), dtype=np.uint32)
+
+    # -- persistence (the persist/fst-segment-files role) --
+
+    def to_bytes(self) -> bytes:
+        """Compact flat encoding: docs then per-field vocab+postings."""
+        out = bytearray(struct.pack(">I", len(self.docs)))
+        for d in self.docs:
+            out += struct.pack(">I", len(d.series_id)) + d.series_id
+            out += struct.pack(">H", len(d.fields))
+            for n, v in d.fields:
+                out += struct.pack(">H", len(n)) + n
+                out += struct.pack(">H", len(v)) + v
+        out += struct.pack(">I", len(self._fields))
+        for name in sorted(self._fields):
+            vocab, plists = self._fields[name]
+            out += struct.pack(">H", len(name)) + name
+            out += struct.pack(">I", len(vocab))
+            for v, pl in zip(vocab, plists):
+                out += struct.pack(">H", len(v)) + v
+                out += struct.pack(">I", len(pl)) + pl.astype(">u4").tobytes()
+        return bytes(out)
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "Segment":
+        off = 0
+        (n_docs,) = struct.unpack_from(">I", raw, off)
+        off += 4
+        docs = []
+        for i in range(n_docs):
+            (idlen,) = struct.unpack_from(">I", raw, off)
+            off += 4
+            sid = raw[off : off + idlen]
+            off += idlen
+            (nf,) = struct.unpack_from(">H", raw, off)
+            off += 2
+            fields = []
+            for _ in range(nf):
+                (ln,) = struct.unpack_from(">H", raw, off)
+                off += 2
+                name = raw[off : off + ln]
+                off += ln
+                (lv,) = struct.unpack_from(">H", raw, off)
+                off += 2
+                value = raw[off : off + lv]
+                off += lv
+                fields.append((name, value))
+            docs.append(Document(i, sid, fields))
+        (n_fields,) = struct.unpack_from(">I", raw, off)
+        off += 4
+        fields_map = {}
+        for _ in range(n_fields):
+            (ln,) = struct.unpack_from(">H", raw, off)
+            off += 2
+            name = raw[off : off + ln]
+            off += ln
+            (nv,) = struct.unpack_from(">I", raw, off)
+            off += 4
+            vocab, plists = [], []
+            for _ in range(nv):
+                (lv,) = struct.unpack_from(">H", raw, off)
+                off += 2
+                vocab.append(raw[off : off + lv])
+                off += lv
+                (np_len,) = struct.unpack_from(">I", raw, off)
+                off += 4
+                pl = np.frombuffer(raw, dtype=">u4", count=np_len, offset=off).astype(
+                    np.uint32
+                )
+                off += 4 * np_len
+                plists.append(pl)
+            fields_map[name] = (vocab, plists)
+        return cls(fields_map, docs)
+
+
+def merge_segments(segments: list[Segment]) -> Segment:
+    """Compaction: merge immutable segments, re-basing doc ids and deduping
+    series (the multi_segments_builder role,
+    /root/reference/src/m3ninx/index/segment/builder/multi_segments_builder.go)."""
+    out = MutableSegment()
+    for seg in segments:
+        for d in seg.docs:
+            out.insert(d.series_id, d.fields)
+    return out.seal()

@@ -21,6 +21,29 @@ import numpy as np
 _GROW = 1024
 
 
+def merge_dedup(times: np.ndarray, vbits: np.ndarray,
+                start_ns: int | None = None, end_ns: int | None = None):
+    """Stable sort by time + last-write-wins dedup (+ optional range filter).
+
+    The single definition of write-conflict resolution: later appends win on
+    timestamp ties, everywhere (buffer reads, seals, shard merges).
+    """
+    order = np.argsort(times, kind="stable")
+    times, vbits = times[order], vbits[order]
+    keep = np.ones(len(times), bool)
+    if len(times) > 1:
+        keep[:-1] = times[1:] != times[:-1]
+    times, vbits = times[keep], vbits[keep]
+    if start_ns is not None or end_ns is not None:
+        sel = np.ones(len(times), bool)
+        if start_ns is not None:
+            sel &= times >= start_ns
+        if end_ns is not None:
+            sel &= times < end_ns
+        times, vbits = times[sel], vbits[sel]
+    return times, vbits
+
+
 class _ColumnLog:
     """Growable (series_idx, time, value_bits) append log."""
 
@@ -112,16 +135,9 @@ class ShardBuffer:
             vb_parts.append(vbits[sel])
         if not ts_parts:
             return np.empty(0, np.int64), np.empty(0, np.uint64)
-        times = np.concatenate(ts_parts)
-        vbits = np.concatenate(vb_parts)
-        order = np.argsort(times, kind="stable")
-        times, vbits = times[order], vbits[order]
-        # last write wins per timestamp
-        keep = np.ones(len(times), bool)
-        keep[:-1] = times[1:] != times[:-1]
-        times, vbits = times[keep], vbits[keep]
-        sel = (times >= start_ns) & (times < end_ns)
-        return times[sel], vbits[sel]
+        return merge_dedup(
+            np.concatenate(ts_parts), np.concatenate(vb_parts), start_ns, end_ns
+        )
 
     # -- seal/flush path --
 

@@ -40,6 +40,10 @@ class Database:
         self.opts = db_opts or DatabaseOptions()
         self.namespaces: dict[str, Namespace] = {}
         self._commitlogs: dict[str, commitlog.CommitLogWriter] = {}
+        # block windows logged into the ACTIVE commitlog, per namespace
+        self._log_windows: dict[str, set[int]] = {}
+        # rotated logs awaiting deletion: ns -> [(path, windows-it-covers)]
+        self._retired_logs: dict[str, list[tuple[str, set[int]]]] = {}
         self._open = False
         self._shard_set = ShardSet(self.opts.n_shards)
 
@@ -68,28 +72,70 @@ class Database:
         self._commitlogs[namespace] = commitlog.CommitLogWriter(
             path, self.opts.commitlog_flush_every_bytes
         )
+        self._log_windows[namespace] = set()
 
-    def open(self) -> None:
+    def open(self, now_ns: int | None = None) -> None:
         """Open + bootstrap: filesets first, then commitlog replay on top
         (the fs -> commitlog bootstrapper order of the reference's default
         pipeline, storage/bootstrap/bootstrapper/README.md)."""
         self._open = True
+        now_ns = now_ns if now_ns is not None else time.time_ns()
         for name, ns in self.namespaces.items():
             if ns.opts.bootstrap_enabled:
-                ns.bootstrap_from_fs()
-                self._replay_commitlogs(name, ns)
+                ns.bootstrap_from_fs(now_ns)
+                self._replay_commitlogs(name, ns, now_ns)
             if ns.opts.writes_to_commitlog:
                 self._open_commitlog(name)
 
-    def _replay_commitlogs(self, name: str, ns: Namespace) -> None:
+    def _replay_commitlogs(self, name: str, ns: Namespace,
+                           now_ns: int | None = None) -> None:
+        """Replay every surviving log entry into the buffers. Entries whose
+        datapoints also live in a flushed volume are resolved by the normal
+        last-write-wins merge (and re-merged into a higher volume on the
+        next flush), so replay is safe to repeat; replayed files are retired
+        and deleted once every window they cover has flushed."""
+        from m3_tpu.utils.ident import decode_tags
+
+        retired = self._retired_logs.setdefault(name, [])
+        cutoff = None
+        if now_ns is not None:
+            r = ns.opts.retention
+            cutoff = r.block_start(now_ns - r.retention_ns)
         for path in commitlog.log_files(self.commitlog_dir(name)):
+            windows: set[int] = set()
             for e in commitlog.replay(path):
-                # skip datapoints already covered by a flushed volume
-                shard = ns.shard_for(e.series_id)
-                bs = ns.opts.retention.block_start(e.time_ns)
-                if bs in shard._filesets:
-                    continue
+                if cutoff is not None and e.time_ns < cutoff:
+                    continue  # past retention: don't resurrect
+                try:
+                    shard = ns.shard_for(e.series_id)
+                except KeyError:
+                    continue  # shard no longer owned by this node
+                windows.add(ns.opts.retention.block_start(e.time_ns))
                 shard.write(e.series_id, e.time_ns, e.value_bits, e.encoded_tags)
+                if ns.index is not None and e.encoded_tags:
+                    ns.index.insert(e.series_id, decode_tags(e.encoded_tags), e.time_ns)
+            retired.append((path, windows))
+
+    def _cleanup_retired_logs(self, name: str, ns: Namespace, now_ns: int) -> None:
+        r = ns.opts.retention
+        remaining = []
+        for path, windows in self._retired_logs.get(name, []):
+            covered = all(
+                (
+                    w + r.block_size_ns + r.buffer_past_ns <= now_ns
+                    and all(s.buffer.points_in(w) == 0 for s in ns.shards.values())
+                )
+                or w < r.block_start(now_ns - r.retention_ns)  # past retention
+                for w in windows
+            )
+            if covered:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            else:
+                remaining.append((path, windows))
+        self._retired_logs[name] = remaining
 
     def close(self) -> None:
         for log in self._commitlogs.values():
@@ -102,11 +148,52 @@ class Database:
     def write(self, namespace: str, series_id: bytes, t_ns: int, value: float,
               encoded_tags: bytes = b"") -> None:
         ns = self.namespaces[namespace]
+        shard = ns.shard_for(series_id)  # validate ownership BEFORE logging
         vbits = _f64_to_bits(value)
         log = self._commitlogs.get(namespace)
         if log is not None:
             log.write(series_id, encoded_tags, t_ns, vbits, int(ns.opts.write_time_unit))
-        ns.write(series_id, t_ns, vbits, encoded_tags)
+            self._log_windows[namespace].add(ns.opts.retention.block_start(t_ns))
+        shard.write(series_id, t_ns, vbits, encoded_tags)
+
+    def write_tagged(self, namespace: str, metric_name: bytes,
+                     tags: list[tuple[bytes, bytes]], t_ns: int, value: float) -> bytes:
+        """Write + index a datapoint; returns the canonical series id."""
+        from m3_tpu.utils.ident import encode_tags, tags_to_id
+
+        ns = self.namespaces[namespace]
+        fields = [(b"__name__", metric_name), *tags] if metric_name else list(tags)
+        series_id = tags_to_id(metric_name, tags)
+        ns.shard_for(series_id)  # validate ownership BEFORE logging
+        enc = encode_tags(fields)
+        vbits = _f64_to_bits(value)
+        log = self._commitlogs.get(namespace)
+        if log is not None:
+            log.write(series_id, enc, t_ns, vbits, int(ns.opts.write_time_unit))
+            self._log_windows[namespace].add(ns.opts.retention.block_start(t_ns))
+        ns.write_tagged(series_id, fields, t_ns, vbits, enc)
+        return series_id
+
+    def query(self, namespace: str, matchers, start_ns: int, end_ns: int,
+              limit: int | None = None):
+        """Index query + per-series reads: [(series_id, fields, [Datapoint])].
+
+        The QueryIDs -> ReadEncoded flow of the reference
+        (storage/database.go:1005,1068) collapsed into one call.
+        """
+        from m3_tpu.index.query import matchers_to_query
+
+        ns = self.namespaces[namespace]
+        docs = ns.query_ids(matchers_to_query(list(matchers)), start_ns, end_ns, limit)
+        out = []
+        for doc in docs:
+            times, vbits = ns.read(doc.series_id, start_ns, end_ns)
+            dps = [
+                Datapoint(int(t), float(v))
+                for t, v in zip(times, vbits.view(np.float64))
+            ]
+            out.append((doc.series_id, doc.fields, dps))
+        return out
 
     def read(self, namespace: str, series_id: bytes, start_ns: int, end_ns: int
              ) -> list[Datapoint]:
@@ -126,11 +213,22 @@ class Database:
             n = ns.flush(now_ns)
             flushed += n
             expired += ns.expire(now_ns)
+            if ns.index is not None:
+                ns.index.expire_before(
+                    ns.opts.retention.block_start(now_ns - ns.opts.retention.retention_ns)
+                )
             if n and name in self._commitlogs:
-                # flushed windows are durable in filesets; rotate the log so
-                # replay cost stays bounded (reference: snapshot + rotate)
-                self._commitlogs[name].close()
+                # flushed windows are durable in filesets: retire the active
+                # log (recording the windows it covers) and start a new one;
+                # retired logs are deleted once every window has flushed
+                old = self._commitlogs[name]
+                old.close()
+                self._retired_logs.setdefault(name, []).append(
+                    (old.path, self._log_windows.get(name, set()))
+                )
                 self._open_commitlog(name)
+            if name in self._commitlogs:
+                self._cleanup_retired_logs(name, ns, now_ns)
         return {"flushed": flushed, "expired": expired}
 
     def flush_all(self, now_ns: int | None = None) -> int:

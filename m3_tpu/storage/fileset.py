@@ -238,6 +238,10 @@ class FilesetReader:
         self._data_file.seek(off)
         return self._ids[i], self._tags[i], self._data_file.read(length)
 
+    def entry_at(self, i: int) -> tuple[bytes, bytes]:
+        """(id, encoded_tags) without touching the data file."""
+        return self._ids[i], self._tags[i]
+
     def tags_of(self, series_id: bytes) -> bytes | None:
         i = bisect_left(self._ids, series_id)
         if i < len(self._ids) and self._ids[i] == series_id:

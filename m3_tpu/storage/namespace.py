@@ -6,6 +6,8 @@ Role parity with the reference dbNamespace
 
 from __future__ import annotations
 
+from m3_tpu.index.index import NamespaceIndex
+from m3_tpu.index.query import Query
 from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions
 from m3_tpu.storage.shard import Shard
 from m3_tpu.storage.sharding import ShardSet
@@ -28,6 +30,9 @@ class Namespace:
             sid: Shard(sid, name, opts, db_opts, fs_root)
             for sid in shard_set.shard_ids
         }
+        self.index = (
+            NamespaceIndex(opts.index.block_size_ns) if opts.index.enabled else None
+        )
 
     def shard_for(self, series_id: bytes) -> Shard:
         sid = self.shard_set.lookup(series_id)
@@ -39,6 +44,20 @@ class Namespace:
     def write(self, series_id: bytes, t_ns: int, value_bits: int,
               encoded_tags: bytes = b"") -> None:
         self.shard_for(series_id).write(series_id, t_ns, value_bits, encoded_tags)
+
+    def write_tagged(self, series_id: bytes, tags: list[tuple[bytes, bytes]],
+                     t_ns: int, value_bits: int, encoded_tags: bytes = b"") -> None:
+        """Write + reverse-index the series in the datapoint's index block
+        (the writeAndIndex path, reference storage/shard.go:869-896)."""
+        self.shard_for(series_id).write(series_id, t_ns, value_bits, encoded_tags)
+        if self.index is not None:
+            self.index.insert(series_id, tags, t_ns)
+
+    def query_ids(self, query: Query, start_ns: int, end_ns: int, limit=None):
+        """Matched index docs for the time range (storage QueryIDs role)."""
+        if self.index is None:
+            raise RuntimeError(f"namespace {self.name} has no index enabled")
+        return self.index.query(query, start_ns, end_ns, limit)
 
     def read(self, series_id: bytes, start_ns: int, end_ns: int):
         return self.shard_for(series_id).read(series_id, start_ns, end_ns)
@@ -56,8 +75,26 @@ class Namespace:
     def expire(self, now_ns: int) -> int:
         return sum(s.expire(now_ns) for s in self.shards.values())
 
-    def bootstrap_from_fs(self) -> int:
-        n = sum(s.bootstrap_from_fs() for s in self.shards.values())
+    def bootstrap_from_fs(self, now_ns: int | None = None) -> int:
+        from m3_tpu.utils.ident import decode_tags
+
+        n = sum(s.bootstrap_from_fs(now_ns) for s in self.shards.values())
+        if self.index is not None:
+            # repopulate the reverse index from fileset tag blobs (the role
+            # of bootstrapping persisted index segments in the reference);
+            # a data block can span several index blocks, so the doc is
+            # inserted into every index block the data block overlaps
+            idx_bs = self.opts.index.block_size_ns
+            data_bs = self.opts.retention.block_size_ns
+            for s in self.shards.values():
+                for bs, reader in s._filesets.items():
+                    starts = range(bs - (bs % idx_bs), bs + data_bs, idx_bs)
+                    for i in range(reader.n_series):
+                        sid, tags_blob = reader.entry_at(i)
+                        if tags_blob:
+                            fields = decode_tags(tags_blob)
+                            for t in starts:
+                                self.index.insert(sid, fields, t)
         for s in self.shards.values():
             s.bootstrapped = True
         return n

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from m3_tpu.storage.buffer import ShardBuffer
+from m3_tpu.storage.buffer import ShardBuffer, merge_dedup
 from m3_tpu.storage.fileset import FilesetReader, FilesetWriter, list_filesets
 from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions
 
@@ -68,17 +68,10 @@ class Shard:
             parts_v.append(bv)
         if not parts_t:
             return np.empty(0, np.int64), np.empty(0, np.uint64)
-        times = np.concatenate(parts_t)
-        vbits = np.concatenate(parts_v)
-        # stable sort keeps append order within equal timestamps; buffer was
-        # appended last, so last-write(-location)-wins keeps buffer values
-        order = np.argsort(times, kind="stable")
-        times, vbits = times[order], vbits[order]
-        keep = np.ones(len(times), bool)
-        keep[:-1] = times[1:] != times[:-1]
-        times, vbits = times[keep], vbits[keep]
-        sel = (times >= start_ns) & (times < end_ns)
-        return times[sel], vbits[sel]
+        # buffer parts were appended last, so last-write-wins keeps them
+        return merge_dedup(
+            np.concatenate(parts_t), np.concatenate(parts_v), start_ns, end_ns
+        )
 
     def series_ids(self) -> set[bytes]:
         ids = set(self.buffer.series_ids)
@@ -137,14 +130,12 @@ class Shard:
                 )
                 old_t = np.array([d.timestamp_ns for d in dps], np.int64)
                 old_v = np.array([d.value for d in dps], np.float64).view(np.uint64)
-                nt = np.concatenate([old_t, times[k, : n_points[k]]])
-                nv = np.concatenate([old_v, vbits[k, : n_points[k]]])
-                order = np.argsort(nt, kind="stable")
-                nt, nv = nt[order], nv[order]
-                keep = np.ones(len(nt), bool)
-                keep[:-1] = nt[1:] != nt[:-1]
-                merged_t.append(nt[keep])
-                merged_v.append(nv[keep])
+                nt, nv = merge_dedup(
+                    np.concatenate([old_t, times[k, : n_points[k]]]),
+                    np.concatenate([old_v, vbits[k, : n_points[k]]]),
+                )
+                merged_t.append(nt)
+                merged_v.append(nv)
                 merged_n.append(k)
             if merged_n:
                 width = max(times.shape[1], max(len(t) for t in merged_t))
@@ -190,9 +181,17 @@ class Shard:
 
     # -- bootstrap --
 
-    def bootstrap_from_fs(self) -> int:
+    def bootstrap_from_fs(self, now_ns: int | None = None) -> int:
+        """Load complete volumes; expired ones are deleted, not loaded."""
+        r = self.opts.retention
+        cutoff = None
+        if now_ns is not None:
+            cutoff = r.block_start(now_ns - r.retention_ns)
         n = 0
         for block_start, volume in list_filesets(self.fs_root, self.namespace, self.shard_id):
+            if cutoff is not None and block_start < cutoff:
+                self._delete_fileset_files(block_start)
+                continue
             try:
                 reader = FilesetReader(
                     self.fs_root, self.namespace, self.shard_id, block_start, volume
@@ -205,8 +204,25 @@ class Shard:
 
     # -- maintenance --
 
+    def _delete_fileset_files(self, block_start: int) -> None:
+        import glob
+        import os
+
+        pattern = os.path.join(
+            self.fs_root, self.namespace, str(self.shard_id),
+            f"fileset-{block_start}-*.db",
+        )
+        # checkpoint first so a crash mid-delete leaves an "incomplete"
+        # (ignored) volume rather than a corrupt-looking one
+        paths = sorted(glob.glob(pattern), key=lambda p: "checkpoint" not in p)
+        for p in paths:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
     def expire(self, now_ns: int) -> int:
-        """Drop block volumes + buffered windows past retention."""
+        """Drop + delete block volumes and buffered windows past retention."""
         r = self.opts.retention
         cutoff = r.block_start(now_ns - r.retention_ns)
         dropped = 0
@@ -214,6 +230,7 @@ class Shard:
             if bs < cutoff:
                 self._filesets[bs].close()
                 del self._filesets[bs]
+                self._delete_fileset_files(bs)
                 dropped += 1
         self.buffer.expire_before(cutoff)
         return dropped

@@ -42,10 +42,16 @@ def decode_tags(data: bytes) -> list[tuple[bytes, bytes]]:
     return tags
 
 
+def _escape(b: bytes) -> bytes:
+    """Escape the ID separators so distinct tag sets can't collide."""
+    return b.replace(b"\\", b"\\\\").replace(b"|", b"\\|").replace(b"=", b"\\=")
+
+
 def tags_to_id(metric_name: bytes, tags: Iterable[tuple[bytes, bytes]]) -> bytes:
     """Canonical series ID from metric name + sorted tags (the role of
-    metric/id/m3 tag-aware IDs in the reference)."""
-    parts = [metric_name]
+    metric/id/m3 tag-aware IDs in the reference). Separators inside names/
+    values are escaped, making the encoding injective."""
+    parts = [_escape(metric_name)]
     for name, value in sorted(tags):
-        parts.append(name + b"=" + value)
+        parts.append(_escape(name) + b"=" + _escape(value))
     return b"|".join(parts)

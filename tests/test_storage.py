@@ -204,7 +204,7 @@ class TestDatabase:
 
         db2 = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
         db2.create_namespace("default", small_opts())
-        db2.open()
+        db2.open(START + 3 * HOUR)
         dps = db2.read("default", sid, START, START + HOUR)
         assert [d.value for d in dps] == [0.0, 1.0, 2.0, 3.0, 4.0]
         db2.close()
@@ -219,7 +219,7 @@ class TestDatabase:
 
         db2 = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
         db2.create_namespace("default", small_opts())
-        db2.open()
+        db2.open(START + HOUR)
         dps = db2.read("default", sid, START, START + HOUR)
         assert [(d.timestamp_ns, d.value) for d in dps] == [(START + 10**9, 42.0)]
         db2.close()
@@ -269,3 +269,86 @@ class TestDatabase:
         assert [(d.timestamp_ns - START) // 10**9 for d in dps] == [1, 5]
         assert [d.value for d in dps] == [1.0, 50.0]
         db.close()
+
+
+class TestReviewRegressions:
+    """Cases found by code-review probes."""
+
+    def test_late_write_survives_crash_after_flush(self, tmp_path):
+        # post-flush write into a flushed window must replay on restart
+        db = make_db(tmp_path)
+        sid = b"late"
+        db.write("default", sid, START + 10**9, 1.0)
+        db.tick(START + 3 * HOUR)  # flush window
+        db.write("default", sid, START + 2 * 10**9, 2.0)  # late write, same window
+        db._commitlogs["default"].flush()
+        db._commitlogs["default"]._f.close()  # crash
+
+        db2 = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+        db2.create_namespace("default", small_opts())
+        db2.open(START + 3 * HOUR)
+        dps = db2.read("default", sid, START, START + HOUR)
+        assert [d.value for d in dps] == [1.0, 2.0]
+        db2.close()
+
+    def test_retention_deletes_files_and_restart_respects_it(self, tmp_path):
+        db = make_db(tmp_path)
+        db.write("default", b"old", START + 10**9, 1.0)
+        db.flush_all()
+        far = START + 48 * HOUR
+        db.tick(far)
+        # files are gone from disk
+        shard_dirs = os.path.join(str(tmp_path / "db"), "data", "default")
+        remaining = [
+            f for d in os.listdir(shard_dirs)
+            for f in os.listdir(os.path.join(shard_dirs, d))
+        ]
+        assert remaining == []
+        db.close()
+        db2 = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+        db2.create_namespace("default", small_opts())
+        db2.open(far)
+        assert db2.read("default", b"old", START, START + HOUR) == []
+        db2.close()
+
+    def test_tags_to_id_no_collision(self):
+        a = tags_to_id(b"m", [(b"a", b"1|b=2")])
+        b = tags_to_id(b"m", [(b"a", b"1"), (b"b", b"2")])
+        assert a != b
+
+    def test_commitlogs_cleaned_after_flush(self, tmp_path):
+        db = make_db(tmp_path)
+        db.write("default", b"s", START + 10**9, 1.0)
+        db.tick(START + 3 * HOUR)  # flush + retire + cleanup
+        db.tick(START + 3 * HOUR + 1)  # second cleanup pass
+        logs = commitlog.log_files(db.commitlog_dir("default"))
+        assert len(logs) == 1  # only the fresh active log remains
+        db.close()
+
+    def test_unowned_shard_write_rejected_before_logging(self, tmp_path):
+        from m3_tpu.storage.sharding import ShardSet
+
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+        db.create_namespace("default", small_opts())
+        db.open()
+        # restrict ownership after open
+        ns = db.namespaces["default"]
+        ns.shard_set = ShardSet(4, shard_ids=(0,))
+        ns.shards = {0: ns.shards[0]}
+        sid_owned = None
+        rejected = 0
+        for i in range(20):
+            sid = f"s{i}".encode()
+            try:
+                db.write("default", sid, START + 10**9, 1.0)
+                sid_owned = sid
+            except KeyError:
+                rejected += 1
+        assert rejected > 0 and sid_owned is not None
+        db.close()
+        # restart with full ownership: no poison in the log
+        db2 = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+        db2.create_namespace("default", small_opts())
+        db2.open(START + HOUR)
+        assert db2.read("default", sid_owned, START, START + HOUR)
+        db2.close()
