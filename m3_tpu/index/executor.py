@@ -38,6 +38,10 @@ def search_segment(seg: Segment, query: Query) -> np.ndarray:
     if isinstance(query, NegationQuery):
         return P.difference(seg.postings_all(), search_segment(seg, query.inner))
     if isinstance(query, ConjunctionQuery):
+        if not query.queries:
+            # an empty conjunction would be the identity (match-all); that's
+            # never intentional from the query layer — reject it
+            raise ValueError("empty conjunction query")
         positives: list[np.ndarray] = []
         negatives: list[np.ndarray] = []
         for q in query.queries:

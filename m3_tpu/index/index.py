@@ -26,14 +26,13 @@ class IndexBlock:
         self._cache_docs = 0
 
     def insert(self, series_id: bytes, fields) -> None:
-        before = self.mutable.n_docs
         self.mutable.insert(series_id, fields)
-        if self.mutable.n_docs != before:
-            self._cache = None  # new doc invalidates the sealed view
 
     def segments(self) -> list[Segment]:
         segs = list(self.sealed)
         if self.mutable.n_docs:
+            # the doc-count check is the (single) cache invalidation: docs
+            # are only ever appended to a mutable segment
             if self._cache is None or self._cache_docs != self.mutable.n_docs:
                 self._cache = self.mutable.seal()
                 self._cache_docs = self.mutable.n_docs

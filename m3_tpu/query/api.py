@@ -1,0 +1,333 @@
+"""Coordinator HTTP API.
+
+Role parity with the reference coordinator surface
+(/root/reference/src/query/api/v1/httpd/handler.go:175-247): Prometheus
+remote write (snappy+protobuf), query/query_range, labels, label values,
+series, plus a JSON debug-write endpoint and health/ready. Runs on the
+stdlib threading HTTP server; each ingest batch lands through the same
+Database write path the TPU ingest pipeline uses.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from m3_tpu.index.query import Matcher, MatchType, matchers_to_query
+from m3_tpu.query.engine import Engine, Scalar, Vector
+from m3_tpu.query.windows import NS
+from m3_tpu.utils import protowire, snappy
+
+_MATCH_TYPE_BY_PROM = {
+    0: MatchType.EQUAL,
+    1: MatchType.NOT_EQUAL,
+    2: MatchType.REGEXP,
+    3: MatchType.NOT_REGEXP,
+}
+
+_SELECTOR_RE = re.compile(
+    r'\s*([a-zA-Z_:][a-zA-Z0-9_:]*)?\s*(\{.*\})?\s*$'
+)
+
+
+def _parse_time(s: str) -> int:
+    """Prometheus API time (unix seconds float or RFC3339) -> ns."""
+    try:
+        return int(float(s) * NS)
+    except ValueError:
+        pass
+    import datetime as dt
+
+    t = dt.datetime.fromisoformat(s.replace("Z", "+00:00"))
+    return int(t.timestamp() * NS)
+
+
+def _parse_step(s: str) -> int:
+    try:
+        return int(float(s) * NS)
+    except ValueError:
+        from m3_tpu.query.promql import parse_duration
+
+        return parse_duration(s)
+
+
+def _parse_series_selector(sel: str) -> list[Matcher]:
+    """'metric{a="b",c!~"d"}' -> matchers (for /series and remote read)."""
+    from m3_tpu.query.promql import Parser
+
+    p = Parser(sel)
+    vs = p.parse_atom()
+    from m3_tpu.query.promql import VectorSelector
+
+    if not isinstance(vs, VectorSelector) or p.peek().kind != "EOF":
+        raise ValueError(f"invalid series selector {sel!r}")
+    return vs.matchers
+
+
+def _fmt_value(v: float) -> str:
+    if np.isnan(v):
+        return "NaN"
+    if np.isposinf(v):
+        return "+Inf"
+    if np.isneginf(v):
+        return "-Inf"
+    return repr(float(v))
+
+
+class CoordinatorAPI:
+    """HTTP facade over a Database + PromQL Engine."""
+
+    def __init__(self, db, namespace: str = "default"):
+        self.db = db
+        self.namespace = namespace
+        self.engine = Engine(db, namespace)
+        self._server: ThreadingHTTPServer | None = None
+
+    # -- request handling --
+
+    def handle(self, method: str, path: str, query: dict, body: bytes):
+        """Returns (status, content_type, payload)."""
+        try:
+            return self._route(method, path, query, body)
+        except Exception as e:  # surface as prometheus-style error envelope
+            return 400, "application/json", json.dumps(
+                {"status": "error", "errorType": "bad_data", "error": str(e)}
+            ).encode()
+
+    def _route(self, method, path, q, body):
+        if path in ("/health", "/ready"):
+            return 200, "application/json", b'{"ok":true}'
+        if path == "/api/v1/prom/remote/write" and method == "POST":
+            return self._remote_write(body)
+        if path == "/api/v1/prom/remote/read" and method == "POST":
+            return self._remote_read(body)
+        if path == "/api/v1/json/write" and method == "POST":
+            return self._json_write(body)
+        if path == "/api/v1/query_range":
+            return self._query_range(q)
+        if path == "/api/v1/query":
+            return self._query_instant(q)
+        if path == "/api/v1/labels":
+            return self._labels(q)
+        m = re.fullmatch(r"/api/v1/label/([^/]+)/values", path)
+        if m:
+            return self._label_values(m.group(1), q)
+        if path == "/api/v1/series":
+            return self._series(q)
+        return 404, "application/json", json.dumps(
+            {"status": "error", "error": f"unknown path {path}"}
+        ).encode()
+
+    # -- ingest --
+
+    def _remote_write(self, body: bytes):
+        payload = snappy.decompress(body)
+        series = protowire.decode_write_request(payload)
+        n = 0
+        for ts in series:
+            name = b""
+            tags = []
+            for k, v in ts.labels:
+                if k == b"__name__":
+                    name = v
+                else:
+                    tags.append((k, v))
+            for ts_ms, value in ts.samples:
+                self.db.write_tagged(self.namespace, name, tags, ts_ms * 1_000_000, value)
+                n += 1
+        return 200, "application/json", json.dumps({"status": "success", "samples": n}).encode()
+
+    def _json_write(self, body: bytes):
+        doc = json.loads(body)
+        tags = [(k.encode(), v.encode()) for k, v in sorted(doc.get("tags", {}).items())]
+        name = doc.get("metric", "").encode()
+        t_ns = int(doc["timestamp"] * NS) if "timestamp" in doc else None
+        if t_ns is None:
+            import time
+
+            t_ns = time.time_ns()
+        self.db.write_tagged(self.namespace, name, tags, t_ns, float(doc["value"]))
+        return 200, "application/json", b'{"status":"success"}'
+
+    # -- read --
+
+    def _remote_read(self, body: bytes):
+        queries = protowire.decode_read_request(snappy.decompress(body))
+        results = []
+        for q in queries:
+            matchers = [
+                Matcher(_MATCH_TYPE_BY_PROM[m.type], m.name, m.value)
+                for m in q.matchers
+            ]
+            res = self.db.query(
+                self.namespace, matchers, q.start_ms * 1_000_000,
+                q.end_ms * 1_000_000 + 1,
+            )
+            out = []
+            for sid, fields, dps in res:
+                out.append(
+                    protowire.PromTimeSeries(
+                        labels=sorted(fields),
+                        samples=[(d.timestamp_ns // 1_000_000, d.value) for d in dps],
+                    )
+                )
+            results.append(out)
+        payload = snappy.compress(protowire.encode_read_response(results))
+        return 200, "application/x-protobuf", payload
+
+    def _query_range(self, q):
+        expr = q["query"][0]
+        start = _parse_time(q["start"][0])
+        end = _parse_time(q["end"][0])
+        step = _parse_step(q["step"][0])
+        result, eval_ts = self.engine.query_range(expr, start, end, step)
+        return 200, "application/json", self._render(result, eval_ts, matrix=True)
+
+    def _query_instant(self, q):
+        expr = q["query"][0]
+        t = _parse_time(q["time"][0]) if "time" in q else None
+        if t is None:
+            import time as _time
+
+            t = _time.time_ns()
+        result, eval_ts = self.engine.query_instant(expr, t)
+        return 200, "application/json", self._render(result, eval_ts, matrix=False)
+
+    def _render(self, result, eval_ts, matrix: bool):
+        ts_sec = eval_ts.astype(np.float64) / NS
+        if isinstance(result, Scalar):
+            if matrix:
+                data = {
+                    "resultType": "matrix",
+                    "result": [
+                        {
+                            "metric": {},
+                            "values": [
+                                [t, _fmt_value(v)]
+                                for t, v in zip(ts_sec, result.values)
+                                if not np.isnan(v)
+                            ],
+                        }
+                    ],
+                }
+            else:
+                data = {
+                    "resultType": "scalar",
+                    "result": [ts_sec[0], _fmt_value(result.values[0])],
+                }
+        elif isinstance(result, Vector):
+            if matrix:
+                out = []
+                for i, lb in enumerate(result.labels):
+                    values = [
+                        [t, _fmt_value(v)]
+                        for t, v in zip(ts_sec, result.values[i])
+                        if not np.isnan(v)
+                    ]
+                    if values:
+                        out.append(
+                            {
+                                "metric": {
+                                    k.decode(): v.decode() for k, v in lb.items()
+                                },
+                                "values": values,
+                            }
+                        )
+                data = {"resultType": "matrix", "result": out}
+            else:
+                out = []
+                for i, lb in enumerate(result.labels):
+                    v = result.values[i, 0]
+                    if not np.isnan(v):
+                        out.append(
+                            {
+                                "metric": {
+                                    k.decode(): val.decode() for k, val in lb.items()
+                                },
+                                "value": [ts_sec[0], _fmt_value(v)],
+                            }
+                        )
+                data = {"resultType": "vector", "result": out}
+        else:
+            data = {"resultType": "string", "result": [ts_sec[0], result.value]}
+        return json.dumps({"status": "success", "data": data}).encode()
+
+    def _time_range(self, q):
+        ns = self.db.namespaces[self.namespace]
+        start = _parse_time(q["start"][0]) if "start" in q else 0
+        end = _parse_time(q["end"][0]) if "end" in q else (1 << 62)
+        return ns, start, end
+
+    def _labels(self, q):
+        ns, start, end = self._time_range(q)
+        names = [n.decode() for n in ns.index.aggregate_field_names(start, end)]
+        return 200, "application/json", json.dumps(
+            {"status": "success", "data": names}
+        ).encode()
+
+    def _label_values(self, name, q):
+        ns, start, end = self._time_range(q)
+        vals = [
+            v.decode()
+            for v in ns.index.aggregate_field_values(name.encode(), start, end)
+        ]
+        return 200, "application/json", json.dumps(
+            {"status": "success", "data": vals}
+        ).encode()
+
+    def _series(self, q):
+        ns, start, end = self._time_range(q)
+        out = []
+        for sel in q.get("match[]", []):
+            matchers = _parse_series_selector(sel)
+            for doc in ns.query_ids(matchers_to_query(matchers), start, end):
+                out.append({k.decode(): v.decode() for k, v in doc.fields})
+        return 200, "application/json", json.dumps(
+            {"status": "success", "data": out}
+        ).encode()
+
+    # -- server lifecycle --
+
+    def serve(self, host: str = "127.0.0.1", port: int = 7201) -> int:
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _do(self, method):
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                if method == "POST" and self.headers.get(
+                    "Content-Type", ""
+                ).startswith("application/x-www-form-urlencoded"):
+                    q = {**parse_qs(body.decode()), **q}
+                status, ctype, payload = api.handle(method, u.path, q, body)
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802
+                self._do("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._do("POST")
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        thread.start()
+        return self._server.server_address[1]
+
+    def shutdown(self):
+        if self._server:
+            self._server.shutdown()
+            self._server = None

@@ -1,0 +1,775 @@
+"""PromQL evaluation engine.
+
+Role parity with the reference executor + function library
+(/root/reference/src/query/executor/engine.go:111, functions/*): parse to an
+AST (promql.py), then evaluate bottom-up over columnar [series x steps]
+value matrices — every operator is a whole-matrix transform (the reference
+streams per-series blocks through transform nodes; here the step grid is one
+tensor program, the layout the TPU path consumes directly).
+
+Numeric semantics follow upstream Prometheus: 5m lookback staleness,
+extrapolated rates, population stddev, interpolated quantiles, bucket
+interpolation for histogram_quantile, vector matching with __name__ excluded.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+
+import numpy as np
+
+from m3_tpu.query import promql, windows
+from m3_tpu.query.promql import (
+    AggregateExpr,
+    BinaryExpr,
+    Call,
+    Expr,
+    MatrixSelector,
+    NumberLiteral,
+    StringLiteral,
+    UnaryExpr,
+    VectorMatching,
+    VectorSelector,
+)
+from m3_tpu.query.windows import NS, RaggedSeries
+
+DEFAULT_LOOKBACK_NS = 5 * 60 * NS
+
+# functions that keep the metric name on their output
+_KEEPS_NAME = {"sort", "sort_desc", "last_over_time"}
+
+
+class EvalError(ValueError):
+    pass
+
+
+@dataclass
+class Vector:
+    """Evaluated instant-vector-per-step matrix."""
+
+    labels: list[dict[bytes, bytes]]  # per series
+    values: np.ndarray  # [S, n_steps]; NaN = no sample
+
+    def drop_name(self) -> "Vector":
+        return Vector(
+            [{k: v for k, v in lb.items() if k != b"__name__"} for lb in self.labels],
+            self.values,
+        )
+
+
+@dataclass
+class Scalar:
+    values: np.ndarray  # [n_steps]
+
+
+@dataclass
+class StringValue:
+    value: str
+
+
+class Engine:
+    """Evaluates PromQL over a storage database namespace."""
+
+    def __init__(self, db, namespace: str = "default",
+                 lookback_ns: int = DEFAULT_LOOKBACK_NS):
+        self.db = db
+        self.namespace = namespace
+        self.lookback_ns = lookback_ns
+
+    # -- public API --
+
+    def query_range(self, q: str, start_ns: int, end_ns: int, step_ns: int):
+        if step_ns <= 0:
+            raise EvalError("step must be positive")
+        eval_ts = np.arange(start_ns, end_ns + 1, step_ns, dtype=np.int64)
+        expr = promql.parse(q)
+        return self._eval(expr, eval_ts), eval_ts
+
+    def query_instant(self, q: str, t_ns: int):
+        eval_ts = np.array([t_ns], dtype=np.int64)
+        expr = promql.parse(q)
+        return self._eval(expr, eval_ts), eval_ts
+
+    # -- fetch --
+
+    def _fetch(self, sel: VectorSelector, eval_ts: np.ndarray, range_ns: int):
+        """(labels, RaggedSeries) for samples covering the windows."""
+        shifted = eval_ts - sel.offset_ns
+        t_min = int(shifted[0]) - max(range_ns, self.lookback_ns)
+        t_max = int(shifted[-1]) + 1
+        ns = self.db.namespaces[self.namespace]
+        from m3_tpu.index.query import matchers_to_query
+
+        docs = ns.query_ids(matchers_to_query(sel.matchers), t_min, t_max)
+        labels = []
+        per_series = []
+        for doc in docs:
+            times, vbits = ns.read(doc.series_id, t_min, t_max)
+            if len(times) == 0:
+                continue
+            labels.append(dict(doc.fields))
+            per_series.append((times, vbits.view(np.float64)))
+        return labels, RaggedSeries.from_lists(per_series)
+
+    # -- evaluation --
+
+    def _eval(self, e: Expr, eval_ts: np.ndarray):
+        if isinstance(e, NumberLiteral):
+            return Scalar(np.full(len(eval_ts), e.value))
+        if isinstance(e, StringLiteral):
+            return StringValue(e.value)
+        if isinstance(e, VectorSelector):
+            labels, raws = self._fetch(e, eval_ts, 0)
+            vals = windows.instant_values(raws, eval_ts - e.offset_ns, self.lookback_ns)
+            return _compact(Vector(labels, vals))
+        if isinstance(e, MatrixSelector):
+            raise EvalError("range vector must be an argument of a function")
+        if isinstance(e, UnaryExpr):
+            v = self._eval(e.expr, eval_ts)
+            if e.op == "-":
+                if isinstance(v, Scalar):
+                    return Scalar(-v.values)
+                return Vector(v.drop_name().labels, -v.values)
+            return v
+        if isinstance(e, Call):
+            return self._eval_call(e, eval_ts)
+        if isinstance(e, AggregateExpr):
+            return self._eval_aggregate(e, eval_ts)
+        if isinstance(e, BinaryExpr):
+            return self._eval_binary(e, eval_ts)
+        raise EvalError(f"cannot evaluate {type(e).__name__}")
+
+    # -- functions --
+
+    _RANGE_FNS = {
+        "rate": ("extrap", True, True),
+        "increase": ("extrap", True, False),
+        "delta": ("extrap", False, False),
+        "irate": ("instant", True, True),
+        "idelta": ("instant", False, False),
+    }
+    _OVER_TIME = {
+        "avg_over_time": "avg",
+        "sum_over_time": "sum",
+        "count_over_time": "count",
+        "min_over_time": "min",
+        "max_over_time": "max",
+        "last_over_time": "last",
+        "stddev_over_time": "stddev",
+        "stdvar_over_time": "stdvar",
+        "present_over_time": "present",
+        "changes": "changes",
+        "resets": "resets",
+    }
+    _MATH = {
+        "abs": np.abs,
+        "ceil": np.ceil,
+        "floor": np.floor,
+        "exp": np.exp,
+        "ln": np.log,
+        "log2": np.log2,
+        "log10": np.log10,
+        "sqrt": np.sqrt,
+        "sgn": np.sign,
+        "deg": np.degrees,
+        "rad": np.radians,
+        "sin": np.sin,
+        "cos": np.cos,
+        "tan": np.tan,
+        "asin": np.arcsin,
+        "acos": np.arccos,
+        "atan": np.arctan,
+        "sinh": np.sinh,
+        "cosh": np.cosh,
+        "tanh": np.tanh,
+    }
+
+    def _range_arg(self, e: Call, idx: int = 0) -> MatrixSelector:
+        if len(e.args) <= idx or not isinstance(e.args[idx], MatrixSelector):
+            raise EvalError(f"{e.func}() expects a range vector argument")
+        return e.args[idx]
+
+    def _eval_call(self, e: Call, eval_ts: np.ndarray):
+        fn = e.func
+        if fn in self._RANGE_FNS:
+            kind, is_counter, is_rate = self._RANGE_FNS[fn]
+            ms = self._range_arg(e)
+            labels, raws = self._fetch(ms.selector, eval_ts, ms.range_ns)
+            shifted = eval_ts - ms.selector.offset_ns
+            if kind == "extrap":
+                vals = windows.extrapolated_rate(raws, shifted, ms.range_ns,
+                                                 is_counter, is_rate)
+            else:
+                vals = windows.instant_delta(raws, shifted, ms.range_ns,
+                                             is_counter, is_rate)
+            return _compact(Vector(labels, vals).drop_name())
+        if fn in self._OVER_TIME:
+            ms = self._range_arg(e)
+            labels, raws = self._fetch(ms.selector, eval_ts, ms.range_ns)
+            shifted = eval_ts - ms.selector.offset_ns
+            vals = windows.over_time(self._OVER_TIME[fn], raws, shifted, ms.range_ns)
+            out = Vector(labels, vals)
+            return _compact(out if fn in _KEEPS_NAME else out.drop_name())
+        if fn == "quantile_over_time":
+            phi = self._scalar_param(e.args[0], eval_ts)
+            ms = self._range_arg(e, 1)
+            labels, raws = self._fetch(ms.selector, eval_ts, ms.range_ns)
+            shifted = eval_ts - ms.selector.offset_ns
+            vals = _quantile_over_time(raws, shifted, ms.range_ns, phi)
+            return _compact(Vector(labels, vals).drop_name())
+        if fn in ("deriv", "predict_linear"):
+            ms = self._range_arg(e)
+            labels, raws = self._fetch(ms.selector, eval_ts, ms.range_ns)
+            shifted = eval_ts - ms.selector.offset_ns
+            off = None
+            if fn == "predict_linear":
+                off = self._scalar_param(e.args[1], eval_ts)
+            vals = windows.linear_regression(raws, shifted, ms.range_ns, off)
+            return _compact(Vector(labels, vals).drop_name())
+        if fn in self._MATH:
+            v = self._eval(e.args[0], eval_ts)
+            if isinstance(v, Scalar):
+                return Scalar(self._MATH[fn](v.values))
+            return Vector(v.drop_name().labels, self._MATH[fn](v.values))
+        if fn == "round":
+            v = self._eval(e.args[0], eval_ts)
+            to = self._scalar_param(e.args[1], eval_ts) if len(e.args) > 1 else 1.0
+            # round half away from... Prometheus rounds half up via floor(v/to+0.5)
+            vals = np.floor(v.values / to + 0.5) * to
+            return Vector(v.drop_name().labels, vals)
+        if fn in ("clamp", "clamp_min", "clamp_max"):
+            v = self._eval(e.args[0], eval_ts)
+            vals = v.values
+            if fn == "clamp":
+                lo = self._scalar_param(e.args[1], eval_ts)
+                hi = self._scalar_param(e.args[2], eval_ts)
+                vals = np.clip(vals, lo, hi)
+            elif fn == "clamp_min":
+                vals = np.maximum(vals, self._scalar_param(e.args[1], eval_ts))
+            else:
+                vals = np.minimum(vals, self._scalar_param(e.args[1], eval_ts))
+            return Vector(v.drop_name().labels, vals)
+        if fn == "scalar":
+            v = self._eval(e.args[0], eval_ts)
+            if not isinstance(v, Vector):
+                raise EvalError("scalar() expects an instant vector")
+            n_valid = (~np.isnan(v.values)).sum(axis=0)
+            one = (n_valid == 1)
+            summed = np.nansum(v.values, axis=0)
+            return Scalar(np.where(one, summed, np.nan))
+        if fn == "vector":
+            s = self._eval(e.args[0], eval_ts)
+            if not isinstance(s, Scalar):
+                raise EvalError("vector() expects a scalar")
+            return Vector([{}], s.values[None, :])
+        if fn == "time":
+            return Scalar(eval_ts.astype(np.float64) / NS)
+        if fn == "timestamp":
+            v = self._eval(e.args[0], eval_ts)
+            ts = np.broadcast_to(eval_ts.astype(np.float64) / NS, v.values.shape)
+            return Vector(v.drop_name().labels, np.where(np.isnan(v.values), np.nan, ts))
+        if fn == "absent":
+            v = self._eval(e.args[0], eval_ts)
+            present = (~np.isnan(v.values)).any(axis=0) if len(v.labels) else np.zeros(
+                len(eval_ts), bool
+            )
+            lbls = _absent_labels(e.args[0])
+            return Vector([lbls], np.where(present, np.nan, 1.0)[None, :])
+        if fn == "histogram_quantile":
+            phi = self._scalar_param(e.args[0], eval_ts)
+            v = self._eval(e.args[1], eval_ts)
+            return _histogram_quantile(phi, v)
+        if fn == "label_replace":
+            v = self._eval(e.args[0], eval_ts)
+            dst, repl, src, rx = (a.value for a in e.args[1:5])
+            pattern = re.compile(rx)
+            # RE2 $1/${name} replacement syntax -> Python \1/\g<name>
+            py_repl = re.sub(
+                r"\$(\d+|\{(\w+)\})",
+                lambda m: f"\\g<{m.group(2)}>" if m.group(2) else f"\\{m.group(1)}",
+                repl.replace("$$", "\x00"),
+            ).replace("\x00", "$")
+            out_labels = []
+            for lb in v.labels:
+                lb = dict(lb)
+                val = lb.get(src.encode(), b"").decode()
+                m = pattern.fullmatch(val)
+                if m:
+                    new = m.expand(py_repl).encode() if repl else b""
+                    if new:
+                        lb[dst.encode()] = new
+                    else:
+                        lb.pop(dst.encode(), None)
+                out_labels.append(lb)
+            return Vector(out_labels, v.values)
+        if fn == "label_join":
+            v = self._eval(e.args[0], eval_ts)
+            dst = e.args[1].value
+            sep = e.args[2].value
+            srcs = [a.value for a in e.args[3:]]
+            out_labels = []
+            for lb in v.labels:
+                lb = dict(lb)
+                joined = sep.join(lb.get(s.encode(), b"").decode() for s in srcs)
+                if joined:
+                    lb[dst.encode()] = joined.encode()
+                else:
+                    lb.pop(dst.encode(), None)
+                out_labels.append(lb)
+            return Vector(out_labels, v.values)
+        if fn in ("sort", "sort_desc"):
+            v = self._eval(e.args[0], eval_ts)
+            if len(v.labels) and v.values.shape[1]:
+                key = np.where(np.isnan(v.values[:, -1]), -np.inf, v.values[:, -1])
+                order = np.argsort(-key if fn == "sort_desc" else key, kind="stable")
+                return Vector([v.labels[i] for i in order], v.values[order])
+            return v
+        raise EvalError(f"unknown function {fn}()")
+
+    def _scalar_param(self, e: Expr, eval_ts: np.ndarray) -> float:
+        v = self._eval(e, eval_ts)
+        if isinstance(v, Scalar):
+            return float(v.values[0])
+        raise EvalError("expected scalar parameter")
+
+    # -- aggregation --
+
+    def _eval_aggregate(self, e: AggregateExpr, eval_ts: np.ndarray):
+        v = self._eval(e.expr, eval_ts)
+        if not isinstance(v, Vector):
+            raise EvalError(f"{e.op} expects an instant vector")
+        S, T = v.values.shape if len(v.labels) else (0, len(eval_ts))
+        # group keys
+        keys = []
+        out_labels_for = {}
+        for lb in v.labels:
+            if e.without:
+                kept = {
+                    k: val for k, val in lb.items()
+                    if k != b"__name__" and k.decode() not in e.grouping
+                }
+            elif e.grouping:
+                kept = {
+                    k: val for k, val in lb.items() if k.decode() in e.grouping
+                }
+            else:
+                kept = {}
+            key = tuple(sorted(kept.items()))
+            keys.append(key)
+            out_labels_for[key] = kept
+        uniq = sorted(set(keys))
+        gid = {k: i for i, k in enumerate(uniq)}
+        groups = np.array([gid[k] for k in keys], np.int64) if keys else np.empty(0, np.int64)
+        G = len(uniq)
+        vals = v.values if S else np.zeros((0, T))
+        nan = np.isnan(vals)
+        filled0 = np.where(nan, 0.0, vals)
+
+        def seg(arr, init=0.0, op="add"):
+            out = np.full((G, T), init)
+            if op == "add":
+                np.add.at(out, groups, arr)
+            elif op == "min":
+                np.minimum.at(out, groups, arr)
+            elif op == "max":
+                np.maximum.at(out, groups, arr)
+            return out
+
+        count = seg((~nan).astype(np.float64))
+        any_present = count > 0
+        op = e.op
+        if op in ("sum", "avg", "stddev", "stdvar"):
+            s1 = seg(filled0)
+            if op == "sum":
+                out = s1
+            else:
+                mean = s1 / np.where(any_present, count, 1)
+                if op == "avg":
+                    out = mean
+                else:
+                    s2 = seg(np.where(nan, 0.0, vals * vals))
+                    var = np.maximum(s2 / np.where(any_present, count, 1) - mean**2, 0)
+                    out = var if op == "stdvar" else np.sqrt(var)
+        elif op == "count":
+            out = count
+        elif op == "min":
+            out = seg(np.where(nan, np.inf, vals), np.inf, "min")
+        elif op == "max":
+            out = seg(np.where(nan, -np.inf, vals), -np.inf, "max")
+        elif op == "group":
+            out = np.ones((G, T))
+        elif op == "quantile":
+            phi = self._scalar_param(e.param, eval_ts)
+            out = np.full((G, T), np.nan)
+            for g in range(G):
+                sub = vals[groups == g]
+                out[g] = _quantile_cols(sub, phi)
+        elif op in ("topk", "bottomk"):
+            k = int(self._scalar_param(e.param, eval_ts))
+            keep = np.zeros_like(vals, dtype=bool)
+            for g in range(G):
+                rows = np.nonzero(groups == g)[0]
+                sub = vals[rows]
+                for t in range(T):
+                    col = sub[:, t]
+                    valid = np.nonzero(~np.isnan(col))[0]
+                    if len(valid) == 0:
+                        continue
+                    order = np.argsort(col[valid], kind="stable")
+                    sel = (order[::-1] if op == "topk" else order)[:k]
+                    keep[rows[valid[sel]], t] = True
+            return _compact(Vector(
+                [dict(lb) for lb in v.labels], np.where(keep, vals, np.nan)
+            ))
+        elif op == "count_values":
+            if not isinstance(e.param, StringLiteral) and not isinstance(
+                self._eval(e.param, eval_ts), StringValue
+            ):
+                raise EvalError("count_values expects a string label parameter")
+            label = (
+                e.param.value if isinstance(e.param, StringLiteral)
+                else self._eval(e.param, eval_ts).value
+            ).encode()
+            bucket: dict[tuple, np.ndarray] = {}
+            out_lbls: dict[tuple, dict] = {}
+            for s in range(S):
+                for t in range(T):
+                    x = vals[s, t]
+                    if np.isnan(x):
+                        continue
+                    vkey = keys[s] + ((label, _fmt(x).encode()),)
+                    if vkey not in bucket:
+                        bucket[vkey] = np.full(T, np.nan)
+                        lb = dict(out_labels_for[keys[s]])
+                        lb[label] = _fmt(x).encode()
+                        out_lbls[vkey] = lb
+                    cur = bucket[vkey][t]
+                    bucket[vkey][t] = 1.0 if np.isnan(cur) else cur + 1.0
+            ks = sorted(bucket)
+            return Vector([out_lbls[k] for k in ks],
+                          np.stack([bucket[k] for k in ks]) if ks else np.zeros((0, T)))
+        else:
+            raise EvalError(f"unknown aggregator {op}")
+        out = np.where(any_present, out, np.nan)
+        return _compact(Vector([dict(out_labels_for[k]) for k in uniq], out))
+
+    # -- binary ops --
+
+    def _eval_binary(self, e: BinaryExpr, eval_ts: np.ndarray):
+        lhs = self._eval(e.lhs, eval_ts)
+        rhs = self._eval(e.rhs, eval_ts)
+        op = e.op
+        if isinstance(lhs, Scalar) and isinstance(rhs, Scalar):
+            out = _apply_op(op, lhs.values, rhs.values)
+            if op in promql.COMPARISONS:
+                if not e.bool_mode:
+                    raise EvalError("comparisons between scalars must use bool")
+                out = out.astype(np.float64)
+            return Scalar(out)
+        if op in ("and", "or", "unless"):
+            return self._set_op(op, lhs, rhs, e.matching)
+        if isinstance(lhs, Scalar) or isinstance(rhs, Scalar):
+            vec, sc = (rhs, lhs) if isinstance(lhs, Scalar) else (lhs, rhs)
+            swapped = isinstance(lhs, Scalar)
+            a = sc.values[None, :] if swapped else vec.values
+            b = vec.values if swapped else sc.values[None, :]
+            raw = _apply_op(op, a, b)
+            if op in promql.COMPARISONS:
+                if e.bool_mode:
+                    vals = np.where(np.isnan(vec.values), np.nan, raw.astype(np.float64))
+                    return _compact(Vector(vec.drop_name().labels, vals))
+                vals = np.where(raw.astype(bool), vec.values, np.nan)
+                return _compact(Vector(vec.labels, vals))
+            return _compact(Vector(vec.drop_name().labels, raw))
+        # vector-vector
+        return self._vector_binary(e, lhs, rhs)
+
+    def _match_key(self, lb: dict, matching: VectorMatching | None):
+        if matching and matching.on:
+            items = [(k, lb[k]) for k in sorted(l.encode() for l in matching.labels)
+                     if k in lb]
+        else:
+            excl = {b"__name__"}
+            if matching:
+                excl |= {l.encode() for l in matching.labels}
+            items = sorted((k, v) for k, v in lb.items() if k not in excl)
+        return tuple(items)
+
+    def _set_op(self, op, lhs, rhs, matching):
+        if not isinstance(lhs, Vector) or not isinstance(rhs, Vector):
+            raise EvalError(f"set operator {op} requires vectors")
+        rkeys = {self._match_key(lb, matching): i for i, lb in enumerate(rhs.labels)}
+        T = lhs.values.shape[1] if len(lhs.labels) else rhs.values.shape[1] if len(rhs.labels) else 0
+        if op == "and":
+            out_l, out_v = [], []
+            for i, lb in enumerate(lhs.labels):
+                j = rkeys.get(self._match_key(lb, matching))
+                if j is not None:
+                    mask = ~np.isnan(rhs.values[j])
+                    out_l.append(lb)
+                    out_v.append(np.where(mask, lhs.values[i], np.nan))
+            return _compact(Vector(out_l, np.stack(out_v) if out_v else np.zeros((0, T))))
+        if op == "unless":
+            out_l, out_v = [], []
+            for i, lb in enumerate(lhs.labels):
+                j = rkeys.get(self._match_key(lb, matching))
+                vals = lhs.values[i]
+                if j is not None:
+                    vals = np.where(np.isnan(rhs.values[j]), vals, np.nan)
+                out_l.append(lb)
+                out_v.append(vals)
+            return _compact(Vector(out_l, np.stack(out_v) if out_v else np.zeros((0, T))))
+        # or
+        out_l = [dict(lb) for lb in lhs.labels]
+        out_v = [lhs.values[i] for i in range(len(lhs.labels))]
+        lkeys = {self._match_key(lb, matching) for lb in lhs.labels}
+        lcover = {}
+        for i, lb in enumerate(lhs.labels):
+            k = self._match_key(lb, matching)
+            cov = ~np.isnan(lhs.values[i])
+            lcover[k] = cov | lcover.get(k, np.zeros_like(cov))
+        for j, lb in enumerate(rhs.labels):
+            k = self._match_key(lb, matching)
+            if k not in lkeys:
+                out_l.append(dict(lb))
+                out_v.append(rhs.values[j])
+            else:
+                gap = np.isnan(rhs.values[j]) | lcover[k]
+                extra = np.where(gap, np.nan, rhs.values[j])
+                if not np.isnan(extra).all():
+                    out_l.append(dict(lb))
+                    out_v.append(extra)
+        return _compact(Vector(out_l, np.stack(out_v) if out_v else np.zeros((0, T))))
+
+    def _vector_binary(self, e: BinaryExpr, lhs: Vector, rhs: Vector):
+        m = e.matching
+        group_left = m.group_left if m else False
+        group_right = m.group_right if m else False
+        if group_right:
+            # evaluate as mirrored group_left
+            sym = {"<": ">", ">": "<", "<=": ">=", ">=": "<=",
+                   "/": None, "-": None, "%": None, "^": None}
+            swapped_op = sym.get(e.op, e.op)
+            if swapped_op is None:
+                lhs, rhs = rhs, lhs  # keep op, swap operand roles manually below
+                group_left, group_right = True, False
+                flip = True
+            else:
+                lhs, rhs = rhs, lhs
+                e = BinaryExpr(swapped_op, e.lhs, e.rhs, e.bool_mode, e.matching)
+                group_left, group_right = True, False
+                flip = False
+        else:
+            flip = False
+
+        rmap: dict[tuple, int] = {}
+        for j, lb in enumerate(rhs.labels):
+            k = self._match_key(lb, m)
+            if k in rmap:
+                raise EvalError("many-to-many vector matching: duplicate series on 'one' side")
+            rmap[k] = j
+        out_l, out_v = [], []
+        seen: dict[tuple, int] = {}
+        for i, lb in enumerate(lhs.labels):
+            k = self._match_key(lb, m)
+            j = rmap.get(k)
+            if j is None:
+                continue
+            if not group_left:
+                if k in seen:
+                    raise EvalError("many-to-one matching requires group_left/group_right")
+                seen[k] = i
+            a, b = lhs.values[i], rhs.values[j]
+            if flip:
+                a, b = b, a
+            raw = _apply_op(e.op, a, b)
+            if e.op in promql.COMPARISONS:
+                if e.bool_mode:
+                    vals = np.where(np.isnan(a) | np.isnan(b), np.nan,
+                                    raw.astype(np.float64))
+                    out_lb = self._result_labels(lb, rhs.labels[j], m, drop_name=True)
+                else:
+                    vals = np.where(raw.astype(bool), lhs.values[i], np.nan)
+                    out_lb = dict(lb)
+            else:
+                vals = raw
+                out_lb = self._result_labels(lb, rhs.labels[j], m, drop_name=True)
+            out_l.append(out_lb)
+            out_v.append(vals)
+        T = lhs.values.shape[1] if len(lhs.labels) else (
+            rhs.values.shape[1] if len(rhs.labels) else 0
+        )
+        return _compact(Vector(out_l, np.stack(out_v) if out_v else np.zeros((0, T))))
+
+    def _result_labels(self, l_lb, r_lb, m: VectorMatching | None, drop_name: bool):
+        if m and m.on:
+            out = {k: v for k, v in l_lb.items()
+                   if k.decode() in m.labels}
+        else:
+            excl = {l.encode() for l in (m.labels if m else ())}
+            out = {k: v for k, v in l_lb.items()
+                   if k not in excl and not (drop_name and k == b"__name__")}
+            if drop_name:
+                out.pop(b"__name__", None)
+        for inc in (m.include if m else ()):
+            k = inc.encode()
+            if k in r_lb:
+                out[k] = r_lb[k]
+            else:
+                out.pop(k, None)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _apply_op(op: str, a, b):
+    with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+        if op == "+":
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            return a / b
+        if op == "%":
+            return np.fmod(a, b)
+        if op == "^":
+            return np.power(a, b)
+        if op == "==":
+            return a == b
+        if op == "!=":
+            return a != b
+        if op == ">":
+            return a > b
+        if op == "<":
+            return a < b
+        if op == ">=":
+            return a >= b
+        if op == "<=":
+            return a <= b
+    raise EvalError(f"unknown operator {op}")
+
+
+def _compact(v: Vector) -> Vector:
+    """Drop series with no samples at any step."""
+    if not len(v.labels):
+        return v
+    keep = ~np.isnan(v.values).all(axis=1)
+    if keep.all():
+        return v
+    idx = np.nonzero(keep)[0]
+    return Vector([v.labels[i] for i in idx], v.values[idx])
+
+
+def _quantile_cols(sub: np.ndarray, phi: float) -> np.ndarray:
+    """Prometheus-style interpolated quantile down columns, NaN-aware."""
+    T = sub.shape[1]
+    out = np.full(T, np.nan)
+    for t in range(T):
+        col = sub[:, t]
+        col = col[~np.isnan(col)]
+        if len(col) == 0:
+            continue
+        if phi < 0:
+            out[t] = -np.inf
+            continue
+        if phi > 1:
+            out[t] = np.inf
+            continue
+        s = np.sort(col)
+        rank = phi * (len(s) - 1)
+        lo = int(math.floor(rank))
+        hi = min(lo + 1, len(s) - 1)
+        out[t] = s[lo] + (rank - lo) * (s[hi] - s[lo])
+    return out
+
+
+def _quantile_over_time(raws: RaggedSeries, eval_ts, range_ns, phi):
+    lo, hi = raws.window_bounds(eval_ts, range_ns)
+    out = np.full(lo.shape, np.nan)
+    for s in range(lo.shape[0]):
+        for t in range(lo.shape[1]):
+            w = raws.values[lo[s, t] : hi[s, t]]
+            if len(w) == 0:
+                continue
+            out[s, t] = _quantile_cols(w[:, None], phi)[0]
+    return out
+
+
+def _histogram_quantile(phi: float, v: Vector) -> Vector:
+    groups: dict[tuple, list[int]] = {}
+    lbls_for: dict[tuple, dict] = {}
+    for i, lb in enumerate(v.labels):
+        key = tuple(sorted(
+            (k, val) for k, val in lb.items() if k not in (b"le", b"__name__")
+        ))
+        groups.setdefault(key, []).append(i)
+        lbls_for[key] = {k: val for k, val in lb.items()
+                         if k not in (b"le", b"__name__")}
+    T = v.values.shape[1] if len(v.labels) else 0
+    out_l, out_v = [], []
+    for key, rows in sorted(groups.items()):
+        les = []
+        for i in rows:
+            le_raw = v.labels[i].get(b"le", b"")
+            try:
+                les.append(float(le_raw))
+            except ValueError:
+                les.append(np.nan)
+        order = np.argsort(les)
+        les_sorted = np.array(les)[order]
+        counts = v.values[[rows[int(o)] for o in order]]
+        vals = np.full(T, np.nan)
+        if len(les_sorted) >= 2 and np.isinf(les_sorted[-1]):
+            # monotonize cumulative counts then interpolate
+            counts = np.maximum.accumulate(np.where(np.isnan(counts), 0, counts), axis=0)
+            total = counts[-1]
+            with np.errstate(invalid="ignore", divide="ignore"):
+                for t in range(T):
+                    obs = total[t]
+                    if not obs > 0:
+                        continue
+                    rank = phi * obs
+                    b = int(np.searchsorted(counts[:, t], rank, side="left"))
+                    b = min(b, len(les_sorted) - 1)
+                    if b == len(les_sorted) - 1:
+                        vals[t] = les_sorted[-2]
+                        continue
+                    if b == 0 and les_sorted[0] <= 0:
+                        vals[t] = les_sorted[0]
+                        continue
+                    b_start = 0.0 if b == 0 else les_sorted[b - 1]
+                    b_end = les_sorted[b]
+                    cnt = counts[b, t] - (0.0 if b == 0 else counts[b - 1, t])
+                    r = rank - (0.0 if b == 0 else counts[b - 1, t])
+                    if cnt <= 0:
+                        vals[t] = b_end
+                    else:
+                        vals[t] = b_start + (b_end - b_start) * (r / cnt)
+        out_l.append(lbls_for[key])
+        out_v.append(vals)
+    return _compact(Vector(out_l, np.stack(out_v) if out_v else np.zeros((0, T))))
+
+
+def _absent_labels(e: Expr) -> dict:
+    if isinstance(e, VectorSelector):
+        out = {}
+        from m3_tpu.index.query import MatchType
+
+        for m in e.matchers:
+            if m.match_type == MatchType.EQUAL and m.name != b"__name__":
+                out[m.name] = m.value
+        return out
+    return {}
+
+
+def _fmt(x: float) -> str:
+    if x == int(x) and abs(x) < 1e15:
+        return str(int(x))
+    return repr(x)

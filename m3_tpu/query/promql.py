@@ -1,0 +1,415 @@
+"""PromQL lexer + recursive-descent parser.
+
+Role parity with the reference's PromQL front-end, which wraps the upstream
+prometheus/prometheus parser (/root/reference/src/query/parser/promql/
+matchers.go:28, types.go). This is an independent implementation of the
+PromQL grammar: vector/matrix selectors with label matchers and offsets,
+binary operators with precedence + vector matching modifiers, aggregation
+operators with by/without grouping, function calls, and literals.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from m3_tpu.index.query import Matcher, MatchType
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+
+class Expr:
+    pass
+
+
+@dataclass
+class NumberLiteral(Expr):
+    value: float
+
+
+@dataclass
+class StringLiteral(Expr):
+    value: str
+
+
+@dataclass
+class VectorSelector(Expr):
+    name: str | None
+    matchers: list[Matcher]
+    offset_ns: int = 0
+
+
+@dataclass
+class MatrixSelector(Expr):
+    selector: VectorSelector
+    range_ns: int = 0
+
+
+@dataclass
+class Call(Expr):
+    func: str
+    args: list[Expr] = field(default_factory=list)
+
+
+@dataclass
+class VectorMatching:
+    on: bool = False  # True: match on `labels`; False: ignoring `labels`
+    labels: tuple[str, ...] = ()
+    group_left: bool = False
+    group_right: bool = False
+    include: tuple[str, ...] = ()
+
+
+@dataclass
+class BinaryExpr(Expr):
+    op: str
+    lhs: Expr
+    rhs: Expr
+    bool_mode: bool = False
+    matching: VectorMatching | None = None
+
+
+@dataclass
+class AggregateExpr(Expr):
+    op: str
+    expr: Expr
+    param: Expr | None = None
+    grouping: tuple[str, ...] = ()
+    without: bool = False
+
+
+@dataclass
+class UnaryExpr(Expr):
+    op: str
+    expr: Expr
+
+
+AGGREGATORS = {
+    "sum", "avg", "min", "max", "count", "stddev", "stdvar",
+    "topk", "bottomk", "quantile", "count_values", "group",
+}
+
+COMPARISONS = {"==", "!=", ">", "<", ">=", "<="}
+SET_OPS = {"and", "or", "unless"}
+
+_DURATION_UNITS = {
+    "ms": 10**6,
+    "s": 10**9,
+    "m": 60 * 10**9,
+    "h": 3600 * 10**9,
+    "d": 24 * 3600 * 10**9,
+    "w": 7 * 24 * 3600 * 10**9,
+    "y": 365 * 24 * 3600 * 10**9,
+}
+
+_DURATION_RE = re.compile(r"(\d+)(ms|s|m|h|d|w|y)")
+
+
+def parse_duration(s: str) -> int:
+    """'1h30m' -> nanoseconds."""
+    total = 0
+    pos = 0
+    for m in _DURATION_RE.finditer(s):
+        if m.start() != pos:
+            raise ParseError(f"invalid duration {s!r}")
+        total += int(m.group(1)) * _DURATION_UNITS[m.group(2)]
+        pos = m.end()
+    if pos != len(s) or total == 0 and s != "0":
+        if not (pos == len(s) and pos > 0):
+            raise ParseError(f"invalid duration {s!r}")
+    return total
+
+
+class ParseError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<WS>\s+)
+  | (?P<COMMENT>\#[^\n]*)
+  | (?P<DURATION>\d+(?:ms|s|m|h|d|w|y)(?:\d+(?:ms|s|m|h|d|w|y))*)
+  | (?P<NUMBER>
+        0[xX][0-9a-fA-F]+
+      | (?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?
+      | [iI][nN][fF]
+      | [nN][aA][nN]
+    )
+  | (?P<IDENT>[a-zA-Z_:][a-zA-Z0-9_:]*)
+  | (?P<STRING>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
+  | (?P<OP>=~|!~|==|!=|<=|>=|<|>|\+|-|\*|/|%|\^|=|\(|\)|\{|\}|\[|\]|,|@)
+    """,
+    re.VERBOSE,
+)
+
+
+@dataclass
+class Token:
+    kind: str
+    text: str
+    pos: int
+
+
+def tokenize(src: str) -> list[Token]:
+    out = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if not m:
+            raise ParseError(f"unexpected character {src[pos]!r} at {pos}")
+        kind = m.lastgroup
+        if kind not in ("WS", "COMMENT"):
+            out.append(Token(kind, m.group(), pos))
+        pos = m.end()
+    out.append(Token("EOF", "", pos))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+
+class Parser:
+    def __init__(self, src: str):
+        self.toks = tokenize(src)
+        self.i = 0
+
+    # -- token helpers --
+
+    def peek(self) -> Token:
+        return self.toks[self.i]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, text: str) -> Token:
+        t = self.next()
+        if t.text != text:
+            raise ParseError(f"expected {text!r}, got {t.text!r} at {t.pos}")
+        return t
+
+    def accept(self, text: str) -> bool:
+        if self.peek().text == text:
+            self.i += 1
+            return True
+        return False
+
+    # -- grammar --
+
+    def parse(self) -> Expr:
+        e = self.parse_expr()
+        t = self.peek()
+        if t.kind != "EOF":
+            raise ParseError(f"unexpected trailing input {t.text!r} at {t.pos}")
+        return e
+
+    def parse_expr(self) -> Expr:
+        return self.parse_binary(0)
+
+    _PRECEDENCE = [
+        ({"or"}, False),
+        ({"and", "unless"}, False),
+        (COMPARISONS, False),
+        ({"+", "-"}, False),
+        ({"*", "/", "%"}, False),
+        ({"^"}, True),  # right associative
+    ]
+
+    def parse_binary(self, level: int) -> Expr:
+        if level >= len(self._PRECEDENCE):
+            return self.parse_unary()
+        ops, right_assoc = self._PRECEDENCE[level]
+        lhs = self.parse_binary(level + 1)
+        while self.peek().text in ops:
+            op = self.next().text
+            bool_mode = False
+            if self.accept("bool"):
+                bool_mode = True
+            matching = self._parse_matching()
+            rhs = self.parse_binary(level if right_assoc else level + 1)
+            lhs = BinaryExpr(op, lhs, rhs, bool_mode, matching)
+        return lhs
+
+    def _parse_matching(self) -> VectorMatching | None:
+        t = self.peek().text
+        if t not in ("on", "ignoring"):
+            return None
+        on = self.next().text == "on"
+        labels = tuple(self._parse_label_list())
+        m = VectorMatching(on=on, labels=labels)
+        t = self.peek().text
+        if t in ("group_left", "group_right"):
+            self.next()
+            if t == "group_left":
+                m.group_left = True
+            else:
+                m.group_right = True
+            if self.peek().text == "(":
+                m.include = tuple(self._parse_label_list())
+        return m
+
+    def _parse_label_list(self) -> list[str]:
+        self.expect("(")
+        labels = []
+        if not self.accept(")"):
+            while True:
+                t = self.next()
+                if t.kind != "IDENT":
+                    raise ParseError(f"expected label name, got {t.text!r}")
+                labels.append(t.text)
+                if not self.accept(","):
+                    break
+            self.expect(")")
+        return labels
+
+    def parse_unary(self) -> Expr:
+        t = self.peek()
+        if t.text in ("+", "-"):
+            self.next()
+            return UnaryExpr(t.text, self.parse_unary())
+        return self.parse_postfix(self.parse_atom())
+
+    def parse_postfix(self, e: Expr) -> Expr:
+        while True:
+            t = self.peek()
+            if t.text == "[":
+                self.next()
+                d = self.next()
+                if d.kind not in ("DURATION", "NUMBER"):
+                    raise ParseError(f"expected duration in range selector, got {d.text!r}")
+                rng = parse_duration(d.text) if d.kind == "DURATION" else int(
+                    float(d.text) * 1e9
+                )
+                self.expect("]")
+                if not isinstance(e, VectorSelector):
+                    raise ParseError("range selector requires a vector selector")
+                e = MatrixSelector(e, rng)
+            elif t.text == "offset":
+                self.next()
+                d = self.next()
+                neg = False
+                if d.text == "-":
+                    neg = True
+                    d = self.next()
+                if d.kind != "DURATION":
+                    raise ParseError(f"expected duration after offset, got {d.text!r}")
+                off = parse_duration(d.text) * (-1 if neg else 1)
+                if isinstance(e, VectorSelector):
+                    e.offset_ns = off
+                elif isinstance(e, MatrixSelector):
+                    e.selector.offset_ns = off
+                else:
+                    raise ParseError("offset requires a selector")
+            else:
+                return e
+
+    def parse_atom(self) -> Expr:
+        t = self.peek()
+        if t.text == "(":
+            self.next()
+            e = self.parse_expr()
+            self.expect(")")
+            return e
+        if t.kind == "NUMBER":
+            self.next()
+            return NumberLiteral(_parse_number(t.text))
+        if t.kind == "STRING":
+            self.next()
+            return StringLiteral(_unquote(t.text))
+        if t.text == "{":
+            return self._parse_vector_selector(None)
+        if t.kind == "IDENT":
+            name = self.next().text
+            if name in AGGREGATORS and self.peek().text in ("(", "by", "without"):
+                return self._parse_aggregate(name)
+            if self.peek().text == "(":
+                return self._parse_call(name)
+            return self._parse_vector_selector(name)
+        raise ParseError(f"unexpected token {t.text!r} at {t.pos}")
+
+    def _parse_call(self, name: str) -> Call:
+        self.expect("(")
+        args = []
+        if not self.accept(")"):
+            while True:
+                args.append(self.parse_expr())
+                if not self.accept(","):
+                    break
+            self.expect(")")
+        return Call(name, args)
+
+    def _parse_aggregate(self, op: str) -> AggregateExpr:
+        grouping: tuple[str, ...] = ()
+        without = False
+        if self.peek().text in ("by", "without"):
+            without = self.next().text == "without"
+            grouping = tuple(self._parse_label_list())
+        self.expect("(")
+        first = self.parse_expr()
+        param = None
+        expr = first
+        if self.accept(","):
+            param = first
+            expr = self.parse_expr()
+        self.expect(")")
+        if self.peek().text in ("by", "without"):
+            without = self.next().text == "without"
+            grouping = tuple(self._parse_label_list())
+        return AggregateExpr(op, expr, param, grouping, without)
+
+    def _parse_vector_selector(self, name: str | None) -> VectorSelector:
+        matchers: list[Matcher] = []
+        if name is not None:
+            matchers.append(Matcher(MatchType.EQUAL, b"__name__", name.encode()))
+        if self.peek().text == "{":
+            self.next()
+            if not self.accept("}"):
+                while True:
+                    lt = self.next()
+                    if lt.kind not in ("IDENT",) and lt.text not in SET_OPS:
+                        raise ParseError(f"expected label name, got {lt.text!r}")
+                    op = self.next().text
+                    try:
+                        mt = MatchType(op)
+                    except ValueError:
+                        raise ParseError(f"invalid matcher operator {op!r}") from None
+                    vt = self.next()
+                    if vt.kind != "STRING":
+                        raise ParseError(f"expected quoted label value, got {vt.text!r}")
+                    matchers.append(Matcher(mt, lt.text.encode(), _unquote(vt.text).encode()))
+                    if not self.accept(","):
+                        break
+                self.expect("}")
+        if not matchers:
+            raise ParseError("vector selector must have at least one matcher")
+        return VectorSelector(name, matchers)
+
+
+def _parse_number(text: str) -> float:
+    t = text.lower()
+    if t.startswith("0x"):
+        return float(int(text, 16))
+    if t == "inf":
+        return float("inf")
+    if t == "nan":
+        return float("nan")
+    return float(text)
+
+
+def _unquote(s: str) -> str:
+    body = s[1:-1]
+    return body.encode().decode("unicode_escape")
+
+
+def parse(src: str) -> Expr:
+    """Parse a PromQL expression."""
+    return Parser(src).parse()

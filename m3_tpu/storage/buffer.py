@@ -192,6 +192,9 @@ class ShardBuffer:
             starts=np.full(B, block_start, dtype=np.int64),
         )
 
+    def drop_window(self, block_start: int) -> None:
+        self._logs.pop(block_start, None)
+
     def expire_before(self, cutoff_block_start: int) -> int:
         dropped = 0
         for bs in list(self._logs):

@@ -164,14 +164,16 @@ class Database:
         ns = self.namespaces[namespace]
         fields = [(b"__name__", metric_name), *tags] if metric_name else list(tags)
         series_id = tags_to_id(metric_name, tags)
-        ns.shard_for(series_id)  # validate ownership BEFORE logging
+        shard = ns.shard_for(series_id)  # validate ownership BEFORE logging
         enc = encode_tags(fields)
         vbits = _f64_to_bits(value)
         log = self._commitlogs.get(namespace)
         if log is not None:
             log.write(series_id, enc, t_ns, vbits, int(ns.opts.write_time_unit))
             self._log_windows[namespace].add(ns.opts.retention.block_start(t_ns))
-        ns.write_tagged(series_id, fields, t_ns, vbits, enc)
+        shard.write(series_id, t_ns, vbits, enc)
+        if ns.index is not None:
+            ns.index.insert(series_id, fields, t_ns)
         return series_id
 
     def query(self, namespace: str, matchers, start_ns: int, end_ns: int,

@@ -144,13 +144,26 @@ class FilesetWriter:
         for suffix, payload in files.items():
             with open(self._path(suffix), "wb") as f:
                 f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
             digests[suffix] = zlib.adler32(payload)
         digest_payload = json.dumps(digests).encode()
         with open(self._path("digest"), "wb") as f:
             f.write(digest_payload)
-        # checkpoint last: its presence marks the fileset complete
+            f.flush()
+            os.fsync(f.fileno())
+        # checkpoint last (after everything else is fsynced): its presence
+        # marks the fileset complete even across power loss
         with open(self._path("checkpoint"), "wb") as f:
             f.write(struct.pack(">I", zlib.adler32(digest_payload)))
+            f.flush()
+            os.fsync(f.fileno())
+        # fsync the directory so the new names themselves are durable
+        dfd = os.open(os.path.dirname(self._path("info")), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
         return digests
 
 

@@ -101,7 +101,10 @@ class Shard:
         from m3_tpu.encoding.m3tsz import decode as scalar_decode
         from m3_tpu.encoding.m3tsz import tpu as m3tsz_tpu
 
-        sealed = self.buffer.seal(block_start)
+        # Seal WITHOUT dropping: the buffer window is the only copy until the
+        # fileset volume is durably on disk; a failed flush must leave it
+        # intact (and with it the retired-commitlog coverage check).
+        sealed = self.buffer.seal(block_start, drop=False)
         if sealed is None:
             return False
 
@@ -177,12 +180,15 @@ class Shard:
         self._filesets[block_start] = FilesetReader(
             self.fs_root, self.namespace, self.shard_id, block_start, volume
         )
+        self.buffer.drop_window(block_start)  # volume durable: buffer copy done
         return True
 
     # -- bootstrap --
 
     def bootstrap_from_fs(self, now_ns: int | None = None) -> int:
-        """Load complete volumes; expired ones are deleted, not loaded."""
+        """Load complete volumes; expired ones are skipped (never deleted
+        here — open() must not be destructive; the explicit tick()/expire
+        path reclaims disk)."""
         r = self.opts.retention
         cutoff = None
         if now_ns is not None:
@@ -190,7 +196,6 @@ class Shard:
         n = 0
         for block_start, volume in list_filesets(self.fs_root, self.namespace, self.shard_id):
             if cutoff is not None and block_start < cutoff:
-                self._delete_fileset_files(block_start)
                 continue
             try:
                 reader = FilesetReader(
@@ -222,7 +227,10 @@ class Shard:
                 pass
 
     def expire(self, now_ns: int) -> int:
-        """Drop + delete block volumes and buffered windows past retention."""
+        """Drop + delete block volumes and buffered windows past retention.
+
+        Also reclaims on-disk volumes that were skipped at bootstrap as
+        already-expired (they were never loaded into _filesets)."""
         r = self.opts.retention
         cutoff = r.block_start(now_ns - r.retention_ns)
         dropped = 0
@@ -232,6 +240,9 @@ class Shard:
                 del self._filesets[bs]
                 self._delete_fileset_files(bs)
                 dropped += 1
+        for bs, _vol in list_filesets(self.fs_root, self.namespace, self.shard_id):
+            if bs < cutoff and bs not in self._filesets:
+                self._delete_fileset_files(bs)
         self.buffer.expire_before(cutoff)
         return dropped
 

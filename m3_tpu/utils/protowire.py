@@ -1,0 +1,188 @@
+"""Minimal protobuf wire-format codec + Prometheus remote read/write messages.
+
+Hand-rolled encoders/decoders for the three message shapes the Prometheus
+remote APIs need (WriteRequest / ReadRequest / ReadResponse), matching the
+public prometheus/prompb schema. The reference carries generated codecs for
+the same protocol (/root/reference/src/query/generated/proto/prompb); a
+generic field walker keeps this dependency-free.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+
+def _uvarint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _zigzag(v: int) -> int:
+    return (v << 1) ^ (v >> 63)
+
+
+def _unzigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def read_uvarint(data: bytes, pos: int) -> tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def iter_fields(data: bytes):
+    """Yield (field_number, wire_type, value) over a message payload."""
+    pos = 0
+    n = len(data)
+    while pos < n:
+        key, pos = read_uvarint(data, pos)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:  # varint
+            val, pos = read_uvarint(data, pos)
+        elif wt == 1:  # fixed64
+            val = data[pos : pos + 8]
+            pos += 8
+        elif wt == 2:  # length-delimited
+            ln, pos = read_uvarint(data, pos)
+            val = data[pos : pos + ln]
+            pos += ln
+        elif wt == 5:  # fixed32
+            val = data[pos : pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fno, wt, val
+
+
+def field_varint(fno: int, v: int) -> bytes:
+    return _uvarint(fno << 3) + _uvarint(v & ((1 << 64) - 1))
+
+
+def field_bytes(fno: int, b: bytes) -> bytes:
+    return _uvarint((fno << 3) | 2) + _uvarint(len(b)) + b
+
+
+def field_double(fno: int, v: float) -> bytes:
+    return _uvarint((fno << 3) | 1) + struct.pack("<d", v)
+
+
+# ---------------------------------------------------------------------------
+# prometheus remote messages (prompb schema)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PromTimeSeries:
+    labels: list[tuple[bytes, bytes]] = field(default_factory=list)
+    samples: list[tuple[int, float]] = field(default_factory=list)  # (ts_ms, value)
+
+
+def decode_write_request(payload: bytes) -> list[PromTimeSeries]:
+    out = []
+    for fno, _, val in iter_fields(payload):
+        if fno != 1:
+            continue
+        ts = PromTimeSeries()
+        for f2, _, v2 in iter_fields(val):
+            if f2 == 1:  # Label
+                name = value = b""
+                for f3, _, v3 in iter_fields(v2):
+                    if f3 == 1:
+                        name = v3
+                    elif f3 == 2:
+                        value = v3
+                ts.labels.append((name, value))
+            elif f2 == 2:  # Sample
+                value_f = 0.0
+                ts_ms = 0
+                for f3, wt3, v3 in iter_fields(v2):
+                    if f3 == 1:
+                        value_f = struct.unpack("<d", v3)[0]
+                    elif f3 == 2:
+                        # prompb.Sample.timestamp is int64 (not zigzag)
+                        ts_ms = v3 if wt3 == 0 else 0
+                        if ts_ms >= 1 << 63:
+                            ts_ms -= 1 << 64
+                ts.samples.append((ts_ms, value_f))
+        out.append(ts)
+    return out
+
+
+def encode_write_request(series: list[PromTimeSeries]) -> bytes:
+    out = bytearray()
+    for ts in series:
+        body = bytearray()
+        for name, value in ts.labels:
+            body += field_bytes(1, field_bytes(1, name) + field_bytes(2, value))
+        for ts_ms, v in ts.samples:
+            body += field_bytes(2, field_double(1, v) + field_varint(2, ts_ms))
+        out += field_bytes(1, bytes(body))
+    return bytes(out)
+
+
+@dataclass
+class PromMatcher:
+    type: int  # 0 EQ, 1 NEQ, 2 RE, 3 NRE
+    name: bytes
+    value: bytes
+
+
+@dataclass
+class PromReadQuery:
+    start_ms: int
+    end_ms: int
+    matchers: list[PromMatcher] = field(default_factory=list)
+
+
+def decode_read_request(payload: bytes) -> list[PromReadQuery]:
+    out = []
+    for fno, _, val in iter_fields(payload):
+        if fno != 1:
+            continue
+        q = PromReadQuery(0, 0)
+        for f2, wt2, v2 in iter_fields(val):
+            if f2 == 1 and wt2 == 0:
+                q.start_ms = v2
+            elif f2 == 2 and wt2 == 0:
+                q.end_ms = v2
+            elif f2 == 3:
+                m = PromMatcher(0, b"", b"")
+                for f3, wt3, v3 in iter_fields(v2):
+                    if f3 == 1 and wt3 == 0:
+                        m.type = v3
+                    elif f3 == 2:
+                        m.name = v3
+                    elif f3 == 3:
+                        m.value = v3
+                q.matchers.append(m)
+        out.append(q)
+    return out
+
+
+def encode_read_response(results: list[list[PromTimeSeries]]) -> bytes:
+    out = bytearray()
+    for series_list in results:
+        body = bytearray()
+        for ts in series_list:
+            ts_body = bytearray()
+            for name, value in ts.labels:
+                ts_body += field_bytes(1, field_bytes(1, name) + field_bytes(2, value))
+            for ts_ms, v in ts.samples:
+                ts_body += field_bytes(2, field_double(1, v) + field_varint(2, ts_ms))
+            body += field_bytes(1, bytes(ts_body))
+        out += field_bytes(1, bytes(body))
+    return bytes(out)

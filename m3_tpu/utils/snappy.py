@@ -1,0 +1,86 @@
+"""Pure-Python snappy block format codec.
+
+Prometheus remote read/write bodies are snappy-compressed protobuf; no
+snappy library ships in this image, so: full decompressor for the block
+format, and a valid literal-only compressor for responses (any conformant
+snappy decoder accepts all-literal streams; we trade ratio for zero deps).
+"""
+
+from __future__ import annotations
+
+
+def _read_uvarint(data: bytes, pos: int) -> tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def decompress(data: bytes) -> bytes:
+    if not data:
+        return b""
+    n, pos = _read_uvarint(data, 0)
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            length = tag >> 2
+            if length < 60:
+                length += 1
+            else:
+                nbytes = length - 59
+                length = int.from_bytes(data[pos : pos + nbytes], "little") + 1
+                pos += nbytes
+            out += data[pos : pos + length]
+            pos += length
+        else:
+            if kind == 1:
+                length = ((tag >> 2) & 0x7) + 4
+                offset = ((tag >> 5) << 8) | data[pos]
+                pos += 1
+            elif kind == 2:
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(data[pos : pos + 2], "little")
+                pos += 2
+            else:
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(data[pos : pos + 4], "little")
+                pos += 4
+            if offset == 0 or offset > len(out):
+                raise ValueError("snappy: corrupt copy offset")
+            # overlapping copies are allowed and common
+            start = len(out) - offset
+            for i in range(length):
+                out.append(out[start + i])
+    if len(out) != n:
+        raise ValueError(f"snappy: length mismatch {len(out)} != {n}")
+    return bytes(out)
+
+
+def compress(data: bytes) -> bytes:
+    """Literal-only snappy stream (valid, uncompressed payload)."""
+    if not data:
+        return b"\x00"
+    out = bytearray()
+    n = len(data)
+    while n:
+        out.append((n & 0x7F) | (0x80 if n > 0x7F else 0))
+        n >>= 7
+    pos = 0
+    while pos < len(data):
+        chunk = data[pos : pos + 65536]
+        length = len(chunk)
+        if length <= 60:
+            out.append(((length - 1) << 2) | 0)
+        else:
+            out.append((61 << 2) | 0)  # 2-byte length literal
+            out += (length - 1).to_bytes(2, "little")
+        out += chunk
+        pos += length
+    return bytes(out)

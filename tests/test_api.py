@@ -1,0 +1,170 @@
+"""End-to-end HTTP API tests: the minimum slice of SURVEY.md §7.4 —
+write over HTTP -> storage -> PromQL query -> JSON, plus Prometheus
+remote write/read wire compatibility (snappy + protobuf)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.query.api import CoordinatorAPI
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import DatabaseOptions
+from m3_tpu.utils import protowire, snappy
+
+MIN = 60 * 10**9
+START = 1_599_998_400_000_000_000
+START_S = START / 1e9
+
+
+@pytest.fixture
+def api(tmp_path):
+    db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+    db.create_namespace("default")
+    db.open(START)
+    a = CoordinatorAPI(db)
+    port = a.serve(port=0)
+    a.base = f"http://127.0.0.1:{port}"
+    yield a
+    a.shutdown()
+    db.close()
+
+
+def get(api, path):
+    with urllib.request.urlopen(api.base + path) as r:
+        return json.loads(r.read())
+
+
+def post(api, path, body, ctype="application/octet-stream"):
+    req = urllib.request.Request(
+        api.base + path, data=body, headers={"Content-Type": ctype}, method="POST"
+    )
+    with urllib.request.urlopen(req) as r:
+        data = r.read()
+        return data if r.headers.get("Content-Type") == "application/x-protobuf" else json.loads(data)
+
+
+class TestSnappy:
+    def test_roundtrip(self, rng):
+        for n in (0, 1, 59, 60, 61, 1000, 70000):
+            data = rng.integers(0, 256, n).astype(np.uint8).tobytes()
+            assert snappy.decompress(snappy.compress(data)) == data
+
+    def test_decompress_with_copies(self):
+        # hand-built stream: literal "abcd" + copy(offset=4, len=4)
+        # tag1: literal len 4 -> ((4-1)<<2)|0 = 12; copy1: len=4 offset=4:
+        # kind1: tag = ((4-4)&7)<<2 | 1 | (0<<5) = 1, offset byte = 4
+        raw = bytes([8, 12]) + b"abcd" + bytes([1, 4])
+        assert snappy.decompress(raw) == b"abcdabcd"
+
+
+class TestProtowire:
+    def test_write_request_roundtrip(self):
+        series = [
+            protowire.PromTimeSeries(
+                labels=[(b"__name__", b"up"), (b"job", b"api")],
+                samples=[(1600000000000, 1.0), (1600000015000, 0.0)],
+            )
+        ]
+        enc = protowire.encode_write_request(series)
+        dec = protowire.decode_write_request(enc)
+        assert dec[0].labels == series[0].labels
+        assert dec[0].samples == series[0].samples
+
+
+class TestHTTP:
+    def test_health(self, api):
+        assert get(api, "/health")["ok"]
+
+    def test_json_write_and_query(self, api):
+        for i in range(5):
+            post(api, "/api/v1/json/write", json.dumps({
+                "metric": "cpu", "tags": {"host": "h1"},
+                "timestamp": START_S + 60 * i, "value": float(i),
+            }).encode(), "application/json")
+        r = get(api, f"/api/v1/query_range?query=cpu&start={START_S}&end={START_S+240}&step=60")
+        assert r["status"] == "success"
+        res = r["data"]["result"]
+        assert len(res) == 1
+        assert res[0]["metric"]["host"] == "h1"
+        assert [float(v) for _, v in res[0]["values"]] == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+    def test_remote_write_and_query(self, api):
+        series = [
+            protowire.PromTimeSeries(
+                labels=[(b"__name__", b"reqs_total"), (b"code", b"200")],
+                samples=[(int(START_S * 1000) + i * 15000, float(i * 30)) for i in range(20)],
+            ),
+            protowire.PromTimeSeries(
+                labels=[(b"__name__", b"reqs_total"), (b"code", b"500")],
+                samples=[(int(START_S * 1000) + i * 15000, float(i * 3)) for i in range(20)],
+            ),
+        ]
+        body = snappy.compress(protowire.encode_write_request(series))
+        r = post(api, "/api/v1/prom/remote/write", body)
+        assert r["samples"] == 40
+        t = START_S + 280
+        r = get(api, f"/api/v1/query?query=sum(rate(reqs_total[2m]))&time={t}")
+        v = float(r["data"]["result"][0]["value"][1])
+        np.testing.assert_allclose(v, 2.0 + 0.2, rtol=1e-6)
+
+    def test_remote_read(self, api):
+        post(api, "/api/v1/json/write", json.dumps({
+            "metric": "m", "tags": {"a": "b"}, "timestamp": START_S + 1, "value": 4.5,
+        }).encode(), "application/json")
+        q = protowire.PromReadQuery(
+            start_ms=int(START_S * 1000), end_ms=int((START_S + 10) * 1000),
+            matchers=[protowire.PromMatcher(0, b"__name__", b"m")],
+        )
+        body = bytearray()
+        inner = (
+            protowire.field_varint(1, q.start_ms)
+            + protowire.field_varint(2, q.end_ms)
+            + protowire.field_bytes(
+                3,
+                protowire.field_varint(1, 0)
+                + protowire.field_bytes(2, b"__name__")
+                + protowire.field_bytes(3, b"m"),
+            )
+        )
+        body += protowire.field_bytes(1, inner)
+        raw = post(api, "/api/v1/prom/remote/read", snappy.compress(bytes(body)))
+        payload = snappy.decompress(raw)
+        # parse QueryResult -> TimeSeries
+        results = list(protowire.iter_fields(payload))
+        assert len(results) == 1
+        ts_list = protowire.decode_write_request(results[0][2])  # same shape
+        assert ts_list[0].samples == [(int((START_S + 1) * 1000), 4.5)]
+        assert (b"a", b"b") in ts_list[0].labels
+
+    def test_labels_and_series(self, api):
+        post(api, "/api/v1/json/write", json.dumps({
+            "metric": "x", "tags": {"dc": "eu", "host": "h9"},
+            "timestamp": START_S + 1, "value": 1.0,
+        }).encode(), "application/json")
+        r = get(api, "/api/v1/labels")
+        assert set(r["data"]) >= {"__name__", "dc", "host"}
+        r = get(api, "/api/v1/label/dc/values")
+        assert r["data"] == ["eu"]
+        r = get(api, '/api/v1/series?match[]=x{dc="eu"}'.replace("{", "%7B").replace("}", "%7D").replace('"', "%22"))
+        assert r["data"][0]["host"] == "h9"
+
+    def test_error_envelope(self, api):
+        import urllib.error
+
+        try:
+            get(api, "/api/v1/query_range?query=sum(&start=0&end=1&step=1")
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            body = json.loads(e.read())
+            assert body["status"] == "error"
+            assert e.code == 400
+
+    def test_instant_query_vector(self, api):
+        post(api, "/api/v1/json/write", json.dumps({
+            "metric": "g", "tags": {}, "timestamp": START_S + 5, "value": 2.5,
+        }).encode(), "application/json")
+        r = get(api, f"/api/v1/query?query=g*2&time={START_S+10}")
+        assert r["data"]["resultType"] == "vector"
+        assert float(r["data"]["result"][0]["value"][1]) == 5.0

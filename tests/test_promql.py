@@ -1,0 +1,305 @@
+"""PromQL parser + engine tests.
+
+Engine numeric cases are hand-computed against upstream Prometheus
+semantics (extrapolated rate, lookback staleness, aggregation grouping,
+vector matching) — the comparator role of SURVEY.md §4.6 at unit scale.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from m3_tpu.index.query import MatchType
+from m3_tpu.query import promql
+from m3_tpu.query.engine import Engine, Scalar, Vector
+from m3_tpu.query.promql import (
+    AggregateExpr,
+    BinaryExpr,
+    Call,
+    MatrixSelector,
+    NumberLiteral,
+    ParseError,
+    VectorSelector,
+    parse,
+)
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import DatabaseOptions
+
+MIN = 60 * 10**9
+HOUR = 3600 * 10**9
+START = 1_599_998_400_000_000_000
+
+
+class TestParser:
+    def test_selector(self):
+        e = parse('http_requests_total{job="api", code=~"5.."}')
+        assert isinstance(e, VectorSelector)
+        assert e.name == "http_requests_total"
+        assert [(m.match_type, m.name, m.value) for m in e.matchers] == [
+            (MatchType.EQUAL, b"__name__", b"http_requests_total"),
+            (MatchType.EQUAL, b"job", b"api"),
+            (MatchType.REGEXP, b"code", b"5.."),
+        ]
+
+    def test_matrix_and_offset(self):
+        e = parse("rate(foo[5m] offset 1h)")
+        assert isinstance(e, Call) and e.func == "rate"
+        ms = e.args[0]
+        assert isinstance(ms, MatrixSelector)
+        assert ms.range_ns == 5 * MIN
+        assert ms.selector.offset_ns == HOUR
+
+    def test_precedence(self):
+        e = parse("1 + 2 * 3 ^ 2")
+        assert isinstance(e, BinaryExpr) and e.op == "+"
+        assert e.rhs.op == "*"
+        assert e.rhs.rhs.op == "^"
+
+    def test_right_assoc_pow(self):
+        e = parse("2 ^ 3 ^ 2")
+        assert e.op == "^" and isinstance(e.lhs, NumberLiteral)
+        assert e.rhs.op == "^"
+
+    def test_aggregate_by(self):
+        e = parse("sum by (job, dc) (rate(x[1m]))")
+        assert isinstance(e, AggregateExpr)
+        assert e.op == "sum" and e.grouping == ("job", "dc") and not e.without
+        e2 = parse("sum(rate(x[1m])) without (host)")
+        assert e2.without and e2.grouping == ("host",)
+
+    def test_quantile_param(self):
+        e = parse("quantile(0.9, x)")
+        assert isinstance(e.param, NumberLiteral) and e.param.value == 0.9
+
+    def test_bool_and_matching(self):
+        e = parse("a > bool b")
+        assert e.bool_mode
+        e = parse("a / on(job) group_left(instance) b")
+        assert e.matching.on and e.matching.labels == ("job",)
+        assert e.matching.group_left and e.matching.include == ("instance",)
+
+    def test_durations(self):
+        assert promql.parse_duration("1h30m") == HOUR + 30 * MIN
+        assert promql.parse_duration("90s") == 90 * 10**9
+        assert promql.parse_duration("100ms") == 10**8
+
+    def test_errors(self):
+        for bad in ["sum(", "foo{", "foo[]", "foo[5m", "1 +", "{}", "foo bar"]:
+            with pytest.raises(ParseError):
+                parse(bad)
+
+    def test_metric_with_colons(self):
+        e = parse("job:request_rate:sum5m")
+        assert e.name == "job:request_rate:sum5m"
+
+
+@pytest.fixture
+def db(tmp_path):
+    db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+    db.create_namespace("default")
+    db.open(START)
+    yield db
+    db.close()
+
+
+def write_series(db, name, tags, points):
+    for t, v in points:
+        db.write_tagged("default", name, tags, t, v)
+
+
+class TestEngine:
+    def test_instant_selector_lookback(self, db):
+        write_series(db, b"up", [(b"job", b"a")], [(START + 10 * 10**9, 1.0)])
+        eng = Engine(db)
+        v, ts = eng.query_range("up", START, START + 5 * MIN, MIN)
+        assert isinstance(v, Vector) and len(v.labels) == 1
+        # sample at t=10s is visible for 5m of lookback
+        assert not np.isnan(v.values[0, 1])  # t = 60s
+        assert not np.isnan(v.values[0, 5])  # t = 300s
+        assert np.isnan(v.values[0, 0])  # t = 0 (before sample)
+
+    def test_rate_counter(self, db):
+        # perfect 1/s counter sampled every 15s for 10m
+        pts = [(START + i * 15 * 10**9, float(i * 15)) for i in range(41)]
+        write_series(db, b"reqs_total", [(b"job", b"a")], pts)
+        eng = Engine(db)
+        v, _ = eng.query_range("rate(reqs_total[2m])", START + 5 * MIN, START + 10 * MIN, MIN)
+        np.testing.assert_allclose(v.values[0], 1.0, rtol=1e-9)
+        # name is dropped
+        assert b"__name__" not in v.labels[0]
+
+    def test_rate_counter_reset(self, db):
+        pts = [
+            (START + 0 * MIN, 0.0),
+            (START + 1 * MIN, 60.0),
+            (START + 2 * MIN, 120.0),
+            (START + 3 * MIN, 20.0),  # reset
+            (START + 4 * MIN, 80.0),
+        ]
+        write_series(db, b"c", [], pts)
+        eng = Engine(db)
+        v, _ = eng.query_range("increase(c[4m])", START + 4 * MIN, START + 4 * MIN, MIN)
+        # window (0,4m] excludes the t=0 sample: samples 60,120,20,80 adjust
+        # to 60,120,140,200 -> result 140 over 3m sampled; extrapolation:
+        # durToStart=60s < 66s threshold, durToZero=180*(60/140)=77s > 60s,
+        # factor (180+60)/180 = 4/3 -> 140 * 4/3 = 186.666..
+        np.testing.assert_allclose(v.values[0, 0], 140 * 4 / 3, rtol=1e-9)
+
+    def test_increase_extrapolation(self, db):
+        # samples at 15..45s in a 60s window: extrapolates to full window
+        pts = [(START + s * 10**9, float(s)) for s in (15, 30, 45)]
+        write_series(db, b"c2", [], pts)
+        eng = Engine(db)
+        v, _ = eng.query_range("increase(c2[1m])", START + MIN, START + MIN, MIN)
+        # upstream: sampled=30s, durToStart=15>16.5? avg=15, thresh=16.5,
+        # both 15<16.5 -> extrapolate full: 30 * (30+15+15)/30 = 60... but
+        # zero-point: durToZero = 30*(15/30)=15 == durToStart -> unchanged
+        np.testing.assert_allclose(v.values[0, 0], 60.0, rtol=1e-9)
+
+    def test_avg_over_time(self, db):
+        pts = [(START + i * 10 * 10**9, float(i)) for i in range(12)]
+        write_series(db, b"g", [], pts)
+        eng = Engine(db)
+        v, _ = eng.query_range("avg_over_time(g[1m])", START + MIN, START + MIN, MIN)
+        # window (0s,60s]: samples at 10..60 -> values 1..6 -> mean 3.5
+        np.testing.assert_allclose(v.values[0, 0], 3.5)
+
+    def test_min_max_last_over_time(self, db):
+        pts = [(START + i * 10 * 10**9, v) for i, v in enumerate([5, 1, 9, 2, 7, 3])]
+        write_series(db, b"g2", [], pts)
+        eng = Engine(db)
+        for fn, want in [("min_over_time", 1.0), ("max_over_time", 9.0),
+                         ("last_over_time", 3.0), ("count_over_time", 5.0),
+                         ("sum_over_time", 22.0)]:
+            v, _ = eng.query_range(f"{fn}(g2[50s])", START + 50 * 10**9,
+                                   START + 50 * 10**9, MIN)
+            np.testing.assert_allclose(v.values[0, 0], want, err_msg=fn)
+
+    def test_aggregation_sum_by(self, db):
+        for job, dc, val in [(b"a", b"e", 1.0), (b"a", b"w", 2.0), (b"b", b"e", 4.0)]:
+            write_series(db, b"m", [(b"job", job), (b"dc", dc)], [(START + 10**9, val)])
+        eng = Engine(db)
+        v, _ = eng.query_range("sum by (job) (m)", START + MIN, START + MIN, MIN)
+        got = {lb[b"job"]: v.values[i, 0] for i, lb in enumerate(v.labels)}
+        assert got == {b"a": 3.0, b"b": 4.0}
+        v, _ = eng.query_range("sum(m)", START + MIN, START + MIN, MIN)
+        assert v.values[0, 0] == 7.0 and v.labels[0] == {}
+        v, _ = eng.query_range("sum without (dc) (m)", START + MIN, START + MIN, MIN)
+        got = {lb[b"job"]: v.values[i, 0] for i, lb in enumerate(v.labels)}
+        assert got == {b"a": 3.0, b"b": 4.0}
+
+    def test_aggregation_variants(self, db):
+        for i, val in enumerate([1.0, 2.0, 3.0, 4.0]):
+            write_series(db, b"m2", [(b"i", str(i).encode())], [(START + 10**9, val)])
+        eng = Engine(db)
+        cases = {
+            "min(m2)": 1.0,
+            "max(m2)": 4.0,
+            "count(m2)": 4.0,
+            "avg(m2)": 2.5,
+            "stddev(m2)": np.std([1, 2, 3, 4]),
+            "quantile(0.5, m2)": 2.5,
+        }
+        for q, want in cases.items():
+            v, _ = eng.query_range(q, START + MIN, START + MIN, MIN)
+            np.testing.assert_allclose(v.values[0, 0], want, err_msg=q)
+
+    def test_topk(self, db):
+        for i, val in enumerate([1.0, 5.0, 3.0]):
+            write_series(db, b"m3", [(b"i", str(i).encode())], [(START + 10**9, val)])
+        eng = Engine(db)
+        v, _ = eng.query_range("topk(2, m3)", START + MIN, START + MIN, MIN)
+        got = sorted(v.values[:, 0])
+        assert got == [3.0, 5.0]
+
+    def test_binary_vector_scalar(self, db):
+        write_series(db, b"m4", [], [(START + 10**9, 10.0)])
+        eng = Engine(db)
+        v, _ = eng.query_range("m4 * 2 + 1", START + MIN, START + MIN, MIN)
+        assert v.values[0, 0] == 21.0
+        v, _ = eng.query_range("m4 > 5", START + MIN, START + MIN, MIN)
+        assert v.values[0, 0] == 10.0  # filter keeps value
+        v, _ = eng.query_range("m4 > bool 5", START + MIN, START + MIN, MIN)
+        assert v.values[0, 0] == 1.0
+        v, _ = eng.query_range("m4 < 5", START + MIN, START + MIN, MIN)
+        assert len(v.labels) == 0  # filtered out entirely
+
+    def test_binary_vector_vector_matching(self, db):
+        write_series(db, b"errs", [(b"job", b"a")], [(START + 10**9, 10.0)])
+        write_series(db, b"reqs", [(b"job", b"a")], [(START + 10**9, 100.0)])
+        write_series(db, b"errs", [(b"job", b"b")], [(START + 10**9, 1.0)])
+        write_series(db, b"reqs", [(b"job", b"b")], [(START + 10**9, 50.0)])
+        eng = Engine(db)
+        v, _ = eng.query_range("errs / reqs", START + MIN, START + MIN, MIN)
+        got = {lb[b"job"]: v.values[i, 0] for i, lb in enumerate(v.labels)}
+        assert got == {b"a": 0.1, b"b": 0.02}
+        assert all(b"__name__" not in lb for lb in v.labels)
+
+    def test_set_ops(self, db):
+        write_series(db, b"x", [(b"k", b"1")], [(START + 10**9, 1.0)])
+        write_series(db, b"x", [(b"k", b"2")], [(START + 10**9, 2.0)])
+        write_series(db, b"y", [(b"k", b"2")], [(START + 10**9, 9.0)])
+        eng = Engine(db)
+        v, _ = eng.query_range("x and y", START + MIN, START + MIN, MIN)
+        assert len(v.labels) == 1 and v.labels[0][b"k"] == b"2"
+        v, _ = eng.query_range("x unless y", START + MIN, START + MIN, MIN)
+        assert len(v.labels) == 1 and v.labels[0][b"k"] == b"1"
+        v, _ = eng.query_range("x or y", START + MIN, START + MIN, MIN)
+        assert len(v.labels) == 2
+
+    def test_math_functions(self, db):
+        write_series(db, b"m5", [], [(START + 10**9, -4.0)])
+        eng = Engine(db)
+        v, _ = eng.query_range("abs(m5)", START + MIN, START + MIN, MIN)
+        assert v.values[0, 0] == 4.0
+        v, _ = eng.query_range("clamp_min(m5, 0)", START + MIN, START + MIN, MIN)
+        assert v.values[0, 0] == 0.0
+        v, _ = eng.query_range("sqrt(abs(m5))", START + MIN, START + MIN, MIN)
+        assert v.values[0, 0] == 2.0
+
+    def test_scalar_and_time(self, db):
+        eng = Engine(db)
+        s, ts = eng.query_range("42", START, START + 2 * MIN, MIN)
+        assert isinstance(s, Scalar)
+        np.testing.assert_array_equal(s.values, [42, 42, 42])
+        s, _ = eng.query_range("time()", START, START, MIN)
+        assert s.values[0] == START / 1e9
+
+    def test_histogram_quantile(self, db):
+        # classic histogram: buckets 0.1 / 0.5 / +Inf with cum counts 10/30/40
+        for le, cnt in [(b"0.1", 10.0), (b"0.5", 30.0), (b"+Inf", 40.0)]:
+            write_series(db, b"lat_bucket", [(b"le", le)], [(START + 10**9, cnt)])
+        eng = Engine(db)
+        v, _ = eng.query_range(
+            "histogram_quantile(0.5, lat_bucket)", START + MIN, START + MIN, MIN
+        )
+        # rank = 20 -> second bucket: 0.1 + (0.5-0.1)*(10/20) = 0.3
+        np.testing.assert_allclose(v.values[0, 0], 0.3)
+
+    def test_absent(self, db):
+        eng = Engine(db)
+        v, _ = eng.query_range('absent(nothing{job="x"})', START + MIN, START + MIN, MIN)
+        assert v.values[0, 0] == 1.0 and v.labels[0] == {b"job": b"x"}
+
+    def test_offset(self, db):
+        write_series(db, b"m6", [], [(START + 10**9, 7.0)])
+        eng = Engine(db)
+        v, _ = eng.query_range("m6 offset 10m", START + 11 * MIN, START + 11 * MIN, MIN)
+        assert v.values[0, 0] == 7.0
+
+    def test_label_replace(self, db):
+        write_series(db, b"m7", [(b"host", b"web-1")], [(START + 10**9, 1.0)])
+        eng = Engine(db)
+        v, _ = eng.query_range(
+            'label_replace(m7, "idx", "$1", "host", "web-(.*)")',
+            START + MIN, START + MIN, MIN,
+        )
+        assert v.labels[0][b"idx"] == b"1"
+
+    def test_deriv(self, db):
+        pts = [(START + i * 10 * 10**9, 2.0 * i * 10) for i in range(7)]
+        write_series(db, b"m8", [], pts)
+        eng = Engine(db)
+        v, _ = eng.query_range("deriv(m8[1m])", START + MIN, START + MIN, MIN)
+        np.testing.assert_allclose(v.values[0, 0], 2.0, rtol=1e-9)

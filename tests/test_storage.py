@@ -352,3 +352,47 @@ class TestReviewRegressions:
         db2.open(START + HOUR)
         assert db2.read("default", sid_owned, START, START + HOUR)
         db2.close()
+
+    def test_failed_flush_keeps_buffer_and_commitlog(self, tmp_path, monkeypatch):
+        # a flush that dies mid-write must not lose the buffered window
+        db = make_db(tmp_path)
+        sid = b"fragile"
+        db.write("default", sid, START + 10**9, 1.0)
+        shard = db.namespaces["default"].shard_for(sid)
+        from m3_tpu.storage import fileset as fs_mod
+
+        def boom(self_):
+            raise RuntimeError("disk full")
+
+        monkeypatch.setattr(fs_mod.FilesetWriter, "close", boom)
+        with pytest.raises(RuntimeError):
+            shard.flush(START)
+        monkeypatch.undo()
+        # buffer still holds the window; a later flush succeeds
+        assert shard.buffer.points_in(START) == 1
+        assert shard.flush(START)
+        dps = db.read("default", sid, START, START + HOUR)
+        assert [d.value for d in dps] == [1.0]
+        db.close()
+
+    def test_open_is_not_destructive(self, tmp_path):
+        # expired volumes are skipped at open, deleted only by tick/expire
+        db = make_db(tmp_path)
+        db.write("default", b"old", START + 10**9, 1.0)
+        db.flush_all()
+        db.close()
+        far = START + 48 * HOUR
+        db2 = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+        db2.create_namespace("default", small_opts())
+        db2.open(far)
+        # not visible (expired), but still on disk
+        assert db2.read("default", b"old", START, START + HOUR) == []
+        data_dir = os.path.join(str(tmp_path / "db"), "data", "default")
+        remaining = [f for d in os.listdir(data_dir)
+                     for f in os.listdir(os.path.join(data_dir, d))]
+        assert remaining  # files survived open()
+        db2.tick(far)  # explicit maintenance reclaims
+        remaining = [f for d in os.listdir(data_dir)
+                     for f in os.listdir(os.path.join(data_dir, d))]
+        assert remaining == []
+        db2.close()
