@@ -1,0 +1,83 @@
+"""Embedded downsampler: the coordinator's in-process aggregator.
+
+Role parity with /root/reference/src/cmd/services/m3coordinator/downsample
+(metrics_appender.go rule-matched appends, flush_handler.go writing
+aggregated output back to storage) and ingest/write.go's
+DownsamplerAndWriter: every incoming write goes to the downsampler (rule
+match -> aggregation) and/or the unaggregated namespace.
+"""
+
+from __future__ import annotations
+
+import time
+
+from m3_tpu.aggregator.engine import Aggregator, storage_flush_handler
+from m3_tpu.metrics.aggregation import MetricType
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.rules import RuleSet
+from m3_tpu.storage.options import NamespaceOptions, RetentionOptions
+
+
+class Downsampler:
+    """Aggregator wired to a Database: flush writes into per-policy
+    aggregated namespaces (created on demand)."""
+
+    def __init__(self, db, ruleset: RuleSet, local_leader: bool = True,
+                 buffer_past_ns: int = 0):
+        self.db = db
+        self.aggregator = Aggregator(ruleset, buffer_past_ns=buffer_past_ns)
+        # local leader mode (leader_local.go role): this process always
+        # flushes; the clustered service swaps in an elected flush manager
+        self.local_leader = local_leader
+        self._handler = storage_flush_handler(db, self._namespace_for)
+
+    def _namespace_for(self, policy: StoragePolicy) -> str:
+        name = policy.namespace_name
+        if name not in self.db.namespaces:
+            self.db.create_namespace(
+                name,
+                NamespaceOptions(
+                    retention=RetentionOptions(
+                        retention_ns=policy.retention_ns,
+                        block_size_ns=max(policy.resolution_ns * 720,
+                                          2 * 3600 * 10**9),
+                    )
+                ),
+            )
+        return name
+
+    def append(self, metric_type: MetricType, series_id: bytes, tags, t_ns: int,
+               value: float) -> bool:
+        """Returns True if the raw write should be DROPPED (drop policy)."""
+        return self.aggregator.add(metric_type, series_id, list(tags), t_ns, value)
+
+    def flush(self, now_ns: int | None = None) -> int:
+        if not self.local_leader:
+            return 0
+        now_ns = now_ns if now_ns is not None else time.time_ns()
+        metrics = self.aggregator.flush(now_ns)
+        return self._handler(metrics)
+
+
+class DownsamplerAndWriter:
+    """Fan a write to the downsampler and the unaggregated namespace
+    (ingest/write.go:176,264,333)."""
+
+    def __init__(self, db, downsampler: Downsampler | None,
+                 unaggregated_namespace: str = "default"):
+        self.db = db
+        self.downsampler = downsampler
+        self.unagg = unaggregated_namespace
+
+    def write(self, metric_type: MetricType, name: bytes, tags, t_ns: int,
+              value: float) -> bytes | None:
+        drop = False
+        if self.downsampler is not None:
+            from m3_tpu.utils.ident import tags_to_id
+
+            series_id = tags_to_id(name, tags)
+            all_tags = [(b"__name__", name), *tags] if name else list(tags)
+            drop = self.downsampler.append(metric_type, series_id, all_tags, t_ns, value)
+        if not drop:
+            return self.db.write_tagged(self.unagg, name, list(tags), t_ns, value)
+        return None

@@ -1,0 +1,290 @@
+"""Streaming metrics aggregator.
+
+Role parity with the reference aggregator service
+(/root/reference/src/aggregator/aggregator/aggregator.go:157-380: AddUntimed
+/AddTimed shard routing, per-elem accumulation, metric lists driving flush)
+redesigned for the device grid: adds append to per-shard columnar buffers
+keyed by elem index, and a flush computes every (elem x window) aggregate in
+one batched pass (m3_tpu.ops.windowed_agg) — the lock-striped map of
+streaming accumulators becomes a segment reduction.
+
+Flush emits AggregatedMetric records to a pluggable handler (storage writer,
+m3msg producer, ...), with agg-type suffixes appended to multi-aggregation
+ids the way the reference names timer outputs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from m3_tpu.metrics.aggregation import (
+    DEFAULT_AGGREGATIONS,
+    AggregationType,
+    MetricType,
+)
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.rules import Matcher, RuleSet
+from m3_tpu.metrics.transformation import TransformationType, apply as apply_transform
+from m3_tpu.ops import windowed_agg
+from m3_tpu.utils.hash import murmur3_32
+
+
+@dataclass(frozen=True)
+class ElemKey:
+    series_id: bytes
+    policy: StoragePolicy
+    aggregations: tuple[AggregationType, ...]
+    transform: TransformationType | None = None
+
+
+@dataclass
+class Elem:
+    index: int
+    key: ElemKey
+    tags: tuple[tuple[bytes, bytes], ...]
+    metric_type: MetricType
+    # previous emitted window aggregate per aggregation (for binary
+    # transforms like PerSecond), keyed by aggregation type
+    prev: dict[AggregationType, tuple[int, float]] = field(default_factory=dict)
+
+
+@dataclass
+class AggregatedMetric:
+    series_id: bytes  # suffixed id
+    tags: tuple[tuple[bytes, bytes], ...]
+    timestamp_ns: int  # window end
+    value: float
+    policy: StoragePolicy
+
+
+class _ShardBuffer:
+    __slots__ = ("elem_idx", "times", "values", "n")
+
+    def __init__(self) -> None:
+        cap = 1024
+        self.elem_idx = np.empty(cap, np.int64)
+        self.times = np.empty(cap, np.int64)
+        self.values = np.empty(cap, np.float64)
+        self.n = 0
+
+    def append(self, elem: int, t_ns: int, value: float) -> None:
+        if self.n == len(self.elem_idx):
+            cap = len(self.elem_idx) * 2
+            self.elem_idx = np.resize(self.elem_idx, cap)
+            self.times = np.resize(self.times, cap)
+            self.values = np.resize(self.values, cap)
+        self.elem_idx[self.n] = elem
+        self.times[self.n] = t_ns
+        self.values[self.n] = value
+        self.n += 1
+
+    def take(self):
+        out = (
+            self.elem_idx[: self.n].copy(),
+            self.times[: self.n].copy(),
+            self.values[: self.n].copy(),
+        )
+        self.n = 0
+        return out
+
+
+class Aggregator:
+    """Single-process aggregator (the coordinator's embedded downsampler
+    shape; the dedicated-service wrapper adds election + m3msg IO)."""
+
+    def __init__(
+        self,
+        ruleset: RuleSet | None = None,
+        n_shards: int = 4,
+        buffer_past_ns: int = 0,
+        max_buffered_per_shard: int = 10_000_000,
+    ):
+        self.matcher = Matcher(ruleset or RuleSet())
+        self.n_shards = n_shards
+        self.buffer_past_ns = buffer_past_ns
+        self.max_buffered_per_shard = max_buffered_per_shard
+        self._elems: dict[ElemKey, Elem] = {}
+        self._elem_list: list[Elem] = []
+        self._shards: dict[int, _ShardBuffer] = {i: _ShardBuffer() for i in range(n_shards)}
+        # carry: samples belonging to windows that were still open at the
+        # last flush, kept per shard until their window closes
+        self._carry: dict[int, tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+        self.num_dropped = 0
+        self.num_late_dropped = 0
+        # flush watermark: windows ending at/before this have been emitted;
+        # samples landing in them are rejected (reference buffer-past rule)
+        self._watermark_ns = 0
+        self._elem_res: list[int] = []
+
+    # -- add path --
+
+    def _shard_for(self, series_id: bytes) -> int:
+        return murmur3_32(series_id) % self.n_shards
+
+    def _elem(self, key: ElemKey, tags, metric_type: MetricType) -> Elem:
+        e = self._elems.get(key)
+        if e is None:
+            e = Elem(len(self._elem_list), key, tuple(tags), metric_type)
+            self._elems[key] = e
+            self._elem_list.append(e)
+            self._elem_res.append(key.policy.resolution_ns)
+        return e
+
+    def add(
+        self,
+        metric_type: MetricType,
+        series_id: bytes,
+        tags: list[tuple[bytes, bytes]],
+        t_ns: int,
+        value: float,
+    ) -> bool:
+        """Match rules and buffer the sample into every target elem.
+        Returns drop_unaggregated (the caller decides whether to also write
+        the raw datapoint)."""
+        tag_dict = dict(tags)
+        result = self.matcher.match(series_id, tag_dict)
+        for rule in result.mappings:
+            aggs = rule.aggregations or DEFAULT_AGGREGATIONS[metric_type]
+            for policy in rule.policies:
+                elem = self._elem(
+                    ElemKey(series_id, policy, tuple(aggs)), tags, metric_type
+                )
+                self._append(series_id, elem, t_ns, value)
+        for _rule, target, rolled_id, rolled_tags in result.rollups:
+            for policy in target.policies:
+                elem = self._elem(
+                    ElemKey(rolled_id, policy, tuple(target.aggregations),
+                            target.transform),
+                    [(b"__name__", target.new_name), *rolled_tags],
+                    metric_type,
+                )
+                self._append(rolled_id, elem, t_ns, value)
+        return result.drop_unaggregated
+
+    def _append(self, routing_id: bytes, elem: Elem, t_ns: int, value: float) -> None:
+        res = elem.key.policy.resolution_ns
+        window_end = (t_ns // res + 1) * res
+        if window_end + self.buffer_past_ns <= self._watermark_ns:
+            # the window was already flushed: a partial re-emit would
+            # overwrite the full aggregate downstream
+            self.num_late_dropped += 1
+            return
+        shard = self._shards[self._shard_for(routing_id)]
+        if shard.n >= self.max_buffered_per_shard:
+            self.num_dropped += 1
+            return
+        shard.append(elem.index, t_ns, value)
+
+    # -- flush path --
+
+    def flush(self, now_ns: int) -> list[AggregatedMetric]:
+        """Close every window whose end + buffer_past has passed and emit
+        its aggregates; still-open windows are carried to the next flush."""
+        out: list[AggregatedMetric] = []
+        self._watermark_ns = max(self._watermark_ns, now_ns)
+        res_by_elem = np.array(self._elem_res, np.int64) if self._elem_res else np.zeros(0, np.int64)
+        for shard_id, buf in self._shards.items():
+            e_idx, times, values = buf.take()
+            carry = self._carry.pop(shard_id, None)
+            if carry is not None:
+                e_idx = np.concatenate([carry[0], e_idx])
+                times = np.concatenate([carry[1], times])
+                values = np.concatenate([carry[2], values])
+            if len(e_idx) == 0:
+                continue
+            res = res_by_elem[e_idx]
+            window_end = (times // res + 1) * res
+            closed = window_end + self.buffer_past_ns <= now_ns
+            if not closed.all():
+                keep = ~closed
+                self._carry[shard_id] = (e_idx[keep], times[keep], values[keep])
+            e_c, t_c, v_c = e_idx[closed], times[closed], values[closed]
+            if len(e_c) == 0:
+                continue
+            w_c = t_c // res[closed]  # window id in units of resolution
+            ge, gw, stats, vq, offsets = windowed_agg.aggregate_groups(
+                e_c, w_c, v_c, order_seq=np.arange(len(e_c)), times=t_c
+            )
+            out.extend(self._emit(ge, gw, stats, vq, offsets))
+        out.sort(key=lambda m: (m.timestamp_ns, m.series_id))
+        return out
+
+    def _emit(self, ge, gw, stats, vq, offsets) -> list[AggregatedMetric]:
+        out = []
+        # one vectorized extract per aggregation type across ALL groups
+        agg_types = set()
+        for g in range(len(ge)):
+            agg_types.update(self._elem_list[int(ge[g])].key.aggregations)
+        extracted = {
+            agg: windowed_agg.extract(agg, stats, vq, offsets) for agg in agg_types
+        }
+        for g in range(len(ge)):
+            elem = self._elem_list[int(ge[g])]
+            res = elem.key.policy.resolution_ns
+            w_end = (int(gw[g]) + 1) * res
+            multi = len(elem.key.aggregations) > 1
+            for agg in elem.key.aggregations:
+                value = float(extracted[agg][g])
+                if elem.key.transform is not None:
+                    tprev = elem.prev.get(agg)
+                    pv = tprev[1] if tprev else np.nan
+                    pt = tprev[0] if tprev else 0
+                    value_arr = apply_transform(
+                        elem.key.transform,
+                        np.array([pv]), np.array([value]),
+                        np.array([pt]), np.array([w_end]),
+                    )
+                    elem.prev[agg] = (w_end, value)
+                    value = float(value_arr[0])
+                    if np.isnan(value):
+                        continue
+                suffix = agg.suffix if multi else b""
+                tags = elem.tags
+                if suffix:
+                    # suffix the metric name too so downstream storage keys
+                    # and ids agree (the reference suffixes the metric ID)
+                    tags = tuple(
+                        (k, v + suffix if k == b"__name__" else v) for k, v in tags
+                    )
+                out.append(
+                    AggregatedMetric(
+                        series_id=elem.key.series_id + suffix,
+                        tags=tags,
+                        timestamp_ns=w_end,
+                        value=value,
+                        policy=elem.key.policy,
+                    )
+                )
+        return out
+
+    @property
+    def n_elems(self) -> int:
+        return len(self._elem_list)
+
+
+# ---------------------------------------------------------------------------
+# flush handlers
+# ---------------------------------------------------------------------------
+
+
+def storage_flush_handler(db, namespace_for_policy: Callable[[StoragePolicy], str]):
+    """Writes aggregated metrics back into per-policy namespaces (the
+    coordinator downsampler flush handler role,
+    /root/reference/src/cmd/services/m3coordinator/downsample/flush_handler.go)."""
+
+    def handle(metrics: list[AggregatedMetric]) -> int:
+        n = 0
+        for m in metrics:
+            ns = namespace_for_policy(m.policy)
+            if ns is None:
+                continue
+            tags = [(k, v) for k, v in m.tags if k != b"__name__"]
+            name = dict(m.tags).get(b"__name__", b"")
+            db.write_tagged(ns, name, tags, m.timestamp_ns, m.value)
+            n += 1
+        return n
+
+    return handle

@@ -1,0 +1,117 @@
+"""Aggregation types.
+
+Parity with the reference aggregation enum
+(/root/reference/src/metrics/aggregation/type.go:34-55) and its compressed
+bitmask sets (types_compressed.go).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class AggregationType(enum.IntEnum):
+    LAST = 1
+    MIN = 2
+    MAX = 3
+    MEAN = 4
+    MEDIAN = 5
+    COUNT = 6
+    SUM = 7
+    SUMSQ = 8
+    STDEV = 9
+    P10 = 10
+    P20 = 11
+    P30 = 12
+    P40 = 13
+    P50 = 14
+    P75 = 15
+    P90 = 16
+    P95 = 17
+    P99 = 18
+    P999 = 19
+    P9999 = 20
+
+    @property
+    def quantile(self) -> float | None:
+        return _QUANTILES.get(self)
+
+    @property
+    def suffix(self) -> bytes:
+        return _SUFFIXES[self]
+
+
+_QUANTILES = {
+    AggregationType.MEDIAN: 0.5,
+    AggregationType.P10: 0.10,
+    AggregationType.P20: 0.20,
+    AggregationType.P30: 0.30,
+    AggregationType.P40: 0.40,
+    AggregationType.P50: 0.50,
+    AggregationType.P75: 0.75,
+    AggregationType.P90: 0.90,
+    AggregationType.P95: 0.95,
+    AggregationType.P99: 0.99,
+    AggregationType.P999: 0.999,
+    AggregationType.P9999: 0.9999,
+}
+
+_SUFFIXES = {
+    AggregationType.LAST: b".last",
+    AggregationType.MIN: b".lower",
+    AggregationType.MAX: b".upper",
+    AggregationType.MEAN: b".mean",
+    AggregationType.MEDIAN: b".median",
+    AggregationType.COUNT: b".count",
+    AggregationType.SUM: b".sum",
+    AggregationType.SUMSQ: b".sum_sq",
+    AggregationType.STDEV: b".stdev",
+    AggregationType.P10: b".p10",
+    AggregationType.P20: b".p20",
+    AggregationType.P30: b".p30",
+    AggregationType.P40: b".p40",
+    AggregationType.P50: b".p50",
+    AggregationType.P75: b".p75",
+    AggregationType.P90: b".p90",
+    AggregationType.P95: b".p95",
+    AggregationType.P99: b".p99",
+    AggregationType.P999: b".p999",
+    AggregationType.P9999: b".p9999",
+}
+
+
+class MetricType(enum.IntEnum):
+    COUNTER = 1
+    TIMER = 2
+    GAUGE = 3
+
+
+DEFAULT_AGGREGATIONS = {
+    MetricType.COUNTER: (AggregationType.SUM,),
+    MetricType.TIMER: (
+        AggregationType.SUM,
+        AggregationType.SUMSQ,
+        AggregationType.MEAN,
+        AggregationType.MIN,
+        AggregationType.MAX,
+        AggregationType.COUNT,
+        AggregationType.STDEV,
+        AggregationType.MEDIAN,
+        AggregationType.P50,
+        AggregationType.P95,
+        AggregationType.P99,
+    ),
+    MetricType.GAUGE: (AggregationType.LAST,),
+}
+
+
+def compress(types) -> int:
+    """Aggregation set -> bitmask (compressed form)."""
+    mask = 0
+    for t in types:
+        mask |= 1 << int(t)
+    return mask
+
+
+def decompress(mask: int) -> tuple[AggregationType, ...]:
+    return tuple(t for t in AggregationType if mask & (1 << int(t)))

@@ -1,0 +1,70 @@
+"""Tag filters: glob-style match expressions for rule targeting.
+
+Parity with the reference filter language
+(/root/reference/src/metrics/filters/filter.go): a filter like
+`app:web* env:{prod,staging} region:!us-*` matches metrics whose tags
+satisfy every clause. Supported per-value syntax: `*` wildcards, `{a,b}`
+alternation, leading `!` negation.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+
+def _glob_to_regex(glob: str) -> str:
+    out = []
+    for part in re.split(r"(\*|\{[^}]*\})", glob):
+        if part == "*":
+            out.append(".*")
+        elif part.startswith("{") and part.endswith("}"):
+            alts = "|".join(re.escape(a) for a in part[1:-1].split(","))
+            out.append(f"(?:{alts})")
+        else:
+            out.append(re.escape(part))
+    return "".join(out)
+
+
+@dataclass(frozen=True)
+class TagClause:
+    name: bytes
+    pattern: str  # original glob
+    negate: bool
+
+    def compiled(self) -> re.Pattern:
+        return re.compile(_glob_to_regex(self.pattern).encode())
+
+
+class TagFilter:
+    """Conjunction of per-tag glob clauses; `__name__` targets the metric
+    name."""
+
+    def __init__(self, clauses: list[TagClause]):
+        self.clauses = clauses
+        self._compiled = [(c.name, c.compiled(), c.negate) for c in clauses]
+
+    @classmethod
+    def parse(cls, expr: str) -> "TagFilter":
+        clauses = []
+        for raw in expr.split():
+            if ":" not in raw:
+                raise ValueError(f"invalid filter clause {raw!r} (want tag:pattern)")
+            name, pattern = raw.split(":", 1)
+            negate = pattern.startswith("!")
+            if negate:
+                pattern = pattern[1:]
+            clauses.append(TagClause(name.encode(), pattern, negate))
+        if not clauses:
+            raise ValueError("empty filter")
+        return cls(clauses)
+
+    def matches(self, tags: dict[bytes, bytes]) -> bool:
+        for name, rx, negate in self._compiled:
+            value = tags.get(name)
+            ok = value is not None and rx.fullmatch(value) is not None
+            if negate:
+                ok = value is None or rx.fullmatch(value) is None
+            if not ok:
+                return False
+        return True

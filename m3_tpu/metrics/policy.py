@@ -1,0 +1,71 @@
+"""Storage policies: resolution + retention pairs.
+
+Parity with /root/reference/src/metrics/policy/storage_policy.go
+("10s:2d"-style policies that route aggregated output to retention tiers).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+_UNIT_NS = {
+    "ns": 1,
+    "us": 1_000,
+    "ms": 1_000_000,
+    "s": 1_000_000_000,
+    "m": 60 * 1_000_000_000,
+    "h": 3600 * 1_000_000_000,
+    "d": 24 * 3600 * 1_000_000_000,
+    "w": 7 * 24 * 3600 * 1_000_000_000,
+    "y": 365 * 24 * 3600 * 1_000_000_000,
+}
+
+_DUR_RE = re.compile(r"(\d+)(ns|us|ms|s|m|h|d|w|y)")
+
+
+def parse_go_duration(s: str) -> int:
+    total = 0
+    pos = 0
+    for m in _DUR_RE.finditer(s):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration {s!r}")
+        total += int(m.group(1)) * _UNIT_NS[m.group(2)]
+        pos = m.end()
+    if pos != len(s) or pos == 0:
+        raise ValueError(f"invalid duration {s!r}")
+    return total
+
+
+@dataclass(frozen=True, order=True)
+class StoragePolicy:
+    resolution_ns: int
+    retention_ns: int
+
+    @classmethod
+    def parse(cls, s: str) -> "StoragePolicy":
+        """'10s:2d' -> StoragePolicy."""
+        parts = s.split(":")
+        if len(parts) != 2:
+            raise ValueError(f"invalid storage policy {s!r}")
+        return cls(parse_go_duration(parts[0]), parse_go_duration(parts[1]))
+
+    def __str__(self) -> str:
+        return f"{_fmt_dur(self.resolution_ns)}:{_fmt_dur(self.retention_ns)}"
+
+    @property
+    def namespace_name(self) -> str:
+        """Conventional aggregated-namespace name for this policy."""
+        return f"aggregated_{_fmt_dur(self.resolution_ns)}_{_fmt_dur(self.retention_ns)}"
+
+
+def _fmt_dur(ns: int) -> str:
+    for unit, size in (("y", _UNIT_NS["y"]), ("w", _UNIT_NS["w"]), ("d", _UNIT_NS["d"]),
+                       ("h", _UNIT_NS["h"]), ("m", _UNIT_NS["m"]), ("s", _UNIT_NS["s"]),
+                       ("ms", _UNIT_NS["ms"]), ("us", _UNIT_NS["us"])):
+        if ns % size == 0 and ns >= size:
+            return f"{ns // size}{unit}"
+    return f"{ns}ns"
+
+
+DEFAULT_POLICIES = (StoragePolicy.parse("10s:2d"),)

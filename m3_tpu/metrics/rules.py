@@ -1,0 +1,105 @@
+"""Mapping and rollup rules + the active ruleset matcher.
+
+Parity with the reference rules model
+(/root/reference/src/metrics/rules — mapping rules route matched metrics to
+aggregation types + storage policies; rollup rules emit NEW series keyed by
+a tag subset; active_ruleset.go matches incoming IDs). Versioning/tombstones
+are collapsed to "the current ruleset" here; the KV-watched dynamic reload
+belongs to the cluster layer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from m3_tpu.metrics.aggregation import AggregationType, MetricType
+from m3_tpu.metrics.transformation import TransformationType
+from m3_tpu.metrics.filters import TagFilter
+from m3_tpu.metrics.policy import StoragePolicy
+
+
+@dataclass
+class MappingRule:
+    name: str
+    filter: TagFilter
+    policies: tuple[StoragePolicy, ...]
+    aggregations: tuple[AggregationType, ...] = ()  # () = type defaults
+    drop: bool = False  # drop policy: matched metrics skip unaggregated store
+
+
+@dataclass
+class RollupTarget:
+    new_name: bytes
+    group_by: tuple[bytes, ...]  # tags kept on the rolled-up series
+    aggregations: tuple[AggregationType, ...]
+    policies: tuple[StoragePolicy, ...]
+    # optional pipeline transform applied between aggregation and emit
+    # (metrics/pipeline + transformation roles: e.g. PerSecond for rates)
+    transform: "TransformationType | None" = None
+
+
+@dataclass
+class RollupRule:
+    name: str
+    filter: TagFilter
+    targets: tuple[RollupTarget, ...]
+
+
+@dataclass
+class MatchResult:
+    mappings: list[MappingRule] = field(default_factory=list)
+    rollups: list[tuple[RollupRule, RollupTarget, bytes, list[tuple[bytes, bytes]]]] = (
+        field(default_factory=list)
+    )  # (rule, target, rolled-up id, rolled-up tags)
+
+    @property
+    def drop_unaggregated(self) -> bool:
+        return any(m.drop for m in self.mappings)
+
+
+class RuleSet:
+    """The active ruleset: matches tag dicts to mapping/rollup outcomes."""
+
+    def __init__(self, mapping_rules=(), rollup_rules=()):
+        self.mapping_rules: list[MappingRule] = list(mapping_rules)
+        self.rollup_rules: list[RollupRule] = list(rollup_rules)
+        self.version = 1
+
+    def match(self, tags: dict[bytes, bytes]) -> MatchResult:
+        from m3_tpu.utils.ident import tags_to_id
+
+        out = MatchResult()
+        for rule in self.mapping_rules:
+            if rule.filter.matches(tags):
+                out.mappings.append(rule)
+        for rule in self.rollup_rules:
+            if not rule.filter.matches(tags):
+                continue
+            for target in rule.targets:
+                kept = [(k, tags[k]) for k in target.group_by if k in tags]
+                rolled_id = tags_to_id(target.new_name, kept)
+                out.rollups.append((rule, target, rolled_id, kept))
+        return out
+
+
+class Matcher:
+    """Caching matcher front-end (the src/metrics/matcher role): rule match
+    results are memoized per canonical id until the ruleset version bumps."""
+
+    def __init__(self, ruleset: RuleSet, cache_size: int = 100_000):
+        self.ruleset = ruleset
+        self._cache: dict[bytes, MatchResult] = {}
+        self._cache_version = ruleset.version
+        self._cache_size = cache_size
+
+    def match(self, series_id: bytes, tags: dict[bytes, bytes]) -> MatchResult:
+        if self._cache_version != self.ruleset.version:
+            self._cache.clear()
+            self._cache_version = self.ruleset.version
+        hit = self._cache.get(series_id)
+        if hit is not None:
+            return hit
+        result = self.ruleset.match(tags)
+        if len(self._cache) < self._cache_size:
+            self._cache[series_id] = result
+        return result

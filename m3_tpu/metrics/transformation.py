@@ -1,0 +1,55 @@
+"""Datapoint transformations used inside rollup pipelines.
+
+Parity with /root/reference/src/metrics/transformation/type.go:39-43
+(Absolute/PerSecond/Increase/Add/Reset): unary ops are stateless per value;
+binary ops consume (previous, current) window aggregates per element.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class TransformationType(enum.IntEnum):
+    ABSOLUTE = 1
+    PERSECOND = 2
+    INCREASE = 3
+    ADD = 4
+    RESET = 5
+
+    @property
+    def is_binary(self) -> bool:
+        return self in (TransformationType.PERSECOND, TransformationType.INCREASE,
+                        TransformationType.ADD)
+
+
+def apply(
+    t: TransformationType,
+    prev_values: np.ndarray,
+    cur_values: np.ndarray,
+    prev_times_ns: np.ndarray,
+    cur_times_ns: np.ndarray,
+) -> np.ndarray:
+    """Vectorized transform over aligned (prev, cur) window aggregates.
+    prev entries are NaN when there is no prior window for the element."""
+    if t == TransformationType.ABSOLUTE:
+        return np.abs(cur_values)
+    if t == TransformationType.RESET:
+        return np.zeros_like(cur_values)
+    if t == TransformationType.ADD:
+        return np.where(np.isnan(prev_values), cur_values, prev_values + cur_values)
+    if t == TransformationType.INCREASE:
+        diff = cur_values - prev_values
+        # counter semantics: negative deltas mean a reset -> emit current
+        diff = np.where(diff < 0, cur_values, diff)
+        return np.where(np.isnan(prev_values), np.nan, diff)
+    if t == TransformationType.PERSECOND:
+        dt = (cur_times_ns - prev_times_ns) / 1e9
+        diff = cur_values - prev_values
+        diff = np.where(diff < 0, cur_values, diff)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            rate = np.where(dt > 0, diff / dt, np.nan)
+        return np.where(np.isnan(prev_values), np.nan, rate)
+    raise ValueError(f"unknown transformation {t}")

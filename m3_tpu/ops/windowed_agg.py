@@ -1,0 +1,118 @@
+"""Batched windowed aggregation over (element x window) groups.
+
+The device-grid replacement for the reference's per-elem streaming
+accumulators (/root/reference/src/aggregator/aggregation/{counter,gauge,
+timer}.go and the CKMS quantile streams in aggregation/quantile/cm): raw
+(elem, window, value) triples are segment-reduced in one vectorized pass;
+quantiles come from a grouped sort — EXACT, unlike CKMS's eps-approximation
+(deviation documented per SURVEY.md §7.5; memory is bounded by samples per
+open window rather than sketch size).
+
+numpy implementation (columnar, no per-sample Python); the group layout is
+chosen so a jnp.segment_* lowering is mechanical.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from m3_tpu.metrics.aggregation import AggregationType
+
+
+def aggregate_groups(
+    elem_ids: np.ndarray,  # [N] int64
+    window_ids: np.ndarray,  # [N] int64
+    values: np.ndarray,  # [N] float64
+    order_seq: np.ndarray | None = None,  # [N] append order (LAST tiebreak)
+    times: np.ndarray | None = None,  # [N] timestamps; LAST = max time
+):
+    """Group by (elem, window) and compute every base statistic.
+
+    Returns (group_elem, group_window, stats dict of [G] arrays, and a
+    grouped-sorted values array + group offsets for quantile extraction).
+    """
+    n = len(values)
+    if order_seq is None:
+        order_seq = np.arange(n)
+    if times is None:
+        times = np.zeros(n, np.int64)
+    # group identity via lexsort on (elem, window); within a group rows
+    # order by (time, append-seq) so LAST = latest timestamp, ties -> the
+    # later append (reference gauge lastAt semantics)
+    order = np.lexsort((order_seq, times, window_ids, elem_ids))
+    e, w, v = elem_ids[order], window_ids[order], values[order]
+    if n == 0:
+        empty = np.empty(0)
+        return (
+            np.empty(0, np.int64), np.empty(0, np.int64),
+            {k: empty for k in ("count", "sum", "sumsq", "min", "max", "mean",
+                                 "last", "stdev")},
+            empty, np.zeros(1, np.int64),
+        )
+    new_group = np.ones(n, bool)
+    new_group[1:] = (e[1:] != e[:-1]) | (w[1:] != w[:-1])
+    group_start = np.nonzero(new_group)[0]
+    offsets = np.concatenate([group_start, [n]])
+    counts = np.diff(offsets).astype(np.float64)
+
+    csum = np.concatenate([[0.0], np.cumsum(v)])
+    s1 = csum[offsets[1:]] - csum[offsets[:-1]]
+    csq = np.concatenate([[0.0], np.cumsum(v * v)])
+    s2 = csq[offsets[1:]] - csq[offsets[:-1]]
+    gmin = np.minimum.reduceat(v, offsets[:-1])
+    gmax = np.maximum.reduceat(v, offsets[:-1])
+    mean = s1 / counts
+    var = np.maximum(s2 / counts - mean**2, 0.0)
+    last = v[offsets[1:] - 1]  # order_seq tiebreak: last append wins
+
+    # grouped sort for quantiles: sort values WITHIN groups
+    vq = values[np.lexsort((values, window_ids, elem_ids))]
+
+    stats = {
+        "count": counts,
+        "sum": s1,
+        "sumsq": s2,
+        "min": gmin,
+        "max": gmax,
+        "mean": mean,
+        "last": last,
+        "stdev": np.sqrt(var),
+    }
+    return e[group_start], w[group_start], stats, vq, offsets
+
+
+def group_quantiles(vq: np.ndarray, offsets: np.ndarray, q: float) -> np.ndarray:
+    """Interpolated quantile per group from grouped-sorted values.
+
+    Same interpolation as the reference timer aggregation contract
+    (linear between closest ranks).
+    """
+    counts = np.diff(offsets)
+    rank = q * (counts - 1)
+    lo = np.floor(rank).astype(np.int64)
+    frac = rank - lo
+    i0 = offsets[:-1] + lo
+    i1 = np.minimum(i0 + 1, offsets[1:] - 1)
+    return vq[i0] * (1 - frac) + vq[i1] * frac
+
+
+def extract(
+    agg_type: AggregationType,
+    stats: dict,
+    vq: np.ndarray,
+    offsets: np.ndarray,
+) -> np.ndarray:
+    q = agg_type.quantile
+    if q is not None:
+        return group_quantiles(vq, offsets, q)
+    key = {
+        AggregationType.LAST: "last",
+        AggregationType.MIN: "min",
+        AggregationType.MAX: "max",
+        AggregationType.MEAN: "mean",
+        AggregationType.COUNT: "count",
+        AggregationType.SUM: "sum",
+        AggregationType.SUMSQ: "sumsq",
+        AggregationType.STDEV: "stdev",
+    }[agg_type]
+    return stats[key]

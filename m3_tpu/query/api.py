@@ -30,11 +30,6 @@ _MATCH_TYPE_BY_PROM = {
     3: MatchType.NOT_REGEXP,
 }
 
-_SELECTOR_RE = re.compile(
-    r'\s*([a-zA-Z_:][a-zA-Z0-9_:]*)?\s*(\{.*\})?\s*$'
-)
-
-
 def _parse_time(s: str) -> int:
     """Prometheus API time (unix seconds float or RFC3339) -> ns."""
     try:

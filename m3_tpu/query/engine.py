@@ -589,13 +589,13 @@ class Engine:
                 if e.bool_mode:
                     vals = np.where(np.isnan(a) | np.isnan(b), np.nan,
                                     raw.astype(np.float64))
-                    out_lb = self._result_labels(lb, rhs.labels[j], m, drop_name=True)
+                    out_lb = self._result_labels(lb, rhs.labels[j], m, group_left)
                 else:
                     vals = np.where(raw.astype(bool), lhs.values[i], np.nan)
                     out_lb = dict(lb)
             else:
                 vals = raw
-                out_lb = self._result_labels(lb, rhs.labels[j], m, drop_name=True)
+                out_lb = self._result_labels(lb, rhs.labels[j], m, group_left)
             out_l.append(out_lb)
             out_v.append(vals)
         T = lhs.values.shape[1] if len(lhs.labels) else (
@@ -603,16 +603,18 @@ class Engine:
         )
         return _compact(Vector(out_l, np.stack(out_v) if out_v else np.zeros((0, T))))
 
-    def _result_labels(self, l_lb, r_lb, m: VectorMatching | None, drop_name: bool):
-        if m and m.on:
-            out = {k: v for k, v in l_lb.items()
-                   if k.decode() in m.labels}
+    def _result_labels(self, l_lb, r_lb, m: VectorMatching | None, group_left: bool):
+        """Result labels per upstream: one-to-one on(...) keeps only the on
+        labels; otherwise the (many-side) lhs labels minus __name__ and
+        minus ignoring(...); group_left keeps the FULL many-side label set
+        (minus __name__) plus any include labels copied from the one side."""
+        if group_left:
+            out = {k: v for k, v in l_lb.items() if k != b"__name__"}
+        elif m and m.on:
+            out = {k: v for k, v in l_lb.items() if k.decode() in m.labels}
         else:
-            excl = {l.encode() for l in (m.labels if m else ())}
-            out = {k: v for k, v in l_lb.items()
-                   if k not in excl and not (drop_name and k == b"__name__")}
-            if drop_name:
-                out.pop(b"__name__", None)
+            excl = {l.encode() for l in (m.labels if m else ())} | {b"__name__"}
+            out = {k: v for k, v in l_lb.items() if k not in excl}
         for inc in (m.include if m else ()):
             k = inc.encode()
             if k in r_lb:

@@ -138,8 +138,8 @@ _TOKEN_RE = re.compile(
   | (?P<NUMBER>
         0[xX][0-9a-fA-F]+
       | (?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?
-      | [iI][nN][fF]
-      | [nN][aA][nN]
+      | [iI][nN][fF](?![a-zA-Z0-9_:])
+      | [nN][aA][nN](?![a-zA-Z0-9_:])
     )
   | (?P<IDENT>[a-zA-Z_:][a-zA-Z0-9_:]*)
   | (?P<STRING>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
@@ -405,9 +405,32 @@ def _parse_number(text: str) -> float:
     return float(text)
 
 
+_ESCAPES = {
+    "n": "\n", "t": "\t", "r": "\r", "\\": "\\", '"': '"', "'": "'",
+    "a": "\a", "b": "\b", "f": "\f", "v": "\v", "0": "\0",
+}
+
+_ESCAPE_RE = re.compile(
+    r"\\(?:x([0-9a-fA-F]{2})|u([0-9a-fA-F]{4})|U([0-9a-fA-F]{8})|(.))",
+    re.DOTALL,
+)
+
+
 def _unquote(s: str) -> str:
+    """Go-style string unescaping, UTF-8 safe (no latin-1 round trip)."""
     body = s[1:-1]
-    return body.encode().decode("unicode_escape")
+
+    def sub(m: re.Match) -> str:
+        if m.group(1):
+            return chr(int(m.group(1), 16))
+        if m.group(2):
+            return chr(int(m.group(2), 16))
+        if m.group(3):
+            return chr(int(m.group(3), 16))
+        c = m.group(4)
+        return _ESCAPES.get(c, c)
+
+    return _ESCAPE_RE.sub(sub, body)
 
 
 def parse(src: str) -> Expr:

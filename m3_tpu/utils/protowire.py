@@ -25,14 +25,6 @@ def _uvarint(v: int) -> bytes:
             return bytes(out)
 
 
-def _zigzag(v: int) -> int:
-    return (v << 1) ^ (v >> 63)
-
-
-def _unzigzag(v: int) -> int:
-    return (v >> 1) ^ -(v & 1)
-
-
 def read_uvarint(data: bytes, pos: int) -> tuple[int, int]:
     out = shift = 0
     while True:

@@ -1,0 +1,275 @@
+"""Aggregator + metrics domain model tests.
+
+Mirrors the reference aggregator test strategy (SURVEY.md §4): accumulator
+correctness per metric type, rule matching, rollups, windowing, transforms,
+and the downsampler write->aggregate->storage round trip.
+"""
+
+import numpy as np
+import pytest
+
+from m3_tpu.aggregator.downsample import Downsampler, DownsamplerAndWriter
+from m3_tpu.aggregator.engine import Aggregator
+from m3_tpu.metrics.aggregation import AggregationType as A, MetricType
+from m3_tpu.metrics.filters import TagFilter
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.rules import (
+    MappingRule,
+    Matcher,
+    RollupRule,
+    RollupTarget,
+    RuleSet,
+)
+from m3_tpu.metrics.transformation import TransformationType
+from m3_tpu.ops import windowed_agg
+
+SEC = 10**9
+START = 1_599_998_400_000_000_000
+
+
+class TestPolicy:
+    def test_parse(self):
+        p = StoragePolicy.parse("10s:2d")
+        assert p.resolution_ns == 10 * SEC
+        assert p.retention_ns == 48 * 3600 * SEC
+        assert str(p) == "10s:2d"
+        assert p.namespace_name == "aggregated_10s_2d"
+
+    def test_parse_invalid(self):
+        for bad in ("10s", "x:1d", "10s:2d:1m"):
+            with pytest.raises(ValueError):
+                StoragePolicy.parse(bad)
+
+
+class TestFilters:
+    def test_glob(self):
+        f = TagFilter.parse("app:web* env:{prod,staging}")
+        assert f.matches({b"app": b"web-1", b"env": b"prod"})
+        assert f.matches({b"app": b"web", b"env": b"staging"})
+        assert not f.matches({b"app": b"db", b"env": b"prod"})
+        assert not f.matches({b"app": b"web-1", b"env": b"dev"})
+        assert not f.matches({b"app": b"web-1"})
+
+    def test_negation(self):
+        f = TagFilter.parse("region:!us-*")
+        assert f.matches({b"region": b"eu-west"})
+        assert not f.matches({b"region": b"us-east"})
+        assert f.matches({})  # absent tag passes a negated clause
+
+    def test_name_clause(self):
+        f = TagFilter.parse("__name__:http_*")
+        assert f.matches({b"__name__": b"http_requests"})
+        assert not f.matches({b"__name__": b"grpc_requests"})
+
+
+class TestRules:
+    def test_mapping_and_rollup_match(self):
+        rs = RuleSet(
+            mapping_rules=[
+                MappingRule("m1", TagFilter.parse("app:web*"),
+                            (StoragePolicy.parse("10s:2d"),)),
+            ],
+            rollup_rules=[
+                RollupRule(
+                    "r1", TagFilter.parse("__name__:reqs app:*"),
+                    (RollupTarget(b"reqs_by_dc", (b"dc",), (A.SUM,),
+                                  (StoragePolicy.parse("1m:30d"),)),),
+                )
+            ],
+        )
+        m = Matcher(rs)
+        tags = {b"__name__": b"reqs", b"app": b"web-1", b"dc": b"east", b"host": b"h1"}
+        res = m.match(b"id-1", tags)
+        assert len(res.mappings) == 1
+        assert len(res.rollups) == 1
+        _, tgt, rolled_id, kept = res.rollups[0]
+        assert kept == [(b"dc", b"east")]
+        # cache hit returns same object
+        assert m.match(b"id-1", tags) is res
+
+
+class TestWindowedAgg:
+    def test_group_stats(self, rng):
+        elems = np.array([0, 0, 0, 1, 1, 0], np.int64)
+        windows = np.array([5, 5, 6, 5, 5, 5], np.int64)
+        values = np.array([1.0, 3.0, 10.0, 2.0, 4.0, 5.0])
+        ge, gw, stats, vq, offsets = windowed_agg.aggregate_groups(elems, windows, values)
+        assert list(ge) == [0, 0, 1]
+        assert list(gw) == [5, 6, 5]
+        np.testing.assert_array_equal(stats["count"], [3, 1, 2])
+        np.testing.assert_array_equal(stats["sum"], [9, 10, 6])
+        np.testing.assert_array_equal(stats["min"], [1, 10, 2])
+        np.testing.assert_array_equal(stats["max"], [5, 10, 4])
+        np.testing.assert_array_equal(stats["last"], [5, 10, 4])
+
+    def test_quantiles_vs_numpy(self, rng):
+        elems = np.zeros(101, np.int64)
+        windows = np.zeros(101, np.int64)
+        values = rng.permutation(np.arange(101, dtype=np.float64))
+        _, _, _, vq, offsets = windowed_agg.aggregate_groups(elems, windows, values)
+        for q in (0.5, 0.95, 0.99):
+            got = windowed_agg.group_quantiles(vq, offsets, q)[0]
+            np.testing.assert_allclose(got, np.quantile(np.arange(101.0), q))
+
+
+def simple_ruleset():
+    return RuleSet(mapping_rules=[
+        MappingRule("all", TagFilter.parse("__name__:*"),
+                    (StoragePolicy.parse("10s:2d"),)),
+    ])
+
+
+class TestAggregatorEngine:
+    def test_counter_sum_windows(self):
+        agg = Aggregator(simple_ruleset())
+        tags = [(b"__name__", b"c"), (b"app", b"x")]
+        for i in range(12):
+            # two 10s windows x 6 samples of value 1
+            agg.add(MetricType.COUNTER, b"c|app=x", tags, START + i * 2 * SEC, 1.0)
+        out = agg.flush(START + 60 * SEC)
+        assert len(out) == 3  # windows [0,10) [10,20) [20,30)
+        assert [m.value for m in out] == [5.0, 5.0, 2.0]
+        assert out[0].timestamp_ns == START + 10 * SEC
+        assert out[0].series_id == b"c|app=x"
+
+    def test_open_window_carries(self):
+        agg = Aggregator(simple_ruleset(), buffer_past_ns=5 * SEC)
+        tags = [(b"__name__", b"c")]
+        agg.add(MetricType.COUNTER, b"c", tags, START + 1 * SEC, 1.0)
+        agg.add(MetricType.COUNTER, b"c", tags, START + 11 * SEC, 2.0)
+        # first flush: only window [0,10) is old enough
+        out = agg.flush(START + 16 * SEC)
+        assert [m.value for m in out] == [1.0]
+        # second flush closes the carried window
+        out = agg.flush(START + 40 * SEC)
+        assert [m.value for m in out] == [2.0]
+
+    def test_timer_quantiles(self):
+        rs = RuleSet(mapping_rules=[
+            MappingRule("t", TagFilter.parse("__name__:lat"),
+                        (StoragePolicy.parse("10s:2d"),),
+                        aggregations=(A.P50, A.P99, A.COUNT)),
+        ])
+        agg = Aggregator(rs)
+        tags = [(b"__name__", b"lat")]
+        for i in range(100):
+            agg.add(MetricType.TIMER, b"lat", tags, START + SEC, float(i + 1))
+        out = agg.flush(START + 60 * SEC)
+        by_id = {m.series_id: m.value for m in out}
+        assert by_id[b"lat.count"] == 100.0
+        np.testing.assert_allclose(by_id[b"lat.p50"], np.quantile(np.arange(1, 101.0), 0.5))
+        np.testing.assert_allclose(by_id[b"lat.p99"], np.quantile(np.arange(1, 101.0), 0.99))
+        # suffixed names propagate to tags
+        tag_names = {dict(m.tags)[b"__name__"] for m in out}
+        assert tag_names == {b"lat.count", b"lat.p50", b"lat.p99"}
+
+    def test_gauge_last(self):
+        rs = RuleSet(mapping_rules=[
+            MappingRule("g", TagFilter.parse("__name__:g"),
+                        (StoragePolicy.parse("10s:2d"),))
+        ])
+        agg = Aggregator(rs)
+        tags = [(b"__name__", b"g")]
+        agg.add(MetricType.GAUGE, b"g", tags, START + 1 * SEC, 5.0)
+        agg.add(MetricType.GAUGE, b"g", tags, START + 8 * SEC, 7.0)
+        agg.add(MetricType.GAUGE, b"g", tags, START + 3 * SEC, 6.0)  # out of order
+        out = agg.flush(START + 60 * SEC)
+        assert [m.value for m in out] == [7.0]  # last by timestamp
+
+    def test_rollup(self):
+        rs = RuleSet(rollup_rules=[
+            RollupRule("r", TagFilter.parse("__name__:reqs"),
+                       (RollupTarget(b"reqs_by_dc", (b"dc",), (A.SUM,),
+                                     (StoragePolicy.parse("10s:2d"),)),))
+        ])
+        agg = Aggregator(rs)
+        for host, dc, v in [(b"h1", b"east", 1.0), (b"h2", b"east", 2.0),
+                            (b"h3", b"west", 4.0)]:
+            agg.add(MetricType.COUNTER, b"reqs|" + host,
+                    [(b"__name__", b"reqs"), (b"host", host), (b"dc", dc)],
+                    START + SEC, v)
+        out = agg.flush(START + 60 * SEC)
+        got = {dict(m.tags)[b"dc"]: m.value for m in out}
+        assert got == {b"east": 3.0, b"west": 4.0}
+        assert all(dict(m.tags)[b"__name__"] == b"reqs_by_dc" for m in out)
+        assert all(b"host" not in dict(m.tags) for m in out)
+
+    def test_per_second_transform(self):
+        rs = RuleSet(rollup_rules=[
+            RollupRule("r", TagFilter.parse("__name__:c"),
+                       (RollupTarget(b"c_rate", (), (A.SUM,),
+                                     (StoragePolicy.parse("10s:2d"),),
+                                     transform=TransformationType.PERSECOND),))
+        ])
+        agg = Aggregator(rs)
+        agg.add(MetricType.COUNTER, b"c", [(b"__name__", b"c")], START + SEC, 10.0)
+        agg.add(MetricType.COUNTER, b"c", [(b"__name__", b"c")], START + 11 * SEC, 30.0)
+        out = agg.flush(START + 60 * SEC)
+        # first window has no prev -> suppressed; second window rate:
+        # (30-10)/10s = 2.0
+        assert [m.value for m in out] == [2.0]
+
+
+class TestDownsampler:
+    def test_write_aggregate_query_roundtrip(self, tmp_path):
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.options import DatabaseOptions
+
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+        db.create_namespace("default")
+        db.open(START)
+        rs = RuleSet(mapping_rules=[
+            MappingRule("m", TagFilter.parse("__name__:cpu"),
+                        (StoragePolicy.parse("10s:2d"),)),
+        ])
+        ds = Downsampler(db, rs)
+        dw = DownsamplerAndWriter(db, ds)
+        for i in range(6):
+            dw.write(MetricType.GAUGE, b"cpu", [(b"host", b"h1")],
+                     START + i * 2 * SEC, float(i))
+        ds.flush(START + 60 * SEC)
+        # raw writes landed in default ns
+        raw = db.query("default",
+                       [__import__("m3_tpu.index.query", fromlist=["Matcher"]).Matcher(
+                           __import__("m3_tpu.index.query", fromlist=["MatchType"]).MatchType.EQUAL,
+                           b"__name__", b"cpu")],
+                       START, START + 60 * SEC)
+        assert len(raw) == 1 and len(raw[0][2]) == 6
+        # aggregated namespace exists and holds the 10s rollup (gauge last)
+        ns_name = StoragePolicy.parse("10s:2d").namespace_name
+        assert ns_name in db.namespaces
+        dps = db.read(ns_name, b"cpu|host=h1", START, START + 60 * SEC)
+        assert [d.value for d in dps] == [4.0, 5.0]  # windows ending 10s, 20s
+        db.close()
+
+    def test_drop_policy(self, tmp_path):
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.options import DatabaseOptions
+
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+        db.create_namespace("default")
+        db.open(START)
+        rs = RuleSet(mapping_rules=[
+            MappingRule("m", TagFilter.parse("__name__:noisy"),
+                        (StoragePolicy.parse("1m:1d"),), drop=True),
+        ])
+        dw = DownsamplerAndWriter(db, Downsampler(db, rs))
+        dw.write(MetricType.COUNTER, b"noisy", [], START + SEC, 1.0)
+        dw.write(MetricType.COUNTER, b"quiet", [], START + SEC, 1.0)
+        assert db.read("default", b"noisy", START, START + 60 * SEC) == []
+        assert len(db.read("default", b"quiet", START, START + 60 * SEC)) == 1
+        db.close()
+
+
+class TestLateArrivals:
+    def test_late_sample_dropped_after_flush(self):
+        agg = Aggregator(simple_ruleset())
+        tags = [(b"__name__", b"c")]
+        agg.add(MetricType.COUNTER, b"c", tags, START + SEC, 100.0)
+        out = agg.flush(START + 60 * SEC)
+        assert [m.value for m in out] == [100.0]
+        # late sample for the already-flushed window must be rejected
+        agg.add(MetricType.COUNTER, b"c", tags, START + 2 * SEC, 1.0)
+        out = agg.flush(START + 120 * SEC)
+        assert out == []
+        assert agg.num_late_dropped == 1

@@ -303,3 +303,32 @@ class TestEngine:
         eng = Engine(db)
         v, _ = eng.query_range("deriv(m8[1m])", START + MIN, START + MIN, MIN)
         np.testing.assert_allclose(v.values[0, 0], 2.0, rtol=1e-9)
+
+
+class TestParserRegressions:
+    def test_metric_starting_with_inf_nan(self):
+        e = parse("infra_up")
+        assert e.name == "infra_up"
+        e = parse("nano_seconds_total")
+        assert e.name == "nano_seconds_total"
+        assert parse("inf").value == float("inf")
+
+    def test_utf8_label_values(self):
+        e = parse('m{city="café", note="tab\\there"}')
+        vals = {m.name: m.value for m in e.matchers}
+        assert vals[b"city"] == "café".encode()
+        assert vals[b"note"] == b"tab\there"
+
+
+class TestGroupLeftLabels:
+    def test_group_left_keeps_many_side_labels(self, db):
+        write_series(db, b"errs", [(b"job", b"j"), (b"code", b"500")],
+                     [(START + 10**9, 5.0)])
+        write_series(db, b"errs", [(b"job", b"j"), (b"code", b"404")],
+                     [(START + 10**9, 10.0)])
+        write_series(db, b"reqs", [(b"job", b"j")], [(START + 10**9, 100.0)])
+        eng = Engine(db)
+        v, _ = eng.query_range("errs / ignoring(code) group_left reqs",
+                               START + MIN, START + MIN, MIN)
+        got = {lb[b"code"]: v.values[i, 0] for i, lb in enumerate(v.labels)}
+        assert got == {b"500": 0.05, b"404": 0.1}
