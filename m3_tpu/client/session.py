@@ -1,0 +1,138 @@
+"""Cluster client session: quorum writes and replica-merged reads.
+
+Role parity with the reference session
+(/root/reference/src/dbnode/client/session.go:1269,1341,1585 and
+consistency accumulators): writes fan out to every replica of the target
+shard and succeed once the consistency level's ack count is met; reads
+fan out, merge replica streams with last-write-wins dedup (the
+MultiReaderIterator role), and satisfy the read consistency level.
+
+Transport is pluggable: a node connection is anything exposing the node
+API (in-process Database for the integration harness, an HTTP/RPC proxy
+for real deployments) — the reference's TChannel host queues become this
+connection layer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Protocol
+
+import numpy as np
+
+from m3_tpu.cluster.topology import (
+    ConsistencyLevel,
+    TopologyMap,
+    is_unstrict,
+    required_acks,
+)
+from m3_tpu.storage.buffer import merge_dedup
+from m3_tpu.utils.hash import murmur3_32
+
+
+class NodeConnection(Protocol):
+    def write_tagged(self, namespace: str, metric_name: bytes, tags, t_ns: int,
+                     value: float): ...
+
+    def read(self, namespace: str, series_id: bytes, start_ns: int, end_ns: int): ...
+
+
+class ConsistencyError(Exception):
+    pass
+
+
+@dataclass
+class WriteResult:
+    acks: int
+    errors: list[tuple[str, Exception]] = field(default_factory=list)
+
+
+class Session:
+    def __init__(
+        self,
+        topology: TopologyMap,
+        connections: dict[str, NodeConnection],
+        write_consistency: ConsistencyLevel = ConsistencyLevel.MAJORITY,
+        read_consistency: ConsistencyLevel = ConsistencyLevel.ONE,
+        shard_seed: int = 42,
+    ):
+        self.topology = topology
+        self.connections = connections
+        self.write_consistency = write_consistency
+        self.read_consistency = read_consistency
+        self.shard_seed = shard_seed
+
+    def _shard(self, series_id: bytes) -> int:
+        return murmur3_32(series_id, self.shard_seed) % self.topology.n_shards
+
+    # -- write path --
+
+    def write_tagged(self, namespace: str, metric_name: bytes, tags,
+                     t_ns: int, value: float) -> WriteResult:
+        from m3_tpu.utils.ident import tags_to_id
+
+        series_id = tags_to_id(metric_name, tags)
+        shard = self._shard(series_id)
+        hosts = self.topology.hosts_for_shard(shard)
+        result = WriteResult(acks=0)
+        for host in hosts:
+            conn = self.connections.get(host)
+            if conn is None:
+                result.errors.append((host, ConnectionError(f"no connection to {host}")))
+                continue
+            try:
+                conn.write_tagged(namespace, metric_name, list(tags), t_ns, value)
+                result.acks += 1
+            except Exception as e:  # per-host failure feeds the accumulator
+                result.errors.append((host, e))
+        need = required_acks(self.write_consistency, self.topology.replica_factor)
+        if result.acks < need:
+            raise ConsistencyError(
+                f"write got {result.acks}/{need} acks "
+                f"(level={self.write_consistency.value}, errors={result.errors})"
+            )
+        return result
+
+    # -- read path --
+
+    def fetch(self, namespace: str, series_id: bytes, start_ns: int, end_ns: int):
+        """Replica-merged datapoints [(t_ns, value)]."""
+        shard = self._shard(series_id)
+        hosts = self.topology.readable_hosts_for_shard(shard)
+        if not hosts:
+            raise ConsistencyError(f"no readable replicas for shard {shard}")
+        # unstrict levels are satisfied by ANY successful replica read
+        # (reference topology.ReadConsistencyAchieved: numSuccess > 0)
+        if is_unstrict(self.read_consistency):
+            need = 1
+        else:
+            need = required_acks(self.read_consistency, self.topology.replica_factor)
+        parts_t, parts_v = [], []
+        successes = 0
+        errors = []
+        for host in hosts:
+            conn = self.connections.get(host)
+            if conn is None:
+                errors.append((host, ConnectionError(f"no connection to {host}")))
+                continue
+            try:
+                dps = conn.read(namespace, series_id, start_ns, end_ns)
+            except Exception as e:
+                errors.append((host, e))
+                continue
+            successes += 1
+            if dps:
+                parts_t.append(np.array([d.timestamp_ns for d in dps], np.int64))
+                parts_v.append(
+                    np.array([d.value for d in dps], np.float64).view(np.uint64)
+                )
+        if successes < need:
+            raise ConsistencyError(
+                f"read got {successes}/{need} replicas "
+                f"(level={self.read_consistency.value}, errors={errors})"
+            )
+        if not parts_t:
+            return []
+        times, vbits = merge_dedup(np.concatenate(parts_t), np.concatenate(parts_v))
+        values = vbits.view(np.float64)
+        return list(zip(times.tolist(), values.tolist()))

@@ -1,0 +1,165 @@
+"""Versioned KV store with watches and CAS transactions.
+
+Role parity with the reference KV abstraction
+(/root/reference/src/cluster/kv/types.go:113,219): versioned values,
+check-and-set, watchable keys. Backends: in-memory (tests/single node) and
+a file-backed store (durable single-host deployments standing in for etcd;
+a real etcd client can implement the same interface later without touching
+callers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+
+class KVError(Exception):
+    pass
+
+
+class VersionMismatch(KVError):
+    pass
+
+
+class KeyNotFound(KVError):
+    pass
+
+
+@dataclass(frozen=True)
+class VersionedValue:
+    version: int
+    data: bytes
+
+
+class KVStore:
+    """In-memory versioned KV with watches."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._data: dict[str, VersionedValue] = {}
+        self._watchers: dict[str, list[Callable[[str, VersionedValue | None], None]]] = {}
+
+    # -- core ops --
+
+    def get(self, key: str) -> VersionedValue:
+        with self._lock:
+            v = self._data.get(key)
+            if v is None:
+                raise KeyNotFound(key)
+            return v
+
+    def set(self, key: str, data: bytes) -> int:
+        with self._lock:
+            cur = self._data.get(key)
+            version = (cur.version + 1) if cur else 1
+            vv = VersionedValue(version, data)
+            self._data[key] = vv
+            self._persist()
+            # notify under the (reentrant) lock so watchers observe updates
+            # in version order; watchers must therefore be fast/non-blocking
+            self._notify(key, vv)
+        return version
+
+    def set_if_not_exists(self, key: str, data: bytes) -> int:
+        with self._lock:
+            if key in self._data:
+                raise VersionMismatch(f"{key} already exists")
+            vv = VersionedValue(1, data)
+            self._data[key] = vv
+            self._persist()
+            self._notify(key, vv)
+        return 1
+
+    def check_and_set(self, key: str, expect_version: int, data: bytes) -> int:
+        """CAS; expect_version 0 means 'must not exist'."""
+        with self._lock:
+            cur = self._data.get(key)
+            cur_version = cur.version if cur else 0
+            if cur_version != expect_version:
+                raise VersionMismatch(
+                    f"{key}: have version {cur_version}, expected {expect_version}"
+                )
+            vv = VersionedValue(cur_version + 1, data)
+            self._data[key] = vv
+            self._persist()
+            self._notify(key, vv)
+        return vv.version
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            if key not in self._data:
+                raise KeyNotFound(key)
+            del self._data[key]
+            self._persist()
+            self._notify(key, None)
+
+    def keys(self, prefix: str = "") -> list[str]:
+        with self._lock:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+    # -- watches --
+
+    def watch(self, key: str, fn: Callable[[str, VersionedValue | None], None]) -> Callable:
+        """Register a watcher; returns an unwatch function. The current
+        value (if any) is delivered immediately, mirroring the reference
+        watch bootstrap."""
+        with self._lock:
+            self._watchers.setdefault(key, []).append(fn)
+            cur = self._data.get(key)
+            if cur is not None:
+                fn(key, cur)  # bootstrap delivery ordered with updates
+
+        def unwatch():
+            with self._lock:
+                try:
+                    self._watchers.get(key, []).remove(fn)
+                except ValueError:
+                    pass
+
+        return unwatch
+
+    def _notify(self, key: str, vv: VersionedValue | None) -> None:
+        with self._lock:
+            fns = list(self._watchers.get(key, []))
+        for fn in fns:
+            try:
+                fn(key, vv)
+            except Exception:
+                pass  # watcher errors never poison the store
+
+    def _persist(self) -> None:  # overridden by FileKVStore
+        pass
+
+
+class FileKVStore(KVStore):
+    """KV durably journaled to a JSON file (single-host etcd stand-in)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self._path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if os.path.exists(path):
+            with open(path) as f:
+                raw = json.load(f)
+            self._data = {
+                k: VersionedValue(v["version"], bytes.fromhex(v["data"]))
+                for k, v in raw.items()
+            }
+
+    def _persist(self) -> None:
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    k: {"version": v.version, "data": v.data.hex()}
+                    for k, v in self._data.items()
+                },
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)

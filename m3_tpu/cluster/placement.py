@@ -1,0 +1,292 @@
+"""Placements: instances, shard assignments, and rebalancing algorithms.
+
+Role parity with the reference placement model
+(/root/reference/src/cluster/placement — instances carrying shard sets with
+Initializing/Available/Leaving states driving elastic add/remove/replace)
+and its algorithms (placement/algo/sharded.go minimal-churn rebalancing;
+mirrored.go paired leader/follower placements for the aggregator).
+
+Multi-chip mapping (SURVEY.md §2.10): a placement's shard->instance
+assignment is exactly the mesh 'shard' axis layout; the parallel/ package
+builds jax.sharding meshes from a Placement.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field, replace
+
+
+class ShardState(enum.Enum):
+    INITIALIZING = "INITIALIZING"
+    AVAILABLE = "AVAILABLE"
+    LEAVING = "LEAVING"
+
+
+@dataclass(frozen=True)
+class Shard:
+    id: int
+    state: ShardState = ShardState.INITIALIZING
+    source_id: str | None = None  # instance streamed from while INITIALIZING
+
+
+@dataclass
+class Instance:
+    id: str
+    isolation_group: str = "default"  # rack/zone anti-affinity domain
+    weight: int = 1
+    endpoint: str = ""
+    shards: dict[int, Shard] = field(default_factory=dict)
+    shard_set_id: int = 0  # mirrored placements: paired instances share ids
+
+    def shard_ids(self, *states: ShardState) -> list[int]:
+        if not states:
+            return sorted(self.shards)
+        return sorted(s.id for s in self.shards.values() if s.state in states)
+
+
+@dataclass
+class Placement:
+    instances: dict[str, Instance] = field(default_factory=dict)
+    n_shards: int = 0
+    replica_factor: int = 1
+    is_mirrored: bool = False
+    version: int = 0
+
+    # -- queries --
+
+    def instances_for_shard(self, shard_id: int) -> list[Instance]:
+        return [
+            inst for inst in self.instances.values()
+            if shard_id in inst.shards
+            and inst.shards[shard_id].state != ShardState.LEAVING
+        ]
+
+    def validate(self) -> None:
+        counts = {s: 0 for s in range(self.n_shards)}
+        for inst in self.instances.values():
+            for sid, sh in inst.shards.items():
+                if sh.state != ShardState.LEAVING:
+                    counts[sid] += 1
+        bad = {s: c for s, c in counts.items() if c != self.replica_factor}
+        if bad:
+            raise ValueError(f"shards without RF={self.replica_factor} owners: {bad}")
+
+    # -- serialization (stored in KV) --
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "n_shards": self.n_shards,
+                "replica_factor": self.replica_factor,
+                "is_mirrored": self.is_mirrored,
+                "version": self.version,
+                "instances": {
+                    iid: {
+                        "isolation_group": inst.isolation_group,
+                        "weight": inst.weight,
+                        "endpoint": inst.endpoint,
+                        "shard_set_id": inst.shard_set_id,
+                        "shards": [
+                            {"id": s.id, "state": s.state.value, "source": s.source_id}
+                            for s in inst.shards.values()
+                        ],
+                    }
+                    for iid, inst in self.instances.items()
+                },
+            }
+        ).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "Placement":
+        doc = json.loads(raw)
+        p = cls(
+            n_shards=doc["n_shards"],
+            replica_factor=doc["replica_factor"],
+            is_mirrored=doc.get("is_mirrored", False),
+            version=doc.get("version", 0),
+        )
+        for iid, d in doc["instances"].items():
+            inst = Instance(
+                id=iid,
+                isolation_group=d.get("isolation_group", "default"),
+                weight=d.get("weight", 1),
+                endpoint=d.get("endpoint", ""),
+                shard_set_id=d.get("shard_set_id", 0),
+            )
+            for s in d["shards"]:
+                inst.shards[s["id"]] = Shard(
+                    s["id"], ShardState(s["state"]), s.get("source")
+                )
+            p.instances[iid] = inst
+        return p
+
+
+# ---------------------------------------------------------------------------
+# sharded placement algorithm (minimal-churn add/remove/replace)
+# ---------------------------------------------------------------------------
+
+
+def initial_placement(
+    instances: list[Instance], n_shards: int, replica_factor: int
+) -> Placement:
+    """Spread each replica of every shard across instances, preferring
+    distinct isolation groups per shard."""
+    p = Placement(n_shards=n_shards, replica_factor=replica_factor)
+    for inst in instances:
+        p.instances[inst.id] = _bare_copy(inst)
+    if len(instances) < replica_factor:
+        raise ValueError("need at least RF instances")
+    # round-robin by load, respecting isolation groups where possible
+    for sid in range(n_shards):
+        owners: list[Instance] = []
+        for _r in range(replica_factor):
+            cand = _least_loaded(p, exclude={o.id for o in owners},
+                                 avoid_groups={o.isolation_group for o in owners})
+            cand.shards[sid] = Shard(sid, ShardState.INITIALIZING)
+            owners.append(cand)
+    # initial placement: every shard immediately AVAILABLE (no data to move)
+    for inst in p.instances.values():
+        inst.shards = {
+            sid: Shard(sid, ShardState.AVAILABLE) for sid in inst.shards
+        }
+    p.version = 1
+    return p
+
+
+def _bare_copy(inst: Instance) -> Instance:
+    """Copy of an instance with an empty shard set."""
+    return replace(inst, shards={})
+
+
+def _active_shards(inst: Instance) -> int:
+    return sum(1 for s in inst.shards.values() if s.state != ShardState.LEAVING)
+
+
+def _least_loaded(p: Placement, exclude: set[str], avoid_groups: set[str]) -> Instance:
+    def load(inst: Instance) -> float:
+        return len(inst.shards) / max(inst.weight, 1)
+
+    cands = [i for i in p.instances.values() if i.id not in exclude]
+    if not cands:
+        raise ValueError("no candidate instances")
+    preferred = [i for i in cands if i.isolation_group not in avoid_groups]
+    pool = preferred or cands
+    return min(pool, key=lambda i: (load(i), i.id))
+
+
+def add_instance(p: Placement, new: Instance) -> Placement:
+    """Move a fair share of shards onto the new instance; moved shards are
+    INITIALIZING on the target (sourced from the donor) and stay AVAILABLE
+    on the donor until the target finishes bootstrapping."""
+    out = Placement.from_json(p.to_json())
+    new_inst = _bare_copy(new)
+    out.instances[new_inst.id] = new_inst
+    total = p.n_shards * p.replica_factor
+    target_load = total // len(out.instances)
+    donors = sorted(out.instances.values(), key=_active_shards, reverse=True)
+    for donor in donors:
+        if donor.id == new_inst.id:
+            continue
+        while (len(new_inst.shards) < target_load
+               and _active_shards(donor) > target_load):
+            movable = [
+                s for s in donor.shards.values()
+                if s.state == ShardState.AVAILABLE and s.id not in new_inst.shards
+            ]
+            if not movable:
+                break
+            sh = movable[0]
+            new_inst.shards[sh.id] = Shard(sh.id, ShardState.INITIALIZING, donor.id)
+            donor.shards[sh.id] = Shard(sh.id, ShardState.LEAVING)
+    out.version += 1
+    return out
+
+
+def remove_instance(p: Placement, instance_id: str) -> Placement:
+    """Reassign the leaving instance's shards to the least-loaded peers."""
+    out = Placement.from_json(p.to_json())
+    leaving = out.instances.get(instance_id)
+    if leaving is None:
+        raise KeyError(instance_id)
+    for sid in list(leaving.shards):
+        leaving.shards[sid] = Shard(sid, ShardState.LEAVING)
+        current_owners = {
+            i.id for i in out.instances.values()
+            if sid in i.shards and i.shards[sid].state != ShardState.LEAVING
+        }
+        target = _least_loaded(
+            out,
+            exclude=current_owners | {instance_id},
+            avoid_groups=set(),
+        )
+        target.shards[sid] = Shard(sid, ShardState.INITIALIZING, instance_id)
+    out.version += 1
+    return out
+
+
+def replace_instance(p: Placement, old_id: str, new: Instance) -> Placement:
+    """Swap an instance: the replacement inherits every shard, INITIALIZING
+    from the departed peer's replicas."""
+    out = Placement.from_json(p.to_json())
+    old = out.instances.get(old_id)
+    if old is None:
+        raise KeyError(old_id)
+    new_inst = _bare_copy(new)
+    new_inst.shards = {
+        sid: Shard(sid, ShardState.INITIALIZING, old_id) for sid in old.shards
+    }
+    for sid in list(old.shards):
+        old.shards[sid] = Shard(sid, ShardState.LEAVING)
+    out.instances[new_inst.id] = new_inst
+    out.version += 1
+    return out
+
+
+def mark_available(p: Placement, instance_id: str, shard_ids: list[int] | None = None
+                   ) -> Placement:
+    """Complete bootstrap: INITIALIZING -> AVAILABLE on the instance, and
+    drop the corresponding LEAVING shard from the donor."""
+    out = Placement.from_json(p.to_json())
+    inst = out.instances[instance_id]
+    ids = shard_ids if shard_ids is not None else list(inst.shards)
+    for sid in ids:
+        sh = inst.shards.get(sid)
+        if sh is None or sh.state != ShardState.INITIALIZING:
+            continue
+        inst.shards[sid] = Shard(sid, ShardState.AVAILABLE)
+        if sh.source_id:
+            donor = out.instances.get(sh.source_id)
+            if donor and sid in donor.shards and donor.shards[sid].state == ShardState.LEAVING:
+                del donor.shards[sid]
+    # prune instances that were draining and now own nothing
+    drained = [
+        iid for iid, inst in out.instances.items()
+        if not inst.shards and iid != instance_id
+        and p.instances.get(iid) is not None and p.instances[iid].shards
+    ]
+    for iid in drained:
+        del out.instances[iid]
+    out.version += 1
+    return out
+
+
+def mirrored_placement(pairs: list[tuple[Instance, Instance]], n_shards: int) -> Placement:
+    """Mirrored placement (aggregator leader/follower pairs): both members
+    of a pair carry identical shard sets and share a shard_set_id
+    (cluster/placement/algo/mirrored.go role)."""
+    p = Placement(n_shards=n_shards, replica_factor=2, is_mirrored=True)
+    for set_id, (a, b) in enumerate(pairs, start=1):
+        for inst in (a, b):
+            cp = _bare_copy(inst)
+            cp.shard_set_id = set_id
+            p.instances[cp.id] = cp
+    n_pairs = len(pairs)
+    for sid in range(n_shards):
+        set_id = (sid % n_pairs) + 1
+        for inst in p.instances.values():
+            if inst.shard_set_id == set_id:
+                inst.shards[sid] = Shard(sid, ShardState.AVAILABLE)
+    p.version = 1
+    return p

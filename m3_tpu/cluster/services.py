@@ -1,0 +1,115 @@
+"""Service discovery + leader election over the KV store.
+
+Role parity with /root/reference/src/cluster/services/types.go:36,326,371:
+instances advertise themselves with heartbeats; a leader service runs
+campaign/resign elections. Elections are lease-based CAS records in KV (the
+etcd-election stand-in): the leader must re-assert within the TTL or any
+campaigner can seize the key.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+
+from m3_tpu.cluster.kv import KeyNotFound, KVStore, VersionMismatch
+
+
+@dataclass
+class Advertisement:
+    service: str
+    instance_id: str
+    endpoint: str
+    heartbeat_ns: int
+
+
+class Services:
+    def __init__(self, kv: KVStore, heartbeat_ttl_s: float = 10.0):
+        self.kv = kv
+        self.ttl = heartbeat_ttl_s
+
+    def _key(self, service: str, instance_id: str) -> str:
+        return f"_sd/{service}/{instance_id}"
+
+    def advertise(self, service: str, instance_id: str, endpoint: str = "") -> None:
+        ad = Advertisement(service, instance_id, endpoint, time.time_ns())
+        self.kv.set(self._key(service, instance_id), json.dumps(ad.__dict__).encode())
+
+    def instances(self, service: str, now_ns: int | None = None) -> list[Advertisement]:
+        """Live (heartbeat within TTL) instances of a service."""
+        now_ns = now_ns if now_ns is not None else time.time_ns()
+        out = []
+        for key in self.kv.keys(f"_sd/{service}/"):
+            try:
+                doc = json.loads(self.kv.get(key).data)
+            except KeyNotFound:
+                continue  # deregistered between keys() and get()
+            ad = Advertisement(**doc)
+            if now_ns - ad.heartbeat_ns <= self.ttl * 1e9:
+                out.append(ad)
+        return sorted(out, key=lambda a: a.instance_id)
+
+
+class LeaderService:
+    """Lease-based election: campaign() seizes or renews a lease record;
+    followers observe; resign() releases. TTL expiry lets a new leader
+    seize (failure detection)."""
+
+    def __init__(self, kv: KVStore, election_id: str, instance_id: str,
+                 lease_ttl_s: float = 10.0):
+        self.kv = kv
+        self.election_id = election_id
+        self.instance_id = instance_id
+        self.ttl = lease_ttl_s
+        self._key = f"_leader/{election_id}"
+        self._lock = threading.Lock()
+
+    def campaign(self, now_ns: int | None = None) -> bool:
+        """Try to become (or stay) leader; returns True when leading."""
+        now_ns = now_ns if now_ns is not None else time.time_ns()
+        record = json.dumps(
+            {"leader": self.instance_id, "renewed_ns": now_ns}
+        ).encode()
+        with self._lock:
+            try:
+                cur = self.kv.get(self._key)
+            except KeyNotFound:
+                try:
+                    self.kv.set_if_not_exists(self._key, record)
+                    return True
+                except VersionMismatch:
+                    return False
+            doc = json.loads(cur.data)
+            expired = now_ns - doc["renewed_ns"] > self.ttl * 1e9
+            if doc["leader"] != self.instance_id and not expired:
+                return False
+            try:
+                self.kv.check_and_set(self._key, cur.version, record)
+                return True
+            except VersionMismatch:
+                return False
+
+    def leader(self, now_ns: int | None = None) -> str | None:
+        now_ns = now_ns if now_ns is not None else time.time_ns()
+        try:
+            doc = json.loads(self.kv.get(self._key).data)
+        except Exception:
+            return None
+        if now_ns - doc["renewed_ns"] > self.ttl * 1e9:
+            return None
+        return doc["leader"]
+
+    def is_leader(self, now_ns: int | None = None) -> bool:
+        return self.leader(now_ns) == self.instance_id
+
+    def resign(self) -> None:
+        with self._lock:
+            try:
+                cur = self.kv.get(self._key)
+                doc = json.loads(cur.data)
+                if doc["leader"] == self.instance_id:
+                    self.kv.delete(self._key)
+            except Exception:
+                pass

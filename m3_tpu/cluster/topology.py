@@ -1,0 +1,75 @@
+"""Topology: the client-facing view of a placement + consistency levels.
+
+Role parity with /root/reference/src/dbnode/topology/types.go:65,99 and
+consistency_level.go: host->shard mapping derived from the placement, and
+the write/read consistency ladder (One / Majority / All, with unstrict
+variants used during bootstraps).
+"""
+
+from __future__ import annotations
+
+import enum
+
+from m3_tpu.cluster.placement import Placement, ShardState
+
+
+class ConsistencyLevel(enum.Enum):
+    ONE = "one"
+    MAJORITY = "majority"
+    ALL = "all"
+    UNSTRICT_MAJORITY = "unstrict_majority"
+    UNSTRICT_ALL = "unstrict_all"
+
+
+def majority(replica_factor: int) -> int:
+    return replica_factor // 2 + 1
+
+
+def required_acks(level: ConsistencyLevel, replica_factor: int) -> int:
+    if level == ConsistencyLevel.ONE:
+        return 1
+    if level in (ConsistencyLevel.MAJORITY, ConsistencyLevel.UNSTRICT_MAJORITY):
+        return majority(replica_factor)
+    return replica_factor
+
+
+def is_unstrict(level: ConsistencyLevel) -> bool:
+    return level in (ConsistencyLevel.UNSTRICT_MAJORITY, ConsistencyLevel.UNSTRICT_ALL)
+
+
+class TopologyMap:
+    """Immutable view over one placement version."""
+
+    def __init__(self, placement: Placement):
+        self.placement = placement
+
+    @property
+    def replica_factor(self) -> int:
+        return self.placement.replica_factor
+
+    @property
+    def n_shards(self) -> int:
+        return self.placement.n_shards
+
+    def hosts_for_shard(self, shard_id: int) -> list[str]:
+        """Instance ids owning the shard in ANY state: writes go to
+        bootstrapping (INITIALIZING) targets so they don't miss data, and
+        to LEAVING donors which keep serving until cutover completes."""
+        return sorted(
+            inst.id
+            for inst in self.placement.instances.values()
+            if shard_id in inst.shards
+        )
+
+    def readable_hosts_for_shard(self, shard_id: int) -> list[str]:
+        """AVAILABLE and LEAVING replicas serve reads (a leaving donor has
+        the full data until the handoff finishes); INITIALIZING replicas
+        are still bootstrapping and would return partial data."""
+        out = []
+        for inst in self.placement.instances.values():
+            sh = inst.shards.get(shard_id)
+            if sh is not None and sh.state in (
+                ShardState.AVAILABLE, ShardState.LEAVING
+            ):
+                out.append(inst.id)
+        return sorted(out)

@@ -1,0 +1,268 @@
+"""Cluster layer tests: KV, elections, placements, topology, and the
+multi-node quorum harness.
+
+Mirrors the reference's in-process integration style (SURVEY.md §4.4):
+several real Database nodes in one process under a fake-etcd placement,
+quorum writes/reads, node-down behavior, and elastic add-node bootstrap.
+"""
+
+import json
+
+import pytest
+
+from m3_tpu.client.session import ConsistencyError, Session
+from m3_tpu.cluster import placement as pl
+from m3_tpu.cluster.kv import FileKVStore, KeyNotFound, KVStore, VersionMismatch
+from m3_tpu.cluster.placement import Instance, ShardState
+from m3_tpu.cluster.services import LeaderService, Services
+from m3_tpu.cluster.topology import ConsistencyLevel, TopologyMap, majority
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import DatabaseOptions
+
+HOUR = 3600 * 10**9
+START = 1_599_998_400_000_000_000
+SEC = 10**9
+
+
+class TestKV:
+    def test_versioned_set_get(self):
+        kv = KVStore()
+        assert kv.set("a", b"1") == 1
+        assert kv.set("a", b"2") == 2
+        assert kv.get("a").data == b"2"
+        with pytest.raises(KeyNotFound):
+            kv.get("missing")
+
+    def test_cas(self):
+        kv = KVStore()
+        kv.set("k", b"v1")
+        with pytest.raises(VersionMismatch):
+            kv.check_and_set("k", 99, b"nope")
+        assert kv.check_and_set("k", 1, b"v2") == 2
+
+    def test_watch(self):
+        kv = KVStore()
+        kv.set("w", b"initial")
+        seen = []
+        kv.watch("w", lambda k, v: seen.append(v.data if v else None))
+        assert seen == [b"initial"]  # current value delivered immediately
+        kv.set("w", b"updated")
+        kv.delete("w")
+        assert seen == [b"initial", b"updated", None]
+
+    def test_file_backed_persistence(self, tmp_path):
+        p = str(tmp_path / "kv.json")
+        kv = FileKVStore(p)
+        kv.set("x", b"hello")
+        kv.set("y", bytes(range(256)))
+        kv2 = FileKVStore(p)
+        assert kv2.get("x").data == b"hello"
+        assert kv2.get("y").version == 1
+        assert kv2.get("y").data == bytes(range(256))
+
+
+class TestLeaderElection:
+    def test_campaign_and_failover(self):
+        kv = KVStore()
+        t0 = 1_000_000_000_000
+        a = LeaderService(kv, "flush", "node-a", lease_ttl_s=10)
+        b = LeaderService(kv, "flush", "node-b", lease_ttl_s=10)
+        assert a.campaign(t0)
+        assert not b.campaign(t0 + int(1e9))
+        assert a.leader(t0 + int(1e9)) == "node-a"
+        assert b.leader(t0 + int(1e9)) == "node-a"
+        # leader renews within ttl
+        assert a.campaign(t0 + int(5e9))
+        # leader dies: after ttl, b seizes
+        t_late = t0 + int(20e9)
+        assert b.campaign(t_late)
+        assert b.is_leader(t_late)
+
+    def test_resign(self):
+        kv = KVStore()
+        a = LeaderService(kv, "e", "a")
+        b = LeaderService(kv, "e", "b")
+        assert a.campaign(10**15)
+        a.resign()
+        assert b.campaign(10**15)
+
+    def test_services_heartbeat(self):
+        kv = KVStore()
+        s = Services(kv, heartbeat_ttl_s=10)
+        t0 = 10**15
+        import m3_tpu.cluster.services as svc_mod
+
+        # advertise uses wall time; emulate by writing directly
+        kv.set("_sd/db/n1", json.dumps(
+            {"service": "db", "instance_id": "n1", "endpoint": "e1",
+             "heartbeat_ns": t0}).encode())
+        kv.set("_sd/db/n2", json.dumps(
+            {"service": "db", "instance_id": "n2", "endpoint": "e2",
+             "heartbeat_ns": t0 - int(60e9)}).encode())
+        live = s.instances("db", now_ns=t0 + int(1e9))
+        assert [a.instance_id for a in live] == ["n1"]
+
+
+class TestPlacement:
+    def test_initial_rf3(self):
+        insts = [Instance(f"n{i}", isolation_group=f"rack{i % 3}") for i in range(6)]
+        p = pl.initial_placement(insts, n_shards=12, replica_factor=3)
+        p.validate()
+        # every shard has 3 AVAILABLE owners in 3 distinct racks
+        for sid in range(12):
+            owners = p.instances_for_shard(sid)
+            assert len(owners) == 3
+            assert len({o.isolation_group for o in owners}) == 3
+        # balanced: 12*3/6 = 6 shards per instance
+        assert all(len(i.shards) == 6 for i in p.instances.values())
+
+    def test_add_instance_minimal_churn(self):
+        insts = [Instance(f"n{i}") for i in range(3)]
+        p = pl.initial_placement(insts, n_shards=9, replica_factor=3)
+        p2 = pl.add_instance(p, Instance("n3"))
+        p2.validate()  # LEAVING donors still count until handoff completes
+        new = p2.instances["n3"]
+        init_ids = new.shard_ids(ShardState.INITIALIZING)
+        assert 0 < len(init_ids) <= 9 * 3 // 4 + 1
+        # donors keep serving while the new node bootstraps
+        for sid in init_ids:
+            donor_id = new.shards[sid].source_id
+            assert p2.instances[donor_id].shards[sid].state == ShardState.LEAVING
+        # complete bootstrap
+        p3 = pl.mark_available(p2, "n3")
+        p3.validate()
+        for sid in init_ids:
+            assert p3.instances["n3"].shards[sid].state == ShardState.AVAILABLE
+
+    def test_remove_instance(self):
+        insts = [Instance(f"n{i}") for i in range(4)]
+        p = pl.initial_placement(insts, n_shards=8, replica_factor=3)
+        p2 = pl.remove_instance(p, "n0")
+        p2.validate()
+        # every ex-n0 shard has a new INITIALIZING owner elsewhere
+        for sid in p.instances["n0"].shards:
+            owners = {i.id for i in p2.instances_for_shard(sid)}
+            assert "n0" not in owners
+            assert len(owners) == 3
+
+    def test_replace_instance(self):
+        insts = [Instance(f"n{i}") for i in range(3)]
+        p = pl.initial_placement(insts, n_shards=6, replica_factor=3)
+        p2 = pl.replace_instance(p, "n1", Instance("n9"))
+        assert set(p2.instances["n9"].shards) == set(p.instances["n1"].shards)
+        p3 = pl.mark_available(p2, "n9")
+        p3.validate()
+        assert "n1" not in p3.instances
+
+    def test_mirrored_pairs(self):
+        pairs = [(Instance("l1"), Instance("f1")), (Instance("l2"), Instance("f2"))]
+        p = pl.mirrored_placement(pairs, n_shards=8)
+        p.validate()
+        assert p.is_mirrored
+        assert set(p.instances["l1"].shards) == set(p.instances["f1"].shards)
+        assert p.instances["l1"].shard_set_id == p.instances["f1"].shard_set_id
+
+    def test_json_roundtrip(self):
+        insts = [Instance(f"n{i}") for i in range(3)]
+        p = pl.initial_placement(insts, n_shards=4, replica_factor=2)
+        p2 = pl.Placement.from_json(p.to_json())
+        assert p2.n_shards == 4 and p2.replica_factor == 2
+        assert {i.id for i in p2.instances.values()} == {"n0", "n1", "n2"}
+
+
+def make_cluster(tmp_path, n_nodes=3, n_shards=6, rf=3):
+    insts = [Instance(f"node-{i}") for i in range(n_nodes)]
+    p = pl.initial_placement(insts, n_shards=n_shards, replica_factor=rf)
+    nodes = {}
+    for inst in insts:
+        db = Database(str(tmp_path / inst.id), DatabaseOptions(n_shards=n_shards))
+        db.create_namespace("default")
+        db.open(START)
+        nodes[inst.id] = db
+    topo = TopologyMap(p)
+    return p, topo, nodes
+
+
+class TestQuorumSession:
+    def test_write_read_quorum(self, tmp_path):
+        p, topo, nodes = make_cluster(tmp_path)
+        sess = Session(topo, nodes,
+                       write_consistency=ConsistencyLevel.MAJORITY,
+                       read_consistency=ConsistencyLevel.ONE)
+        res = sess.write_tagged("default", b"cpu", [(b"h", b"1")], START + SEC, 1.5)
+        assert res.acks == 3  # all replicas took the write
+        from m3_tpu.utils.ident import tags_to_id
+
+        sid = tags_to_id(b"cpu", [(b"h", b"1")])
+        dps = sess.fetch("default", sid, START, START + HOUR)
+        assert dps == [(START + SEC, 1.5)]
+        for db in nodes.values():
+            db.close()
+
+    def test_one_node_down_majority_still_writes(self, tmp_path):
+        p, topo, nodes = make_cluster(tmp_path)
+        dead = sorted(nodes)[0]
+        nodes[dead].close()
+
+        class Down:
+            def write_tagged(self, *a, **k):
+                raise ConnectionError("node down")
+
+            def read(self, *a, **k):
+                raise ConnectionError("node down")
+
+        live = dict(nodes)
+        live[dead] = Down()
+        sess = Session(topo, live, write_consistency=ConsistencyLevel.MAJORITY)
+        res = sess.write_tagged("default", b"m", [], START + SEC, 2.0)
+        assert res.acks == 2 and len(res.errors) == 1
+        # ALL consistency fails with a node down
+        sess_all = Session(topo, live, write_consistency=ConsistencyLevel.ALL)
+        with pytest.raises(ConsistencyError):
+            sess_all.write_tagged("default", b"m2", [], START + SEC, 1.0)
+        for k, db in nodes.items():
+            if k != dead:
+                db.close()
+
+    def test_majority_fails_with_two_down(self, tmp_path):
+        p, topo, nodes = make_cluster(tmp_path)
+        ids = sorted(nodes)
+
+        class Down:
+            def write_tagged(self, *a, **k):
+                raise ConnectionError("down")
+
+            def read(self, *a, **k):
+                raise ConnectionError("down")
+
+        live = dict(nodes)
+        live[ids[0]] = Down()
+        live[ids[1]] = Down()
+        sess = Session(topo, live, write_consistency=ConsistencyLevel.MAJORITY)
+        with pytest.raises(ConsistencyError):
+            sess.write_tagged("default", b"m", [], START + SEC, 1.0)
+        for db in nodes.values():
+            db.close()
+
+    def test_replica_merge_prefers_latest(self, tmp_path):
+        # one replica missing a point: merged read still returns it
+        p, topo, nodes = make_cluster(tmp_path)
+        sess = Session(topo, nodes)
+        sess.write_tagged("default", b"m", [], START + SEC, 1.0)
+        from m3_tpu.utils.ident import tags_to_id
+
+        sid = tags_to_id(b"m", [])
+        # write an extra point directly to ONE replica only
+        shard = sess._shard(sid)
+        host = topo.readable_hosts_for_shard(shard)[0]
+        nodes[host].write_tagged("default", b"m", [], START + 2 * SEC, 9.0)
+        sess_all = Session(topo, nodes, read_consistency=ConsistencyLevel.ALL)
+        dps = sess_all.fetch("default", sid, START, START + HOUR)
+        assert dps == [(START + SEC, 1.0), (START + 2 * SEC, 9.0)]
+        for db in nodes.values():
+            db.close()
+
+    def test_majority_value(self):
+        assert majority(3) == 2
+        assert majority(5) == 3
+        assert majority(1) == 1
