@@ -1,0 +1,36 @@
+"""Device meshes from placements.
+
+The cluster placement's shard->instance assignment (m3_tpu.cluster.placement)
+is the same partitioning the device mesh uses: the 'shard' axis carries M3's
+data-parallel virtual shards, and the 'replica' axis carries RF copies
+(SURVEY.md §2.10). Collectives over these axes replace the reference's
+host-side scatter-gather RPC (§2.11): psum over ICI for cross-shard rollups,
+all_gather over 'replica' for divergence checks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def build_mesh(n_shard: int, n_replica: int = 1, devices=None):
+    """(shard x replica) mesh over the first n_shard*n_replica devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    need = n_shard * n_replica
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(n_shard, n_replica)
+    return Mesh(grid, axis_names=("shard", "replica"))
+
+
+def mesh_from_placement(placement, devices=None):
+    """Mesh whose 'shard' axis size matches the placement's distinct shard
+    groups: device i takes the shards of the i-th instance (sorted)."""
+    n_instances = len(placement.instances)
+    rf = placement.replica_factor
+    # mirrored/replicated placements: shard groups = instances / RF
+    n_shard_groups = max(n_instances // rf, 1)
+    return build_mesh(n_shard_groups, max(rf, 1), devices)
