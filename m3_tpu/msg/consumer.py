@@ -1,0 +1,92 @@
+"""Message consumer: TCP server delivering messages to a handler with
+batched acks.
+
+Role parity with /root/reference/src/msg/consumer/consumer.go:152-211 (ack
+batching) and the server accept loop in x/server. At-least-once: a message
+is acked only after the handler returns; redelivered duplicates are the
+handler's concern (idempotent writes downstream).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable
+
+from m3_tpu.msg.protocol import recv_frame, send_frame
+
+
+class Consumer:
+    def __init__(
+        self,
+        handler: Callable[[int, bytes], None],  # (shard, payload)
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ack_batch: int = 16,
+    ):
+        self.handler = handler
+        self.ack_batch = ack_batch
+        self._server = socket.create_server((host, port))
+        self.port = self._server.getsockname()[1]
+        self._closed = False
+        self._threads: list[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        self.num_processed = 0
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        pending_acks: list[int] = []
+        conn.settimeout(0.05)  # idle timeout doubles as the ack-flush tick
+        try:
+            while not self._closed:
+                try:
+                    frame = recv_frame(conn)
+                except TimeoutError:
+                    if pending_acks:
+                        send_frame(conn, {"type": "ack", "ids": pending_acks})
+                        pending_acks = []
+                    continue
+                if frame is None:
+                    return
+                header, payload = frame
+                if header.get("type") != "msg":
+                    continue
+                try:
+                    self.handler(header.get("shard", 0), payload)
+                    self.num_processed += 1
+                except Exception:
+                    continue  # no ack -> producer redelivers
+                pending_acks.append(header["id"])
+                if len(pending_acks) >= self.ack_batch:
+                    send_frame(conn, {"type": "ack", "ids": pending_acks})
+                    pending_acks = []
+        except OSError:
+            pass
+        finally:
+            if pending_acks:
+                try:
+                    send_frame(conn, {"type": "ack", "ids": pending_acks})
+                except OSError:
+                    pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._server.close()
+        except OSError:
+            pass

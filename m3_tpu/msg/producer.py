@@ -1,0 +1,170 @@
+"""Message producer: ordered, acked, at-least-once pub/sub over TCP.
+
+Role parity with the reference producer
+(/root/reference/src/msg/producer — writer fan-out per consumer service ->
+shard -> message writers with retry-until-ack, ref-counted messages,
+backpressure buffer; data-flow doc msg/README.md:5-17). One writer thread
+per consumer connection drains a per-shard queue; unacked messages
+redeliver after a timeout; the buffer applies backpressure by dropping
+oldest when full (configurable).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+
+from m3_tpu.msg.protocol import recv_frame, send_frame
+
+
+@dataclass
+class _Pending:
+    msg_id: int
+    shard: int
+    payload: bytes
+    sent_at: float = 0.0
+    attempts: int = 0
+
+
+class Producer:
+    """Publishes messages to one consumer endpoint with ack tracking."""
+
+    def __init__(
+        self,
+        endpoint: tuple[str, int],
+        retry_after_s: float = 2.0,
+        max_buffer: int = 100_000,
+        on_drop=None,
+    ):
+        self.endpoint = endpoint
+        self.retry_after_s = retry_after_s
+        self.max_buffer = max_buffer
+        self.on_drop = on_drop
+        self._pending: dict[int, _Pending] = {}
+        self._queue: list[int] = []
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._next_id = 1
+        self._closed = False
+        self._sock: socket.socket | None = None
+        self._writer = threading.Thread(target=self._run_writer, daemon=True)
+        self._acker: threading.Thread | None = None
+        self._writer.start()
+        self.num_dropped = 0
+
+    # -- publish --
+
+    def publish(self, shard: int, payload: bytes) -> int:
+        with self._cv:
+            if len(self._pending) >= self.max_buffer:
+                # backpressure: drop the oldest unacked message, whether it
+                # is still queued or already in flight (dict preserves
+                # insertion order = publish order)
+                oldest = next(iter(self._pending), None)
+                if oldest is not None:
+                    dropped = self._pending.pop(oldest)
+                    try:
+                        self._queue.remove(oldest)
+                    except ValueError:
+                        pass
+                    self.num_dropped += 1
+                    if self.on_drop:
+                        self.on_drop(dropped)
+            msg_id = self._next_id
+            self._next_id += 1
+            self._pending[msg_id] = _Pending(msg_id, shard, payload)
+            self._queue.append(msg_id)
+            self._cv.notify()
+            return msg_id
+
+    @property
+    def unacked(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._sock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    # -- writer/acker loops --
+
+    def _connect(self) -> socket.socket | None:
+        try:
+            sock = socket.create_connection(self.endpoint, timeout=5)
+            sock.settimeout(None)
+            return sock
+        except OSError:
+            return None
+
+    def _run_writer(self) -> None:
+        while not self._closed:
+            if self._sock is None:
+                self._sock = self._connect()
+                if self._sock is None:
+                    time.sleep(0.2)
+                    continue
+                self._acker = threading.Thread(
+                    target=self._run_acker, args=(self._sock,), daemon=True
+                )
+                self._acker.start()
+            with self._cv:
+                while not self._queue and not self._closed:
+                    # also wake to retry unacked messages
+                    self._cv.wait(timeout=self.retry_after_s / 2)
+                    self._requeue_stale_locked()
+                if self._closed:
+                    return
+                msg_id = self._queue.pop(0)
+                p = self._pending.get(msg_id)
+            if p is None:
+                continue  # acked while queued
+            try:
+                send_frame(
+                    self._sock,
+                    {"type": "msg", "id": p.msg_id, "shard": p.shard},
+                    p.payload,
+                )
+                with self._lock:
+                    p.sent_at = time.monotonic()
+                    p.attempts += 1
+            except OSError:
+                with self._cv:
+                    self._queue.insert(0, msg_id)
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def _requeue_stale_locked(self) -> None:
+        now = time.monotonic()
+        queued = set(self._queue)
+        for p in self._pending.values():
+            if (
+                p.msg_id not in queued
+                and p.sent_at
+                and now - p.sent_at > self.retry_after_s
+            ):
+                self._queue.append(p.msg_id)
+
+    def _run_acker(self, sock: socket.socket) -> None:
+        while not self._closed:
+            try:
+                frame = recv_frame(sock)
+            except OSError:
+                return
+            if frame is None:
+                return
+            header, _ = frame
+            if header.get("type") == "ack":
+                with self._lock:
+                    for msg_id in header.get("ids", []):
+                        self._pending.pop(msg_id, None)

@@ -1,0 +1,59 @@
+"""Wire protocol: length-prefixed messages with acks.
+
+Role parity with the reference m3msg protocol
+(/root/reference/src/msg/generated/proto/msgpb/msg.proto:7-19 + protocol/
+proto): a Message carries (shard, sentinel id, payload); an Ack carries the
+ids being acknowledged. Frames are u32-length-prefixed JSON headers with a
+raw payload, avoiding a codegen dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from dataclasses import dataclass
+
+
+@dataclass
+class Message:
+    shard: int
+    msg_id: int
+    payload: bytes
+
+
+def send_frame(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+    h = json.dumps(header).encode()
+    sock.sendall(struct.pack(">II", len(h), len(payload)) + h + payload)
+
+
+def recv_frame(sock: socket.socket) -> tuple[dict, bytes] | None:
+    # a timeout may only surface at the frame boundary (first byte); once a
+    # frame is partially read, keep reading so framing never desyncs
+    head = _recv_exact(sock, 8, allow_timeout=True)
+    if head is None:
+        return None
+    hlen, plen = struct.unpack(">II", head)
+    h = _recv_exact(sock, hlen)
+    if h is None:
+        return None
+    payload = _recv_exact(sock, plen) if plen else b""
+    if plen and payload is None:
+        return None
+    return json.loads(h), payload or b""
+
+
+def _recv_exact(sock: socket.socket, n: int, allow_timeout: bool = False
+                ) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except TimeoutError:
+            if allow_timeout and not buf:
+                raise
+            continue
+        if not chunk:
+            return None
+        buf += chunk
+    return buf

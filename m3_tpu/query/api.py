@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -40,6 +41,18 @@ def _parse_time(s: str) -> int:
 
     t = dt.datetime.fromisoformat(s.replace("Z", "+00:00"))
     return int(t.timestamp() * NS)
+
+
+def _parse_graphite_time(s: str, now_ns: int) -> int:
+    """Graphite from/until: epoch seconds, 'now', or relative '-1h'."""
+    if s == "now":
+        return now_ns
+    if s.startswith("-") or s.startswith("+"):
+        from m3_tpu.metrics.policy import parse_go_duration
+
+        mag = parse_go_duration(s.lstrip("+-"))
+        return now_ns - mag if s.startswith("-") else now_ns + mag
+    return _parse_time(s)
 
 
 def _parse_step(s: str) -> int:
@@ -114,9 +127,78 @@ class CoordinatorAPI:
             return self._label_values(m.group(1), q)
         if path == "/api/v1/series":
             return self._series(q)
+        if path == "/render":
+            return self._graphite_render(q)
+        if path == "/metrics/find":
+            return self._graphite_find(q)
         return 404, "application/json", json.dumps(
             {"status": "error", "error": f"unknown path {path}"}
         ).encode()
+
+    # -- graphite --
+
+    def _graphite_render(self, q):
+        from m3_tpu.query.graphite import GraphiteEngine
+
+        now = time.time_ns()
+        start = _parse_graphite_time(q["from"][0], now) if "from" in q else now - 24 * 3600 * NS
+        end = _parse_graphite_time(q["until"][0], now) if "until" in q else now
+        step = 60 * NS
+        if "maxDataPoints" in q:
+            mdp = max(int(q["maxDataPoints"][0]), 1)
+            step = max((end - start) // mdp, 10 * NS)
+            step -= step % (10 * NS) or 0
+            step = max(step, 10 * NS)
+        eng = GraphiteEngine(self.db, self.namespace)
+        out = []
+        for target in q.get("target", []):
+            for s in eng.render(target, start, end, step):
+                out.append(
+                    {
+                        "target": s.name.decode(),
+                        "datapoints": [
+                            [None if np.isnan(v) else float(v), int(t // NS)]
+                            for t, v in zip(s.times, s.values)
+                        ],
+                    }
+                )
+        return 200, "application/json", json.dumps(out).encode()
+
+    def _graphite_find(self, q):
+        from m3_tpu.query.graphite import path_prefix_query
+
+        pattern = q["query"][0]
+        ns, start, end = self._time_range(q)
+        parts = pattern.split(".")
+        depth = len(parts) - 1
+        docs = ns.query_ids(path_prefix_query(pattern), start, end)
+        name_tag = f"__g{depth}__".encode()
+        deeper_tag = f"__g{depth + 1}__".encode()
+        # a node can be BOTH a leaf (series ends here) and a branch
+        nodes: dict[bytes, set] = {}
+        for doc in docs:
+            fields = dict(doc.fields)
+            text = fields.get(name_tag)
+            if text is None:
+                continue
+            kind = "branch" if deeper_tag in fields else "leaf"
+            nodes.setdefault(text, set()).add(kind)
+        out = []
+        prefix = ".".join(parts[:-1])
+        for text in sorted(nodes):
+            node_id = (prefix + "." if prefix else "") + text.decode()
+            for kind in sorted(nodes[text]):
+                is_branch = kind == "branch"
+                out.append(
+                    {
+                        "text": text.decode(),
+                        "id": node_id,
+                        "leaf": 0 if is_branch else 1,
+                        "expandable": 1 if is_branch else 0,
+                        "allowChildren": 1 if is_branch else 0,
+                    }
+                )
+        return 200, "application/json", json.dumps(out).encode()
 
     # -- ingest --
 

@@ -1,0 +1,691 @@
+"""Graphite engine: carbon ingest, path model, expression language, and
+the render function library.
+
+Role parity with the reference's Graphite support
+(/root/reference/src/query/graphite — lexer/parser, native/compiler.go,
+110 builtin functions in native/builtin_functions.go, and the storage
+adapter mapping dotted paths to tag queries) and the carbon line-protocol
+parser (src/metrics/carbon/parser.go). Dotted paths map to positional tags
+(__g0__, __g1__, ...) exactly like the reference's graphite storage
+adapter, so Graphite data lives in the same TSDB namespaces as Prometheus
+data.
+
+The function library here is the high-traffic core (~35 builtins);
+registering more is adding an entry to FUNCTIONS.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import math
+import re
+from dataclasses import dataclass
+
+import numpy as np
+
+from m3_tpu.index.query import (
+    ConjunctionQuery,
+    Matcher,
+    MatchType,
+    RegexpQuery,
+    TermQuery,
+)
+
+NS = 10**9
+
+
+def path_to_tags(path: bytes) -> list[tuple[bytes, bytes]]:
+    """'web.host1.cpu' -> [(__g0__, web), (__g1__, host1), (__g2__, cpu)]."""
+    return [
+        (f"__g{i}__".encode(), part)
+        for i, part in enumerate(path.split(b"."))
+    ]
+
+
+def tags_to_path(tags: dict[bytes, bytes]) -> bytes:
+    parts = []
+    i = 0
+    while True:
+        v = tags.get(f"__g{i}__".encode())
+        if v is None:
+            break
+        parts.append(v)
+        i += 1
+    return b".".join(parts)
+
+
+def _glob_part_to_regex(part: str) -> str:
+    out = []
+    for seg in re.split(r"(\*|\?|\{[^}]*\}|\[[^\]]*\])", part):
+        if seg == "*":
+            out.append("[^.]*")
+        elif seg == "?":
+            out.append("[^.]")
+        elif seg.startswith("{") and seg.endswith("}"):
+            out.append("(?:" + "|".join(re.escape(a) for a in seg[1:-1].split(",")) + ")")
+        elif seg.startswith("[") and seg.endswith("]"):
+            out.append(seg)
+        else:
+            out.append(re.escape(seg))
+    return "".join(out)
+
+
+def path_query(pattern: str):
+    """Graphite glob path -> index query over positional tags."""
+    parts = pattern.split(".")
+    qs = []
+    for i, part in enumerate(parts):
+        name = f"__g{i}__".encode()
+        if part == "*":
+            from m3_tpu.index.query import FieldQuery
+
+            qs.append(FieldQuery(name))
+        elif any(c in part for c in "*?{}[]"):
+            qs.append(RegexpQuery(name, _glob_part_to_regex(part)))
+        else:
+            qs.append(TermQuery(name, part.encode()))
+    # exact depth: the next position must not exist
+    from m3_tpu.index.query import FieldQuery, NegationQuery
+
+    qs.append(NegationQuery(FieldQuery(f"__g{len(parts)}__".encode())))
+    return ConjunctionQuery(tuple(qs))
+
+
+def path_prefix_query(pattern: str):
+    """Like path_query but WITHOUT the exact-depth constraint: matches any
+    series whose path starts with the pattern (used by /metrics/find)."""
+    q = path_query(pattern)
+    return ConjunctionQuery(tuple(q.queries[:-1]))
+
+
+# ---------------------------------------------------------------------------
+# carbon line protocol
+# ---------------------------------------------------------------------------
+
+
+def parse_carbon_line(line: bytes):
+    """'path value timestamp' -> (path, value, t_ns) or None for junk."""
+    parts = line.strip().split()
+    if len(parts) != 3:
+        return None
+    try:
+        value = float(parts[1])
+        ts = float(parts[2])
+    except ValueError:
+        return None
+    return parts[0], value, int(ts * NS)
+
+
+class CarbonIngester:
+    """TCP line-protocol server writing into the database (the reference's
+    coordinator carbon ingest, ingest/carbon/ingest.go)."""
+
+    def __init__(self, db, namespace: str = "default", host: str = "127.0.0.1",
+                 port: int = 0):
+        import socket
+        import threading
+
+        self.db = db
+        self.namespace = namespace
+        self._server = socket.create_server((host, port))
+        self.port = self._server.getsockname()[1]
+        self._closed = False
+        self.num_ingested = 0
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        import threading
+
+        while not self._closed:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn):
+        buf = b""
+        try:
+            while not self._closed:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                *lines, buf = buf.split(b"\n")
+                for line in lines:
+                    parsed = parse_carbon_line(line)
+                    if parsed is None:
+                        continue
+                    path, value, t_ns = parsed
+                    self.db.write_tagged(
+                        self.namespace, b"", path_to_tags(path), t_ns, value
+                    )
+                    self.num_ingested += 1
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._closed = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# render expression language
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Series:
+    name: bytes
+    times: np.ndarray  # [T] step grid (ns)
+    values: np.ndarray  # [T] float64 (NaN = missing)
+
+
+class GraphiteError(ValueError):
+    pass
+
+
+# a path segment char may not be a bare comma (argument separator); commas
+# are only meaningful inside {a,b} alternations
+_TOKEN = re.compile(
+    r"\s*(?:(?P<num>-?\d+\.?\d*)(?![A-Za-z0-9_.\-*?{\[])"
+    r"|(?P<str>'[^']*'|\"[^\"]*\")"
+    r"|(?P<ident>[A-Za-z_][A-Za-z0-9_]*)(?=\()"
+    r"|(?P<path>(?:[A-Za-z0-9_.\-*?\[\]:=]|\{[^}]*\})+)"
+    r"|(?P<lp>\()|(?P<rp>\))|(?P<comma>,))"
+)
+
+
+def parse_target(expr: str, pos: int = 0):
+    """Parse one render target expression -> AST of ('call', name, args) /
+    ('path', pattern) / ('num', x) / ('str', s)."""
+    m = _TOKEN.match(expr, pos)
+    if not m:
+        raise GraphiteError(f"parse error at {pos} in {expr!r}")
+    if m.group("ident"):
+        name = m.group("ident")
+        pos = m.end()
+        m2 = _TOKEN.match(expr, pos)
+        if not m2 or not m2.group("lp"):
+            raise GraphiteError(f"expected ( after {name}")
+        pos = m2.end()
+        args = []
+        while True:
+            m3 = _TOKEN.match(expr, pos)
+            if m3 and m3.group("rp"):
+                pos = m3.end()
+                break
+            arg, pos = parse_target(expr, pos)
+            args.append(arg)
+            m4 = _TOKEN.match(expr, pos)
+            if m4 and m4.group("comma"):
+                pos = m4.end()
+            elif m4 and m4.group("rp"):
+                pos = m4.end()
+                break
+            else:
+                raise GraphiteError(f"expected , or ) at {pos} in {expr!r}")
+        return ("call", name, args), pos
+    if m.group("num"):
+        return ("num", float(m.group("num"))), m.end()
+    if m.group("str"):
+        return ("str", m.group("str")[1:-1]), m.end()
+    if m.group("path"):
+        return ("path", m.group("path")), m.end()
+    raise GraphiteError(f"unexpected token at {pos} in {expr!r}")
+
+
+class GraphiteEngine:
+    """Evaluates render targets against the database."""
+
+    def __init__(self, db, namespace: str = "default"):
+        self.db = db
+        self.namespace = namespace
+
+    # -- fetch --
+
+    def fetch(self, pattern: str, start_ns: int, end_ns: int, step_ns: int
+              ) -> list[Series]:
+        ns = self.db.namespaces[self.namespace]
+        docs = ns.query_ids(path_query(pattern), start_ns, end_ns)
+        grid = np.arange(start_ns, end_ns, step_ns, dtype=np.int64)
+        out = []
+        for doc in sorted(docs, key=lambda d: d.series_id):
+            times, vbits = ns.read(doc.series_id, start_ns, end_ns)
+            vals = np.full(len(grid), np.nan)
+            if len(times):
+                idx = np.searchsorted(grid, times, side="right") - 1
+                ok = idx >= 0
+                vals[idx[ok]] = vbits.view(np.float64)[ok]
+            out.append(Series(tags_to_path(dict(doc.fields)), grid, vals))
+        return out
+
+    # -- evaluate --
+
+    def render(self, target: str, start_ns: int, end_ns: int,
+               step_ns: int = 60 * NS) -> list[Series]:
+        ast, pos = parse_target(target)
+        if pos != len(target.rstrip()):
+            raise GraphiteError(f"trailing input in {target!r}")
+        out = self._eval(ast, start_ns, end_ns, step_ns)
+        if not isinstance(out, list):
+            raise GraphiteError("target did not evaluate to series")
+        return out
+
+    def _eval(self, ast, start_ns, end_ns, step_ns):
+        kind = ast[0]
+        if kind == "path":
+            return self.fetch(ast[1], start_ns, end_ns, step_ns)
+        if kind == "num":
+            return ast[1]
+        if kind == "str":
+            return ast[1]
+        if kind == "call":
+            _, name, args = ast
+            if name == "timeShift":
+                return self._time_shift(args, start_ns, end_ns, step_ns)
+            fn = FUNCTIONS.get(name)
+            if fn is None:
+                raise GraphiteError(f"unknown function {name}()")
+            vals = [self._eval(a, start_ns, end_ns, step_ns) for a in args]
+            return fn(self, vals, start_ns, end_ns, step_ns)
+        raise GraphiteError(f"bad ast {ast!r}")
+
+    def _time_shift(self, args, start_ns, end_ns, step_ns):
+        """Special form: re-evaluates the inner expression at a shifted
+        window (works for aggregates/aliases, not just bare paths).
+        Graphite sign semantics: unsigned and '-' shift back in time,
+        '+' shifts forward."""
+        from m3_tpu.metrics.policy import parse_go_duration
+
+        if len(args) != 2 or args[1][0] != "str":
+            raise GraphiteError("timeShift(expr, 'interval')")
+        spec = args[1][1]
+        mag = parse_go_duration(spec.lstrip("+-"))
+        shift = mag if spec.startswith("+") else -mag
+        inner = self._eval(args[0], start_ns + shift, end_ns + shift, step_ns)
+        if not isinstance(inner, list):
+            raise GraphiteError("timeShift expects series")
+        return [Series(s.name, s.times - shift, s.values) for s in inner]
+
+
+# -- function library ------------------------------------------------------
+
+FUNCTIONS = {}
+
+
+def register(name):
+    def deco(fn):
+        FUNCTIONS[name] = fn
+        return fn
+
+    return deco
+
+
+def _combine(series: list[Series], op, name: bytes) -> list[Series]:
+    if not series:
+        return []
+    stack = np.stack([s.values for s in series])
+    with np.errstate(invalid="ignore"):
+        vals = op(stack)
+    return [Series(name, series[0].times, vals)]
+
+
+def _flatten(args) -> list[Series]:
+    out = []
+    for a in args:
+        if isinstance(a, list):
+            out.extend(a)
+    return out
+
+
+@register("sumSeries")
+def _sum_series(eng, args, *_):
+    s = _flatten(args)
+
+    def op(x):
+        out = np.nansum(x, axis=0)
+        # a column with no values is null, not 0 (nansum quirk)
+        return np.where(np.isnan(x).all(axis=0), np.nan, out)
+
+    return _combine(s, op, b"sumSeries")
+
+
+@register("averageSeries")
+@register("avg")
+def _avg_series(eng, args, *_):
+    s = _flatten(args)
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return _combine(s, lambda x: np.nanmean(x, axis=0), b"averageSeries")
+
+
+@register("maxSeries")
+def _max_series(eng, args, *_):
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return _combine(_flatten(args), lambda x: np.nanmax(x, axis=0), b"maxSeries")
+
+
+@register("minSeries")
+def _min_series(eng, args, *_):
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return _combine(_flatten(args), lambda x: np.nanmin(x, axis=0), b"minSeries")
+
+
+@register("countSeries")
+def _count_series(eng, args, *_):
+    s = _flatten(args)
+    return _combine(s, lambda x: (~np.isnan(x)).sum(axis=0).astype(float),
+                    b"countSeries")
+
+
+@register("scale")
+def _scale(eng, args, *_):
+    series, factor = args[0], args[1]
+    return [Series(s.name, s.times, s.values * factor) for s in series]
+
+
+@register("offset")
+def _offset(eng, args, *_):
+    series, amount = args[0], args[1]
+    return [Series(s.name, s.times, s.values + amount) for s in series]
+
+
+@register("absolute")
+def _absolute(eng, args, *_):
+    return [Series(s.name, s.times, np.abs(s.values)) for s in args[0]]
+
+
+@register("invert")
+def _invert(eng, args, *_):
+    with np.errstate(divide="ignore"):
+        return [Series(s.name, s.times, 1.0 / s.values) for s in args[0]]
+
+
+@register("derivative")
+def _derivative(eng, args, *_):
+    out = []
+    for s in args[0]:
+        d = np.concatenate([[np.nan], np.diff(s.values)])
+        out.append(Series(s.name, s.times, d))
+    return out
+
+
+@register("nonNegativeDerivative")
+def _nn_derivative(eng, args, *_):
+    out = []
+    for s in args[0]:
+        d = np.concatenate([[np.nan], np.diff(s.values)])
+        d = np.where(d < 0, np.nan, d)
+        out.append(Series(s.name, s.times, d))
+    return out
+
+
+@register("perSecond")
+def _per_second(eng, args, start, end, step):
+    out = []
+    for s in args[0]:
+        d = np.concatenate([[np.nan], np.diff(s.values)])
+        d = np.where(d < 0, np.nan, d) / (step / NS)
+        out.append(Series(s.name, s.times, d))
+    return out
+
+
+@register("integral")
+def _integral(eng, args, *_):
+    out = []
+    for s in args[0]:
+        v = np.nancumsum(s.values)
+        v[np.isnan(s.values)] = np.nan
+        out.append(Series(s.name, s.times, v))
+    return out
+
+
+@register("movingAverage")
+def _moving_average(eng, args, *_):
+    series, window = args[0], int(args[1])
+    out = []
+    for s in series:
+        v = s.values
+        acc = np.full(len(v), np.nan)
+        csum = np.nancumsum(np.concatenate([[0.0], v]))
+        ccnt = np.cumsum(np.concatenate([[0], (~np.isnan(v)).astype(int)]))
+        for i in range(len(v)):
+            lo = max(0, i - window + 1)
+            cnt = ccnt[i + 1] - ccnt[lo]
+            if cnt:
+                acc[i] = (csum[i + 1] - csum[lo]) / cnt
+        out.append(Series(s.name, s.times, acc))
+    return out
+
+
+@register("keepLastValue")
+def _keep_last(eng, args, *_):
+    out = []
+    for s in args[0]:
+        v = s.values.copy()
+        idx = np.where(np.isnan(v), 0, np.arange(len(v)))
+        np.maximum.accumulate(idx, out=idx)
+        filled = v[idx]
+        filled[np.isnan(v) & (idx == 0) & np.isnan(v[0])] = np.nan
+        out.append(Series(s.name, s.times, filled))
+    return out
+
+
+@register("transformNull")
+def _transform_null(eng, args, *_):
+    series = args[0]
+    default = args[1] if len(args) > 1 else 0.0
+    return [
+        Series(s.name, s.times, np.where(np.isnan(s.values), default, s.values))
+        for s in series
+    ]
+
+
+@register("alias")
+def _alias(eng, args, *_):
+    return [Series(args[1].encode(), s.times, s.values) for s in args[0]]
+
+
+@register("aliasByNode")
+def _alias_by_node(eng, args, *_):
+    series = args[0]
+    nodes = [int(a) for a in args[1:]]
+    out = []
+    for s in series:
+        parts = s.name.split(b".")
+        name = b".".join(parts[n] for n in nodes if -len(parts) <= n < len(parts))
+        out.append(Series(name, s.times, s.values))
+    return out
+
+
+@register("groupByNode")
+def _group_by_node(eng, args, start, end, step):
+    series, node = args[0], int(args[1])
+    agg = args[2] if len(args) > 2 else "sum"
+    groups: dict[bytes, list[Series]] = {}
+    for s in series:
+        parts = s.name.split(b".")
+        key = parts[node] if -len(parts) <= node < len(parts) else b""
+        groups.setdefault(key, []).append(s)
+    op = {
+        "sum": lambda x: np.nansum(x, axis=0),
+        "avg": lambda x: np.nanmean(x, axis=0),
+        "max": lambda x: np.nanmax(x, axis=0),
+        "min": lambda x: np.nanmin(x, axis=0),
+    }[agg]
+    out = []
+    import warnings
+
+    for key in sorted(groups):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out.extend(_combine(groups[key], op, key))
+    return out
+
+
+@register("highestMax")
+def _highest_max(eng, args, *_):
+    series, n = args[0], int(args[1])
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ranked = sorted(series, key=lambda s: -np.nanmax(s.values))
+    return ranked[:n]
+
+
+@register("highestCurrent")
+def _highest_current(eng, args, *_):
+    series, n = args[0], int(args[1])
+
+    def cur(s):
+        ok = s.values[~np.isnan(s.values)]
+        return ok[-1] if len(ok) else -math.inf
+
+    return sorted(series, key=lambda s: -cur(s))[:n]
+
+
+@register("lowestCurrent")
+def _lowest_current(eng, args, *_):
+    series, n = args[0], int(args[1])
+
+    def cur(s):
+        ok = s.values[~np.isnan(s.values)]
+        return ok[-1] if len(ok) else math.inf
+
+    return sorted(series, key=cur)[:n]
+
+
+@register("limit")
+def _limit(eng, args, *_):
+    return args[0][: int(args[1])]
+
+
+@register("exclude")
+def _exclude(eng, args, *_):
+    rx = re.compile(args[1].encode())
+    return [s for s in args[0] if not rx.search(s.name)]
+
+
+@register("grep")
+def _grep(eng, args, *_):
+    rx = re.compile(args[1].encode())
+    return [s for s in args[0] if rx.search(s.name)]
+
+
+@register("averageAbove")
+def _average_above(eng, args, *_):
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return [s for s in args[0] if np.nanmean(s.values) > args[1]]
+
+
+@register("currentAbove")
+def _current_above(eng, args, *_):
+    def cur(s):
+        ok = s.values[~np.isnan(s.values)]
+        return ok[-1] if len(ok) else -math.inf
+
+    return [s for s in args[0] if cur(s) > args[1]]
+
+
+@register("divideSeries")
+def _divide_series(eng, args, *_):
+    num, den = args[0], args[1]
+    if len(den) != 1:
+        raise GraphiteError("divideSeries requires a single divisor series")
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return [
+            Series(s.name, s.times, s.values / den[0].values) for s in num
+        ]
+
+
+@register("diffSeries")
+def _diff_series(eng, args, *_):
+    s = _flatten(args)
+    if not s:
+        return []
+    first = s[0].values
+    rest = np.stack([x.values for x in s[1:]]) if len(s) > 1 else np.zeros((1, len(first)))
+    vals = np.where(np.isnan(first), np.nan,
+                    first - np.nansum(rest, axis=0))
+    return [Series(b"diffSeries", s[0].times, vals)]
+
+
+@register("asPercent")
+def _as_percent(eng, args, *_):
+    series = args[0]
+    if len(args) > 1 and isinstance(args[1], list):
+        total = args[1][0].values
+    elif len(args) > 1:
+        total = args[1]
+    else:
+        total = np.nansum(np.stack([s.values for s in series]), axis=0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return [
+            Series(s.name, s.times, 100.0 * s.values / total) for s in series
+        ]
+
+
+@register("summarize")
+def _summarize(eng, args, start, end, step):
+    from m3_tpu.metrics.policy import parse_go_duration
+
+    series, interval = args[0], parse_go_duration(args[1])
+    agg = args[2] if len(args) > 2 else "sum"
+    op = {"sum": np.nansum, "avg": np.nanmean, "max": np.nanmax, "min": np.nanmin}[agg]
+    out = []
+    import warnings
+
+    for s in series:
+        bucket = ((s.times - s.times[0]) // interval).astype(np.int64)
+        n_buckets = int(bucket[-1]) + 1 if len(bucket) else 0
+        times = s.times[0] + np.arange(n_buckets) * interval
+        vals = np.full(n_buckets, np.nan)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for b in range(n_buckets):
+                sel = s.values[bucket == b]
+                if (~np.isnan(sel)).any():
+                    vals[b] = op(sel)
+        out.append(Series(s.name, times, vals))
+    return out
+
+
+@register("constantLine")
+def _constant_line(eng, args, start, end, step):
+    grid = np.arange(start, end, step, dtype=np.int64)
+    return [Series(str(args[0]).encode(), grid, np.full(len(grid), args[0]))]
+
+
+@register("sortByMaxima")
+def _sort_by_maxima(eng, args, *_):
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return sorted(args[0], key=lambda s: -np.nanmax(s.values))
+
+
+@register("sortByName")
+def _sort_by_name(eng, args, *_):
+    return sorted(args[0], key=lambda s: s.name)

@@ -1,0 +1,248 @@
+"""Graphite engine tests: carbon ingest, path queries, render functions,
+and the HTTP render/find endpoints."""
+
+import json
+import socket
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.query.graphite import (
+    CarbonIngester,
+    GraphiteEngine,
+    parse_carbon_line,
+    parse_target,
+    path_query,
+    path_to_tags,
+    tags_to_path,
+)
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import DatabaseOptions
+
+NS = 10**9
+MIN = 60 * NS
+START = 1_599_998_400_000_000_000
+START_S = START // NS
+
+
+@pytest.fixture
+def db(tmp_path):
+    db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+    db.create_namespace("default")
+    db.open(START)
+    yield db
+    db.close()
+
+
+def seed(db, paths_vals):
+    for path, vals in paths_vals.items():
+        for i, v in enumerate(vals):
+            db.write_tagged("default", b"", path_to_tags(path.encode()),
+                            START + i * MIN, float(v))
+
+
+class TestPathModel:
+    def test_roundtrip(self):
+        tags = path_to_tags(b"web.host1.cpu")
+        assert tags == [(b"__g0__", b"web"), (b"__g1__", b"host1"),
+                        (b"__g2__", b"cpu")]
+        assert tags_to_path(dict(tags)) == b"web.host1.cpu"
+
+    def test_carbon_line(self):
+        assert parse_carbon_line(b"a.b.c 4.5 1599998400") == (
+            b"a.b.c", 4.5, 1599998400 * NS
+        )
+        assert parse_carbon_line(b"junk") is None
+        assert parse_carbon_line(b"a.b notanumber 1") is None
+
+    def test_parse_target(self):
+        ast, _ = parse_target("sumSeries(web.*.cpu)")
+        assert ast == ("call", "sumSeries", [("path", "web.*.cpu")])
+        ast, _ = parse_target("movingAverage(scale(a.b, 2), 5)")
+        assert ast[1] == "movingAverage"
+        assert ast[2][0][1] == "scale"
+        assert ast[2][1] == ("num", 5.0)
+
+
+class TestFetchAndFunctions:
+    def test_glob_fetch(self, db):
+        seed(db, {"web.h1.cpu": [1, 2, 3], "web.h2.cpu": [10, 20, 30],
+                  "db.h1.cpu": [5, 5, 5]})
+        eng = GraphiteEngine(db)
+        out = eng.render("web.*.cpu", START, START + 3 * MIN, MIN)
+        assert [s.name for s in out] == [b"web.h1.cpu", b"web.h2.cpu"]
+        np.testing.assert_array_equal(out[0].values, [1, 2, 3])
+
+    def test_exact_depth(self, db):
+        seed(db, {"a.b": [1], "a.b.c": [2]})
+        eng = GraphiteEngine(db)
+        out = eng.render("a.b", START, START + MIN, MIN)
+        assert [s.name for s in out] == [b"a.b"]
+
+    def test_sum_and_alias(self, db):
+        seed(db, {"web.h1.cpu": [1, 2], "web.h2.cpu": [10, 20]})
+        eng = GraphiteEngine(db)
+        out = eng.render('alias(sumSeries(web.*.cpu), "total")',
+                         START, START + 2 * MIN, MIN)
+        assert out[0].name == b"total"
+        np.testing.assert_array_equal(out[0].values, [11, 22])
+
+    def test_group_by_node(self, db):
+        seed(db, {"web.h1.cpu": [1, 1], "web.h1.mem": [2, 2],
+                  "web.h2.cpu": [3, 3]})
+        eng = GraphiteEngine(db)
+        out = eng.render("groupByNode(web.*.*, 2, 'sum')",
+                         START, START + 2 * MIN, MIN)
+        got = {s.name: list(s.values) for s in out}
+        assert got == {b"cpu": [4.0, 4.0], b"mem": [2.0, 2.0]}
+
+    def test_derivative_and_per_second(self, db):
+        seed(db, {"c.total": [0, 60, 180, 180]})
+        eng = GraphiteEngine(db)
+        out = eng.render("derivative(c.total)", START, START + 4 * MIN, MIN)
+        vals = out[0].values
+        assert np.isnan(vals[0]) and list(vals[1:]) == [60.0, 120.0, 0.0]
+        out = eng.render("perSecond(c.total)", START, START + 4 * MIN, MIN)
+        np.testing.assert_allclose(out[0].values[1:], [1.0, 2.0, 0.0])
+
+    def test_moving_average_and_keep_last(self, db):
+        seed(db, {"g.x": [1, 2, 3, 4]})
+        eng = GraphiteEngine(db)
+        out = eng.render("movingAverage(g.x, 2)", START, START + 4 * MIN, MIN)
+        np.testing.assert_allclose(out[0].values, [1, 1.5, 2.5, 3.5])
+
+    def test_filters_and_sort(self, db):
+        seed(db, {"s.a": [1, 9], "s.b": [5, 2], "s.c": [3, 3]})
+        eng = GraphiteEngine(db)
+        out = eng.render("highestCurrent(s.*, 2)", START, START + 2 * MIN, MIN)
+        assert [s.name for s in out] == [b"s.a", b"s.c"]
+        out = eng.render('grep(s.*, "a|b")', START, START + 2 * MIN, MIN)
+        assert [s.name for s in out] == [b"s.a", b"s.b"]
+
+    def test_as_percent_and_divide(self, db):
+        seed(db, {"p.a": [1, 1], "p.b": [3, 3]})
+        eng = GraphiteEngine(db)
+        out = eng.render("asPercent(p.*)", START, START + 2 * MIN, MIN)
+        np.testing.assert_allclose(out[0].values, [25.0, 25.0])
+        out = eng.render("divideSeries(p.a, p.b)", START, START + 2 * MIN, MIN)
+        np.testing.assert_allclose(out[0].values, [1 / 3, 1 / 3])
+
+    def test_summarize(self, db):
+        seed(db, {"m.x": [1, 2, 3, 4]})
+        eng = GraphiteEngine(db)
+        out = eng.render("summarize(m.x, '2m', 'sum')", START, START + 4 * MIN, MIN)
+        np.testing.assert_allclose(out[0].values, [3.0, 7.0])
+
+
+class TestCarbonIngest:
+    def test_tcp_ingest(self, db):
+        ing = CarbonIngester(db)
+        try:
+            with socket.create_connection(("127.0.0.1", ing.port)) as s:
+                s.sendall(
+                    f"metrics.live.count 42 {START_S + 30}\n"
+                    f"metrics.live.count 43 {START_S + 90}\n"
+                    f"bad line\n".encode()
+                )
+            deadline = time.monotonic() + 5
+            while ing.num_ingested < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert ing.num_ingested == 2
+            eng = GraphiteEngine(db)
+            out = eng.render("metrics.live.count", START, START + 2 * MIN, MIN)
+            np.testing.assert_array_equal(out[0].values, [42.0, 43.0])
+        finally:
+            ing.close()
+
+
+class TestGraphiteHTTP:
+    @pytest.fixture
+    def api(self, db):
+        from m3_tpu.query.api import CoordinatorAPI
+
+        a = CoordinatorAPI(db)
+        port = a.serve(port=0)
+        a.base = f"http://127.0.0.1:{port}"
+        yield a
+        a.shutdown()
+
+    def test_render_endpoint(self, db, api):
+        seed(db, {"web.h1.cpu": [1, 2], "web.h2.cpu": [3, 4]})
+        url = (f"{api.base}/render?target=sumSeries(web.*.cpu)"
+               f"&from={START_S}&until={START_S + 120}")
+        with urllib.request.urlopen(url.replace("*", "%2A")) as r:
+            doc = json.loads(r.read())
+        assert doc[0]["target"] == "sumSeries"
+        assert [v for v, _ in doc[0]["datapoints"]] == [4.0, 6.0]
+
+    def test_find_endpoint(self, db, api):
+        seed(db, {"web.h1.cpu": [1], "web.h2.cpu": [1], "db.h3.mem": [1]})
+        with urllib.request.urlopen(f"{api.base}/metrics/find?query=%2A") as r:
+            doc = json.loads(r.read())
+        assert {d["text"] for d in doc} == {"web", "db"}
+        assert all(d["leaf"] == 0 for d in doc)
+        with urllib.request.urlopen(f"{api.base}/metrics/find?query=web.%2A") as r:
+            doc = json.loads(r.read())
+        assert {d["text"] for d in doc} == {"h1", "h2"}
+        with urllib.request.urlopen(
+            f"{api.base}/metrics/find?query=web.h1.%2A"
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc == [{"text": "cpu", "id": "web.h1.cpu", "leaf": 1,
+                        "expandable": 0, "allowChildren": 0}]
+
+
+class TestNullSemantics:
+    def test_sum_of_all_null_column_is_null(self, db):
+        # no samples before the first write: that column must be null, not 0
+        seed(db, {"n.a": [1], "n.b": [2]})
+        eng = GraphiteEngine(db)
+        out = eng.render("sumSeries(n.*)", START - 2 * MIN, START + MIN, MIN)
+        vals = out[0].values
+        assert np.isnan(vals[0]) and np.isnan(vals[1]) and vals[2] == 3.0
+
+
+class TestReviewRegressions:
+    def test_time_shift_signs(self, db):
+        # value exists only in [START, START+2m); query a later window
+        seed(db, {"t.x": [7, 7]})
+        eng = GraphiteEngine(db)
+        late = START + 60 * MIN
+        # '-1h' and unsigned '1h' both look back
+        for spec in ("'-1h'", "'1h'"):
+            out = eng.render(f"timeShift(t.x, {spec})", late, late + 2 * MIN, MIN)
+            np.testing.assert_array_equal(out[0].values, [7.0, 7.0])
+        # works on aggregates too (special form re-evaluates the subtree)
+        out = eng.render("timeShift(sumSeries(t.*), '1h')", late, late + 2 * MIN, MIN)
+        np.testing.assert_array_equal(out[0].values, [7.0, 7.0])
+
+    def test_producer_cap_counts_inflight(self):
+        from m3_tpu.msg.producer import Producer
+
+        p = Producer(("127.0.0.1", 1), max_buffer=5, retry_after_s=60)
+        try:
+            for i in range(20):
+                p.publish(0, f"x{i}".encode())
+            assert p.unacked <= 5
+            assert p.num_dropped == 15
+        finally:
+            p.close()
+
+    def test_find_leaf_and_branch_same_node(self, db):
+        import urllib.request
+        from m3_tpu.query.api import CoordinatorAPI
+
+        seed(db, {"a.b": [1], "a.b.c": [1]})
+        api = CoordinatorAPI(db)
+        port = api.serve(port=0)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics/find?query=a.%2A"
+            ) as r:
+                doc = json.loads(r.read())
+            kinds = {(d["text"], d["leaf"]) for d in doc}
+            assert kinds == {("b", 0), ("b", 1)}
+        finally:
+            api.shutdown()

@@ -1,0 +1,85 @@
+"""Acked pub/sub transport tests: delivery, ack, redelivery, backpressure."""
+
+import threading
+import time
+
+import pytest
+
+from m3_tpu.msg.consumer import Consumer
+from m3_tpu.msg.producer import Producer
+
+
+def wait_until(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestPubSub:
+    def test_delivery_and_ack(self):
+        got = []
+        consumer = Consumer(lambda shard, payload: got.append((shard, payload)))
+        producer = Producer(("127.0.0.1", consumer.port), retry_after_s=0.5)
+        try:
+            for i in range(20):
+                producer.publish(i % 4, f"m{i}".encode())
+            assert wait_until(lambda: len(got) == 20)
+            assert wait_until(lambda: producer.unacked == 0)
+            assert {p for _, p in got} == {f"m{i}".encode() for i in range(20)}
+            assert {s for s, _ in got} == {0, 1, 2, 3}
+        finally:
+            producer.close()
+            consumer.close()
+
+    def test_redelivery_on_handler_failure(self):
+        calls = {"n": 0}
+        delivered = threading.Event()
+
+        def flaky(shard, payload):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient")
+            delivered.set()
+
+        consumer = Consumer(flaky)
+        producer = Producer(("127.0.0.1", consumer.port), retry_after_s=0.3)
+        try:
+            producer.publish(0, b"retry-me")
+            assert delivered.wait(10)
+            assert calls["n"] >= 2  # first failed, redelivered
+            assert wait_until(lambda: producer.unacked == 0)
+        finally:
+            producer.close()
+            consumer.close()
+
+    def test_consumer_down_then_up(self):
+        got = []
+        consumer = Consumer(lambda s, p: got.append(p))
+        port = consumer.port
+        consumer.close()
+        producer = Producer(("127.0.0.1", port), retry_after_s=0.3)
+        try:
+            producer.publish(0, b"early")
+            time.sleep(0.3)  # producer retrying against a dead endpoint
+            consumer2 = Consumer(lambda s, p: got.append(p), port=port)
+            assert wait_until(lambda: got == [b"early"])
+            assert wait_until(lambda: producer.unacked == 0)
+            consumer2.close()
+        finally:
+            producer.close()
+
+    def test_backpressure_drops_oldest(self):
+        # no consumer: buffer fills, the oldest messages get dropped
+        dropped = []
+        producer = Producer(("127.0.0.1", 1), max_buffer=5,
+                            retry_after_s=60, on_drop=lambda p: dropped.append(p.payload))
+        try:
+            for i in range(8):
+                producer.publish(0, f"x{i}".encode())
+            assert producer.num_dropped == 3
+            assert dropped == [b"x0", b"x1", b"x2"]
+        finally:
+            producer.close()
