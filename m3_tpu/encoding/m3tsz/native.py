@@ -1,0 +1,148 @@
+"""ctypes bindings for the native C++ M3TSZ codec (native/m3tsz.cpp).
+
+The shared library is built on demand with g++ (no pip deps); callers fall
+back to the pure-Python scalar codec when no compiler is available, so the
+native path is an accelerator, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from m3_tpu.utils.xtime import TimeUnit, unit_value_ns
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "m3tsz.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "libm3tsz.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load():
+    """The loaded library or None (no compiler / build failed)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        src_mtime = os.path.getmtime(_SRC) if os.path.exists(_SRC) else 0
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime:
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.m3tsz_encode.restype = ctypes.c_int64
+        lib.m3tsz_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.m3tsz_decode.restype = ctypes.c_int32
+        lib.m3tsz_decode.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+        ]
+        lib.m3tsz_bench_roundtrip.restype = ctypes.c_int64
+        lib.m3tsz_bench_roundtrip.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _default_bits(unit: TimeUnit) -> int:
+    return 32 if unit in (TimeUnit.SECOND, TimeUnit.MILLISECOND) else 64
+
+
+def encode_series(times: np.ndarray, values: np.ndarray, start: int,
+                  unit: TimeUnit = TimeUnit.SECOND) -> bytes:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    times = np.ascontiguousarray(times, dtype=np.int64)
+    vbits = np.ascontiguousarray(values, dtype=np.float64).view(np.uint64)
+    cap = 8 + (len(times) * 146 + 11) // 8 + 16
+    out = np.zeros(cap, dtype=np.uint8)
+    n = lib.m3tsz_encode(
+        times.ctypes.data, vbits.ctypes.data, len(times),
+        start, unit_value_ns(unit), _default_bits(unit),
+        out.ctypes.data, cap,
+    )
+    if n == -1:
+        raise ValueError("native encode overflow or misaligned start")
+    if n == -2:
+        raise OverflowError("delta-of-delta overflows 32 bits for this unit")
+    return out[:n].tobytes()
+
+
+def decode_series(stream: bytes, unit: TimeUnit = TimeUnit.SECOND,
+                  max_points: int = 1 << 20):
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    data = np.frombuffer(stream, dtype=np.uint8)
+    # a datapoint costs >= 2 bits, so the stream bounds the output size
+    max_points = min(max_points, len(data) * 4 + 16)
+    times = np.empty(max_points, dtype=np.int64)
+    vbits = np.empty(max_points, dtype=np.uint64)
+    n = lib.m3tsz_decode(
+        data.ctypes.data, len(data), unit_value_ns(unit), _default_bits(unit),
+        times.ctypes.data, vbits.ctypes.data, max_points,
+    )
+    if n < 0:
+        raise ValueError("native decode failed (corrupt or host-path stream)")
+    return times[:n].copy(), vbits[:n].view(np.float64).copy()
+
+
+def bench_roundtrip(times: np.ndarray, values: np.ndarray, start: int,
+                    unit: TimeUnit = TimeUnit.SECOND) -> float:
+    """Datapoints/sec for a [B, T] encode+decode round trip executed
+    entirely in native code (one FFI call: the honest CPU baseline)."""
+    import time as _time
+
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    B, T = times.shape
+    times = np.ascontiguousarray(times, dtype=np.int64)
+    vbits = np.ascontiguousarray(values, dtype=np.float64).view(np.uint64)
+    cap = 8 + (T * 146 + 11) // 8 + 16
+    scratch = np.zeros(cap, dtype=np.uint8)
+    out_t = np.empty(T, dtype=np.int64)
+    out_v = np.empty(T, dtype=np.uint64)
+    t0 = _time.perf_counter()
+    n = lib.m3tsz_bench_roundtrip(
+        times.ctypes.data, vbits.ctypes.data, B, T,
+        start, unit_value_ns(unit), _default_bits(unit),
+        scratch.ctypes.data, cap, out_t.ctypes.data, out_v.ctypes.data,
+    )
+    dt = _time.perf_counter() - t0
+    if n < 0:
+        raise ValueError("native bench roundtrip failed")
+    return n / dt

@@ -1,0 +1,262 @@
+// Native M3TSZ float-mode codec: the host-side hot path.
+//
+// Role: the reference's performance-critical inner loops are hand-optimized
+// Go (SURVEY.md §2.9); here the TPU kernels carry the batch path and this
+// C++ library carries the host/serving path (single-series encodes on the
+// ingest shell, block merges, and the measured CPU baseline for bench.py).
+// Bit-identical to m3_tpu/encoding/m3tsz with int_optimized=False and a
+// fixed time unit (same contract as the batched device kernels).
+//
+// Build: g++ -O3 -shared -fPIC -o libm3tsz.so m3tsz.cpp
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+struct BitWriter {
+    uint8_t* buf;
+    int64_t cap;
+    int64_t nbytes = 0;   // complete bytes flushed
+    uint64_t acc = 0;     // pending bits, right-aligned
+    int accbits = 0;
+    bool overflow = false;
+
+    void write(uint64_t v, int nbits) {  // MSB-first packing
+        if (nbits == 0 || overflow) return;
+        if (nbits < 64) v &= (1ull << nbits) - 1;
+        while (nbits > 0) {
+            int take = nbits;
+            if (accbits + take > 56) take = 56 - accbits;  // keep room
+            acc = (acc << take) | (take == 64 ? v : (v >> (nbits - take)));
+            accbits += take;
+            nbits -= take;
+            if (nbits > 0) v &= (nbits == 64) ? ~0ull : ((1ull << nbits) - 1);
+            while (accbits >= 8) {
+                if (nbytes >= cap) { overflow = true; return; }
+                accbits -= 8;
+                buf[nbytes++] = (uint8_t)(acc >> accbits);
+            }
+        }
+    }
+
+    int64_t finish() {  // pad to byte boundary; returns total bytes
+        if (accbits > 0) {
+            if (nbytes >= cap) { overflow = true; return -1; }
+            buf[nbytes++] = (uint8_t)(acc << (8 - accbits));
+            accbits = 0;
+        }
+        return nbytes;
+    }
+
+    int64_t bitlen() const { return nbytes * 8 + accbits; }
+};
+
+struct BitReader {
+    const uint8_t* buf;
+    int64_t nbits;
+    int64_t bitpos = 0;
+    bool err = false;  // set on any out-of-bounds read; stream is invalid
+
+    bool can(int n) const { return bitpos + n <= nbits; }
+
+    uint64_t read(int n) {
+        if (!can(n)) { err = true; bitpos = nbits; return 0; }
+        // byte-window read: gather up to 9 bytes covering the span
+        uint64_t out = 0;
+        int64_t p = bitpos;
+        bitpos += n;
+        while (n > 0) {
+            int64_t byte = p >> 3;
+            int off = (int)(p & 7);
+            int take = 8 - off;
+            if (take > n) take = n;
+            uint8_t b = buf[byte];
+            out = (out << take) | (uint64_t)((uint8_t)(b << off) >> (8 - take));
+            p += take;
+            n -= take;
+        }
+        return out;
+    }
+
+    uint64_t peek(int n) {
+        int64_t save = bitpos;
+        uint64_t v = read(n);
+        bitpos = save;
+        return v;
+    }
+};
+
+inline int clz64(uint64_t v) { return v ? __builtin_clzll(v) : 64; }
+inline int ctz64(uint64_t v) { return v ? __builtin_ctzll(v) : 0; }
+
+// delta-of-delta bucket scheme (reference scheme.go:44-52)
+void write_dod(BitWriter& w, int64_t dod, int default_bits) {
+    if (dod == 0) { w.write(0, 1); return; }
+    if (dod >= -64 && dod <= 63) {
+        w.write(0b10, 2); w.write((uint64_t)dod & 0x7F, 7);
+    } else if (dod >= -256 && dod <= 255) {
+        w.write(0b110, 3); w.write((uint64_t)dod & 0x1FF, 9);
+    } else if (dod >= -2048 && dod <= 2047) {
+        w.write(0b1110, 4); w.write((uint64_t)dod & 0xFFF, 12);
+    } else {
+        w.write(0b1111, 4);
+        if (default_bits == 32) w.write((uint64_t)dod & 0xFFFFFFFFu, 32);
+        else w.write((uint64_t)dod, 64);
+    }
+}
+
+inline int64_t sign_extend(uint64_t v, int bits) {
+    uint64_t sign = 1ull << (bits - 1);
+    return (int64_t)((v ^ sign)) - (int64_t)sign;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Encode one series; returns total bytes written (incl. EOS tail), -1 on
+// overflow or misaligned start, -2 on dod overflow for 32-bit units.
+int64_t m3tsz_encode(const int64_t* times, const uint64_t* vbits, int32_t n,
+                     int64_t start, int64_t unit_ns, int32_t default_bits,
+                     uint8_t* out, int64_t out_cap) {
+    if (n <= 0 || unit_ns <= 0 || start % unit_ns != 0) return -1;
+    memset(out, 0, (size_t)out_cap);
+    BitWriter w{out, out_cap};
+    w.write((uint64_t)start, 64);
+    int64_t prev_t = start, prev_dt = 0;
+    uint64_t prev_bits = 0, prev_xor = 0;
+    for (int32_t i = 0; i < n; ++i) {
+        int64_t dt = times[i] - prev_t;
+        int64_t dod_ns = dt - prev_dt;
+        int64_t dod = dod_ns / unit_ns;  // trunc toward zero (C++ semantics)
+        if (default_bits == 32 && (dod < INT32_MIN || dod > INT32_MAX)) return -2;
+        write_dod(w, dod, default_bits);
+        prev_dt = dt;
+        prev_t = times[i];
+
+        uint64_t vb = vbits[i];
+        if (i == 0) {
+            w.write(vb, 64);
+            prev_bits = vb;
+            prev_xor = vb;
+        } else {
+            uint64_t x = vb ^ prev_bits;
+            if (x == 0) {
+                w.write(0, 1);
+            } else {
+                int pl = clz64(prev_xor), pt = ctz64(prev_xor);
+                int cl = clz64(x), ct = ctz64(x);
+                if (prev_xor != 0 && cl >= pl && ct >= pt) {
+                    w.write(0b10, 2);
+                    w.write(x >> pt, 64 - pl - pt);
+                } else {
+                    int m = 64 - cl - ct;
+                    w.write(0b11, 2);
+                    w.write((uint64_t)cl, 6);
+                    w.write((uint64_t)(m - 1), 6);
+                    w.write(x >> ct, m);
+                }
+            }
+            prev_xor = x;
+            prev_bits = vb;
+        }
+        if (w.overflow) return -1;
+    }
+    // end-of-stream marker: 9-bit opcode 0x100 + 2-bit value 0
+    w.write(0x100, 9);
+    w.write(0, 2);
+    int64_t total = w.finish();
+    if (w.overflow) return -1;
+    return total;
+}
+
+// Decode one stream; returns datapoint count, -1 on error/marker.
+int32_t m3tsz_decode(const uint8_t* data, int64_t len, int64_t unit_ns,
+                     int32_t default_bits, int64_t* times, uint64_t* vbits,
+                     int32_t max_points) {
+    BitReader r{data, len * 8};
+    if (!r.can(64)) return 0;
+    int64_t prev_t = sign_extend(r.read(64), 64);
+    int64_t prev_dt = 0;
+    uint64_t prev_bits = 0, prev_xor = 0;
+    int32_t count = 0;
+    while (count < max_points) {
+        if (r.can(11) && (r.peek(11) >> 2) == 0x100) {
+            uint64_t marker = r.peek(11) & 3;
+            if (marker == 0) break;   // EOS
+            return -1;                 // host-path marker: not ours to decode
+        }
+        if (!r.can(1)) break;
+        int64_t dod;
+        if (r.read(1) == 0) {
+            dod = 0;
+        } else if (!r.can(1)) { break; }
+        else if (r.read(1) == 0) {
+            dod = sign_extend(r.read(7), 7);
+        } else if (r.read(1) == 0) {
+            dod = sign_extend(r.read(9), 9);
+        } else if (r.read(1) == 0) {
+            dod = sign_extend(r.read(12), 12);
+        } else {
+            dod = (default_bits == 32) ? sign_extend(r.read(32), 32)
+                                       : sign_extend(r.read(64), 64);
+        }
+        prev_dt += dod * unit_ns;
+        prev_t += prev_dt;
+
+        if (count == 0) {
+            if (!r.can(64)) return -1;
+            prev_bits = r.read(64);
+            prev_xor = prev_bits;
+        } else {
+            if (!r.can(1)) return -1;
+            if (r.read(1) == 0) {
+                prev_xor = 0;  // repeat value
+            } else {
+                if (!r.can(1)) return -1;
+                if (r.read(1) == 0) {  // contained
+                    int pl = clz64(prev_xor), pt = ctz64(prev_xor);
+                    int m = 64 - pl - pt;
+                    prev_xor = r.read(m) << pt;
+                } else {  // uncontained
+                    int lead = (int)r.read(6);
+                    int m = (int)r.read(6) + 1;
+                    int trail = 64 - lead - m;
+                    if (trail < 0) return -1;  // corrupt: lead + m > 64
+                    prev_xor = r.read(m) << trail;
+                }
+                prev_bits ^= prev_xor;
+            }
+        }
+        if (r.err) break;  // truncated mid-datapoint: keep complete points
+        times[count] = prev_t;
+        vbits[count] = prev_bits;
+        ++count;
+    }
+    return count;
+}
+
+// Batched round-trip driver for baseline measurement: encodes and decodes
+// B series of T points entirely in native code (no per-series FFI cost).
+// Returns total datapoints processed, or -1 on any error.
+int64_t m3tsz_bench_roundtrip(const int64_t* times, const uint64_t* vbits,
+                              int32_t B, int32_t T, int64_t start,
+                              int64_t unit_ns, int32_t default_bits,
+                              uint8_t* scratch, int64_t scratch_cap,
+                              int64_t* out_times, uint64_t* out_vbits) {
+    int64_t total = 0;
+    for (int32_t b = 0; b < B; ++b) {
+        int64_t nbytes = m3tsz_encode(times + (int64_t)b * T, vbits + (int64_t)b * T,
+                                      T, start, unit_ns, default_bits,
+                                      scratch, scratch_cap);
+        if (nbytes < 0) return -1;
+        int32_t n = m3tsz_decode(scratch, nbytes, unit_ns, default_bits,
+                                 out_times, out_vbits, T);
+        if (n != T) return -1;
+        total += n;
+    }
+    return total;
+}
+
+}  // extern "C"

@@ -1,0 +1,81 @@
+"""Native C++ codec tests: bit-exactness vs the Python scalar codec."""
+
+import numpy as np
+import pytest
+
+from m3_tpu.encoding.m3tsz import Encoder, native
+from m3_tpu.encoding.m3tsz import decode as py_decode
+from m3_tpu.utils.xtime import TimeUnit
+
+START = 1_599_998_400_000_000_000
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain for native codec"
+)
+
+
+def series(rng, n=150, unit_step=10**9, scale=60):
+    times = START + np.cumsum(rng.integers(1, scale, n)) * unit_step
+    return times.astype(np.int64), rng.normal(100, 25, n)
+
+
+class TestNativeCodec:
+    def test_bit_exact_vs_python(self, rng):
+        times, values = series(rng)
+        stream = native.encode_series(times, values, START, TimeUnit.SECOND)
+        enc = Encoder(START, int_optimized=False)
+        for t, v in zip(times, values):
+            enc.encode(int(t), float(v), TimeUnit.SECOND)
+        assert stream == enc.stream()
+
+    def test_roundtrip(self, rng):
+        times, values = series(rng)
+        stream = native.encode_series(times, values, START, TimeUnit.SECOND)
+        dt, dv = native.decode_series(stream, TimeUnit.SECOND)
+        np.testing.assert_array_equal(dt, times)
+        np.testing.assert_array_equal(dv, values)
+
+    def test_cross_decoding(self, rng):
+        times, values = series(rng)
+        stream = native.encode_series(times, values, START, TimeUnit.SECOND)
+        dps = py_decode(stream, int_optimized=False)
+        assert [d.value for d in dps] == list(values)
+        enc = Encoder(START, int_optimized=False)
+        for t, v in zip(times, values):
+            enc.encode(int(t), float(v), TimeUnit.SECOND)
+        dt, dv = native.decode_series(enc.stream(), TimeUnit.SECOND)
+        np.testing.assert_array_equal(dt, times)
+
+    def test_nanosecond_unit(self, rng):
+        times, values = series(rng, unit_step=1, scale=10**10)
+        stream = native.encode_series(times, values, START, TimeUnit.NANOSECOND)
+        enc = Encoder(START, int_optimized=False,
+                      default_time_unit=TimeUnit.NANOSECOND)
+        for t, v in zip(times, values):
+            enc.encode(int(t), float(v), TimeUnit.NANOSECOND)
+        assert stream == enc.stream()
+        dt, dv = native.decode_series(stream, TimeUnit.NANOSECOND)
+        np.testing.assert_array_equal(dv, values)
+
+    def test_errors(self, rng):
+        times, values = series(rng, n=5)
+        with pytest.raises(ValueError, match="misaligned|overflow"):
+            native.encode_series(times, values, START + 1, TimeUnit.SECOND)
+        bad_times = times.copy(); bad_times[2] = 0
+        with pytest.raises(OverflowError):
+            native.encode_series(bad_times, values, START, TimeUnit.SECOND)
+        with pytest.raises(ValueError):
+            # a stream with an annotation marker is a host-path feature the
+            # native float-mode decoder must reject, not misparse
+            enc = Encoder(START, int_optimized=False)
+            enc.encode(START + 10**9, 1.0, TimeUnit.SECOND, b"annotation")
+            enc.encode(START + 2 * 10**9, 1.0, TimeUnit.SECOND)
+            native.decode_series(enc.stream(), TimeUnit.SECOND)
+
+    def test_special_values(self):
+        times = START + (np.arange(8) + 1) * 10**9
+        values = np.array([0.0, -0.0, np.inf, -np.inf, np.nan, 1e300, 1e-300, 7.0])
+        stream = native.encode_series(times, values, START, TimeUnit.SECOND)
+        dt, dv = native.decode_series(stream, TimeUnit.SECOND)
+        for a, b in zip(dv, values):
+            assert a == b or (np.isnan(a) and np.isnan(b))
