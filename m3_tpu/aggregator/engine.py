@@ -15,6 +15,7 @@ ids the way the reference names timer outputs.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -112,6 +113,10 @@ class Aggregator:
         # carry: samples belonging to windows that were still open at the
         # last flush, kept per shard until their window closes
         self._carry: dict[int, tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+        # one coarse lock serializes add vs flush: ingest threads and the
+        # flush loop share the columnar buffers (appends are O(1), flush
+        # swaps the buffers out under the lock then reduces outside it)
+        self._lock = threading.Lock()
         self.num_dropped = 0
         self.num_late_dropped = 0
         # flush watermark: windows ending at/before this have been emitted;
@@ -146,6 +151,10 @@ class Aggregator:
         the raw datapoint)."""
         tag_dict = dict(tags)
         result = self.matcher.match(series_id, tag_dict)
+        with self._lock:
+            return self._add_locked(metric_type, series_id, tags, t_ns, value, result)
+
+    def _add_locked(self, metric_type, series_id, tags, t_ns, value, result) -> bool:
         for rule in result.mappings:
             aggs = rule.aggregations or DEFAULT_AGGREGATIONS[metric_type]
             for policy in rule.policies:
@@ -184,11 +193,15 @@ class Aggregator:
         """Close every window whose end + buffer_past has passed and emit
         its aggregates; still-open windows are carried to the next flush."""
         out: list[AggregatedMetric] = []
-        self._watermark_ns = max(self._watermark_ns, now_ns)
-        res_by_elem = np.array(self._elem_res, np.int64) if self._elem_res else np.zeros(0, np.int64)
-        for shard_id, buf in self._shards.items():
-            e_idx, times, values = buf.take()
-            carry = self._carry.pop(shard_id, None)
+        with self._lock:
+            self._watermark_ns = max(self._watermark_ns, now_ns)
+            res_by_elem = (np.array(self._elem_res, np.int64)
+                           if self._elem_res else np.zeros(0, np.int64))
+            taken = {sid: buf.take() for sid, buf in self._shards.items()}
+            carries = {sid: self._carry.pop(sid, None) for sid in self._shards}
+        for shard_id in taken:
+            e_idx, times, values = taken[shard_id]
+            carry = carries[shard_id]
             if carry is not None:
                 e_idx = np.concatenate([carry[0], e_idx])
                 times = np.concatenate([carry[1], times])
@@ -200,7 +213,8 @@ class Aggregator:
             closed = window_end + self.buffer_past_ns <= now_ns
             if not closed.all():
                 keep = ~closed
-                self._carry[shard_id] = (e_idx[keep], times[keep], values[keep])
+                with self._lock:
+                    self._carry[shard_id] = (e_idx[keep], times[keep], values[keep])
             e_c, t_c, v_c = e_idx[closed], times[closed], values[closed]
             if len(e_c) == 0:
                 continue

@@ -95,6 +95,16 @@ class CoordinatorAPI:
         self.namespace = namespace
         self.engine = Engine(db, namespace)
         self._server: ThreadingHTTPServer | None = None
+        # optional DownsamplerAndWriter: ingest then fans out through the
+        # embedded downsampler (coordinator service wiring)
+        self.writer = None
+
+    def _write(self, name: bytes, tags, t_ns: int, value: float):
+        if self.writer is not None:
+            from m3_tpu.metrics.aggregation import MetricType
+
+            return self.writer.write(MetricType.GAUGE, name, tags, t_ns, value)
+        return self.db.write_tagged(self.namespace, name, list(tags), t_ns, value)
 
     # -- request handling --
 
@@ -110,6 +120,13 @@ class CoordinatorAPI:
     def _route(self, method, path, q, body):
         if path in ("/health", "/ready"):
             return 200, "application/json", b'{"ok":true}'
+        if path == "/metrics":
+            from m3_tpu.utils.instrument import default_registry
+
+            return (200, "text/plain; version=0.0.4",
+                    default_registry().render_prometheus())
+        if path == "/debug/dump":
+            return self._debug_dump()
         if path == "/api/v1/prom/remote/write" and method == "POST":
             return self._remote_write(body)
         if path == "/api/v1/prom/remote/read" and method == "POST":
@@ -133,6 +150,27 @@ class CoordinatorAPI:
             return self._graphite_find(q)
         return 404, "application/json", json.dumps(
             {"status": "error", "error": f"unknown path {path}"}
+        ).encode()
+
+    def _debug_dump(self):
+        """Thread stacks + namespace stats (the x/debug zip-dump role)."""
+        import sys
+        import traceback
+
+        stacks = {}
+        for tid, frame in sys._current_frames().items():
+            stacks[str(tid)] = traceback.format_stack(frame)
+        ns_stats = {}
+        for name, ns in list(self.db.namespaces.items()):
+            ns_stats[name] = {
+                "shards": len(ns.shards),
+                "series": sum(s.buffer.n_series for s in ns.shards.values()),
+                "flushed_blocks": sum(
+                    len(s._filesets) for s in ns.shards.values()
+                ),
+            }
+        return 200, "application/json", json.dumps(
+            {"threads": stacks, "namespaces": ns_stats}
         ).encode()
 
     # -- graphite --
@@ -215,7 +253,7 @@ class CoordinatorAPI:
                 else:
                     tags.append((k, v))
             for ts_ms, value in ts.samples:
-                self.db.write_tagged(self.namespace, name, tags, ts_ms * 1_000_000, value)
+                self._write(name, tags, ts_ms * 1_000_000, value)
                 n += 1
         return 200, "application/json", json.dumps({"status": "success", "samples": n}).encode()
 
@@ -228,7 +266,7 @@ class CoordinatorAPI:
             import time
 
             t_ns = time.time_ns()
-        self.db.write_tagged(self.namespace, name, tags, t_ns, float(doc["value"]))
+        self._write(name, tags, t_ns, float(doc["value"]))
         return 200, "application/json", b'{"status":"success"}'
 
     # -- read --

@@ -121,16 +121,18 @@ class CarbonIngester:
     coordinator carbon ingest, ingest/carbon/ingest.go)."""
 
     def __init__(self, db, namespace: str = "default", host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, writer=None):
         import socket
         import threading
 
         self.db = db
         self.namespace = namespace
+        self.writer = writer  # optional DownsamplerAndWriter (rules path)
         self._server = socket.create_server((host, port))
         self.port = self._server.getsockname()[1]
         self._closed = False
         self.num_ingested = 0
+        self.num_errors = 0
         threading.Thread(target=self._accept, daemon=True).start()
 
     def _accept(self):
@@ -157,10 +159,21 @@ class CarbonIngester:
                     if parsed is None:
                         continue
                     path, value, t_ns = parsed
-                    self.db.write_tagged(
-                        self.namespace, b"", path_to_tags(path), t_ns, value
-                    )
-                    self.num_ingested += 1
+                    try:
+                        if self.writer is not None:
+                            from m3_tpu.metrics.aggregation import MetricType
+
+                            self.writer.write(MetricType.GAUGE, b"",
+                                              path_to_tags(path), t_ns, value)
+                        else:
+                            self.db.write_tagged(
+                                self.namespace, b"", path_to_tags(path),
+                                t_ns, value,
+                            )
+                        self.num_ingested += 1
+                    except Exception:
+                        # a bad datapoint must not kill the connection
+                        self.num_errors += 1
         except OSError:
             pass
         finally:

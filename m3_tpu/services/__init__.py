@@ -1,0 +1,6 @@
+"""Service assemblies: the deployable binaries.
+
+Role parity with the reference's cmd/services layer (SURVEY.md §2 L8):
+`python -m m3_tpu.services.dbnode -f config.yml` etc. assemble the full
+process from a config file the way server.Run/RunComponents do.
+"""

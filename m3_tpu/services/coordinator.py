@@ -1,0 +1,168 @@
+"""Coordinator service: HTTP APIs + embedded downsampler + carbon ingest.
+
+Role parity with the reference coordinator assembly
+(/root/reference/src/query/server/query.go:201 Run — storage, downsampler
+wiring at :500-530, ingest servers, HTTP). One process serves Prometheus
+remote read/write, PromQL, Graphite render/find, carbon ingest, and flushes
+rule-matched aggregations into per-policy namespaces.
+
+Run: python -m m3_tpu.services.coordinator -f config/coordinator.yml
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+from m3_tpu.aggregator.downsample import Downsampler, DownsamplerAndWriter
+from m3_tpu.metrics.aggregation import AggregationType, MetricType
+from m3_tpu.metrics.filters import TagFilter
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.rules import MappingRule, RollupRule, RollupTarget, RuleSet
+from m3_tpu.query.api import CoordinatorAPI
+from m3_tpu.query.graphite import CarbonIngester
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions, RetentionOptions
+from m3_tpu.utils.config import load_config
+from m3_tpu.utils.instrument import Logger, default_registry
+
+
+def ruleset_from_config(doc: dict | None) -> RuleSet:
+    """Build mapping/rollup rules from the config's `rules:` section."""
+    rs = RuleSet()
+    if not doc:
+        return rs
+    for r in doc.get("mapping", []) or []:
+        rs.mapping_rules.append(
+            MappingRule(
+                name=r.get("name", ""),
+                filter=TagFilter.parse(r["filter"]),
+                policies=tuple(
+                    StoragePolicy.parse(p) for p in r.get("policies", [])
+                ),
+                aggregations=tuple(
+                    AggregationType[a.upper()] for a in r.get("aggregations", [])
+                ),
+                drop=bool(r.get("drop", False)),
+            )
+        )
+    for r in doc.get("rollup", []) or []:
+        targets = tuple(
+            RollupTarget(
+                new_name=t["name"].encode(),
+                group_by=tuple(g.encode() for g in t.get("group_by", [])),
+                aggregations=tuple(
+                    AggregationType[a.upper()] for a in t.get("aggregations", ["SUM"])
+                ),
+                policies=tuple(StoragePolicy.parse(p) for p in t.get("policies", [])),
+            )
+            for t in r.get("targets", [])
+        )
+        rs.rollup_rules.append(
+            RollupRule(r.get("name", ""), TagFilter.parse(r["filter"]), targets)
+        )
+    return rs
+
+
+def namespace_options(doc: dict | None) -> NamespaceOptions:
+    if not doc:
+        return NamespaceOptions()
+    from m3_tpu.metrics.policy import parse_go_duration as dur
+
+    r = doc.get("retention", {}) or {}
+    return NamespaceOptions(
+        retention=RetentionOptions(
+            retention_ns=dur(r.get("period", "48h")),
+            block_size_ns=dur(r.get("block_size", "2h")),
+            buffer_past_ns=dur(r.get("buffer_past", "10m")),
+            buffer_future_ns=dur(r.get("buffer_future", "2m")),
+        )
+    )
+
+
+class CoordinatorService:
+    def __init__(self, config: dict):
+        self.config = config
+        self.log = Logger("coordinator")
+        db_cfg = config.get("db", {}) or {}
+        self.db = Database(
+            db_cfg.get("path", "./m3data"),
+            DatabaseOptions(n_shards=db_cfg.get("n_shards", 8)),
+        )
+        self.db.create_namespace(
+            db_cfg.get("namespace", "default"),
+            namespace_options(db_cfg.get("options")),
+        )
+        ruleset = ruleset_from_config(config.get("rules"))
+        self.downsampler = (
+            Downsampler(self.db, ruleset)
+            if (ruleset.mapping_rules or ruleset.rollup_rules)
+            else None
+        )
+        self.writer = DownsamplerAndWriter(
+            self.db, self.downsampler, db_cfg.get("namespace", "default")
+        )
+        self.api = CoordinatorAPI(self.db, db_cfg.get("namespace", "default"))
+        self.api.writer = self.writer  # ingest fans out through downsampler
+        self.carbon: CarbonIngester | None = None
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        if not self.db._open:
+            self.db.open()  # bootstrap filesets + commitlog replay + WAL
+            self.log.info("bootstrapped")
+        http_cfg = self.config.get("http", {}) or {}
+        port = self.api.serve(
+            host=http_cfg.get("host", "0.0.0.0"),
+            port=http_cfg.get("port", 7201),
+        )
+        self.log.info("http listening", port=port)
+        carbon_cfg = self.config.get("carbon", {}) or {}
+        if carbon_cfg.get("enabled", False):
+            db_cfg = self.config.get("db", {}) or {}
+            self.carbon = CarbonIngester(
+                self.db,
+                namespace=db_cfg.get("namespace", "default"),
+                port=carbon_cfg.get("port", 7204),
+                writer=self.writer,  # carbon goes through the same rules
+            )
+            self.log.info("carbon listening", port=self.carbon.port)
+        tick_every = float(self.config.get("tick_interval_s", 10.0))
+        scope = default_registry().root_scope("coordinator")
+        try:
+            while not self._stop.is_set():
+                self._stop.wait(tick_every)
+                if self._stop.is_set():
+                    break
+                with scope.timer("tick"):
+                    if self.downsampler is not None:
+                        flushed = self.downsampler.flush()
+                        scope.counter("downsample_flushed", flushed)
+                    stats = self.db.tick()
+                    scope.counter("blocks_flushed", stats["flushed"])
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.api.shutdown()
+        if self.carbon:
+            self.carbon.close()
+        self.db.close()
+        self.log.info("coordinator stopped")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-f", "--config", required=True)
+    args = ap.parse_args(argv)
+    svc = CoordinatorService(load_config(args.config) or {})
+    try:
+        svc.run()
+    except KeyboardInterrupt:
+        svc.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,181 @@
+"""Storage node service.
+
+Role parity with the reference node assembly
+(/root/reference/src/dbnode/server/server.go:171: config -> topology ->
+storage opts -> servers -> db.Open -> bootstrap -> mediator loop). Serves
+the node API over HTTP (the TChannel/Thrift role: writes, reads, peer
+block streaming for bootstrap/repair) and runs the tick loop.
+
+Run: python -m m3_tpu.services.dbnode -f config/dbnode.yml
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from m3_tpu.services.coordinator import namespace_options
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import DatabaseOptions
+from m3_tpu.utils.config import load_config
+from m3_tpu.utils.instrument import Logger, default_registry
+
+
+class NodeAPI:
+    """The node RPC surface (write/read/blocks-metadata/blocks-stream)."""
+
+    def __init__(self, db: Database):
+        self.db = db
+        self._server: ThreadingHTTPServer | None = None
+
+    def handle(self, method, path, q, body):
+        try:
+            if path in ("/health", "/bootstrapped"):
+                return 200, json.dumps({"ok": True}).encode()
+            if path == "/metrics":
+                return 200, default_registry().render_prometheus()
+            if path == "/write" and method == "POST":
+                doc = json.loads(body)
+                tags = [(k.encode(), v.encode()) for k, v in
+                        sorted(doc.get("tags", {}).items())]
+                self.db.write_tagged(
+                    doc.get("namespace", "default"),
+                    doc.get("metric", "").encode(), tags,
+                    int(doc["timestamp_ns"]), float(doc["value"]),
+                )
+                return 200, b'{"ok":true}'
+            if path == "/read":
+                dps = self.db.read(
+                    q["namespace"][0], base64.b64decode(q["series_id"][0]),
+                    int(q["start_ns"][0]), int(q["end_ns"][0]),
+                )
+                return 200, json.dumps(
+                    [[d.timestamp_ns, d.value] for d in dps]
+                ).encode()
+            if path == "/blocks/metadata":
+                # repair/bootstrap support: per-series stream checksums
+                import zlib
+
+                ns = self.db.namespaces[q["namespace"][0]]
+                shard = ns.shards[int(q["shard"][0])]
+                bs = int(q["block_start"][0])
+                out = {}
+                reader = shard._filesets.get(bs)
+                if reader is not None:
+                    for i in range(reader.n_series):
+                        sid, _tags, stream = reader.read_at(i)
+                        out[base64.b64encode(sid).decode()] = {
+                            "checksum": zlib.adler32(stream),
+                            "size": len(stream),
+                        }
+                return 200, json.dumps(out).encode()
+            if path == "/blocks/stream":
+                ns = self.db.namespaces[q["namespace"][0]]
+                shard = ns.shards[int(q["shard"][0])]
+                bs = int(q["block_start"][0])
+                sid = base64.b64decode(q["series_id"][0])
+                reader = shard._filesets.get(bs)
+                stream = reader.read(sid) if reader else None
+                return 200, json.dumps(
+                    {
+                        "stream": base64.b64encode(stream or b"").decode(),
+                        "tags": base64.b64encode(
+                            (reader.tags_of(sid) or b"") if reader else b""
+                        ).decode(),
+                    }
+                ).encode()
+            return 404, b'{"error":"unknown path"}'
+        except Exception as e:
+            return 400, json.dumps({"error": str(e)}).encode()
+
+    def serve(self, host="0.0.0.0", port=9000) -> int:
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _do(self, method):
+                u = urlparse(self.path)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                status, payload = api.handle(method, u.path, parse_qs(u.query), body)
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802
+                self._do("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._do("POST")
+
+            def log_message(self, *a):
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self._server.server_address[1]
+
+    def shutdown(self):
+        if self._server:
+            self._server.shutdown()
+
+
+class DBNodeService:
+    def __init__(self, config: dict):
+        self.config = config
+        self.log = Logger("dbnode")
+        db_cfg = config.get("db", {}) or {}
+        self.db = Database(
+            db_cfg.get("path", "./m3data"),
+            DatabaseOptions(n_shards=db_cfg.get("n_shards", 8)),
+        )
+        for ns in db_cfg.get("namespaces", [{"name": "default"}]) or []:
+            self.db.create_namespace(ns["name"], namespace_options(ns.get("options")))
+        self.api = NodeAPI(self.db)
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        self.db.open()
+        self.log.info("bootstrapped")
+        http_cfg = self.config.get("http", {}) or {}
+        port = self.api.serve(http_cfg.get("host", "0.0.0.0"),
+                              http_cfg.get("port", 9000))
+        self.log.info("node api listening", port=port)
+        tick_every = float(self.config.get("tick_interval_s", 10.0))
+        scope = default_registry().root_scope("dbnode")
+        try:
+            while not self._stop.is_set():
+                self._stop.wait(tick_every)
+                if self._stop.is_set():
+                    break
+                with scope.timer("tick"):
+                    stats = self.db.tick()
+                scope.counter("blocks_flushed", stats["flushed"])
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.api.shutdown()
+        self.db.close()
+        self.log.info("dbnode stopped")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-f", "--config", required=True)
+    args = ap.parse_args(argv)
+    svc = DBNodeService(load_config(args.config) or {})
+    try:
+        svc.run()
+    except KeyboardInterrupt:
+        svc.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,162 @@
+"""Instrumentation: metrics scopes + structured logging.
+
+Role parity with the reference's x/instrument (tally scopes + zap logging):
+a process-local metrics registry with counters/gauges/timers and tagged
+subscopes, exportable in Prometheus text format (served on /metrics by the
+services), plus a minimal structured logger. The platform monitors itself
+with the same metric model it stores.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Counter:
+    value: float = 0.0
+
+
+@dataclass
+class _Gauge:
+    value: float = 0.0
+
+
+@dataclass
+class _Timer:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+
+
+class Scope:
+    """Tagged metrics scope; subscope() adds tags, prefix joins with '.'"""
+
+    def __init__(self, registry: "MetricsRegistry", prefix: str = "",
+                 tags: tuple = ()):
+        self._registry = registry
+        self._prefix = prefix
+        self._tags = tags
+
+    def _name(self, name: str) -> str:
+        return f"{self._prefix}.{name}" if self._prefix else name
+
+    def subscope(self, prefix: str, **tags) -> "Scope":
+        merged = tuple(sorted({**dict(self._tags), **tags}.items()))
+        return Scope(self._registry, self._name(prefix), merged)
+
+    def counter(self, name: str, delta: float = 1.0) -> None:
+        with self._registry._lock:
+            self._registry.counters[(self._name(name), self._tags)].value += delta
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._registry._lock:
+            self._registry.gauges[(self._name(name), self._tags)].value = value
+
+    def timer(self, name: str):
+        """Context manager recording a duration."""
+        scope = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                dt = time.perf_counter() - self.t0
+                with scope._registry._lock:
+                    t = scope._registry.timers[(scope._name(name), scope._tags)]
+                    t.count += 1
+                    t.total_s += dt
+                    t.max_s = max(t.max_s, dt)
+
+        return _Ctx()
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: dict = defaultdict(_Counter)
+        self.gauges: dict = defaultdict(_Gauge)
+        self.timers: dict = defaultdict(_Timer)
+
+    def root_scope(self, prefix: str = "") -> Scope:
+        return Scope(self, prefix)
+
+    def render_prometheus(self) -> bytes:
+        """Prometheus text exposition of everything recorded."""
+        out = []
+
+        def fmt(name, tags, value):
+            name = name.replace(".", "_").replace("-", "_")
+            if tags:
+                t = ",".join(f'{k}="{v}"' for k, v in tags)
+                out.append(f"{name}{{{t}}} {value}")
+            else:
+                out.append(f"{name} {value}")
+
+        with self._lock:
+            for (name, tags), c in sorted(self.counters.items()):
+                fmt(name, tags, c.value)
+            for (name, tags), g in sorted(self.gauges.items()):
+                fmt(name, tags, g.value)
+            for (name, tags), t in sorted(self.timers.items()):
+                fmt(name + "_count", tags, t.count)
+                fmt(name + "_total_seconds", tags, round(t.total_s, 9))
+                fmt(name + "_max_seconds", tags, round(t.max_s, 9))
+        return ("\n".join(out) + "\n").encode()
+
+
+_default_registry = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    return _default_registry
+
+
+class Logger:
+    """Structured JSON-lines logger (the zap role)."""
+
+    LEVELS = {"debug": 10, "info": 20, "warn": 30, "error": 40}
+
+    def __init__(self, name: str = "", level: str = "info", stream=None):
+        self.name = name
+        self.level = self.LEVELS[level]
+        self.stream = stream if stream is not None else sys.stderr
+        self.fields: dict = {}
+
+    def with_fields(self, **fields) -> "Logger":
+        lg = Logger(self.name, stream=self.stream)
+        lg.level = self.level
+        lg.fields = {**self.fields, **fields}
+        return lg
+
+    def _log(self, level: str, msg: str, **fields) -> None:
+        if self.LEVELS[level] < self.level:
+            return
+        rec = {
+            "ts": time.time(),
+            "level": level,
+            "logger": self.name,
+            "msg": msg,
+            **self.fields,
+            **fields,
+        }
+        print(json.dumps(rec, default=str), file=self.stream, flush=True)
+
+    def debug(self, msg: str, **fields) -> None:
+        self._log("debug", msg, **fields)
+
+    def info(self, msg: str, **fields) -> None:
+        self._log("info", msg, **fields)
+
+    def warn(self, msg: str, **fields) -> None:
+        self._log("warn", msg, **fields)
+
+    def error(self, msg: str, **fields) -> None:
+        self._log("error", msg, **fields)
