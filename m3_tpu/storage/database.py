@@ -233,6 +233,82 @@ class Database:
                 self._cleanup_retired_logs(name, ns, now_ns)
         return {"flushed": flushed, "expired": expired}
 
+    def aggregate_tiles(self, source_ns: str, target_ns: str,
+                        start_ns: int, end_ns: int, tile_ns: int,
+                        agg: str = "last") -> int:
+        """Server-side downsampling of historical data: re-aggregate the
+        source namespace's datapoints into `tile_ns` tiles written to the
+        target namespace (the AggregateTiles RPC role,
+        reference storage/database.go:1284). Returns tiles written.
+
+        The tile reduction runs as one batched pass per shard via the same
+        windowed segment reductions the aggregator uses.
+        """
+        from m3_tpu.metrics.aggregation import AggregationType
+        from m3_tpu.ops import windowed_agg
+
+        agg_type = {
+            "last": AggregationType.LAST,
+            "sum": AggregationType.SUM,
+            "min": AggregationType.MIN,
+            "max": AggregationType.MAX,
+            "mean": AggregationType.MEAN,
+            "count": AggregationType.COUNT,
+        }[agg]
+        src = self.namespaces[source_ns]
+        if target_ns not in self.namespaces:
+            raise KeyError(f"target namespace {target_ns} not created")
+        # align to tile boundaries: a partial boundary tile computed from a
+        # sub-range would overwrite the full tile on incremental runs
+        start_ns = start_ns - (start_ns % tile_ns)
+        end_ns = end_ns + (-end_ns % tile_ns)
+        written = 0
+        for shard in src.shards.values():
+            ids = sorted(shard.series_ids())
+            elem_rows, t_rows, v_rows = [], [], []
+            tags_by_idx = []
+            for sid in ids:
+                times, vbits = shard.read(sid, start_ns, end_ns)
+                if len(times) == 0:
+                    continue
+                buf_idx = shard.buffer._series.get(sid)
+                tags_blob = (
+                    shard.buffer.series_tags[buf_idx] if buf_idx is not None else b""
+                )
+                if not tags_blob:
+                    for reader in shard._filesets.values():
+                        tags_blob = reader.tags_of(sid) or tags_blob
+                        if tags_blob:
+                            break
+                elem_rows.append(np.full(len(times), len(tags_by_idx), np.int64))
+                t_rows.append(times)
+                v_rows.append(vbits.view(np.float64))
+                tags_by_idx.append((sid, tags_blob))
+            if not elem_rows:
+                continue
+            e = np.concatenate(elem_rows)
+            t = np.concatenate(t_rows)
+            v = np.concatenate(v_rows)
+            w = t // tile_ns
+            ge, gw, stats, vq, offsets = windowed_agg.aggregate_groups(
+                e, w, v, times=t
+            )
+            values = windowed_agg.extract(agg_type, stats, vq, offsets)
+            tgt = self.namespaces[target_ns]
+            for g in range(len(ge)):
+                sid, tags_blob = tags_by_idx[int(ge[g])]
+                tile_start = int(gw[g]) * tile_ns
+                # through Database.write so tiles hit the commitlog like
+                # every other write into the target namespace
+                self.write(target_ns, sid, tile_start, float(values[g]),
+                           tags_blob)
+                if tgt.index is not None and tags_blob:
+                    from m3_tpu.utils.ident import decode_tags
+
+                    tgt.index.insert(sid, decode_tags(tags_blob), tile_start)
+                written += 1
+        return written
+
     def flush_all(self, now_ns: int | None = None) -> int:
         """Force-flush every buffered window regardless of buffer_past."""
         flushed = 0

@@ -75,26 +75,31 @@ class Namespace:
     def expire(self, now_ns: int) -> int:
         return sum(s.expire(now_ns) for s in self.shards.values())
 
+    def index_insert_spanning(self, series_id: bytes, fields,
+                              data_block_start: int) -> None:
+        """Insert a doc into EVERY index block its data block overlaps (a
+        data block can span several smaller index blocks)."""
+        if self.index is None:
+            return
+        idx_bs = self.opts.index.block_size_ns
+        data_bs = self.opts.retention.block_size_ns
+        first = data_block_start - (data_block_start % idx_bs)
+        for t in range(first, data_block_start + data_bs, idx_bs):
+            self.index.insert(series_id, fields, t)
+
     def bootstrap_from_fs(self, now_ns: int | None = None) -> int:
         from m3_tpu.utils.ident import decode_tags
 
         n = sum(s.bootstrap_from_fs(now_ns) for s in self.shards.values())
         if self.index is not None:
             # repopulate the reverse index from fileset tag blobs (the role
-            # of bootstrapping persisted index segments in the reference);
-            # a data block can span several index blocks, so the doc is
-            # inserted into every index block the data block overlaps
-            idx_bs = self.opts.index.block_size_ns
-            data_bs = self.opts.retention.block_size_ns
+            # of bootstrapping persisted index segments in the reference)
             for s in self.shards.values():
                 for bs, reader in s._filesets.items():
-                    starts = range(bs - (bs % idx_bs), bs + data_bs, idx_bs)
                     for i in range(reader.n_series):
                         sid, tags_blob = reader.entry_at(i)
                         if tags_blob:
-                            fields = decode_tags(tags_blob)
-                            for t in starts:
-                                self.index.insert(sid, fields, t)
+                            self.index_insert_spanning(sid, decode_tags(tags_blob), bs)
         for s in self.shards.values():
             s.bootstrapped = True
         return n

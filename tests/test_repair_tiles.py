@@ -1,0 +1,236 @@
+"""Peers bootstrap, replica repair, and AggregateTiles tests
+(SURVEY.md §5 failure detection / §3.5)."""
+
+import numpy as np
+import pytest
+
+from m3_tpu.storage import peers as peers_mod
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import (
+    DatabaseOptions,
+    NamespaceOptions,
+    RetentionOptions,
+)
+
+HOUR = 3600 * 10**9
+SEC = 10**9
+START = 1_599_998_400_000_000_000
+
+
+def opts():
+    return NamespaceOptions(
+        retention=RetentionOptions(retention_ns=24 * HOUR, block_size_ns=2 * HOUR)
+    )
+
+
+def make_db(tmp_path, name):
+    db = Database(str(tmp_path / name), DatabaseOptions(n_shards=2))
+    db.create_namespace("default", opts())
+    db.open(START)
+    return db
+
+
+class TestPeersBootstrap:
+    def test_new_node_streams_blocks(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        b = make_db(tmp_path, "b")
+        for i in range(10):
+            for db in (a, b):
+                db.write_tagged("default", b"m", [(b"i", str(i).encode())],
+                                START + (i + 1) * SEC, float(i))
+        a.flush_all()
+        b.flush_all()
+        # fresh node c bootstraps shard contents from peers a+b
+        c = make_db(tmp_path, "c")
+        total = 0
+        for shard_id in (0, 1):
+            total += peers_mod.bootstrap_shard_from_peers(
+                c, "default", shard_id,
+                [peers_mod.InProcessPeer(a), peers_mod.InProcessPeer(b)],
+            )
+        assert total >= 1
+        from m3_tpu.index.query import Matcher, MatchType
+
+        res = c.query("default", [Matcher(MatchType.EQUAL, b"__name__", b"m")],
+                      START, START + HOUR)
+        assert len(res) == 10
+        for _sid, _fields, dps in res:
+            assert len(dps) == 1
+        for db in (a, b, c):
+            db.close()
+
+    def test_majority_checksum_wins(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        b = make_db(tmp_path, "b")
+        bad = make_db(tmp_path, "bad")
+        sid_val = 7.0
+        for db, v in ((a, sid_val), (b, sid_val), (bad, 999.0)):
+            db.write_tagged("default", b"x", [], START + SEC, v)
+            db.flush_all()
+        c = make_db(tmp_path, "c")
+        for shard_id in (0, 1):
+            peers_mod.bootstrap_shard_from_peers(
+                c, "default", shard_id,
+                [peers_mod.InProcessPeer(x) for x in (a, b, bad)],
+            )
+        from m3_tpu.utils.ident import tags_to_id
+
+        dps = c.read("default", tags_to_id(b"x", []), START, START + HOUR)
+        assert [d.value for d in dps] == [sid_val]
+        for db in (a, b, bad, c):
+            db.close()
+
+
+class TestRepair:
+    def test_divergent_replica_merged(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        b = make_db(tmp_path, "b")
+        # both have the series; b has an extra point a missed
+        for db in (a, b):
+            db.write_tagged("default", b"r", [], START + SEC, 1.0)
+        b.write_tagged("default", b"r", [], START + 2 * SEC, 2.0)
+        a.flush_all()
+        b.flush_all()
+        from m3_tpu.utils.ident import tags_to_id
+
+        sid = tags_to_id(b"r", [])
+        shard_id = a.namespaces["default"].shard_set.lookup(sid)
+        bs = a.namespaces["default"].opts.retention.block_start(START + SEC)
+        res = peers_mod.repair_shard_block(
+            a, "default", shard_id, bs, [peers_mod.InProcessPeer(b)]
+        )
+        assert res.diverged == 1 and res.repaired == 1
+        dps = a.read("default", sid, START, START + HOUR)
+        assert [d.value for d in dps] == [1.0, 2.0]
+        # repair is convergent: second run finds nothing
+        res2 = peers_mod.repair_shard_block(
+            a, "default", shard_id, bs, [peers_mod.InProcessPeer(b)]
+        )
+        assert res2.diverged == 0
+        for db in (a, b):
+            db.close()
+
+    def test_identical_replicas_untouched(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        b = make_db(tmp_path, "b")
+        for db in (a, b):
+            db.write_tagged("default", b"same", [], START + SEC, 5.0)
+            db.flush_all()
+        from m3_tpu.utils.ident import tags_to_id
+
+        sid = tags_to_id(b"same", [])
+        shard_id = a.namespaces["default"].shard_set.lookup(sid)
+        bs = a.namespaces["default"].opts.retention.block_start(START + SEC)
+        res = peers_mod.repair_shard_block(
+            a, "default", shard_id, bs, [peers_mod.InProcessPeer(b)]
+        )
+        assert res.checked == 1 and res.diverged == 0 and res.repaired == 0
+        for db in (a, b):
+            db.close()
+
+
+class TestAggregateTiles:
+    def test_downsample_historical(self, tmp_path):
+        db = make_db(tmp_path, "db")
+        db.create_namespace("coarse", opts())
+        for i in range(60):
+            db.write_tagged("default", b"cpu", [(b"h", b"1")],
+                            START + i * 10 * SEC, float(i))
+        n = db.aggregate_tiles("default", "coarse", START, START + HOUR,
+                               tile_ns=60 * SEC, agg="mean")
+        assert n == 10  # 600s of data -> 10 one-minute tiles
+        from m3_tpu.utils.ident import tags_to_id
+
+        sid = tags_to_id(b"cpu", [(b"h", b"1")])
+        dps = db.read("coarse", sid, START, START + HOUR)
+        assert len(dps) == 10
+        # first tile: values 0..5 -> mean 2.5
+        np.testing.assert_allclose(dps[0].value, 2.5)
+        # tiles are index-visible in the target namespace
+        from m3_tpu.index.query import Matcher, MatchType
+
+        res = db.query("coarse", [Matcher(MatchType.EQUAL, b"h", b"1")],
+                       START, START + HOUR)
+        assert len(res) == 1
+        db.close()
+
+    def test_agg_variants(self, tmp_path):
+        db = make_db(tmp_path, "db")
+        db.create_namespace("coarse", opts())
+        for i in range(6):
+            db.write_tagged("default", b"m", [], START + i * 10 * SEC, float(i))
+        for agg, want in (("sum", 15.0), ("max", 5.0), ("count", 6.0)):
+            db.aggregate_tiles("default", "coarse", START, START + HOUR,
+                               tile_ns=60 * SEC, agg=agg)
+            from m3_tpu.utils.ident import tags_to_id
+
+            dps = db.read("coarse", tags_to_id(b"m", []), START, START + HOUR)
+            assert dps[-1].value == want
+        db.close()
+
+
+class TestReviewRegressions:
+    def test_http_peer_plus_in_base64(self, tmp_path):
+        # a series id whose base64 contains '+' must survive the URL
+        import base64
+
+        sid = bytes([0xFB, 0xEF, 0xBE])  # b64: "++++"-ish
+        assert b"+" in base64.b64encode(sid)
+        a = make_db(tmp_path, "a")
+        a.namespaces["default"].shards[0].write(sid, START + SEC, 0, b"")
+        a.flush_all()
+        from m3_tpu.services.dbnode import NodeAPI
+        from m3_tpu.storage.peers import HTTPPeer
+
+        api = NodeAPI(a)
+        port = api.serve(host="127.0.0.1", port=0)
+        try:
+            shard_id = 0
+            bs = a.namespaces["default"].opts.retention.block_start(START + SEC)
+            peer = HTTPPeer(f"http://127.0.0.1:{port}")
+            stream, _tags = peer.stream_block("default", shard_id, bs, sid)
+            assert stream  # round-tripped through the query string
+        finally:
+            api.shutdown()
+            a.close()
+
+    def test_repair_unreachable_peers_writes_nothing(self, tmp_path):
+        a = make_db(tmp_path, "a")
+
+        class DeadPeer:
+            def block_metadata(self, *args):
+                return {b"ghost": {"checksum": 1, "size": 10}}
+
+            def stream_block(self, *args):
+                raise ConnectionError("down")
+
+        sid = b"ghost"
+        shard_id = a.namespaces["default"].shard_set.lookup(sid)
+        bs = START
+        res = peers_mod.repair_shard_block(a, "default", shard_id, bs, [DeadPeer()])
+        assert res.diverged == 1 and res.repaired == 0
+        # no empty volume was registered (the block can still bootstrap later)
+        assert bs not in a.namespaces["default"].shards[shard_id]._filesets
+        a.close()
+
+    def test_repaired_peer_only_series_queryable(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        b = make_db(tmp_path, "b")
+        b.write_tagged("default", b"only_on_b", [(b"k", b"v")], START + SEC, 3.0)
+        b.flush_all()
+        from m3_tpu.utils.ident import tags_to_id
+
+        sid = tags_to_id(b"only_on_b", [(b"k", b"v")])
+        shard_id = a.namespaces["default"].shard_set.lookup(sid)
+        bs = a.namespaces["default"].opts.retention.block_start(START + SEC)
+        res = peers_mod.repair_shard_block(
+            a, "default", shard_id, bs, [peers_mod.InProcessPeer(b)]
+        )
+        assert res.repaired == 1
+        from m3_tpu.index.query import Matcher, MatchType
+
+        got = a.query("default", [Matcher(MatchType.EQUAL, b"k", b"v")],
+                      START, START + HOUR)
+        assert len(got) == 1 and got[0][2][0].value == 3.0
+        a.close()
+        b.close()
