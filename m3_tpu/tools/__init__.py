@@ -1,0 +1,1 @@
+"""Operator tools (the reference's src/cmd/tools inspectors)."""

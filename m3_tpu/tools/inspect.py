@@ -1,0 +1,176 @@
+"""Fileset / commitlog inspectors and verifiers.
+
+Role parity with the reference operator tools
+(/root/reference/src/cmd/tools: read_data_files, read_index_files,
+verify_data_files, and the commitlog reader):
+
+  python -m m3_tpu.tools.inspect list     <data_root> <namespace>
+  python -m m3_tpu.tools.inspect info     <data_root> <namespace> <shard> <block_start>
+  python -m m3_tpu.tools.inspect read     <data_root> <namespace> <shard> <block_start> [series_id]
+  python -m m3_tpu.tools.inspect verify   <data_root> <namespace>
+  python -m m3_tpu.tools.inspect commitlog <path>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from m3_tpu.encoding.m3tsz import decode as m3tsz_decode
+from m3_tpu.storage import commitlog
+from m3_tpu.storage.fileset import FilesetReader, list_filesets
+from m3_tpu.utils.ident import decode_tags
+from m3_tpu.utils.xtime import TimeUnit
+
+
+def cmd_list(root: str, namespace: str) -> int:
+    ns_dir = os.path.join(root, namespace)
+    if not os.path.isdir(ns_dir):
+        print(f"no such namespace dir {ns_dir}", file=sys.stderr)
+        return 1
+    shards = sorted((s for s in os.listdir(ns_dir) if s.isdigit()), key=int)
+    for shard in shards:
+        for bs, vol in list_filesets(root, namespace, int(shard)):
+            r = FilesetReader(root, namespace, int(shard), bs, vol, verify=False)
+            print(json.dumps({
+                "shard": int(shard), "block_start": bs, "volume": vol,
+                "n_series": r.n_series, "data_bytes": r.info["data_length"],
+            }))
+            r.close()
+    return 0
+
+
+def cmd_info(root, namespace, shard, block_start) -> int:
+    for bs, vol in list_filesets(root, namespace, shard):
+        if bs == block_start:
+            r = FilesetReader(root, namespace, shard, bs, vol, verify=False)
+            print(json.dumps(r.info, indent=2))
+            r.close()
+            return 0
+    print("fileset not found", file=sys.stderr)
+    return 1
+
+
+def cmd_read(root, namespace, shard, block_start, series_id=None,
+             unit=TimeUnit.SECOND) -> int:
+    vols = dict(list_filesets(root, namespace, shard))
+    if block_start not in vols:
+        print("fileset not found", file=sys.stderr)
+        return 1
+    r = FilesetReader(root, namespace, shard, block_start, vols[block_start])
+    try:
+        want = series_id.encode() if series_id else None
+        found = False
+        for i in range(r.n_series):
+            sid, tags_blob, stream = r.read_at(i)
+            if want is not None and sid != want:
+                continue
+            found = True
+            tags = (
+                {k.decode(errors="replace"): v.decode(errors="replace")
+                 for k, v in decode_tags(tags_blob)}
+                if tags_blob else {}
+            )
+            dps = m3tsz_decode(stream, int_optimized=False,
+                               default_time_unit=unit)
+            print(json.dumps({
+                "series_id": sid.decode(errors="replace"),
+                "tags": tags,
+                "bytes": len(stream),
+                "datapoints": [[d.timestamp_ns, d.value] for d in dps],
+            }))
+        if want is not None and not found:
+            print(f"series not found: {want!r}", file=sys.stderr)
+            return 1
+    finally:
+        r.close()
+    return 0
+
+
+def cmd_verify(root, namespace, unit=TimeUnit.SECOND) -> int:
+    """Digest-verify every complete fileset and decode every stream."""
+    ns_dir = os.path.join(root, namespace)
+    if not os.path.isdir(ns_dir):
+        print(f"no such namespace dir {ns_dir}", file=sys.stderr)
+        return 1
+    bad = total = 0
+    for shard in sorted(os.listdir(ns_dir)):
+        if not shard.isdigit():
+            continue
+        for bs, vol in list_filesets(root, namespace, int(shard)):
+            total += 1
+            r = None
+            try:
+                r = FilesetReader(root, namespace, int(shard), bs, vol, verify=True)
+                for i in range(r.n_series):
+                    sid, _tags, stream = r.read_at(i)
+                    m3tsz_decode(stream, int_optimized=False,
+                                 default_time_unit=unit)
+            except Exception as e:
+                bad += 1
+                print(json.dumps({
+                    "shard": int(shard), "block_start": bs, "volume": vol,
+                    "error": str(e),
+                }))
+            finally:
+                if r is not None:
+                    r.close()
+    print(json.dumps({"filesets": total, "corrupt": bad}))
+    return 1 if bad else 0
+
+
+def cmd_commitlog(path: str) -> int:
+    for e in commitlog.replay(path):
+        print(json.dumps({
+            "series_id": e.series_id.decode(errors="replace"),
+            "t_ns": e.time_ns,
+            "value_bits": e.value_bits,
+            "unit": e.unit,
+        }))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="m3_tpu.tools.inspect")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("list")
+    p.add_argument("root")
+    p.add_argument("namespace")
+    p = sub.add_parser("info")
+    p.add_argument("root")
+    p.add_argument("namespace")
+    p.add_argument("shard", type=int)
+    p.add_argument("block_start", type=int)
+    p = sub.add_parser("read")
+    p.add_argument("root")
+    p.add_argument("namespace")
+    p.add_argument("shard", type=int)
+    p.add_argument("block_start", type=int)
+    p.add_argument("series_id", nargs="?")
+    p.add_argument("--unit", default="SECOND",
+                   help="block write time unit (SECOND/MILLISECOND/...)")
+    p = sub.add_parser("verify")
+    p.add_argument("root")
+    p.add_argument("namespace")
+    p.add_argument("--unit", default="SECOND")
+    p = sub.add_parser("commitlog")
+    p.add_argument("path")
+    args = ap.parse_args(argv)
+    if args.cmd == "list":
+        return cmd_list(args.root, args.namespace)
+    if args.cmd == "info":
+        return cmd_info(args.root, args.namespace, args.shard, args.block_start)
+    if args.cmd == "read":
+        return cmd_read(args.root, args.namespace, args.shard, args.block_start,
+                        args.series_id, TimeUnit[args.unit.upper()])
+    if args.cmd == "verify":
+        return cmd_verify(args.root, args.namespace, TimeUnit[args.unit.upper()])
+    if args.cmd == "commitlog":
+        return cmd_commitlog(args.path)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

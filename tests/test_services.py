@@ -253,3 +253,56 @@ class TestAggregatorThreadSafety:
         total = sum(m.value for m in collected)
         assert total + agg.num_late_dropped == N_THREADS * PER
         assert agg.num_dropped == 0
+
+
+class TestInspectTools:
+    def test_list_read_verify(self, tmp_path, capsys):
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.options import DatabaseOptions
+        from m3_tpu.tools import inspect as tools
+
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=2))
+        db.create_namespace("default")
+        db.open(START)
+        db.write_tagged("default", b"cpu", [(b"h", b"1")], START + SEC, 7.5)
+        db.flush_all()
+        db.close()
+        root = str(tmp_path / "db" / "data")
+        assert tools.main(["list", root, "default"]) == 0
+        lines = [json.loads(line) for line in
+                 capsys.readouterr().out.strip().splitlines()]
+        assert lines and lines[0]["n_series"] == 1
+        bs = lines[0]["block_start"]
+        shard = lines[0]["shard"]
+        assert tools.main(["read", root, "default", str(shard), str(bs)]) == 0
+        doc = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+        assert doc["tags"] == {"__name__": "cpu", "h": "1"}
+        assert doc["datapoints"] == [[START + SEC, 7.5]]
+        assert tools.main(["verify", root, "default"]) == 0
+        summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert summary == {"filesets": 1, "corrupt": 0}
+
+    def test_verify_detects_corruption(self, tmp_path, capsys):
+        import os as _os
+
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.options import DatabaseOptions
+        from m3_tpu.tools import inspect as tools
+
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=1))
+        db.create_namespace("default")
+        db.open(START)
+        db.write_tagged("default", b"x", [], START + SEC, 1.0)
+        db.flush_all()
+        db.close()
+        root = str(tmp_path / "db" / "data")
+        victim = None
+        for dirpath, _dirs, files in _os.walk(root):
+            for f in files:
+                if f.endswith("-data.db"):
+                    victim = _os.path.join(dirpath, f)
+        with open(victim, "r+b") as f:
+            f.write(b"CORRUPT!")
+        assert tools.main(["verify", root, "default"]) == 1
+        out = capsys.readouterr().out.strip().splitlines()
+        assert json.loads(out[-1])["corrupt"] == 1
