@@ -24,6 +24,7 @@ class IndexBlock:
         self.sealed: list[Segment] = []
         self._cache: Segment | None = None  # sealed view of `mutable`
         self._cache_docs = 0
+        self.persisted_docs = -1  # doc count at last persist (persist.py)
 
     def insert(self, series_id: bytes, fields) -> None:
         self.mutable.insert(series_id, fields)

@@ -82,7 +82,16 @@ class Database:
         now_ns = now_ns if now_ns is not None else time.time_ns()
         for name, ns in self.namespaces.items():
             if ns.opts.bootstrap_enabled:
-                ns.bootstrap_from_fs(now_ns)
+                restored = set()
+                if ns.index is not None:
+                    from m3_tpu.index import persist as index_persist
+
+                    r = ns.opts.retention
+                    restored = index_persist.load_index(
+                        ns.index, self.fs_root, name,
+                        cutoff_ns=r.block_start(now_ns - r.retention_ns),
+                    )
+                ns.bootstrap_from_fs(now_ns, skip_index_blocks=restored)
                 self._replay_commitlogs(name, ns, now_ns)
             if ns.opts.writes_to_commitlog:
                 self._open_commitlog(name)
@@ -155,6 +164,12 @@ class Database:
             log.write(series_id, encoded_tags, t_ns, vbits, int(ns.opts.write_time_unit))
             self._log_windows[namespace].add(ns.opts.retention.block_start(t_ns))
         shard.write(series_id, t_ns, vbits, encoded_tags)
+        if ns.index is not None and encoded_tags:
+            # tagged-at-the-wire writes are index-visible like write_tagged,
+            # not dependent on the fileset rebuild at restart
+            from m3_tpu.utils.ident import decode_tags
+
+            ns.index.insert(series_id, decode_tags(encoded_tags), t_ns)
 
     def write_tagged(self, namespace: str, metric_name: bytes,
                      tags: list[tuple[bytes, bytes]], t_ns: int, value: float) -> bytes:
@@ -216,8 +231,15 @@ class Database:
             flushed += n
             expired += ns.expire(now_ns)
             if ns.index is not None:
-                ns.index.expire_before(
-                    ns.opts.retention.block_start(now_ns - ns.opts.retention.retention_ns)
+                from m3_tpu.index import persist as index_persist
+
+                cutoff = ns.opts.retention.block_start(
+                    now_ns - ns.opts.retention.retention_ns
+                )
+                ns.index.expire_before(cutoff)
+                index_persist.persist_index(ns.index, self.fs_root, name)
+                index_persist.expire_index_files(
+                    self.fs_root, name, cutoff, ns.opts.index.block_size_ns
                 )
             if n and name in self._commitlogs:
                 # flushed windows are durable in filesets: retire the active

@@ -75,27 +75,37 @@ class Namespace:
     def expire(self, now_ns: int) -> int:
         return sum(s.expire(now_ns) for s in self.shards.values())
 
+    def _spanned_index_starts(self, data_block_start: int) -> range:
+        """Index block starts a data block overlaps (single source of the
+        spanning rule for insert AND bootstrap-skip checks)."""
+        idx_bs = self.opts.index.block_size_ns
+        data_bs = self.opts.retention.block_size_ns
+        first = data_block_start - (data_block_start % idx_bs)
+        return range(first, data_block_start + data_bs, idx_bs)
+
     def index_insert_spanning(self, series_id: bytes, fields,
                               data_block_start: int) -> None:
         """Insert a doc into EVERY index block its data block overlaps (a
         data block can span several smaller index blocks)."""
         if self.index is None:
             return
-        idx_bs = self.opts.index.block_size_ns
-        data_bs = self.opts.retention.block_size_ns
-        first = data_block_start - (data_block_start % idx_bs)
-        for t in range(first, data_block_start + data_bs, idx_bs):
+        for t in self._spanned_index_starts(data_block_start):
             self.index.insert(series_id, fields, t)
 
-    def bootstrap_from_fs(self, now_ns: int | None = None) -> int:
+    def bootstrap_from_fs(self, now_ns: int | None = None,
+                          skip_index_blocks: set[int] | None = None) -> int:
         from m3_tpu.utils.ident import decode_tags
 
         n = sum(s.bootstrap_from_fs(now_ns) for s in self.shards.values())
         if self.index is not None:
-            # repopulate the reverse index from fileset tag blobs (the role
-            # of bootstrapping persisted index segments in the reference)
+            # rebuild the reverse index from fileset tag blobs, EXCEPT for
+            # index blocks already restored from persisted segments
+            skip = skip_index_blocks or set()
             for s in self.shards.values():
                 for bs, reader in s._filesets.items():
+                    # skip only if every overlapping index block was restored
+                    if set(self._spanned_index_starts(bs)) <= skip:
+                        continue
                     for i in range(reader.n_series):
                         sid, tags_blob = reader.entry_at(i)
                         if tags_blob:

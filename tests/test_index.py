@@ -295,3 +295,66 @@ class TestDatabaseTaggedPath:
         assert isinstance(q, AllQuery)
         q = matchers_to_query([Matcher(MatchType.EQUAL, b"a", b"b")])
         assert isinstance(q, TermQuery)
+
+
+class TestIndexPersistence:
+    def test_persist_and_restore(self, tmp_path):
+        from m3_tpu.index import persist as ip
+        from m3_tpu.index.index import NamespaceIndex
+
+        idx = NamespaceIndex(2 * HOUR)
+        for i in range(30):
+            idx.insert(f"s{i}".encode(), [(b"k", b"v"), (b"i", str(i).encode())],
+                       START + (i % 2) * 2 * HOUR)
+        assert ip.persist_index(idx, str(tmp_path), "ns") == 2
+        # second persist with no new docs is a no-op
+        assert ip.persist_index(idx, str(tmp_path), "ns") == 0
+        idx2 = NamespaceIndex(2 * HOUR)
+        restored = ip.load_index(idx2, str(tmp_path), "ns")
+        assert restored == {START, START + 2 * HOUR}
+        got = idx2.query(TermQuery(b"k", b"v"), START, START + 4 * HOUR)
+        assert len(got) == 30
+
+    def test_corrupt_segment_skipped(self, tmp_path):
+        from m3_tpu.index import persist as ip
+        from m3_tpu.index.index import NamespaceIndex
+        import os
+
+        idx = NamespaceIndex(2 * HOUR)
+        idx.insert(b"a", [(b"k", b"v")], START)
+        ip.persist_index(idx, str(tmp_path), "ns")
+        seg_dir = os.path.join(str(tmp_path), "ns", "_index")
+        f = os.path.join(seg_dir, os.listdir(seg_dir)[0])
+        with open(f, "r+b") as fh:
+            fh.seek(10)
+            fh.write(b"XX")
+        idx2 = NamespaceIndex(2 * HOUR)
+        assert ip.load_index(idx2, str(tmp_path), "ns") == set()
+
+    def test_database_persists_index_through_restart(self, tmp_path):
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.options import DatabaseOptions
+
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=2))
+        db.create_namespace("default")
+        db.open(START)
+        for i in range(20):
+            db.write_tagged("default", b"m", [(b"i", str(i).encode())],
+                            START + (i + 1) * 10**9, float(i))
+        db.tick(START + 4 * HOUR)  # flush + index persist
+        import os
+
+        seg_dir = os.path.join(str(tmp_path / "db"), "data", "default", "_index")
+        assert os.path.isdir(seg_dir) and os.listdir(seg_dir)
+        db.close()
+        db2 = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=2))
+        db2.create_namespace("default")
+        db2.open(START + 4 * HOUR)
+        # the restore path actually ran (not just the fileset rebuild
+        # fallback): restored blocks carry a non-default persisted_docs
+        idx = db2.namespaces["default"].index
+        assert any(blk.persisted_docs >= 0 for blk in idx._blocks.values())
+        res = db2.query("default", [Matcher(MatchType.EQUAL, b"__name__", b"m")],
+                        START, START + HOUR)
+        assert len(res) == 20
+        db2.close()

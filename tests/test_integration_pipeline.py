@@ -1,0 +1,97 @@
+"""Multi-service integration: the dedicated-aggregator deployment topology.
+
+The reference's docker integration tier (SURVEY.md §4.5 aggregator/
+coordinator scenarios) run in-process: a coordinator-side producer ships
+metrics over the REAL msg TCP transport to a dedicated aggregator service,
+which aggregates and ships results back over msg to a consumer writing into
+storage — then PromQL reads the rolled-up series.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from m3_tpu.msg.consumer import Consumer
+from m3_tpu.msg.producer import Producer
+from m3_tpu.services.aggregator import AggregatorService, decode_metric, encode_metric
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import DatabaseOptions
+
+SEC = 10**9
+START = 1_599_998_400_000_000_000
+
+
+def wait_until(cond, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestAggregatorPipeline:
+    def test_coordinator_to_aggregator_roundtrip(self, tmp_path):
+        # storage + final-destination consumer (the coordinator's m3msg
+        # ingest server role: aggregated metrics written back to storage)
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=2))
+        db.create_namespace("agg_out")
+        db.open(START)
+
+        def write_back(shard, payload):
+            _mt, sid, tags, t_ns, value = decode_metric(payload)
+            name = dict(tags).get(b"__name__", b"")
+            plain = [(k, v) for k, v in tags if k != b"__name__"]
+            db.write_tagged("agg_out", name, plain, t_ns, value)
+
+        out_consumer = Consumer(write_back)
+
+        # dedicated aggregator service: msg ingest -> rules -> msg output
+        agg = AggregatorService({
+            "instance_id": "agg-1",
+            "n_shards": 2,
+            "ingest": {"host": "127.0.0.1", "port": 0},
+            "output": {"host": "127.0.0.1", "port": out_consumer.port},
+            "rules": {"mapping": [
+                {"name": "all", "filter": "__name__:*", "policies": ["10s:2d"]}
+            ]},
+        })
+        agg.consumer = Consumer(agg._on_message, host="127.0.0.1", port=0)
+
+        # coordinator-side producer shipping raw metrics over TCP
+        producer = Producer(("127.0.0.1", agg.consumer.port), retry_after_s=0.5)
+        try:
+            for i in range(30):
+                payload = encode_metric(
+                    1, b"reqs|app=web", [(b"__name__", b"reqs"), (b"app", b"web")],
+                    START + (i % 30) * SEC, 1.0,
+                )
+                producer.publish(i % 2, payload)
+            assert wait_until(lambda: agg.scope is not None and
+                              agg.aggregator._shards[0].n +
+                              agg.aggregator._shards[1].n +
+                              sum(len(c[0]) for c in agg.aggregator._carry.values())
+                              >= 30 or producer.unacked == 0)
+            assert wait_until(lambda: producer.unacked == 0)
+            # leader flush emits over msg to the write-back consumer
+            emitted = agg.flush_once(START + 3600 * SEC)
+            assert emitted == 3  # 30s of data -> 3 ten-second windows
+            assert wait_until(lambda: agg.producer.unacked == 0)
+
+            from m3_tpu.query.engine import Engine
+
+            eng = Engine(db, "agg_out")
+            v, _ = eng.query_range("reqs", START + 30 * SEC, START + 30 * SEC,
+                                   60 * SEC)
+            assert len(v.labels) == 1
+            assert v.labels[0][b"app"] == b"web"
+            # three windows of 10 counter samples each -> SUM 10 per window;
+            # instant read sees the latest window value
+            assert v.values[0, 0] == 10.0
+        finally:
+            producer.close()
+            agg.shutdown()
+            out_consumer.close()
+            db.close()
