@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from m3_tpu.metrics.aggregation import AggregationType, MetricType
+from m3_tpu.metrics.aggregation import AggregationType
 from m3_tpu.metrics.transformation import TransformationType
 from m3_tpu.metrics.filters import TagFilter
 from m3_tpu.metrics.policy import StoragePolicy

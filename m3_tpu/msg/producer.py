@@ -14,7 +14,7 @@ from __future__ import annotations
 import socket
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from m3_tpu.msg.protocol import recv_frame, send_frame
 

@@ -16,20 +16,13 @@ registering more is adding an entry to FUNCTIONS.
 
 from __future__ import annotations
 
-import fnmatch
 import math
 import re
 from dataclasses import dataclass
 
 import numpy as np
 
-from m3_tpu.index.query import (
-    ConjunctionQuery,
-    Matcher,
-    MatchType,
-    RegexpQuery,
-    TermQuery,
-)
+from m3_tpu.index.query import ConjunctionQuery, RegexpQuery, TermQuery
 
 NS = 10**9
 

@@ -13,10 +13,9 @@ from __future__ import annotations
 
 import argparse
 import threading
-import time
 
 from m3_tpu.aggregator.downsample import Downsampler, DownsamplerAndWriter
-from m3_tpu.metrics.aggregation import AggregationType, MetricType
+from m3_tpu.metrics.aggregation import AggregationType
 from m3_tpu.metrics.filters import TagFilter
 from m3_tpu.metrics.policy import StoragePolicy
 from m3_tpu.metrics.rules import MappingRule, RollupRule, RollupTarget, RuleSet

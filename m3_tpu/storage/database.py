@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import os
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -19,7 +19,6 @@ from m3_tpu.storage import commitlog
 from m3_tpu.storage.namespace import Namespace
 from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions
 from m3_tpu.storage.sharding import ShardSet
-from m3_tpu.utils.xtime import TimeUnit
 
 
 @dataclass
