@@ -90,10 +90,10 @@ def _fmt_value(v: float) -> str:
 class CoordinatorAPI:
     """HTTP facade over a Database + PromQL Engine."""
 
-    def __init__(self, db, namespace: str = "default"):
+    def __init__(self, db, namespace: str = "default", limits=None):
         self.db = db
         self.namespace = namespace
-        self.engine = Engine(db, namespace)
+        self.engine = Engine(db, namespace, limits=limits)
         self._server: ThreadingHTTPServer | None = None
         # optional DownsamplerAndWriter: ingest then fans out through the
         # embedded downsampler (coordinator service wiring)

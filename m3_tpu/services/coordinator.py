@@ -102,7 +102,16 @@ class CoordinatorService:
         self.writer = DownsamplerAndWriter(
             self.db, self.downsampler, db_cfg.get("namespace", "default")
         )
-        self.api = CoordinatorAPI(self.db, db_cfg.get("namespace", "default"))
+        lim_cfg = config.get("limits", {}) or {}
+        from m3_tpu.query.engine import QueryLimits
+
+        limits = QueryLimits(
+            max_series=int(lim_cfg.get("max_series", 0)),
+            max_datapoints=int(lim_cfg.get("max_datapoints", 0)),
+            max_steps=int(lim_cfg.get("max_steps", 0)),
+        )
+        self.api = CoordinatorAPI(self.db, db_cfg.get("namespace", "default"),
+                                  limits=limits)
         self.api.writer = self.writer  # ingest fans out through downsampler
         self.carbon: CarbonIngester | None = None
         self._stop = threading.Event()

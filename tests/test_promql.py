@@ -332,3 +332,64 @@ class TestGroupLeftLabels:
                                START + MIN, START + MIN, MIN)
         got = {lb[b"code"]: v.values[i, 0] for i, lb in enumerate(v.labels)}
         assert got == {b"500": 0.05, b"404": 0.1}
+
+
+class TestQueryLimits:
+    def test_series_and_datapoint_limits(self, db):
+        from m3_tpu.query.engine import QueryLimitError, QueryLimits
+
+        for i in range(10):
+            write_series(db, b"lim", [(b"i", str(i).encode())],
+                         [(START + j * 10**9, 1.0) for j in range(1, 6)])
+        eng = Engine(db, limits=QueryLimits(max_series=5))
+        with pytest.raises(QueryLimitError, match="series"):
+            eng.query_range("lim", START + MIN, START + MIN, MIN)
+        eng = Engine(db, limits=QueryLimits(max_datapoints=20))
+        with pytest.raises(QueryLimitError, match="datapoints"):
+            eng.query_range("lim", START + MIN, START + MIN, MIN)
+        eng = Engine(db, limits=QueryLimits(max_steps=10))
+        with pytest.raises(QueryLimitError, match="steps"):
+            eng.query_range("lim", START, START + HOUR, MIN)
+        # generous limits pass
+        eng = Engine(db, limits=QueryLimits(max_series=100,
+                                            max_datapoints=1000, max_steps=100))
+        v, _ = eng.query_range("lim", START + MIN, START + MIN, MIN)
+        assert len(v.labels) == 10
+
+    def test_budget_shared_across_selectors(self, db):
+        from m3_tpu.query.engine import QueryLimitError, QueryLimits
+
+        for name in (b"la", b"lb", b"lc"):
+            for i in range(4):
+                write_series(db, name, [(b"i", str(i).encode())],
+                             [(START + 10**9, 1.0)])
+        # 12 series total across three selectors; per-selector 4 <= 10 but
+        # the shared budget must trip
+        eng = Engine(db, limits=QueryLimits(max_series=10))
+        with pytest.raises(QueryLimitError, match="series"):
+            eng.query_range("la + lb + lc" if False else "sum(la) + sum(lb) + sum(lc)",
+                            START + MIN, START + MIN, MIN)
+
+    def test_http_limits_plumbed(self, db):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from m3_tpu.query.api import CoordinatorAPI
+        from m3_tpu.query.engine import QueryLimits
+
+        for i in range(8):
+            write_series(db, b"h", [(b"i", str(i).encode())], [(START + 10**9, 1.0)])
+        api = CoordinatorAPI(db, limits=QueryLimits(max_series=3))
+        port = api.serve(port=0)
+        try:
+            url = (f"http://127.0.0.1:{port}/api/v1/query_range?query=h"
+                   f"&start={START//10**9 + 60}&end={START//10**9 + 60}&step=60")
+            try:
+                urllib.request.urlopen(url)
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as e:
+                body = _json.loads(e.read())
+                assert "limit" in body["error"]
+        finally:
+            api.shutdown()
