@@ -46,7 +46,7 @@ def window_aggregate(times, values, n_points, start, window_ns: int, n_windows: 
     b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
 
     shape = (B, n_windows + 1)
-    ones = jnp.where(valid, 1, 0)
+    ones = jnp.where(valid, 1, 0).astype(jnp.int32)
     v = values
     count = jnp.zeros(shape, jnp.int32).at[b_idx, w].add(ones)
     total = jnp.zeros(shape, v.dtype).at[b_idx, w].add(jnp.where(valid, v, 0.0))
@@ -54,7 +54,8 @@ def window_aggregate(times, values, n_points, start, window_ns: int, n_windows: 
     vmax = jnp.full(shape, -jnp.inf, v.dtype).at[b_idx, w].max(jnp.where(valid, v, -jnp.inf))
     # last = value at the latest timestamp per window; timestamps ascend per
     # series, so the max in-window column index identifies it.
-    last_col = jnp.full(shape, -1, jnp.int32).at[b_idx, w].max(jnp.where(valid, idx[None, :], -1))
+    idx32 = idx.astype(jnp.int32)
+    last_col = jnp.full(shape, -1, jnp.int32).at[b_idx, w].max(jnp.where(valid, idx32[None, :], -1))
     last = jnp.take_along_axis(v, jnp.maximum(last_col[:, :n_windows], 0), axis=1)
 
     count = count[:, :n_windows]
