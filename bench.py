@@ -31,22 +31,14 @@ import subprocess
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from m3_tpu.utils.childproc import env_float, scrubbed_env, tail  # noqa: E402
+
 FALLBACK_BASELINE_DP_PER_SEC = 10_000_000.0
 
 _CHILD_ENV = "M3_BENCH_CHILD"
-_SAFE_ENV = {
-    "PALLAS_AXON_POOL_IPS": "",
-    "JAX_PLATFORMS": "cpu",
-}
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-_CHILD_TIMEOUT_S = _env_float("M3_BENCH_CHILD_TIMEOUT", 420.0)
-_SAFE_TIMEOUT_S = _env_float("M3_BENCH_SAFE_TIMEOUT", 300.0)
+_CHILD_TIMEOUT_S = env_float("M3_BENCH_CHILD_TIMEOUT", 420.0)
+_SAFE_TIMEOUT_S = env_float("M3_BENCH_SAFE_TIMEOUT", 300.0)
 
 
 def _measure_cpu_baseline(times, values, start, T) -> float | None:
@@ -132,10 +124,9 @@ def _fallback(detail: str) -> dict:
     }
 
 
-def _run_child(extra_env: dict, timeout_s: float) -> dict | None:
+def _run_child(scrub: bool, timeout_s: float) -> dict | None:
     """Run this script in a child process; parse its one-line JSON result."""
-    env = dict(os.environ)
-    env.update(extra_env)
+    env = scrubbed_env() if scrub else dict(os.environ)
     env[_CHILD_ENV] = "1"
     here = os.path.dirname(os.path.abspath(__file__))
     try:
@@ -147,14 +138,18 @@ def _run_child(extra_env: dict, timeout_s: float) -> dict | None:
             text=True,
             timeout=timeout_s,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         print(f"bench child timed out after {timeout_s}s", file=sys.stderr)
+        for name, out in (("stdout", e.stdout), ("stderr", e.stderr)):
+            t = tail(out)
+            if t:
+                sys.stderr.write(f"--- bench child {name} tail ---\n{t}\n")
         return None
     except Exception as e:  # noqa: BLE001
         print(f"bench child failed to launch: {e}", file=sys.stderr)
         return None
     if r.stderr:
-        sys.stderr.write(r.stderr[-4000:])
+        sys.stderr.write(tail(r.stderr))
     for line in reversed(r.stdout.strip().splitlines()):
         try:
             out = json.loads(line)
@@ -176,11 +171,11 @@ def main() -> None:
         return
 
     # parent: never imports jax; watchdogs the child and falls back to CPU
-    out = _run_child({}, _CHILD_TIMEOUT_S)
+    out = _run_child(False, _CHILD_TIMEOUT_S)
     bad = not out or not out.get("value") or "CORRECTNESS FAILED" in out.get("metric", "")
     if bad:
         print("retrying bench with scrubbed CPU env", file=sys.stderr)
-        safe = _run_child(_SAFE_ENV, _SAFE_TIMEOUT_S)
+        safe = _run_child(True, _SAFE_TIMEOUT_S)
         if safe and safe.get("value") and "CORRECTNESS FAILED" not in safe.get("metric", ""):
             out = safe
     if not out:

@@ -6,6 +6,13 @@ float_encoder_iterator,int_sig_bits_tracker}.go): delta-of-delta timestamps
 with per-unit bucket schemes and special markers, XOR float compression, and
 the float->scaled-int optimization.
 
+One deliberate carve-out from bit-identity: integer-valued floats with
+|value| >= 2^63 are encoded in float mode here, whereas the reference wraps
+them through uint64(int64(v)) into int mode. Such streams differ from the
+reference bit-for-bit but decode to the same values either way (our decoder
+accepts both forms); the wraparound would otherwise corrupt the sig-bits
+budget. See _write_first_value/_write_next_value.
+
 This scalar path is the semantic ground truth that the batched TPU kernels
 (m3_tpu.encoding.m3tsz.tpu) are property-tested against; it also serves the
 control plane for small/one-off encodes where device dispatch would dominate.

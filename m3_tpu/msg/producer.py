@@ -116,6 +116,12 @@ class Producer:
                 )
                 self._acker.start()
             with self._cv:
+                # redeliver stale unacked messages on EVERY iteration, not
+                # only when the queue drains — under sustained publish load
+                # the empty-queue wait below may never run, and at-least-once
+                # depends on this check (reference message_writer retries on
+                # a ticker, msg/producer/writer/message_writer.go)
+                self._requeue_stale_locked()
                 while not self._queue and not self._closed:
                     # also wake to retry unacked messages
                     self._cv.wait(timeout=self.retry_after_s / 2)
@@ -146,6 +152,11 @@ class Producer:
 
     def _requeue_stale_locked(self) -> None:
         now = time.monotonic()
+        # throttle: the O(pending) scan runs at most every retry_after_s/2,
+        # so the per-message fast path stays O(1) under sustained load
+        if now - getattr(self, "_last_requeue_scan", 0.0) < self.retry_after_s / 2:
+            return
+        self._last_requeue_scan = now
         queued = set(self._queue)
         for p in self._pending.values():
             if (

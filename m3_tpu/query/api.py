@@ -20,7 +20,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from m3_tpu.index.query import Matcher, MatchType, matchers_to_query
-from m3_tpu.query.engine import Engine, Scalar, Vector
+from m3_tpu.query.engine import Engine, QueryLimitError, Scalar, Vector
 from m3_tpu.query.windows import NS
 from m3_tpu.utils import protowire, snappy
 
@@ -110,12 +110,24 @@ class CoordinatorAPI:
 
     def handle(self, method: str, path: str, query: dict, body: bytes):
         """Returns (status, content_type, payload)."""
+        # one resource budget per request, enforced in the storage read
+        # path (covers PromQL, Graphite render, and remote read alike)
+        limits = getattr(self.db, "limits", None)
         try:
+            if limits is not None:
+                limits.start_query()
             return self._route(method, path, query, body)
+        except QueryLimitError as e:
+            return 422, "application/json", json.dumps(
+                {"status": "error", "errorType": "query_limit", "error": str(e)}
+            ).encode()
         except Exception as e:  # surface as prometheus-style error envelope
             return 400, "application/json", json.dumps(
                 {"status": "error", "errorType": "bad_data", "error": str(e)}
             ).encode()
+        finally:
+            if limits is not None:
+                limits.end_query()
 
     def _route(self, method, path, q, body):
         if path in ("/health", "/ready"):
@@ -420,7 +432,10 @@ class CoordinatorAPI:
                 if method == "POST" and self.headers.get(
                     "Content-Type", ""
                 ).startswith("application/x-www-form-urlencoded"):
-                    q = {**parse_qs(body.decode()), **q}
+                    try:
+                        q = {**parse_qs(body.decode()), **q}
+                    except UnicodeDecodeError:
+                        pass  # mislabeled binary body; routes read it raw
                 status, ctype, payload = api.handle(method, u.path, q, body)
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)

@@ -37,51 +37,9 @@ from m3_tpu.query.windows import NS, RaggedSeries
 
 DEFAULT_LOOKBACK_NS = 5 * 60 * NS
 
-
-class QueryLimitError(ValueError):
-    """A query exceeded the configured resource limits
-    (the storage/limits role, reference storage/limits/types.go:37-57)."""
-
-
-class QueryLimits:
-    """Resource ceilings accumulated across a WHOLE query (every selector
-    in the expression shares the budget); zero means unlimited. Accounting
-    state is thread-local so one Engine can serve concurrent requests."""
-
-    def __init__(self, max_series: int = 0, max_datapoints: int = 0,
-                 max_steps: int = 0):
-        import threading
-
-        self.max_series = max_series
-        self.max_datapoints = max_datapoints
-        self.max_steps = max_steps
-        self._tl = threading.local()
-
-    def start_query(self) -> None:
-        self._tl.series = 0
-        self._tl.datapoints = 0
-
-    def check_steps(self, n_steps: int) -> None:
-        if self.max_steps and n_steps > self.max_steps:
-            raise QueryLimitError(
-                f"query spans {n_steps} steps, limit {self.max_steps}"
-            )
-
-    def add_series(self, n_series: int) -> None:
-        total = getattr(self._tl, "series", 0) + n_series
-        self._tl.series = total
-        if self.max_series and total > self.max_series:
-            raise QueryLimitError(
-                f"query matched {total} series, limit {self.max_series}"
-            )
-
-    def add_datapoints(self, n: int) -> None:
-        total = getattr(self._tl, "datapoints", 0) + n
-        self._tl.datapoints = total
-        if self.max_datapoints and total > self.max_datapoints:
-            raise QueryLimitError(
-                f"query would read {total} datapoints, limit {self.max_datapoints}"
-            )
+# accounting moved to the storage layer so every read path shares the
+# budget; re-exported here for the existing query-facing API
+from m3_tpu.storage.limits import QueryLimitError, QueryLimits  # noqa: E402
 
 # functions that keep the metric name on their output
 _KEEPS_NAME = {"sort", "sort_desc", "last_over_time"}
@@ -124,7 +82,13 @@ class Engine:
         self.db = db
         self.namespace = namespace
         self.lookback_ns = lookback_ns
-        self.limits = limits or QueryLimits()
+        # Budgets are enforced in the storage read path; an explicit limits
+        # arg (re)binds the DATABASE-WIDE budget, mirroring the reference
+        # where limits live in storage options, one set per node — so the
+        # most recent binding governs every reader of this db.
+        if limits is not None:
+            db.limits = limits
+        self.limits = limits or getattr(db, "limits", None) or QueryLimits()
 
     # -- public API --
 
@@ -134,14 +98,20 @@ class Engine:
         eval_ts = np.arange(start_ns, end_ns + 1, step_ns, dtype=np.int64)
         self.limits.check_steps(len(eval_ts))
         self.limits.start_query()
-        expr = promql.parse(q)
-        return self._eval(expr, eval_ts), eval_ts
+        try:
+            expr = promql.parse(q)
+            return self._eval(expr, eval_ts), eval_ts
+        finally:
+            self.limits.end_query()
 
     def query_instant(self, q: str, t_ns: int):
         eval_ts = np.array([t_ns], dtype=np.int64)
         self.limits.start_query()
-        expr = promql.parse(q)
-        return self._eval(expr, eval_ts), eval_ts
+        try:
+            expr = promql.parse(q)
+            return self._eval(expr, eval_ts), eval_ts
+        finally:
+            self.limits.end_query()
 
     # -- fetch --
 
@@ -154,14 +124,12 @@ class Engine:
         from m3_tpu.index.query import matchers_to_query
 
         docs = ns.query_ids(matchers_to_query(sel.matchers), t_min, t_max)
-        self.limits.add_series(len(docs))
         labels = []
         per_series = []
         for doc in docs:
             times, vbits = ns.read(doc.series_id, t_min, t_max)
             if len(times) == 0:
                 continue
-            self.limits.add_datapoints(len(times))
             labels.append(dict(doc.fields))
             per_series.append((times, vbits.view(np.float64)))
         return labels, RaggedSeries.from_lists(per_series)

@@ -45,6 +45,8 @@ class Database:
         self._retired_logs: dict[str, list[tuple[str, set[int]]]] = {}
         self._open = False
         self._shard_set = ShardSet(self.opts.n_shards)
+        # optional storage-layer QueryLimits shared by all read paths
+        self.limits = None
 
     # -- lifecycle --
 
@@ -60,6 +62,7 @@ class Database:
             return self.namespaces[name]
         ns = Namespace(name, opts or NamespaceOptions(), self.opts, self._shard_set,
                        self.fs_root)
+        ns.database = self
         self.namespaces[name] = ns
         if ns.opts.writes_to_commitlog and self._open:
             self._open_commitlog(name)

@@ -33,6 +33,12 @@ class Namespace:
         self.index = (
             NamespaceIndex(opts.index.block_size_ns) if opts.index.enabled else None
         )
+        # set by Database.create_namespace; carries the shared QueryLimits
+        self.database = None
+
+    @property
+    def limits(self):
+        return getattr(self.database, "limits", None)
 
     def shard_for(self, series_id: bytes) -> Shard:
         sid = self.shard_set.lookup(series_id)
@@ -54,13 +60,24 @@ class Namespace:
             self.index.insert(series_id, tags, t_ns)
 
     def query_ids(self, query: Query, start_ns: int, end_ns: int, limit=None):
-        """Matched index docs for the time range (storage QueryIDs role)."""
+        """Matched index docs for the time range (storage QueryIDs role).
+
+        Limits are accounted HERE — the shared storage read path — so every
+        caller (PromQL, Graphite, remote read) draws from one budget, the
+        way the reference enforces storage/limits below the query engines
+        (/root/reference/src/dbnode/storage/limits/types.go:37)."""
         if self.index is None:
             raise RuntimeError(f"namespace {self.name} has no index enabled")
-        return self.index.query(query, start_ns, end_ns, limit)
+        docs = self.index.query(query, start_ns, end_ns, limit)
+        if self.limits is not None:
+            self.limits.add_series(len(docs))
+        return docs
 
     def read(self, series_id: bytes, start_ns: int, end_ns: int):
-        return self.shard_for(series_id).read(series_id, start_ns, end_ns)
+        times, vbits = self.shard_for(series_id).read(series_id, start_ns, end_ns)
+        if self.limits is not None:
+            self.limits.add_datapoints(len(times))
+        return times, vbits
 
     def flush(self, now_ns: int) -> int:
         if not self.opts.flush_enabled:

@@ -1,0 +1,44 @@
+"""Shared helpers for running jax work in defended child processes.
+
+The axon TPU relay dials at interpreter startup and can hang every python
+process when the tunnel is down; driver-facing entry points (bench.py,
+__graft_entry__.dryrun_multichip) therefore run their jax work in child
+processes with this scrubbed env. One definition here so a tunnel-related
+fix lands in every caller.
+"""
+
+from __future__ import annotations
+
+import os
+
+# PALLAS_AXON_POOL_IPS= skips the relay dial entirely;
+# JAX_PLATFORMS=cpu prevents a half-registered axon backend being chosen
+SCRUBBED_TPU_ENV = {
+    "PALLAS_AXON_POOL_IPS": "",
+    "JAX_PLATFORMS": "cpu",
+}
+
+
+def scrubbed_env(n_devices: int | None = None) -> dict:
+    """A copy of os.environ that cannot touch the TPU relay; optionally
+    forces an n_devices virtual CPU mesh."""
+    env = dict(os.environ)
+    env.update(SCRUBBED_TPU_ENV)
+    if n_devices is not None:
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    return env
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def tail(text: str | bytes | None, n: int = 4000) -> str:
+    if not text:
+        return ""
+    if isinstance(text, bytes):
+        text = text.decode(errors="replace")
+    return text[-n:]

@@ -165,6 +165,39 @@ def decode_read_request(payload: bytes) -> list[PromReadQuery]:
     return out
 
 
+def encode_read_request(queries: list[tuple[int, int, list[PromMatcher]]]) -> bytes:
+    """Client-side prompb.ReadRequest: [(start_ms, end_ms, matchers)]."""
+    out = bytearray()
+    for start_ms, end_ms, matchers in queries:
+        body = bytearray()
+        body += field_varint(1, start_ms)
+        body += field_varint(2, end_ms)
+        for m in matchers:
+            mb = bytearray()
+            if m.type:
+                mb += field_varint(1, m.type)
+            mb += field_bytes(2, m.name)
+            mb += field_bytes(3, m.value)
+            body += field_bytes(3, bytes(mb))
+        out += field_bytes(1, bytes(body))
+    return bytes(out)
+
+
+def decode_read_response(payload: bytes) -> list[list[PromTimeSeries]]:
+    """Client-side decode of prompb.ReadResponse (inverse of
+    encode_read_response)."""
+    results = []
+    for fno, _, val in iter_fields(payload):
+        if fno != 1:
+            continue
+        series_list = []
+        for f2, _, v2 in iter_fields(val):
+            if f2 == 1:
+                series_list.extend(decode_write_request(field_bytes(1, v2)))
+        results.append(series_list)
+    return results
+
+
 def encode_read_response(results: list[list[PromTimeSeries]]) -> bytes:
     out = bytearray()
     for series_list in results:

@@ -370,6 +370,84 @@ class TestQueryLimits:
             eng.query_range("la + lb + lc" if False else "sum(la) + sum(lb) + sum(lc)",
                             START + MIN, START + MIN, MIN)
 
+    def test_limits_cover_graphite_render(self, db):
+        """Budgets are enforced in the storage read path, so Graphite
+        /render draws from the same per-request budget as PromQL."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from m3_tpu.query.api import CoordinatorAPI
+        from m3_tpu.query.engine import QueryLimits
+        from m3_tpu.query.graphite import path_to_tags
+
+        for i in range(8):
+            path = f"web.host{i}.cpu".encode()
+            write_series(db, path, path_to_tags(path), [(START + 10**9, 1.0)])
+        api = CoordinatorAPI(db, limits=QueryLimits(max_series=3))
+        port = api.serve(port=0)
+        try:
+            url = (f"http://127.0.0.1:{port}/render?target=web.*.cpu"
+                   f"&from={START//10**9}&until={START//10**9 + 120}")
+            try:
+                urllib.request.urlopen(url)
+                raise AssertionError("expected query-limit rejection")
+            except urllib.error.HTTPError as e:
+                assert e.code == 422
+                body = _json.loads(e.read())
+                assert "limit" in body["error"]
+        finally:
+            api.shutdown()
+
+    def test_limits_cover_remote_read(self, db):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from m3_tpu.query.api import CoordinatorAPI
+        from m3_tpu.query.engine import QueryLimits
+        from m3_tpu.utils import protowire, snappy
+
+        for i in range(8):
+            write_series(db, b"rr", [(b"i", str(i).encode())],
+                         [(START + 10**9, 1.0)])
+        api = CoordinatorAPI(db, limits=QueryLimits(max_series=3))
+        port = api.serve(port=0)
+        try:
+            req = protowire.encode_read_request(
+                [(START // 10**6, START // 10**6 + 120_000,
+                  [protowire.PromMatcher(0, b"__name__", b"rr")])]
+            )
+            body = snappy.compress(req)
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/api/v1/prom/remote/read",
+                    data=body, method="POST",
+                    headers={"Content-Type": "application/x-protobuf"},
+                ))
+                raise AssertionError("expected query-limit rejection")
+            except urllib.error.HTTPError as e:
+                assert e.code == 422
+                assert "limit" in _json.loads(e.read())["error"]
+            # an under-limit remote read succeeds and round-trips
+            req = protowire.encode_read_request(
+                [(START // 10**6, START // 10**6 + 120_000,
+                  [protowire.PromMatcher(0, b"__name__", b"rr"),
+                   protowire.PromMatcher(0, b"i", b"1")])]
+            )
+            r = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/prom/remote/read",
+                data=snappy.compress(req), method="POST",
+                headers={"Content-Type": "application/x-protobuf"},
+            ))
+            results = protowire.decode_read_response(snappy.decompress(r.read()))
+            assert len(results) == 1 and len(results[0]) == 1
+            (ts,) = results[0]
+            assert (b"i", b"1") in ts.labels
+            assert ts.samples == [(START // 10**6 + 1000, 1.0)]
+        finally:
+            api.shutdown()
+
     def test_http_limits_plumbed(self, db):
         import json as _json
         import urllib.error
