@@ -12,6 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 from m3_tpu.index import postings as P
+from m3_tpu.utils import dispatch
 from m3_tpu.index.query import (
     AllQuery,
     ConjunctionQuery,
@@ -23,6 +24,37 @@ from m3_tpu.index.query import (
     TermQuery,
 )
 from m3_tpu.index.segment import Segment
+
+# device bitmap algebra pays off when (terms x doc-space) is large; below
+# this the sorted-array set ops win
+BITMAP_WORK_THRESHOLD = 1 << 17
+
+
+def _bitmap_combine(seg: Segment, positives: list[np.ndarray],
+                    negatives: list[np.ndarray], conjunction: bool) -> np.ndarray:
+    """Dense-bitmap evaluation on device: one [Q, W] AND/OR reduction plus
+    an AND-NOT, replacing the reference's roaring container loops
+    (/root/reference/src/m3ninx/search/searcher/conjunction.go:78-111)."""
+    from m3_tpu.ops import bitmaps
+
+    n_docs = seg.n_docs
+    # pad the word axis to a power of two (zero words beyond n_docs in every
+    # input, so padded output bits stay zero) to bound XLA recompiles
+    W = dispatch.next_pow2((n_docs + 63) // 64)
+
+    def mask(p: np.ndarray) -> np.ndarray:
+        m = P.to_bitmap(p, n_docs)
+        return np.pad(m, (0, W - len(m)))
+
+    if positives:
+        masks = np.stack([mask(p) for p in positives])
+        acc = bitmaps.conjunct(masks) if conjunction else bitmaps.disjunct(masks)
+    else:
+        acc = mask(seg.postings_all())
+    if negatives:
+        neg = bitmaps.disjunct(np.stack([mask(m) for m in negatives]))
+        acc = bitmaps.and_not(acc, neg)
+    return P.from_bitmap(np.asarray(acc))
 
 
 def search_segment(seg: Segment, query: Query) -> np.ndarray:
@@ -49,6 +81,13 @@ def search_segment(seg: Segment, query: Query) -> np.ndarray:
                 negatives.append(search_segment(seg, q.inner))
             else:
                 positives.append(search_segment(seg, q))
+        n_terms = len(positives) + len(negatives)
+        if n_terms >= 3 and dispatch.use_device(
+            n_terms * seg.n_docs, BITMAP_WORK_THRESHOLD
+        ):
+            dispatch.record("bitmaps.conjunct", True)
+            return _bitmap_combine(seg, positives, negatives, conjunction=True)
+        dispatch.record("bitmaps.conjunct", False)
         if positives:
             positives.sort(key=len)
             acc = positives[0]
@@ -64,7 +103,14 @@ def search_segment(seg: Segment, query: Query) -> np.ndarray:
             acc = P.difference(acc, n)
         return acc
     if isinstance(query, DisjunctionQuery):
-        return P.union_many([search_segment(seg, q) for q in query.queries])
+        parts = [search_segment(seg, q) for q in query.queries]
+        if len(parts) >= 3 and dispatch.use_device(
+            len(parts) * seg.n_docs, BITMAP_WORK_THRESHOLD
+        ):
+            dispatch.record("bitmaps.disjunct", True)
+            return _bitmap_combine(seg, parts, [], conjunction=False)
+        dispatch.record("bitmaps.disjunct", False)
+        return P.union_many(parts)
     raise TypeError(f"unknown query type {type(query)}")
 
 

@@ -1,0 +1,207 @@
+"""Device kernels for the PromQL temporal hot loops.
+
+The jax lowering of m3_tpu.query.windows' columnar math (reference hot
+loops: /root/reference/src/query/functions/temporal/{rate,aggregation}.go):
+window bounds (cheap searchsorted) stay on host; the heavy [S x steps]
+matrix math — prefix-sum window reductions, extrapolated-rate algebra,
+staleness gathers — runs as one fused XLA program per shape.
+
+All kernels take ragged sample arrays padded to a power of two (values
+pad 0.0, so prefix sums are unaffected; lo/hi indices never reach pads)
+and a [S, steps] lo/hi bound pair. ``query.windows`` dispatches here via
+``utils.dispatch`` and keeps numpy as the flag-off fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from m3_tpu.utils import dispatch
+
+NS = 1_000_000_000
+
+# elementwise matrix math wins earlier than sort-based ops
+DEVICE_THRESHOLD = 16_384
+
+
+def _pad_samples(values: np.ndarray, times: np.ndarray | None = None):
+    n = len(values)
+    N = dispatch.next_pow2(n)
+    v = np.concatenate([values, np.zeros(N - n)])
+    if times is None:
+        return v, None
+    t = np.concatenate([times, np.full(N - n, np.iinfo(np.int64).max, np.int64)])
+    return v, t
+
+
+@functools.lru_cache(maxsize=None)
+def _kernels():
+    import jax
+    import jax.numpy as jnp
+
+    import m3_tpu.ops  # noqa: F401  (x64)
+
+    @jax.jit
+    def sum_avg_std(v, lo, hi):
+        """(count, s1, s2) per window in one fused program."""
+        csum = jnp.concatenate([jnp.zeros(1), jnp.cumsum(v)])
+        csq = jnp.concatenate([jnp.zeros(1), jnp.cumsum(v * v)])
+        count = (hi - lo).astype(jnp.float64)
+        return count, csum[hi] - csum[lo], csq[hi] - csq[lo]
+
+    @jax.jit
+    def instant_values(v, lo, hi):
+        has = hi > lo
+        idx = jnp.clip(hi - 1, 0, v.shape[0] - 1)
+        return jnp.where(has, v[idx], jnp.nan)
+
+    @functools.partial(jax.jit, static_argnames=("is_counter", "is_rate"))
+    def extrapolated_rate(v, adj, t, lo, hi, eval_ts, range_ns,
+                          is_counter, is_rate):
+        """Mirrors upstream promql extrapolatedRate (windows.py host path).
+
+        Known deviation: XLA may reassociate (sampled/count)*1.1 when
+        computing the extrapolation threshold, so a window whose edge gap
+        EXACTLY equals the threshold (possible only with perfectly regular
+        sample spacing) can take the other extrapolation branch than the
+        numpy path. Both branches are valid upstream-Prometheus behavior;
+        off the knife edge the paths agree bit-for-bit on exact inputs."""
+        n = v.shape[0]
+        count = (hi - lo).astype(jnp.float64)
+        ok = count >= 2
+        safe_lo = jnp.clip(lo, 0, n - 1)
+        safe_hi = jnp.clip(hi - 1, 0, n - 1)
+        first_v = adj[safe_lo]
+        last_v = adj[safe_hi]
+        raw_first_v = v[safe_lo]
+        first_t = t[safe_lo].astype(jnp.float64)
+        last_t = t[safe_hi].astype(jnp.float64)
+        result = last_v - first_v
+
+        window_start = (eval_ts - range_ns).astype(jnp.float64)[None, :]
+        window_end = eval_ts.astype(jnp.float64)[None, :]
+        sampled = (last_t - first_t) / NS
+        dur_to_start = (first_t - window_start) / NS
+        dur_to_end = (window_end - last_t) / NS
+        avg_between = sampled / jnp.maximum(count - 1, 1)
+        threshold = avg_between * 1.1
+
+        if is_counter:
+            dur_to_zero = jnp.where(
+                result > 0, sampled * (raw_first_v / result), jnp.inf
+            )
+            dur_to_start = jnp.where(
+                (result > 0) & (raw_first_v >= 0) & (dur_to_zero < dur_to_start),
+                dur_to_zero,
+                dur_to_start,
+            )
+
+        dur_to_start = jnp.where(dur_to_start >= threshold, avg_between / 2,
+                                 dur_to_start)
+        dur_to_end = jnp.where(dur_to_end >= threshold, avg_between / 2,
+                               dur_to_end)
+
+        extrap = sampled + dur_to_start + dur_to_end
+        factor = jnp.where(sampled > 0, extrap / sampled, jnp.nan)
+        out = result * factor
+        if is_rate:
+            out = out / (range_ns / NS)
+        return jnp.where(ok & (sampled > 0), out, jnp.nan)
+
+    @jax.jit
+    def reset_adjusted(v, is_first, row_start_index):
+        """Counter monotonization: v + cumulative in-row reset drops.
+        row_start_index[i] = index of sample i's row's first sample."""
+        prev = jnp.concatenate([jnp.zeros(1), v[:-1]])
+        drop = jnp.where((v < prev) & ~is_first, prev, 0.0)
+        cdrop = jnp.cumsum(drop)
+        cdrop0 = jnp.concatenate([jnp.zeros(1), cdrop])
+        row_base = cdrop0[row_start_index]
+        return v + (cdrop - row_base)
+
+    return {
+        "sum_avg_std": sum_avg_std,
+        "instant_values": instant_values,
+        "extrapolated_rate": extrapolated_rate,
+        "reset_adjusted": reset_adjusted,
+    }
+
+
+def _pad_bounds(lo: np.ndarray, hi: np.ndarray):
+    """Pad BOTH axes to powers of two with empty windows, so varying
+    series counts AND step counts (dashboard zooms) reuse O(log^2)
+    compiled shapes instead of one XLA program per exact shape."""
+    S, T = lo.shape
+    Sp, Tp = dispatch.next_pow2(S), dispatch.next_pow2(T)
+    if Sp == S and Tp == T:
+        return lo, hi, S, T
+    lo_p = np.zeros((Sp, Tp), np.int64)
+    hi_p = np.zeros((Sp, Tp), np.int64)
+    lo_p[:S, :T] = lo
+    hi_p[:S, :T] = hi
+    return lo_p, hi_p, S, T
+
+
+def _pad_eval_ts(eval_ts: np.ndarray) -> np.ndarray:
+    T = len(eval_ts)
+    Tp = dispatch.next_pow2(T)
+    if Tp == T:
+        return eval_ts
+    fill = eval_ts[-1] if T else 0
+    return np.concatenate([eval_ts, np.full(Tp - T, fill, np.int64)])
+
+
+def instant_values(values: np.ndarray, lo: np.ndarray, hi: np.ndarray):
+    v, _ = _pad_samples(values)
+    lo_p, hi_p, S, T = _pad_bounds(lo, hi)
+    out = _kernels()["instant_values"](v, lo_p, hi_p)
+    return np.asarray(out)[:S, :T]
+
+
+def sum_avg_std(values: np.ndarray, lo: np.ndarray, hi: np.ndarray):
+    v, _ = _pad_samples(values)
+    lo_p, hi_p, S, T = _pad_bounds(lo, hi)
+    count, s1, s2 = _kernels()["sum_avg_std"](v, lo_p, hi_p)
+    return (np.asarray(count)[:S, :T], np.asarray(s1)[:S, :T],
+            np.asarray(s2)[:S, :T])
+
+
+def extrapolated_rate(
+    values: np.ndarray,
+    adjusted: np.ndarray,
+    times: np.ndarray,
+    lo: np.ndarray,
+    hi: np.ndarray,
+    eval_ts: np.ndarray,
+    range_ns: int,
+    is_counter: bool,
+    is_rate: bool,
+):
+    v, t = _pad_samples(values, times)
+    adj, _ = _pad_samples(adjusted)
+    lo_p, hi_p, S, T = _pad_bounds(lo, hi)
+    out = _kernels()["extrapolated_rate"](
+        v, adj, t, lo_p, hi_p, _pad_eval_ts(eval_ts), np.int64(range_ns),
+        bool(is_counter), bool(is_rate),
+    )
+    return np.asarray(out)[:S, :T]
+
+
+def reset_adjusted(values: np.ndarray, offsets: np.ndarray) -> np.ndarray:
+    """Device counter monotonization over CSR rows."""
+    n = len(values)
+    if n == 0:
+        return values
+    v, _ = _pad_samples(values)
+    N = len(v)
+    is_first = np.zeros(N, bool)
+    is_first[offsets[:-1][offsets[:-1] < n]] = True
+    row_id = np.repeat(np.arange(len(offsets) - 1), np.diff(offsets))  # [n]
+    row_start = np.full(N, n, np.int64)  # pads form their own "row"
+    row_start[:n] = offsets[:-1][row_id]
+    if N > n:
+        is_first[n] = True
+    out = _kernels()["reset_adjusted"](v, is_first, row_start)
+    return np.asarray(out)[:n]

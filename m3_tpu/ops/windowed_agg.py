@@ -8,15 +8,24 @@ quantiles come from a grouped sort — EXACT, unlike CKMS's eps-approximation
 (deviation documented per SURVEY.md §7.5; memory is bounded by samples per
 open window rather than sketch size).
 
-numpy implementation (columnar, no per-sample Python); the group layout is
-chosen so a jnp.segment_* lowering is mechanical.
+Two implementations share one contract: the numpy host path below, and a
+jax lowering (sort + ``jax.ops.segment_*`` reductions) that
+``utils.dispatch`` selects for large flushes on an accelerator — the device
+path the aggregator's production flush actually runs, not a test-only
+kernel. Inputs are padded to a power of two so XLA compiles O(log) shapes.
 """
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from m3_tpu.metrics.aggregation import AggregationType
+from m3_tpu.utils import dispatch
+
+# device sort+segment-reduce pays off later than pure elementwise ops
+DEVICE_THRESHOLD = 32_768
 
 
 def aggregate_groups(
@@ -36,6 +45,11 @@ def aggregate_groups(
         order_seq = np.arange(n)
     if times is None:
         times = np.zeros(n, np.int64)
+    device = n > 0 and dispatch.use_device(n, DEVICE_THRESHOLD)
+    dispatch.record("windowed_agg.aggregate_groups", device)
+    if device:
+        return _aggregate_groups_device(elem_ids, window_ids, values,
+                                        order_seq, times)
     # group identity via lexsort on (elem, window); within a group rows
     # order by (time, append-seq) so LAST = latest timestamp, ties -> the
     # later append (reference gauge lastAt semantics)
@@ -79,6 +93,80 @@ def aggregate_groups(
         "stdev": np.sqrt(var),
     }
     return e[group_start], w[group_start], stats, vq, offsets
+
+
+@functools.lru_cache(maxsize=None)
+def _grouped_stats_jit():
+    """Build the jitted device kernel lazily (jax import deferred)."""
+    import jax
+    import jax.numpy as jnp
+
+    import m3_tpu.ops  # noqa: F401  (x64)
+
+    @jax.jit
+    def kernel(e, w, v, seq, t):
+        # sort rows by (elem, window, time, append-seq): group identity plus
+        # the LAST-wins ordering inside each group
+        order = jnp.lexsort((seq, t, w, e))
+        es, ws, vs = e[order], w[order], v[order]
+        n = e.shape[0]
+        new_group = jnp.concatenate(
+            [jnp.ones(1, bool), (es[1:] != es[:-1]) | (ws[1:] != ws[:-1])]
+        )
+        seg = jnp.cumsum(new_group) - 1  # [N] group index, 0-based
+        ones = jnp.ones(n, jnp.float64)
+        count = jax.ops.segment_sum(ones, seg, num_segments=n)
+        s1 = jax.ops.segment_sum(vs, seg, num_segments=n)
+        s2 = jax.ops.segment_sum(vs * vs, seg, num_segments=n)
+        gmin = jax.ops.segment_min(vs, seg, num_segments=n)
+        gmax = jax.ops.segment_max(vs, seg, num_segments=n)
+        idx_last = jax.ops.segment_max(jnp.arange(n), seg, num_segments=n)
+        last = vs[jnp.clip(idx_last, 0, n - 1)]
+        # grouped sort for quantiles: values ascending WITHIN (elem, window)
+        vq = v[jnp.lexsort((v, w, e))]
+        return es, ws, new_group, count, s1, s2, gmin, gmax, last, vq
+
+    return kernel
+
+
+def _aggregate_groups_device(elem_ids, window_ids, values, order_seq, times):
+    """jax lowering of aggregate_groups; pads N to a power of two with a
+    sentinel group that is trimmed on the way out."""
+    n = len(values)
+    N = dispatch.next_pow2(n)
+    pad = N - n
+    BIG = np.iinfo(np.int64).max
+    e_p = np.concatenate([elem_ids, np.full(pad, BIG, np.int64)])
+    w_p = np.concatenate([window_ids, np.full(pad, BIG, np.int64)])
+    v_p = np.concatenate([values, np.zeros(pad)])
+    s_p = np.concatenate([order_seq.astype(np.int64),
+                          np.arange(pad, dtype=np.int64) + (1 << 60)])
+    t_p = np.concatenate([times, np.full(pad, BIG, np.int64)])
+
+    kernel = _grouped_stats_jit()
+    es, ws, new_group, count, s1, s2, gmin, gmax, last, vq = (
+        np.asarray(x) for x in kernel(e_p, w_p, v_p, s_p, t_p)
+    )
+    group_start = np.nonzero(new_group)[0]
+    n_groups_total = len(group_start)
+    # pads share the (BIG, BIG) key: exactly one trailing sentinel group
+    G = n_groups_total - (1 if pad else 0)
+    sel = slice(0, G)
+    counts = count[sel]
+    mean = s1[sel] / counts
+    var = np.maximum(s2[sel] / counts - mean**2, 0.0)
+    stats = {
+        "count": counts,
+        "sum": s1[sel],
+        "sumsq": s2[sel],
+        "min": gmin[sel],
+        "max": gmax[sel],
+        "mean": mean,
+        "last": last[sel],
+        "stdev": np.sqrt(var),
+    }
+    offsets = np.concatenate([group_start[:G], [n]]).astype(np.int64)
+    return es[group_start[:G]], ws[group_start[:G]], stats, vq[:n], offsets
 
 
 def group_quantiles(vq: np.ndarray, offsets: np.ndarray, q: float) -> np.ndarray:

@@ -92,26 +92,34 @@ class Engine:
 
     # -- public API --
 
+    def _active_limits(self) -> "QueryLimits":
+        """The CURRENT database-wide binding (storage accounting consults
+        db.limits, so activation must target the same object even if
+        another Engine rebound it after this one was constructed)."""
+        return getattr(self.db, "limits", None) or self.limits
+
     def query_range(self, q: str, start_ns: int, end_ns: int, step_ns: int):
         if step_ns <= 0:
             raise EvalError("step must be positive")
         eval_ts = np.arange(start_ns, end_ns + 1, step_ns, dtype=np.int64)
-        self.limits.check_steps(len(eval_ts))
-        self.limits.start_query()
+        limits = self._active_limits()
+        limits.check_steps(len(eval_ts))
+        limits.start_query()
         try:
             expr = promql.parse(q)
             return self._eval(expr, eval_ts), eval_ts
         finally:
-            self.limits.end_query()
+            limits.end_query()
 
     def query_instant(self, q: str, t_ns: int):
         eval_ts = np.array([t_ns], dtype=np.int64)
-        self.limits.start_query()
+        limits = self._active_limits()
+        limits.start_query()
         try:
             expr = promql.parse(q)
             return self._eval(expr, eval_ts), eval_ts
         finally:
-            self.limits.end_query()
+            limits.end_query()
 
     # -- fetch --
 

@@ -9,15 +9,27 @@ Prometheus — the comparator requirement in SURVEY.md §4.6.
 
 Everything here is columnar: one call computes a whole [n_series, n_steps]
 matrix from ragged per-series sample arrays using prefix sums + searchsorted
-window bounds (no per-sample Python loops). These run on numpy for the host
-path; shapes and algorithms are chosen so a jnp swap-in stays mechanical.
+window bounds (no per-sample Python loops). Large fetches dispatch the
+matrix math to the jax kernels in m3_tpu.ops.temporal (ops.dispatch policy,
+M3_TPU_DEVICE_OPS to force); numpy remains the flag-off host fallback.
+min/max over overlapping windows stay host-side (ufunc.reduceat has no
+segment-op equivalent).
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from m3_tpu.utils import dispatch
+
 NS = 1_000_000_000
+
+
+def _use_device(raws: "RaggedSeries", eval_ts: np.ndarray) -> bool:
+    from m3_tpu.ops import temporal
+
+    work = len(raws.values) + raws.n_series * len(eval_ts)
+    return dispatch.use_device(work, temporal.DEVICE_THRESHOLD)
 
 
 class RaggedSeries:
@@ -65,6 +77,12 @@ def instant_values(raws: RaggedSeries, eval_ts: np.ndarray, lookback_ns: int):
     if len(raws.values) == 0:
         return np.full((raws.n_series, len(eval_ts)), np.nan)
     lo, hi = raws.window_bounds(eval_ts, lookback_ns)
+    device = _use_device(raws, eval_ts)
+    dispatch.record("temporal.instant_values", device)
+    if device:
+        from m3_tpu.ops import temporal
+
+        return temporal.instant_values(raws.values, lo, hi)
     has = hi > lo
     idx = np.clip(hi - 1, 0, len(raws.values) - 1)
     return np.where(has, raws.values[idx], np.nan)
@@ -100,6 +118,21 @@ def over_time(fn: str, raws: RaggedSeries, eval_ts: np.ndarray, range_ns: int):
     lo, hi = raws.window_bounds(eval_ts, range_ns)
     count = (hi - lo).astype(np.float64)
     empty = count == 0
+    if fn in ("sum", "avg", "stddev", "stdvar") and _use_device(raws, eval_ts):
+        from m3_tpu.ops import temporal
+
+        dispatch.record("temporal.over_time", True)
+        dcount, s1, s2 = temporal.sum_avg_std(raws.values, lo, hi)
+        if fn == "sum":
+            return np.where(empty, np.nan, s1)
+        if fn == "avg":
+            return np.where(empty, np.nan, s1 / np.where(empty, 1, dcount))
+        mean = s1 / np.where(empty, 1, dcount)
+        var = np.maximum(s2 / np.where(empty, 1, dcount) - mean**2, 0.0)
+        out = var if fn == "stdvar" else np.sqrt(var)
+        return np.where(empty, np.nan, out)
+    if fn in ("sum", "avg", "stddev", "stdvar"):
+        dispatch.record("temporal.over_time", False)
     if fn == "count":
         return np.where(empty, np.nan, count)
     if fn == "present":
@@ -185,6 +218,18 @@ def extrapolated_rate(
     safe_hi = np.clip(hi - 1, 0, max(n - 1, 0))
     if n == 0:
         return np.full(lo.shape, np.nan)
+
+    device = _use_device(raws, eval_ts)
+    dispatch.record("temporal.extrapolated_rate", device)
+    if device:
+        from m3_tpu.ops import temporal
+
+        adj = (temporal.reset_adjusted(raws.values, raws.offsets)
+               if is_counter else raws.values)
+        return temporal.extrapolated_rate(
+            raws.values, adj, raws.times, lo, hi, eval_ts, range_ns,
+            is_counter, is_rate,
+        )
 
     v = _reset_adjusted(raws) if is_counter else raws.values
     first_v = v[safe_lo]

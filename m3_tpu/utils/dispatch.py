@@ -1,0 +1,73 @@
+"""Device-dispatch policy for the hot serving paths.
+
+Each serving-path op (aggregator flush reductions, postings bitmap algebra,
+PromQL temporal math) has a numpy host implementation and a jax device
+kernel. This module decides which runs:
+
+- ``M3_TPU_DEVICE_OPS=1`` forces the device path (tests use this to assert
+  kernel parity), ``=0`` forces host numpy;
+- otherwise the device path runs when an accelerator backend is live and
+  the workload is big enough to amortize dispatch (~O(100us) per call), the
+  same batching rationale as the reference's insert-queue batching
+  (/root/reference/src/dbnode/storage/shard_insert_queue.go).
+
+Counters record which path executed so tests (and /metrics) can verify the
+device path actually serves production queries — the round-1 failure mode
+was device kernels that only tests invoked.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+
+counters: Counter = Counter()
+
+# below this many elements the fixed dispatch cost dominates on any backend
+DEFAULT_DEVICE_THRESHOLD = 16_384
+
+_accel_cache: bool | None = None
+
+
+def _accelerator_present() -> bool:
+    """True when jax has an ALREADY-INITIALIZED accelerator backend.
+
+    Never imports jax and never triggers backend initialization: both can
+    hang indefinitely when the axon TPU tunnel is down, and a query thread
+    must not be the one to pay (or wedge on) PJRT init. The device path
+    therefore activates only after something else — the ingest/encode
+    pipeline, service startup — has successfully initialized the backend."""
+    global _accel_cache
+    if _accel_cache is None:
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return False  # leave cache unset: jax may be imported later
+        try:
+            from jax._src import xla_bridge
+
+            backends = xla_bridge._backends  # populated only after init
+            if not backends:
+                return False  # leave cache unset: init may happen later
+            _accel_cache = jax.default_backend() not in ("cpu",)
+        except Exception:
+            _accel_cache = False
+    return _accel_cache
+
+
+def use_device(n: int, threshold: int = DEFAULT_DEVICE_THRESHOLD) -> bool:
+    force = os.environ.get("M3_TPU_DEVICE_OPS")
+    if force == "1":
+        return True
+    if force == "0":
+        return False
+    return n >= threshold and _accelerator_present()
+
+
+def record(op: str, device: bool) -> None:
+    counters[f"{op}[{'device' if device else 'host'}]"] += 1
+
+
+def next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length() if n > 2 else max(n, 1)
