@@ -13,9 +13,10 @@ from __future__ import annotations
 
 import re
 
+from m3_tpu.index import packed
 from m3_tpu.index.executor import search
 from m3_tpu.index.query import Query
-from m3_tpu.index.segment import MutableSegment, Segment, merge_segments
+from m3_tpu.index.segment import MutableSegment, Segment
 
 
 class IndexBlock:
@@ -42,11 +43,13 @@ class IndexBlock:
 
     def compact(self) -> None:
         """Fold the mutable segment (and fragmented sealed ones) into one
-        immutable segment."""
+        PACKED immutable segment (the mutable->FST compaction,
+        reference storage/index/mutable_segments.go)."""
         segs = self.segments()
         if not segs:
             return
-        self.sealed = [merge_segments(segs)] if len(segs) > 1 else segs
+        if len(segs) > 1 or not isinstance(segs[0], packed.PackedSegment):
+            self.sealed = [packed.merge(segs)]
         self.mutable = MutableSegment()
         self._cache = None
 

@@ -7,6 +7,11 @@ each index block's compacted immutable segment is written to
 adler32 trailer; bootstrap loads persisted segments instead of rebuilding
 the reverse index from fileset tag scans (which remains the fallback for
 blocks without a persisted segment).
+
+Current format: the packed-segment buffer (index/packed.py) written
+verbatim + adler32 trailer, loaded back as ZERO-COPY views over an mmap —
+no dict rebuilding, the fst-segment mmap model (segment/fst/segment.go:130).
+Legacy "M3IXSEG1" files (round-1 dict segments) still load.
 """
 
 from __future__ import annotations
@@ -15,6 +20,7 @@ import os
 import struct
 import zlib
 
+from m3_tpu.index import packed
 from m3_tpu.index.index import IndexBlock, NamespaceIndex
 from m3_tpu.index.segment import Segment
 
@@ -42,7 +48,9 @@ def persist_index(index: NamespaceIndex, root: str, namespace: str) -> int:
         if not blk.sealed:
             continue
         payload = blk.sealed[0].to_bytes()
-        raw = _MAGIC + payload + struct.pack(">I", zlib.adler32(payload))
+        # packed buffers are written verbatim (their own magic leads) so
+        # the loader can mmap them in place; trailer guards torn writes
+        raw = payload + struct.pack(">I", zlib.adler32(payload))
         tmp = _path(root, namespace, bs) + ".tmp"
         with open(tmp, "wb") as f:
             f.write(raw)
@@ -54,6 +62,21 @@ def persist_index(index: NamespaceIndex, root: str, namespace: str) -> int:
         blk.persisted_docs = blk.sealed[0].n_docs
         written += 1
     return written
+
+
+def _load_packed(path: str) -> packed.PackedSegment:
+    """mmap a packed segment file; views are zero-copy over the mapping."""
+    import mmap as _mmap
+
+    with open(path, "rb") as f:
+        mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+    mv = memoryview(mm)
+    try:
+        if zlib.adler32(mv[:-4]) != struct.unpack(">I", mv[-4:])[0]:
+            raise ValueError(f"checksum mismatch in {path}")
+        return packed.PackedSegment(mm)
+    finally:
+        mv.release()
 
 
 def load_index(index: NamespaceIndex, root: str, namespace: str,
@@ -76,14 +99,20 @@ def load_index(index: NamespaceIndex, root: str, namespace: str,
         if cutoff_ns is not None and bs + index.block_size_ns <= cutoff_ns:
             continue  # expired: leave for expire_index_files to reclaim
         try:
-            with open(os.path.join(d, name), "rb") as f:
-                raw = f.read()
-            if not raw.startswith(_MAGIC):
-                continue
-            payload, trailer = raw[len(_MAGIC) : -4], raw[-4:]
-            if zlib.adler32(payload) != struct.unpack(">I", trailer)[0]:
-                continue
-            seg = Segment.from_bytes(payload)
+            path = os.path.join(d, name)
+            with open(path, "rb") as f:
+                head = f.read(8)
+            if head == packed.MAGIC:
+                seg = _load_packed(path)
+            else:
+                with open(path, "rb") as f:
+                    raw = f.read()
+                if not raw.startswith(_MAGIC):
+                    continue
+                payload, trailer = raw[len(_MAGIC) : -4], raw[-4:]
+                if zlib.adler32(payload) != struct.unpack(">I", trailer)[0]:
+                    continue
+                seg = Segment.from_bytes(payload)  # legacy round-1 format
         except Exception:
             continue
         blk = index._blocks.get(bs)

@@ -1,0 +1,241 @@
+"""Packed immutable segment tier: parity with the dict segment, regex vocab
+scan + prefix narrowing, postings cache, and zero-copy mmap persistence."""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+import pytest
+
+from m3_tpu.index import packed
+from m3_tpu.index import postings as P
+from m3_tpu.index.executor import search, search_segment
+from m3_tpu.index.query import (
+    ConjunctionQuery,
+    DisjunctionQuery,
+    FieldQuery,
+    NegationQuery,
+    RegexpQuery,
+    TermQuery,
+)
+from m3_tpu.index.segment import MutableSegment
+
+
+def build_mutable(n=500):
+    m = MutableSegment()
+    for i in range(n):
+        fields = [
+            (b"__name__", b"reqs" if i % 2 else b"errs"),
+            (b"host", f"web-{i % 40:03d}".encode()),
+            (b"pod", f"pod-{i:05d}".encode()),
+        ]
+        m.insert(f"series-{i}".encode(), fields)
+    return m
+
+
+@pytest.fixture
+def pair():
+    m = build_mutable()
+    dict_seg = m.seal()
+    packed_seg = packed.build(dict_seg.docs)
+    return dict_seg, packed_seg
+
+
+class TestPackedParity:
+    def test_basic_shape(self, pair):
+        d, p = pair
+        assert p.n_docs == d.n_docs
+        assert p.field_names() == d.field_names()
+        for f in d.field_names():
+            assert p.terms(f) == d.terms(f)
+
+    def test_term_postings(self, pair):
+        d, p = pair
+        for f in d.field_names():
+            for t in d.terms(f):
+                np.testing.assert_array_equal(
+                    p.postings_term(f, t), d.postings_term(f, t)
+                )
+        assert len(p.postings_term(b"host", b"nope")) == 0
+        assert len(p.postings_term(b"ghost", b"x")) == 0
+
+    def test_field_and_all(self, pair):
+        d, p = pair
+        np.testing.assert_array_equal(p.postings_field(b"host"),
+                                      d.postings_field(b"host"))
+        np.testing.assert_array_equal(p.postings_all(), d.postings_all())
+
+    def test_regexp_parity(self, pair):
+        d, p = pair
+        for pat in (rb"web-0\d\d", rb"pod-000\d\d", rb".*-001", rb"errs|reqs",
+                    rb"web-(01|02)\d"):
+            rx = re.compile(pat)
+            field = b"pod" if pat.startswith(b"pod") else (
+                b"__name__" if b"errs" in pat else b"host")
+            np.testing.assert_array_equal(
+                p.postings_regexp(field, rx), d.postings_regexp(field, rx),
+                err_msg=pat.decode(),
+            )
+
+    def test_docs_roundtrip(self, pair):
+        d, p = pair
+        for i in (0, 7, 499):
+            assert p.docs[i].series_id == d.docs[i].series_id
+            assert p.docs[i].fields == d.docs[i].fields
+
+    def test_executor_over_packed(self, pair):
+        d, p = pair
+        q = ConjunctionQuery([
+            TermQuery(b"__name__", b"reqs"),
+            RegexpQuery(b"host", "web-00\\d"),
+            NegationQuery(TermQuery(b"host", b"web-003")),
+        ])
+        np.testing.assert_array_equal(search_segment(p, q), search_segment(d, q))
+        q2 = DisjunctionQuery([TermQuery(b"host", b"web-001"),
+                               FieldQuery(b"ghost")])
+        np.testing.assert_array_equal(search_segment(p, q2), search_segment(d, q2))
+        docs = search([p], q, limit=5)
+        assert len(docs) == 5
+
+    def test_regex_cache_hit(self, pair):
+        _, p = pair
+        rx = re.compile(rb"web-0\d\d")
+        a = p.postings_regexp(b"host", rx)
+        assert (b"host", rb"web-0\d\d") in p._regex_cache
+        b = p.postings_regexp(b"host", rx)
+        assert a is b  # served from cache
+
+    def test_newline_terms_fallback(self):
+        m = MutableSegment()
+        m.insert(b"s1", [(b"k", b"line1\nline2")])
+        m.insert(b"s2", [(b"k", b"plain")])
+        p = packed.build(m.seal().docs)
+        assert not p._vocab_clean
+        assert p.postings_term(b"k", b"line1\nline2").tolist() == [0]
+        rx = re.compile(rb"line1\nline2")
+        assert p.postings_regexp(b"k", rx).tolist() == [0]
+        assert p.postings_regexp(b"k", re.compile(rb"pla.n")).tolist() == [1]
+
+    def test_empty_matching_pattern(self, pair):
+        """Patterns that can match the empty string (.*, (x)?, a|) must not
+        crash on the zero-width match at blob end."""
+        d, p = pair
+        for pat in (rb".*", rb"(web-001)?", rb"web-001|"):
+            rx = re.compile(pat)
+            np.testing.assert_array_equal(
+                p.postings_regexp(b"host", rx), d.postings_regexp(b"host", rx),
+                err_msg=pat.decode(),
+            )
+
+    def test_newline_matching_class_falls_back(self):
+        """A pattern whose classes can match \\n (e.g. [^c]*) may greedily
+        span vocab lines; the scan must fall back to per-term matching
+        rather than silently dropping the swallowed terms."""
+        m = MutableSegment()
+        for i, v in enumerate((b"ab", b"adb", b"axb", b"acb")):
+            m.insert(b"s%d" % i, [(b"f", v)])
+        d = m.seal()
+        p = packed.build(d.docs)
+        for pat in (rb"a[^c]*b", rb"a\Db", rb"a[\s\S]*b"):
+            rx = re.compile(pat)
+            np.testing.assert_array_equal(
+                p.postings_regexp(b"f", rx), d.postings_regexp(b"f", rx),
+                err_msg=pat.decode(),
+            )
+
+    def test_to_bytes_roundtrip_stable(self, tmp_path):
+        """A disk-loaded segment re-serializes to the original payload (the
+        checksum trailer must not accrete into the buffer)."""
+        from m3_tpu.index.index import NamespaceIndex
+        from m3_tpu.index.persist import load_index, persist_index
+
+        BS = 3600 * 10**9
+        idx = NamespaceIndex(BS)
+        idx.insert(b"s", [(b"a", b"b")], 0)
+        persist_index(idx, str(tmp_path), "ns")
+        original = idx._blocks[0].sealed[0].to_bytes()
+        idx2 = NamespaceIndex(BS)
+        load_index(idx2, str(tmp_path), "ns")
+        assert idx2._blocks[0].sealed[0].to_bytes() == original
+
+    def test_prefix_narrowing_correct(self, pair):
+        d, p = pair
+        # anchored-prefix pattern must narrow but still match correctly
+        rx = re.compile(rb"pod-0000[0-5]")
+        np.testing.assert_array_equal(
+            p.postings_regexp(b"pod", rx), d.postings_regexp(b"pod", rx))
+        # pattern with no literal prefix scans everything
+        rx2 = re.compile(rb".*-00042")
+        np.testing.assert_array_equal(
+            p.postings_regexp(b"pod", rx2), d.postings_regexp(b"pod", rx2))
+
+    def test_merge_dedupes(self, pair):
+        d, p = pair
+        m2 = MutableSegment()
+        m2.insert(b"series-1", [(b"host", b"web-001")])  # dup series
+        m2.insert(b"extra", [(b"host", b"web-xyz")])
+        merged = packed.merge([p, packed.build(m2.seal().docs)])
+        assert merged.n_docs == p.n_docs + 1
+        assert merged.postings_term(b"host", b"web-xyz").tolist() == [p.n_docs]
+
+
+class TestPackedPersistence:
+    def test_mmap_roundtrip(self, tmp_path):
+        from m3_tpu.index.index import NamespaceIndex
+        from m3_tpu.index.persist import load_index, persist_index
+
+        BS = 3600 * 10**9
+        idx = NamespaceIndex(BS)
+        for i in range(200):
+            idx.insert(f"s{i}".encode(),
+                       [(b"host", f"h{i % 9}".encode())], i * 10**6)
+        assert persist_index(idx, str(tmp_path), "ns") == 1
+
+        idx2 = NamespaceIndex(BS)
+        restored = load_index(idx2, str(tmp_path), "ns")
+        assert restored == {0}
+        seg = idx2._blocks[0].sealed[0]
+        assert isinstance(seg, packed.PackedSegment)  # mmap'd, not rebuilt
+        docs = idx2.query(
+            packed_query := ConjunctionQuery([TermQuery(b"host", b"h3")]),
+            0, BS,
+        )
+        assert sorted(d.series_id for d in docs) == sorted(
+            f"s{i}".encode() for i in range(200) if i % 9 == 3)
+        del packed_query
+
+    def test_corrupt_file_skipped(self, tmp_path):
+        from m3_tpu.index.index import NamespaceIndex
+        from m3_tpu.index.persist import load_index, persist_index
+
+        BS = 3600 * 10**9
+        idx = NamespaceIndex(BS)
+        idx.insert(b"s", [(b"a", b"b")], 0)
+        persist_index(idx, str(tmp_path), "ns")
+        f = tmp_path / "ns" / "_index" / "segment-0.db"
+        raw = bytearray(f.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        f.write_bytes(bytes(raw))
+        idx2 = NamespaceIndex(BS)
+        assert load_index(idx2, str(tmp_path), "ns") == set()
+
+    def test_legacy_format_still_loads(self, tmp_path):
+        import struct
+        import zlib
+
+        from m3_tpu.index.index import NamespaceIndex
+        from m3_tpu.index.persist import _MAGIC, load_index
+
+        BS = 3600 * 10**9
+        m = MutableSegment()
+        m.insert(b"old-series", [(b"k", b"v")])
+        payload = m.seal().to_bytes()
+        d = tmp_path / "ns" / "_index"
+        d.mkdir(parents=True)
+        (d / "segment-0.db").write_bytes(
+            _MAGIC + payload + struct.pack(">I", zlib.adler32(payload)))
+        idx = NamespaceIndex(BS)
+        assert load_index(idx, str(tmp_path), "ns") == {0}
+        docs = idx.query(ConjunctionQuery([TermQuery(b"k", b"v")]), 0, BS)
+        assert [doc.series_id for doc in docs] == [b"old-series"]
