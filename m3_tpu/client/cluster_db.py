@@ -1,0 +1,129 @@
+"""ClusterDatabase: the coordinator's database facade over a quorum Session.
+
+The reference coordinator reads/writes through a topology-aware client
+session instead of local storage (/root/reference/src/query/server/query.go
+:201 wiring m3.NewStorage over client sessions; storage fanout
+query/storage/m3/storage.go:183-757). This facade exposes the same surface
+the single-node Database gives the PromQL Engine, Graphite engine, and
+CoordinatorAPI — namespaces[...].query_ids/read, write_tagged, query — so
+the whole query layer runs unchanged against a 3-node quorum deployment.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from m3_tpu.storage.database import Datapoint
+
+
+class ClusterNamespace:
+    """Namespace view: index scatter/gather + replica-merged reads."""
+
+    def __init__(self, cdb: "ClusterDatabase", name: str):
+        self._cdb = cdb
+        self.name = name
+
+    @property
+    def limits(self):
+        return self._cdb.limits
+
+    def query_ids(self, query, start_ns: int, end_ns: int, limit=None):
+        docs = self._cdb.session.query_ids(
+            self.name, query, start_ns, end_ns, limit)
+        if self.limits is not None:
+            self.limits.add_series(len(docs))
+        return docs
+
+    def read(self, series_id: bytes, start_ns: int, end_ns: int):
+        dps = self._cdb.session.fetch(self.name, series_id, start_ns, end_ns)
+        times = np.array([t for t, _ in dps], np.int64)
+        vbits = np.array([v for _, v in dps], np.float64).view(np.uint64)
+        if self.limits is not None:
+            self.limits.add_datapoints(len(times))
+        return times, vbits
+
+    # label APIs used by /labels and /label/<name>/values
+    class _IndexFacade:
+        def __init__(self, ns: "ClusterNamespace"):
+            self._ns = ns
+
+        def aggregate_field_names(self, start_ns, end_ns):
+            return self._ns._cdb.session.label_names(
+                self._ns.name, start_ns, end_ns)
+
+        def aggregate_field_values(self, field, start_ns, end_ns):
+            return self._ns._cdb.session.label_values(
+                self._ns.name, field, start_ns, end_ns)
+
+    @property
+    def index(self):
+        return ClusterNamespace._IndexFacade(self)
+
+
+class _Namespaces(dict):
+    """Lazily materializes a ClusterNamespace per name."""
+
+    def __init__(self, cdb: "ClusterDatabase"):
+        super().__init__()
+        self._cdb = cdb
+
+    def __missing__(self, name: str) -> ClusterNamespace:
+        ns = ClusterNamespace(self._cdb, name)
+        self[name] = ns
+        return ns
+
+
+class ClusterDatabase:
+    def __init__(self, session):
+        self.session = session
+        self.namespaces = _Namespaces(self)
+        self.limits = None
+        self._open = True
+
+    def create_namespace(self, name: str, opts=None) -> ClusterNamespace:
+        """Namespaces are owned by the storage nodes; the facade just
+        materializes a view (the downsampler calls this per policy)."""
+        return self.namespaces[name]
+
+    # -- write path (quorum fan-out) --
+
+    def write_tagged(self, namespace: str, metric_name: bytes, tags,
+                     t_ns: int, value: float):
+        return self.session.write_tagged(
+            namespace, metric_name, tags, t_ns, value)
+
+    # -- read paths --
+
+    def query(self, namespace: str, matchers, start_ns: int, end_ns: int,
+              limit=None):
+        """Remote-read shape: [(series_id, fields, [Datapoint])]."""
+        from m3_tpu.index.query import matchers_to_query
+
+        ns = self.namespaces[namespace]
+        docs = ns.query_ids(matchers_to_query(list(matchers)),
+                            start_ns, end_ns, limit)
+        out = []
+        for doc in docs:
+            times, vbits = ns.read(doc.series_id, start_ns, end_ns)
+            dps = [Datapoint(int(t), float(v))
+                   for t, v in zip(times, vbits.view(np.float64))]
+            out.append((doc.series_id, doc.fields, dps))
+        return out
+
+    def read(self, namespace: str, series_id: bytes, start_ns: int,
+             end_ns: int):
+        ns = self.namespaces[namespace]
+        times, vbits = ns.read(series_id, start_ns, end_ns)
+        return [Datapoint(int(t), float(v))
+                for t, v in zip(times, vbits.view(np.float64))]
+
+    # -- lifecycle noops (the nodes own storage maintenance) --
+
+    def tick(self, now_ns=None) -> dict:
+        return {"flushed": 0, "expired": 0}
+
+    def close(self) -> None:
+        for conn in self.session.connections.values():
+            close = getattr(conn, "close", None)
+            if close:
+                close()

@@ -1,0 +1,150 @@
+"""HTTP connection to a storage node's NodeAPI.
+
+The host-queue/transport layer of the reference client
+(/root/reference/src/dbnode/client/host_queue.go — TChannel connections per
+host) becomes one persistent HTTP connection per (host, thread), reconnected
+on failure. Implements the Session's NodeConnection protocol plus the index
+query surface.
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import threading
+from urllib.parse import urlencode, urlparse
+
+from m3_tpu.storage.database import Datapoint
+
+
+class NodeUnavailableError(ConnectionError):
+    pass
+
+
+class HTTPNodeConnection:
+    def __init__(self, endpoint: str, timeout_s: float = 10.0):
+        u = urlparse(endpoint if "//" in endpoint else f"http://{endpoint}")
+        self.host = u.hostname
+        self.port = u.port or 9000
+        self.timeout_s = timeout_s
+        self._tl = threading.local()
+        # every thread's socket, so close() can tear all of them down
+        self._all_lock = threading.Lock()
+        self._all: set[http.client.HTTPConnection] = set()
+
+    def _conn(self) -> http.client.HTTPConnection:
+        c = getattr(self._tl, "conn", None)
+        if c is None:
+            c = http.client.HTTPConnection(self.host, self.port,
+                                           timeout=self.timeout_s)
+            self._tl.conn = c
+            with self._all_lock:
+                self._all.add(c)
+        return c
+
+    def _request(self, method: str, path: str, body: bytes | None = None):
+        last_err: Exception | None = None
+        for attempt in range(2):  # one transparent reconnect for stale conns
+            c = self._conn()
+            try:
+                c.request(method, path, body=body,
+                          headers={"Content-Type": "application/json"})
+                r = c.getresponse()
+                payload = r.read()
+                if r.status >= 400:
+                    raise NodeUnavailableError(
+                        f"{self.host}:{self.port}{path} -> {r.status} "
+                        f"{payload[:200]!r}"
+                    )
+                return json.loads(payload) if payload else None
+            except NodeUnavailableError:
+                raise
+            except Exception as e:  # noqa: BLE001 - socket-level failure
+                last_err = e
+                self._tl.conn = None
+                with self._all_lock:
+                    self._all.discard(c)
+                try:
+                    c.close()
+                except Exception:
+                    pass
+        raise NodeUnavailableError(f"{self.host}:{self.port}: {last_err}")
+
+    # -- NodeConnection protocol --
+
+    def write_tagged(self, namespace: str, metric_name: bytes, tags,
+                     t_ns: int, value: float) -> None:
+        self._request("POST", "/write", json.dumps({
+            "namespace": namespace,
+            "metric": metric_name.decode(),
+            "tags": {k.decode(): v.decode() for k, v in tags},
+            "timestamp_ns": int(t_ns),
+            "value": float(value),
+        }).encode())
+
+    def read(self, namespace: str, series_id: bytes, start_ns: int,
+             end_ns: int) -> list[Datapoint]:
+        qs = urlencode({
+            "namespace": namespace,
+            "series_id": base64.b64encode(series_id).decode(),
+            "start_ns": int(start_ns),
+            "end_ns": int(end_ns),
+        })
+        rows = self._request("GET", f"/read?{qs}") or []
+        return [Datapoint(int(t), float(v)) for t, v in rows]
+
+    # -- index query surface --
+
+    def query_ids(self, namespace: str, query_doc: dict, start_ns: int,
+                  end_ns: int, limit: int | None = None):
+        """[(series_id, fields)] from the node's reverse index."""
+        out = self._request("POST", "/query_ids", json.dumps({
+            "namespace": namespace,
+            "query": query_doc,
+            "start_ns": int(start_ns),
+            "end_ns": int(end_ns),
+            "limit": limit,
+        }).encode()) or []
+        return [
+            (
+                base64.b64decode(d["series_id"]),
+                [(base64.b64decode(k), base64.b64decode(v))
+                 for k, v in d["fields"]],
+            )
+            for d in out
+        ]
+
+    def label_names(self, namespace: str, start_ns: int, end_ns: int):
+        qs = urlencode({"namespace": namespace, "start_ns": int(start_ns),
+                        "end_ns": int(end_ns)})
+        return [base64.b64decode(n)
+                for n in self._request("GET", f"/label_names?{qs}") or []]
+
+    def label_values(self, namespace: str, field: bytes, start_ns: int,
+                     end_ns: int):
+        qs = urlencode({
+            "namespace": namespace,
+            "field": base64.b64encode(field).decode(),
+            "start_ns": int(start_ns), "end_ns": int(end_ns),
+        })
+        return [base64.b64decode(v)
+                for v in self._request("GET", f"/label_values?{qs}") or []]
+
+    def health(self) -> bool:
+        try:
+            return bool(self._request("GET", "/health"))
+        except Exception:
+            return False
+
+    def close(self) -> None:
+        """Close EVERY thread's socket to this node (called when topology
+        removes the instance), not just the calling thread's."""
+        with self._all_lock:
+            conns, self._all = self._all, set()
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        self._tl.conn = None

@@ -136,3 +136,87 @@ class Session:
         times, vbits = merge_dedup(np.concatenate(parts_t), np.concatenate(parts_v))
         values = vbits.view(np.float64)
         return list(zip(times.tolist(), values.tolist()))
+
+    # -- index scatter/gather (the FetchTagged fan-out, session.go:1585) --
+
+    def _readable_shards_of(self, host: str) -> set[int]:
+        from m3_tpu.cluster.placement import ShardState
+
+        inst = self.topology.placement.instances.get(host)
+        if inst is None:
+            return set()
+        return {
+            s.id for s in inst.shards.values()
+            if s.state in (ShardState.AVAILABLE, ShardState.LEAVING)
+        }
+
+    def query_ids(self, namespace: str, query, start_ns: int, end_ns: int,
+                  limit: int | None = None):
+        """Matched docs across the cluster, deduped by series id. Succeeds
+        when the successful hosts together cover every shard (each shard
+        answered by >= one readable replica)."""
+        from m3_tpu.index.query import query_to_json
+        from m3_tpu.index.segment import Document
+
+        doc = query_to_json(query)
+        covered: set[int] = set()
+        merged: dict[bytes, list] = {}
+        errors = []
+        for host, conn in self.connections.items():
+            shards = self._readable_shards_of(host)
+            if not shards:
+                continue
+            if shards and shards <= covered:
+                continue  # replicas of covered shards hold the same index
+            try:
+                rows = conn.query_ids(namespace, doc, start_ns, end_ns, limit)
+            except Exception as e:  # noqa: BLE001 - per-host failure
+                errors.append((host, e))
+                continue
+            covered |= shards
+            for sid, fields in rows:
+                merged.setdefault(sid, fields)
+        missing = set(range(self.topology.n_shards)) - covered
+        if missing:
+            raise ConsistencyError(
+                f"index query missing shards {sorted(missing)[:8]}... "
+                f"(errors={errors})"
+            )
+        docs = [Document(0, sid, fields) for sid, fields in merged.items()]
+        docs.sort(key=lambda d: d.series_id)
+        if limit is not None:
+            docs = docs[:limit]
+        return docs
+
+    def _union_from_any(self, fn_name: str, *args) -> list[bytes]:
+        """Union across hosts with the same shard-coverage requirement as
+        query_ids — a partial union would silently hide series."""
+        out: set[bytes] = set()
+        errors = []
+        covered: set[int] = set()
+        for host, conn in self.connections.items():
+            shards = self._readable_shards_of(host)
+            if not shards:
+                continue
+            if shards <= covered:
+                continue
+            try:
+                out.update(getattr(conn, fn_name)(*args))
+                covered |= shards
+            except Exception as e:  # noqa: BLE001
+                errors.append((host, e))
+        missing = set(range(self.topology.n_shards)) - covered
+        if missing:
+            raise ConsistencyError(
+                f"{fn_name} missing shards {sorted(missing)[:8]} "
+                f"(errors={errors})"
+            )
+        return sorted(out)
+
+    def label_names(self, namespace: str, start_ns: int, end_ns: int):
+        return self._union_from_any("label_names", namespace, start_ns, end_ns)
+
+    def label_values(self, namespace: str, field: bytes, start_ns: int,
+                     end_ns: int):
+        return self._union_from_any(
+            "label_values", namespace, field, start_ns, end_ns)

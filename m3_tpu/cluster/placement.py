@@ -272,6 +272,48 @@ def mark_available(p: Placement, instance_id: str, shard_ids: list[int] | None =
     return out
 
 
+# -- KV-backed placement store (the placement service storage role,
+#    reference cluster/placement/service + kvstore) --
+
+PLACEMENT_KEY = "placements/m3db"
+
+
+def load_placement(kv, key: str = PLACEMENT_KEY) -> tuple["Placement", int] | None:
+    """(placement, kv_version) or None when no placement exists."""
+    from m3_tpu.cluster.kv import KeyNotFound
+
+    try:
+        vv = kv.get(key)
+    except KeyNotFound:
+        return None
+    return Placement.from_json(vv.data), vv.version
+
+
+def store_placement(kv, p: "Placement", key: str = PLACEMENT_KEY) -> int:
+    return kv.set(key, p.to_json())
+
+
+def cas_update_placement(kv, update_fn, key: str = PLACEMENT_KEY,
+                         max_retries: int = 10) -> "Placement":
+    """Read-modify-write with compare-and-set; update_fn(Placement) ->
+    Placement. Retries on concurrent writers (the changeset/CAS discipline
+    of the reference's etcd-backed placement updates)."""
+    from m3_tpu.cluster.kv import VersionMismatch
+
+    for _ in range(max_retries):
+        loaded = load_placement(kv, key)
+        if loaded is None:
+            raise KeyError(f"no placement at {key!r}")
+        p, version = loaded
+        new_p = update_fn(p)
+        try:
+            kv.check_and_set(key, version, new_p.to_json())
+            return new_p
+        except VersionMismatch:
+            continue
+    raise RuntimeError(f"placement CAS contention on {key!r}")
+
+
 def mirrored_placement(pairs: list[tuple[Instance, Instance]], n_shards: int) -> Placement:
     """Mirrored placement (aggregator leader/follower pairs): both members
     of a pair carry identical shard sets and share a shard_set_id

@@ -91,3 +91,55 @@ def matchers_to_query(matchers: list[Matcher]) -> Query:
     if len(qs) == 1:
         return qs[0]
     return ConjunctionQuery(qs)
+
+
+# -- wire codec (the search/query proto codecs role, search/query/*.go) --
+
+
+def query_to_json(q: Query) -> dict:
+    """JSON-able encoding for shipping a query AST to a storage node."""
+    import base64
+
+    def b64(b: bytes) -> str:
+        return base64.b64encode(b).decode()
+
+    if isinstance(q, AllQuery):
+        return {"t": "all"}
+    if isinstance(q, TermQuery):
+        return {"t": "term", "f": b64(q.field_name), "v": b64(q.value)}
+    if isinstance(q, RegexpQuery):
+        pat = q.pattern.encode() if isinstance(q.pattern, str) else q.pattern
+        return {"t": "regexp", "f": b64(q.field_name), "p": b64(pat)}
+    if isinstance(q, FieldQuery):
+        return {"t": "field", "f": b64(q.field_name)}
+    if isinstance(q, NegationQuery):
+        return {"t": "not", "q": query_to_json(q.inner)}
+    if isinstance(q, ConjunctionQuery):
+        return {"t": "and", "qs": [query_to_json(x) for x in q.queries]}
+    if isinstance(q, DisjunctionQuery):
+        return {"t": "or", "qs": [query_to_json(x) for x in q.queries]}
+    raise TypeError(f"unknown query type {type(q)}")
+
+
+def query_from_json(doc: dict) -> Query:
+    import base64
+
+    def b(s: str) -> bytes:
+        return base64.b64decode(s)
+
+    t = doc["t"]
+    if t == "all":
+        return AllQuery()
+    if t == "term":
+        return TermQuery(b(doc["f"]), b(doc["v"]))
+    if t == "regexp":
+        return RegexpQuery(b(doc["f"]), b(doc["p"]).decode())
+    if t == "field":
+        return FieldQuery(b(doc["f"]))
+    if t == "not":
+        return NegationQuery(query_from_json(doc["q"]))
+    if t == "and":
+        return ConjunctionQuery(tuple(query_from_json(x) for x in doc["qs"]))
+    if t == "or":
+        return DisjunctionQuery(tuple(query_from_json(x) for x in doc["qs"]))
+    raise ValueError(f"unknown query kind {t}")

@@ -174,11 +174,15 @@ class CoordinatorAPI:
             stacks[str(tid)] = traceback.format_stack(frame)
         ns_stats = {}
         for name, ns in list(self.db.namespaces.items()):
+            shards = getattr(ns, "shards", None)
+            if shards is None:  # cluster facade: nodes own the storage
+                ns_stats[name] = {"remote": True}
+                continue
             ns_stats[name] = {
-                "shards": len(ns.shards),
-                "series": sum(s.buffer.n_series for s in ns.shards.values()),
+                "shards": len(shards),
+                "series": sum(s.buffer.n_series for s in shards.values()),
                 "flushed_blocks": sum(
-                    len(s._filesets) for s in ns.shards.values()
+                    len(s._filesets) for s in shards.values()
                 ),
             }
         return 200, "application/json", json.dumps(

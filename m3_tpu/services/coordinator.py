@@ -81,18 +81,31 @@ def namespace_options(doc: dict | None) -> NamespaceOptions:
 
 
 class CoordinatorService:
-    def __init__(self, config: dict):
+    def __init__(self, config: dict, kv=None):
         self.config = config
         self.log = Logger("coordinator")
         db_cfg = config.get("db", {}) or {}
-        self.db = Database(
-            db_cfg.get("path", "./m3data"),
-            DatabaseOptions(n_shards=db_cfg.get("n_shards", 8)),
-        )
-        self.db.create_namespace(
-            db_cfg.get("namespace", "default"),
-            namespace_options(db_cfg.get("options")),
-        )
+        cl_cfg = config.get("cluster", {}) or {}
+        self.kv = kv
+        self._placement_version = -1
+        if cl_cfg.get("enabled") or (kv is not None):
+            # cluster mode: all reads/writes go through the quorum session
+            # to the placement's storage nodes (reference query/server
+            # wiring m3.NewStorage over client sessions)
+            if self.kv is None:
+                from m3_tpu.cluster.kv import FileKVStore
+
+                self.kv = FileKVStore(cl_cfg["kv_path"])
+            self.db = self._build_cluster_db(cl_cfg)
+        else:
+            self.db = Database(
+                db_cfg.get("path", "./m3data"),
+                DatabaseOptions(n_shards=db_cfg.get("n_shards", 8)),
+            )
+            self.db.create_namespace(
+                db_cfg.get("namespace", "default"),
+                namespace_options(db_cfg.get("options")),
+            )
         ruleset = ruleset_from_config(config.get("rules"))
         self.downsampler = (
             Downsampler(self.db, ruleset)
@@ -115,6 +128,60 @@ class CoordinatorService:
         self.api.writer = self.writer  # ingest fans out through downsampler
         self.carbon: CarbonIngester | None = None
         self._stop = threading.Event()
+
+    def _build_cluster_db(self, cl_cfg: dict):
+        from m3_tpu.client.cluster_db import ClusterDatabase
+        from m3_tpu.client.http_conn import HTTPNodeConnection
+        from m3_tpu.client.session import Session
+        from m3_tpu.cluster import placement as pl
+        from m3_tpu.cluster.topology import ConsistencyLevel, TopologyMap
+
+        key = cl_cfg.get("placement_key") or pl.PLACEMENT_KEY
+        loaded = pl.load_placement(self.kv, key)
+        if loaded is None:
+            raise RuntimeError(f"cluster mode but no placement at {key!r}")
+        # change detection keys on the KV version: placement edits that do
+        # not bump the embedded document version must still be observed
+        p, self._placement_version = loaded
+        self._placement_key = key
+        connections = {
+            iid: HTTPNodeConnection(inst.endpoint)
+            for iid, inst in p.instances.items() if inst.endpoint
+        }
+        session = Session(
+            TopologyMap(p), connections,
+            write_consistency=ConsistencyLevel(
+                cl_cfg.get("write_consistency", "majority")),
+            read_consistency=ConsistencyLevel(
+                cl_cfg.get("read_consistency", "one")),
+        )
+        return ClusterDatabase(session)
+
+    def _refresh_topology(self) -> None:
+        """Pick up placement changes (node add/remove) between ticks."""
+        from m3_tpu.client.http_conn import HTTPNodeConnection
+        from m3_tpu.cluster import placement as pl
+        from m3_tpu.cluster.topology import TopologyMap
+
+        loaded = pl.load_placement(self.kv, self._placement_key)
+        if loaded is None:
+            return
+        p, kv_version = loaded
+        if kv_version == self._placement_version:
+            return
+        session = self.db.session
+        for iid, inst in p.instances.items():
+            if iid not in session.connections and inst.endpoint:
+                session.connections[iid] = HTTPNodeConnection(inst.endpoint)
+        for iid in list(session.connections):
+            if iid not in p.instances:
+                conn = session.connections.pop(iid)
+                close = getattr(conn, "close", None)
+                if close:
+                    close()
+        session.topology = TopologyMap(p)
+        self._placement_version = kv_version
+        self.log.info("topology refreshed", version=kv_version)
 
     def run(self) -> None:
         if not self.db._open:
@@ -143,12 +210,18 @@ class CoordinatorService:
                 self._stop.wait(tick_every)
                 if self._stop.is_set():
                     break
-                with scope.timer("tick"):
-                    if self.downsampler is not None:
-                        flushed = self.downsampler.flush()
-                        scope.counter("downsample_flushed", flushed)
-                    stats = self.db.tick()
-                    scope.counter("blocks_flushed", stats["flushed"])
+                try:
+                    with scope.timer("tick"):
+                        if self.kv is not None:
+                            self._refresh_topology()
+                        if self.downsampler is not None:
+                            flushed = self.downsampler.flush()
+                            scope.counter("downsample_flushed", flushed)
+                        stats = self.db.tick()
+                        scope.counter("blocks_flushed", stats["flushed"])
+                except Exception as e:  # noqa: BLE001 - a transient KV/IO
+                    # error must not kill the long-running coordinator
+                    self.log.info("tick error; continuing", error=str(e))
         finally:
             self.shutdown()
 

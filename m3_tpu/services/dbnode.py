@@ -56,6 +56,49 @@ class NodeAPI:
                 return 200, json.dumps(
                     [[d.timestamp_ns, d.value] for d in dps]
                 ).encode()
+            if path == "/query_ids" and method == "POST":
+                # index query (the fetchTagged/query RPC role,
+                # reference rpc.thrift:51 service Node query/fetchTagged)
+                from m3_tpu.index.query import query_from_json
+
+                doc = json.loads(body)
+                ns = self.db.namespaces[doc.get("namespace", "default")]
+                docs = ns.query_ids(
+                    query_from_json(doc["query"]),
+                    int(doc["start_ns"]), int(doc["end_ns"]),
+                    doc.get("limit"),
+                )
+                out = [
+                    {
+                        "series_id": base64.b64encode(d.series_id).decode(),
+                        "fields": [
+                            [base64.b64encode(k).decode(),
+                             base64.b64encode(v).decode()]
+                            for k, v in d.fields
+                        ],
+                    }
+                    for d in docs
+                ]
+                return 200, json.dumps(out).encode()
+            if path == "/label_names":
+                ns = self.db.namespaces[q["namespace"][0]]
+                names = ns.index.aggregate_field_names(
+                    int(q["start_ns"][0]), int(q["end_ns"][0]))
+                return 200, json.dumps(
+                    [base64.b64encode(n).decode() for n in names]).encode()
+            if path == "/label_values":
+                ns = self.db.namespaces[q["namespace"][0]]
+                vals = ns.index.aggregate_field_values(
+                    base64.b64decode(q["field"][0]),
+                    int(q["start_ns"][0]), int(q["end_ns"][0]))
+                return 200, json.dumps(
+                    [base64.b64encode(v).decode() for v in vals]).encode()
+            if path == "/blocks/starts":
+                # flushed block starts per shard (peer bootstrap discovery)
+                ns = self.db.namespaces[q["namespace"][0]]
+                shard = ns.shards.get(int(q["shard"][0]))
+                starts = sorted(shard._filesets) if shard else []
+                return 200, json.dumps(starts).encode()
             if path == "/blocks/metadata":
                 # repair/bootstrap support: per-series stream checksums
                 import zlib
@@ -126,22 +169,155 @@ class NodeAPI:
 
 
 class DBNodeService:
-    def __init__(self, config: dict):
+    """Storage node: optionally placement-driven.
+
+    With a `cluster:` config section the node reads its shard assignment
+    from the KV placement, peer-bootstraps INITIALIZING shards from the
+    replicas that own them, CASes them AVAILABLE, and keeps watching the
+    placement every tick — the topology-watch -> shard-assignment flow of
+    the reference (dbnode/storage/cluster/database.go, placement shard
+    states driving elastic add/remove)."""
+
+    def __init__(self, config: dict, kv=None):
         self.config = config
         self.log = Logger("dbnode")
         db_cfg = config.get("db", {}) or {}
+        cl_cfg = config.get("cluster", {}) or {}
+        self.instance_id = cl_cfg.get("instance_id", "")
+        self.placement_key = cl_cfg.get("placement_key")
+        self.kv = kv
+        if self.kv is None and cl_cfg.get("kv_path"):
+            from m3_tpu.cluster.kv import FileKVStore
+
+            self.kv = FileKVStore(cl_cfg["kv_path"])
+        self._placement_version = -1
+        owned = None
+        if self.kv is not None:
+            owned = self._owned_from_placement() or ()
         self.db = Database(
             db_cfg.get("path", "./m3data"),
-            DatabaseOptions(n_shards=db_cfg.get("n_shards", 8)),
+            DatabaseOptions(
+                n_shards=db_cfg.get("n_shards", 8),
+                owned_shards=tuple(sorted(owned)) if owned is not None else None,
+            ),
         )
         for ns in db_cfg.get("namespaces", [{"name": "default"}]) or []:
             self.db.create_namespace(ns["name"], namespace_options(ns.get("options")))
         self.api = NodeAPI(self.db)
         self._stop = threading.Event()
 
+    # -- placement plumbing --
+
+    def _load_placement(self):
+        """(placement, kv_version) or (None, -1). Change detection uses the
+        KV VERSION — placement edits that don't bump the embedded document
+        version (e.g. endpoint updates) must still be observed."""
+        from m3_tpu.cluster import placement as pl
+
+        key = self.placement_key or pl.PLACEMENT_KEY
+        loaded = pl.load_placement(self.kv, key)
+        return loaded if loaded else (None, -1)
+
+    def _owned_from_placement(self) -> set[int] | None:
+        p, version = self._load_placement()
+        if p is None:
+            return None
+        self._placement_version = version
+        inst = p.instances.get(self.instance_id)
+        return set(inst.shards) if inst else set()
+
+    def _peers_for_shard(self, p, shard_id: int) -> list:
+        """HTTP peers that can stream this shard (AVAILABLE/LEAVING)."""
+        from m3_tpu.cluster.placement import ShardState
+        from m3_tpu.storage.peers import HTTPPeer
+
+        peers = []
+        for iid, inst in p.instances.items():
+            if iid == self.instance_id:
+                continue
+            sh = inst.shards.get(shard_id)
+            if sh is not None and sh.state in (ShardState.AVAILABLE,
+                                               ShardState.LEAVING):
+                if inst.endpoint:
+                    peers.append(HTTPPeer(inst.endpoint))
+        return peers
+
+    def sync_placement(self) -> None:
+        """Reconcile shard ownership with the current placement; bootstrap
+        and mark newly-assigned INITIALIZING shards AVAILABLE."""
+        from m3_tpu.cluster import placement as pl
+        from m3_tpu.cluster.placement import ShardState
+        from m3_tpu.storage.peers import bootstrap_shard_from_peers
+
+        p, version = self._load_placement()
+        if p is None:
+            return
+        inst = p.instances.get(self.instance_id)
+        owned = set(inst.shards) if inst else set()
+        added, removed = self.db.assign_shards(owned)
+        if added or removed:
+            self.log.info("placement reassignment",
+                          added=sorted(added), removed=sorted(removed))
+        self._placement_version = version
+        if inst is None:
+            return
+        initializing = [
+            s.id for s in inst.shards.values()
+            if s.state == ShardState.INITIALIZING
+        ]
+        if not initializing:
+            return
+        # Only shards whose data sources were actually reachable (or that
+        # have no source at all) may go AVAILABLE: marking an empty replica
+        # available drops the donor's LEAVING shard — the only full copy.
+        ready: list[int] = []
+        for sid in initializing:
+            peers = self._peers_for_shard(p, sid)
+            if not peers:
+                ready.append(sid)  # fresh shard: nothing to stream
+                continue
+            reached = 0
+            for ns_name in self.db.namespaces:
+                for peer in peers:
+                    try:
+                        peer.block_starts(ns_name, sid)
+                        reached += 1
+                        break
+                    except Exception:  # noqa: BLE001 - peer down
+                        continue
+            if reached == 0:
+                self.log.info("no reachable peer for shard; deferring",
+                              shard=sid)
+                continue
+            for ns_name in self.db.namespaces:
+                n = bootstrap_shard_from_peers(self.db, ns_name, sid, peers)
+                if n:
+                    self.log.info("peer-bootstrapped shard",
+                                  shard=sid, namespace=ns_name, blocks=n)
+            ready.append(sid)
+        if not ready:
+            return
+        key = self.placement_key or pl.PLACEMENT_KEY
+        me = self.instance_id
+
+        def make_available(cur):
+            return pl.mark_available(cur, me, ready)
+
+        try:
+            pl.cas_update_placement(self.kv, make_available, key)
+            self.log.info("shards available", shards=ready)
+        except Exception as e:  # noqa: BLE001 - retried next tick
+            self.log.info("mark_available failed; will retry", error=str(e))
+
+    def _placement_changed(self) -> bool:
+        p, version = self._load_placement()
+        return p is not None and version != self._placement_version
+
     def run(self) -> None:
         self.db.open()
         self.log.info("bootstrapped")
+        if self.kv is not None:
+            self.sync_placement()
         http_cfg = self.config.get("http", {}) or {}
         port = self.api.serve(http_cfg.get("host", "0.0.0.0"),
                               http_cfg.get("port", 9000))
@@ -153,9 +329,15 @@ class DBNodeService:
                 self._stop.wait(tick_every)
                 if self._stop.is_set():
                     break
-                with scope.timer("tick"):
-                    stats = self.db.tick()
-                scope.counter("blocks_flushed", stats["flushed"])
+                try:
+                    if self.kv is not None and self._placement_changed():
+                        self.sync_placement()
+                    with scope.timer("tick"):
+                        stats = self.db.tick()
+                    scope.counter("blocks_flushed", stats["flushed"])
+                except Exception as e:  # noqa: BLE001 - a transient KV/IO
+                    # error must not kill the long-running node
+                    self.log.info("tick error; continuing", error=str(e))
         finally:
             self.shutdown()
 

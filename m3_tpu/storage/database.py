@@ -44,7 +44,7 @@ class Database:
         # rotated logs awaiting deletion: ns -> [(path, windows-it-covers)]
         self._retired_logs: dict[str, list[tuple[str, set[int]]]] = {}
         self._open = False
-        self._shard_set = ShardSet(self.opts.n_shards)
+        self._shard_set = ShardSet(self.opts.n_shards, self.opts.owned_shards)
         # optional storage-layer QueryLimits shared by all read paths
         self.limits = None
 
@@ -153,6 +153,38 @@ class Database:
             log.close()
         self._commitlogs.clear()
         self._open = False
+
+    # -- shard assignment (placement-driven; storage/cluster role) --
+
+    @property
+    def owned_shards(self) -> set[int]:
+        return set(self._shard_set.shard_ids)
+
+    def assign_shards(self, shard_ids: set[int], now_ns: int | None = None) -> tuple[set[int], set[int]]:
+        """Reconcile shard ownership with a placement: create newly-assigned
+        shards in every namespace (bootstrapping them from local filesets if
+        present) and drop unassigned ones. Returns (added, removed).
+
+        The topology-watch -> shard-assignment flow of the reference
+        (/root/reference/src/dbnode/storage/cluster/database.go)."""
+        current = self.owned_shards
+        added = set(shard_ids) - current
+        removed = current - set(shard_ids)
+        if not added and not removed:
+            return added, removed
+        # order matters under concurrent writes from the HTTP handlers:
+        # materialize new shard objects BEFORE publishing the new shard set
+        # (a routed write finds its shard), and drop old ones only after
+        for ns in self.namespaces.values():
+            for sid in added:
+                ns.add_shard(sid, now_ns)
+        new_set = ShardSet(self.opts.n_shards, tuple(sorted(shard_ids)))
+        self._shard_set = new_set
+        for ns in self.namespaces.values():
+            ns.shard_set = new_set
+            for sid in removed:
+                ns.remove_shard(sid)
+        return added, removed
 
     # -- write/read --
 

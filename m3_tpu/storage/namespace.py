@@ -26,6 +26,7 @@ class Namespace:
         self.opts = opts
         self.db_opts = db_opts
         self.shard_set = shard_set
+        self.fs_root = fs_root
         self.shards: dict[int, Shard] = {
             sid: Shard(sid, name, opts, db_opts, fs_root)
             for sid in shard_set.shard_ids
@@ -39,6 +40,22 @@ class Namespace:
     @property
     def limits(self):
         return getattr(self.database, "limits", None)
+
+    def add_shard(self, shard_id: int, now_ns: int | None = None) -> Shard:
+        """Start owning a shard (placement assignment). Local fileset data
+        for the shard is bootstrapped if present; peer bootstrap is the
+        caller's job (services layer) since it needs the topology."""
+        shard = self.shards.get(shard_id)
+        if shard is None:
+            shard = Shard(shard_id, self.name, self.opts, self.db_opts,
+                          self.fs_root)
+            self.shards[shard_id] = shard
+            shard.bootstrap_from_fs(now_ns)
+            shard.bootstrapped = True
+        return shard
+
+    def remove_shard(self, shard_id: int) -> None:
+        self.shards.pop(shard_id, None)
 
     def shard_for(self, series_id: bytes) -> Shard:
         sid = self.shard_set.lookup(series_id)

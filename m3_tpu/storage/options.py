@@ -47,6 +47,9 @@ class NamespaceOptions:
 @dataclass(frozen=True)
 class DatabaseOptions:
     n_shards: int = 8
+    # shard ids this node owns (None = all n_shards; a placement-driven
+    # node passes its assigned subset, reference storage/cluster/database.go)
+    owned_shards: tuple[int, ...] | None = None
     # device batch geometry for seal/flush encodes
     max_points_per_block: int = 4096
     commitlog_flush_every_bytes: int = 1 << 20

@@ -81,8 +81,13 @@ class HTTPPeer:
         with urllib.request.urlopen(self.base + path, timeout=self.timeout) as r:
             return json.loads(r.read())
 
-    def block_starts(self, namespace, shard):  # via metadata probing
-        raise NotImplementedError("HTTP peers enumerate via placement")
+    def block_starts(self, namespace, shard):
+        from urllib.parse import quote
+
+        return [int(b) for b in self._get(
+            f"/blocks/starts?namespace={quote(namespace, safe='')}"
+            f"&shard={shard}"
+        )]
 
     def block_metadata(self, namespace, shard, block_start):
         from urllib.parse import quote
@@ -118,7 +123,7 @@ def bootstrap_shard_from_peers(db, namespace: str, shard_id: int,
     for p in peers:
         try:
             all_starts.update(p.block_starts(namespace, shard_id))
-        except NotImplementedError:
+        except Exception:  # noqa: BLE001 - an unreachable peer contributes none
             pass
     written = 0
     for bs in sorted(all_starts):
