@@ -290,15 +290,32 @@ def storage_flush_handler(db, namespace_for_policy: Callable[[StoragePolicy], st
     /root/reference/src/cmd/services/m3coordinator/downsample/flush_handler.go)."""
 
     def handle(metrics: list[AggregatedMetric]) -> int:
+        from m3_tpu.utils.instrument import Logger
+
         n = 0
+        failed = 0
+        first_err: Exception | None = None
         for m in metrics:
             ns = namespace_for_policy(m.policy)
             if ns is None:
                 continue
             tags = [(k, v) for k, v in m.tags if k != b"__name__"]
             name = dict(m.tags).get(b"__name__", b"")
-            db.write_tagged(ns, name, tags, m.timestamp_ns, m.value)
-            n += 1
+            try:
+                db.write_tagged(ns, name, tags, m.timestamp_ns, m.value)
+                n += 1
+            except Exception as e:  # noqa: BLE001 - count, don't abort the
+                # whole flush: one bad namespace (e.g. not configured on the
+                # storage nodes in cluster mode) must not drop the rest
+                failed += 1
+                if first_err is None:
+                    first_err = e
+        if failed:
+            Logger("downsample").info(
+                "aggregated writes failed (is the target namespace "
+                "configured on the storage nodes?)",
+                failed=failed, written=n, first_error=str(first_err),
+            )
         return n
 
     return handle

@@ -75,10 +75,13 @@ class HTTPNodeConnection:
 
     def write_tagged(self, namespace: str, metric_name: bytes, tags,
                      t_ns: int, value: float) -> None:
+        # base64 wire: tag bytes are not guaranteed UTF-8 anywhere else in
+        # the stack, and a dict would collapse duplicate keys
         self._request("POST", "/write", json.dumps({
             "namespace": namespace,
-            "metric": metric_name.decode(),
-            "tags": {k.decode(): v.decode() for k, v in tags},
+            "metric_b64": base64.b64encode(metric_name).decode(),
+            "tags_b64": [[base64.b64encode(k).decode(),
+                          base64.b64encode(v).decode()] for k, v in tags],
             "timestamp_ns": int(t_ns),
             "value": float(value),
         }).encode())
@@ -93,6 +96,17 @@ class HTTPNodeConnection:
         })
         rows = self._request("GET", f"/read?{qs}") or []
         return [Datapoint(int(t), float(v)) for t, v in rows]
+
+    def read_batch(self, namespace: str, series_ids: list[bytes],
+                   start_ns: int, end_ns: int) -> list[list[Datapoint]]:
+        """One round-trip for many series (the host-queue batching role)."""
+        rows = self._request("POST", "/read_batch", json.dumps({
+            "namespace": namespace,
+            "series_ids": [base64.b64encode(s).decode() for s in series_ids],
+            "start_ns": int(start_ns),
+            "end_ns": int(end_ns),
+        }).encode()) or []
+        return [[Datapoint(int(t), float(v)) for t, v in row] for row in rows]
 
     # -- index query surface --
 

@@ -137,6 +137,61 @@ class Session:
         values = vbits.view(np.float64)
         return list(zip(times.tolist(), values.tolist()))
 
+    def fetch_many(self, namespace: str, series_ids: list[bytes],
+                   start_ns: int, end_ns: int):
+        """Replica-merged reads for MANY series with one batched request
+        per host (the host-queue op-batching role, client/host_queue.go).
+        Returns [(times int64[], value_bits uint64[])] aligned to input."""
+        if is_unstrict(self.read_consistency):
+            need = 1
+        else:
+            need = required_acks(self.read_consistency,
+                                 self.topology.replica_factor)
+        shard_of = {sid: self._shard(sid) for sid in series_ids}
+        successes = {sid: 0 for sid in series_ids}
+        parts: dict[bytes, list] = {sid: [] for sid in series_ids}
+        errors = []
+        for host, conn in self.connections.items():
+            readable = self._readable_shards_of(host)
+            want = [sid for sid in series_ids if shard_of[sid] in readable]
+            if not want:
+                continue
+            try:
+                batch = getattr(conn, "read_batch", None)
+                if batch is not None:
+                    rows = batch(namespace, want, start_ns, end_ns)
+                else:  # in-process/test doubles expose read() only
+                    rows = [conn.read(namespace, sid, start_ns, end_ns)
+                            for sid in want]
+            except Exception as e:  # noqa: BLE001 - per-host failure
+                errors.append((host, e))
+                continue
+            for sid, dps in zip(want, rows):
+                successes[sid] += 1
+                if dps:
+                    parts[sid].append((
+                        np.array([d.timestamp_ns for d in dps], np.int64),
+                        np.array([d.value for d in dps],
+                                 np.float64).view(np.uint64),
+                    ))
+        out = []
+        for sid in series_ids:
+            if successes[sid] < need:
+                raise ConsistencyError(
+                    f"batched read got {successes[sid]}/{need} replicas for "
+                    f"{sid!r} (level={self.read_consistency.value}, "
+                    f"errors={errors})"
+                )
+            if not parts[sid]:
+                out.append((np.empty(0, np.int64), np.empty(0, np.uint64)))
+                continue
+            t, v = merge_dedup(
+                np.concatenate([p[0] for p in parts[sid]]),
+                np.concatenate([p[1] for p in parts[sid]]),
+            )
+            out.append((t, v))
+        return out
+
     # -- index scatter/gather (the FetchTagged fan-out, session.go:1585) --
 
     def _readable_shards_of(self, host: str) -> set[int]:

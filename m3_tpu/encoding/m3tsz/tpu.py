@@ -232,7 +232,16 @@ def encode_bits(
         in32 = (dod_units >= -(1 << 31)) & (dod_units <= (1 << 31) - 1)
         overflow = overflow | jnp.any(valid & ~in32)
 
-    # --- payload assembly & scatter ---
+    words = _pack_stream(ts_hi, ts_lo, ts_len, v_hi, v_lo, v_len,
+                         valid, offsets, end_off, start, capacity_words)
+    return EncodedBlocks(words=words, bit_lengths=total_bits, overflow=overflow)
+
+
+def _pack_stream(ts_hi, ts_lo, ts_len, v_hi, v_lo, v_len, valid,
+                 offsets, end_off, start, capacity_words: int) -> jnp.ndarray:
+    """Assemble per-dp (timestamp, value) fields into word tensors via the
+    192-bit register + disjoint scatter-add scheme, and cap with EOS."""
+    B, T = ts_len.shape  # noqa: N806
     zero_reg = (jnp.zeros((B, T), U64),) * 3
     reg = reg3_insert(zero_reg, jnp.uint64(0), ts_hi, ts_lo, ts_len)
     reg = reg3_insert(reg, ts_len, v_hi, v_lo, v_len)
@@ -257,7 +266,41 @@ def encode_bits(
     for k, piece in enumerate(eos_pieces):
         words = words.at[bb, ew0 + k].add(piece, mode="drop")
 
-    return EncodedBlocks(words=words, bit_lengths=total_bits, overflow=overflow)
+    return words
+
+
+def _decode_ts_fields(series_words, off, win, default_bits: int):
+    """(dod_units int64, ts_len) decoded at the cursor (shared by the
+    float-mode and int-optimized decode scans)."""
+    b1 = shr(win, jnp.uint64(63))
+    p2 = shr(win, jnp.uint64(62))
+    p3 = shr(win, jnp.uint64(61))
+    p4 = shr(win, jnp.uint64(60))
+    zero = b1 == 0
+    in7 = p2 == jnp.uint64(0b10)
+    in9 = p3 == jnp.uint64(0b110)
+    in12 = p4 == jnp.uint64(0b1110)
+    d7 = sign_extend64(shr(win, jnp.uint64(55)), jnp.uint64(7))
+    d9 = sign_extend64(shr(win, jnp.uint64(52)), jnp.uint64(9))
+    d12 = sign_extend64(shr(win, jnp.uint64(48)), jnp.uint64(12))
+    if default_bits == 32:
+        ddef = sign_extend64(shr(win, jnp.uint64(28)), jnp.uint64(32))
+    else:
+        win2 = read_window(series_words, off + jnp.uint64(4))
+        ddef = sign_extend64(win2, jnp.uint64(64))
+    dod_u = jnp.where(
+        zero, 0, jnp.where(in7, d7, jnp.where(in9, d9, jnp.where(in12, d12, ddef)))
+    ).astype(I64)
+    ts_len = jnp.where(
+        zero,
+        jnp.uint64(1),
+        jnp.where(
+            in7,
+            jnp.uint64(9),
+            jnp.where(in9, jnp.uint64(12), jnp.where(in12, jnp.uint64(16), jnp.uint64(4 + default_bits))),
+        ),
+    )
+    return dod_u, ts_len
 
 
 class DecodedBlocks(NamedTuple):
@@ -299,34 +342,7 @@ def decode(
             is_eos = is_eos | (is_marker & (marker_val != 0))
 
             # --- delta-of-delta ---
-            b1 = shr(win, jnp.uint64(63))
-            p2 = shr(win, jnp.uint64(62))
-            p3 = shr(win, jnp.uint64(61))
-            p4 = shr(win, jnp.uint64(60))
-            zero = b1 == 0
-            in7 = p2 == jnp.uint64(0b10)
-            in9 = p3 == jnp.uint64(0b110)
-            in12 = p4 == jnp.uint64(0b1110)
-            d7 = sign_extend64(shr(win, jnp.uint64(55)), jnp.uint64(7))
-            d9 = sign_extend64(shr(win, jnp.uint64(52)), jnp.uint64(9))
-            d12 = sign_extend64(shr(win, jnp.uint64(48)), jnp.uint64(12))
-            if default_bits == 32:
-                ddef = sign_extend64(shr(win, jnp.uint64(28)), jnp.uint64(32))
-            else:
-                win2 = read_window(series_words, off + jnp.uint64(4))
-                ddef = sign_extend64(win2, jnp.uint64(64))
-            dod_u = jnp.where(
-                zero, 0, jnp.where(in7, d7, jnp.where(in9, d9, jnp.where(in12, d12, ddef)))
-            ).astype(I64)
-            ts_len = jnp.where(
-                zero,
-                jnp.uint64(1),
-                jnp.where(
-                    in7,
-                    jnp.uint64(9),
-                    jnp.where(in9, jnp.uint64(12), jnp.where(in12, jnp.uint64(16), jnp.uint64(4 + default_bits))),
-                ),
-            )
+            dod_u, ts_len = _decode_ts_fields(series_words, off, win, default_bits)
             new_dt = prev_dt + dod_u * unit_ns
             new_time = prev_time + new_dt
 

@@ -134,8 +134,9 @@ class Engine:
         docs = ns.query_ids(matchers_to_query(sel.matchers), t_min, t_max)
         labels = []
         per_series = []
-        for doc in docs:
-            times, vbits = ns.read(doc.series_id, t_min, t_max)
+        # one batched read (one request per storage node in cluster mode)
+        results = ns.read_many([d.series_id for d in docs], t_min, t_max)
+        for doc, (times, vbits) in zip(docs, results):
             if len(times) == 0:
                 continue
             labels.append(dict(doc.fields))

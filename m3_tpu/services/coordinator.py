@@ -76,7 +76,8 @@ def namespace_options(doc: dict | None) -> NamespaceOptions:
             block_size_ns=dur(r.get("block_size", "2h")),
             buffer_past_ns=dur(r.get("buffer_past", "10m")),
             buffer_future_ns=dur(r.get("buffer_future", "2m")),
-        )
+        ),
+        int_optimized=bool(doc.get("int_optimized", False)),
     )
 
 
@@ -158,7 +159,10 @@ class CoordinatorService:
         return ClusterDatabase(session)
 
     def _refresh_topology(self) -> None:
-        """Pick up placement changes (node add/remove) between ticks."""
+        """Pick up placement changes (node add/remove/endpoint) between
+        ticks."""
+        from urllib.parse import urlparse
+
         from m3_tpu.client.http_conn import HTTPNodeConnection
         from m3_tpu.cluster import placement as pl
         from m3_tpu.cluster.topology import TopologyMap
@@ -171,7 +175,16 @@ class CoordinatorService:
             return
         session = self.db.session
         for iid, inst in p.instances.items():
-            if iid not in session.connections and inst.endpoint:
+            if not inst.endpoint:
+                continue
+            cur = session.connections.get(iid)
+            u = urlparse(inst.endpoint if "//" in inst.endpoint
+                         else f"http://{inst.endpoint}")
+            if cur is not None and (cur.host, cur.port) != (u.hostname,
+                                                            u.port or 9000):
+                cur.close()  # instance restarted on a new endpoint
+                cur = None
+            if cur is None:
                 session.connections[iid] = HTTPNodeConnection(inst.endpoint)
         for iid in list(session.connections):
             if iid not in p.instances:

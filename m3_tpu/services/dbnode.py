@@ -40,14 +40,30 @@ class NodeAPI:
                 return 200, default_registry().render_prometheus()
             if path == "/write" and method == "POST":
                 doc = json.loads(body)
-                tags = [(k.encode(), v.encode()) for k, v in
-                        sorted(doc.get("tags", {}).items())]
+                if "tags_b64" in doc:  # binary-safe wire (tags are bytes)
+                    tags = [(base64.b64decode(k), base64.b64decode(v))
+                            for k, v in doc["tags_b64"]]
+                    metric = base64.b64decode(doc.get("metric_b64", ""))
+                else:
+                    tags = [(k.encode(), v.encode()) for k, v in
+                            sorted(doc.get("tags", {}).items())]
+                    metric = doc.get("metric", "").encode()
                 self.db.write_tagged(
-                    doc.get("namespace", "default"),
-                    doc.get("metric", "").encode(), tags,
+                    doc.get("namespace", "default"), metric, tags,
                     int(doc["timestamp_ns"]), float(doc["value"]),
                 )
                 return 200, b'{"ok":true}'
+            if path == "/read_batch" and method == "POST":
+                doc = json.loads(body)
+                out = []
+                for sid_b64 in doc["series_ids"]:
+                    dps = self.db.read(
+                        doc.get("namespace", "default"),
+                        base64.b64decode(sid_b64),
+                        int(doc["start_ns"]), int(doc["end_ns"]),
+                    )
+                    out.append([[d.timestamp_ns, d.value] for d in dps])
+                return 200, json.dumps(out).encode()
             if path == "/read":
                 dps = self.db.read(
                     q["namespace"][0], base64.b64decode(q["series_id"][0]),
@@ -191,14 +207,18 @@ class DBNodeService:
 
             self.kv = FileKVStore(cl_cfg["kv_path"])
         self._placement_version = -1
-        owned = None
         if self.kv is not None:
-            owned = self._owned_from_placement() or ()
+            # placement-driven node: own NOTHING until the placement says
+            # otherwise (sync_placement assigns once one appears)
+            owned = self._owned_from_placement() or set()
+            owned_arg = tuple(sorted(owned))
+        else:
+            owned_arg = None  # standalone node: owns every shard
         self.db = Database(
             db_cfg.get("path", "./m3data"),
             DatabaseOptions(
                 n_shards=db_cfg.get("n_shards", 8),
-                owned_shards=tuple(sorted(owned)) if owned is not None else None,
+                owned_shards=owned_arg,
             ),
         )
         for ns in db_cfg.get("namespaces", [{"name": "default"}]) or []:
@@ -276,21 +296,26 @@ class DBNodeService:
             if not peers:
                 ready.append(sid)  # fresh shard: nothing to stream
                 continue
+            # one probe pass doubles as reachability check AND block-start
+            # discovery (bootstrap reuses the probed starts)
             reached = 0
+            starts_by_ns: dict[str, set[int]] = {}
             for ns_name in self.db.namespaces:
+                starts: set[int] = set()
                 for peer in peers:
                     try:
-                        peer.block_starts(ns_name, sid)
+                        starts.update(peer.block_starts(ns_name, sid))
                         reached += 1
-                        break
                     except Exception:  # noqa: BLE001 - peer down
                         continue
+                starts_by_ns[ns_name] = starts
             if reached == 0:
                 self.log.info("no reachable peer for shard; deferring",
                               shard=sid)
                 continue
-            for ns_name in self.db.namespaces:
-                n = bootstrap_shard_from_peers(self.db, ns_name, sid, peers)
+            for ns_name, starts in starts_by_ns.items():
+                n = bootstrap_shard_from_peers(self.db, ns_name, sid, peers,
+                                               known_starts=starts)
                 if n:
                     self.log.info("peer-bootstrapped shard",
                                   shard=sid, namespace=ns_name, blocks=n)

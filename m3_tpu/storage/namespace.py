@@ -55,7 +55,19 @@ class Namespace:
         return shard
 
     def remove_shard(self, shard_id: int) -> None:
-        self.shards.pop(shard_id, None)
+        """Stop owning a shard. Buffered (unflushed) windows are force-
+        flushed to fileset volumes first so a handoff never discards the
+        only copy of recent writes — background repair can reconcile the
+        new owner from disk later (reference keeps LEAVING donors serving
+        until cutover for the same reason)."""
+        shard = self.shards.pop(shard_id, None)
+        if shard is None:
+            return
+        for bs in shard.buffer.block_starts():
+            try:
+                shard.flush(bs)
+            except Exception:  # noqa: BLE001 - best effort on the way out
+                pass
 
     def shard_for(self, series_id: bytes) -> Shard:
         sid = self.shard_set.lookup(series_id)
@@ -95,6 +107,11 @@ class Namespace:
         if self.limits is not None:
             self.limits.add_datapoints(len(times))
         return times, vbits
+
+    def read_many(self, series_ids: list[bytes], start_ns: int, end_ns: int):
+        """Batch-read surface shared with the cluster facade (which turns
+        it into one request per storage node)."""
+        return [self.read(sid, start_ns, end_ns) for sid in series_ids]
 
     def flush(self, now_ns: int) -> int:
         if not self.opts.flush_enabled:

@@ -37,6 +37,9 @@ class NamespaceOptions:
     retention: RetentionOptions = field(default_factory=RetentionOptions)
     index: IndexOptions = field(default_factory=IndexOptions)
     write_time_unit: TimeUnit = TimeUnit.SECOND
+    # encode value streams with the M3TSZ int optimization (the reference's
+    # production default; float-XOR only when False)
+    int_optimized: bool = False
     bootstrap_enabled: bool = True
     flush_enabled: bool = True
     writes_to_commitlog: bool = True

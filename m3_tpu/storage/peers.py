@@ -113,18 +113,24 @@ class HTTPPeer:
 
 
 def bootstrap_shard_from_peers(db, namespace: str, shard_id: int,
-                               peers: list[PeerSource]) -> int:
+                               peers: list[PeerSource],
+                               known_starts: set[int] | None = None) -> int:
     """Stream every flushed block a replica set has for this shard into
     local fileset volumes (the new-node bootstrap path). Returns blocks
-    written. Majority checksum wins when peers disagree."""
+    written. Majority checksum wins when peers disagree. Callers that
+    already probed the peers' block starts pass them via known_starts to
+    avoid re-fetching."""
     ns = db.namespaces[namespace]
     shard = ns.shards[shard_id]
-    all_starts: set[int] = set()
-    for p in peers:
-        try:
-            all_starts.update(p.block_starts(namespace, shard_id))
-        except Exception:  # noqa: BLE001 - an unreachable peer contributes none
-            pass
+    if known_starts is not None:
+        all_starts = set(known_starts)
+    else:
+        all_starts = set()
+        for p in peers:
+            try:
+                all_starts.update(p.block_starts(namespace, shard_id))
+            except Exception:  # noqa: BLE001 - unreachable peer adds none
+                pass
     written = 0
     for bs in sorted(all_starts):
         if bs in shard._filesets:
@@ -261,7 +267,8 @@ def repair_shard_block(db, namespace: str, shard_id: int, block_start: int,
                 streams.append(stream)
                 tags = tags or ptags
         for stream in streams:
-            dps = scalar_decode(stream, int_optimized=False, default_time_unit=unit)
+            dps = scalar_decode(stream, int_optimized=ns.opts.int_optimized,
+                                default_time_unit=unit)
             if dps:
                 parts_t.append(np.array([d.timestamp_ns for d in dps], np.int64))
                 parts_v.append(
@@ -270,7 +277,8 @@ def repair_shard_block(db, namespace: str, shard_id: int, block_start: int,
         if not parts_t:
             continue
         times, vbits = merge_dedup(np.concatenate(parts_t), np.concatenate(parts_v))
-        enc = Encoder(block_start, int_optimized=False, default_time_unit=unit)
+        enc = Encoder(block_start, int_optimized=ns.opts.int_optimized,
+                      default_time_unit=unit)
         for t, vb in zip(times, vbits):
             enc.encode(int(t), float(np.uint64(vb).view(np.float64)), unit)
         merged[sid] = (tags or b"", enc.stream())

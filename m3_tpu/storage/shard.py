@@ -52,7 +52,7 @@ class Shard:
             stream = reader.read(series_id)
             if stream:
                 dps = scalar_decode(
-                    stream, int_optimized=False,
+                    stream, int_optimized=self.opts.int_optimized,
                     default_time_unit=self.opts.write_time_unit,
                 )
                 if dps:
@@ -128,7 +128,7 @@ class Shard:
                     continue
                 k = new_ids[sid]
                 dps = scalar_decode(
-                    stream, int_optimized=False,
+                    stream, int_optimized=self.opts.int_optimized,
                     default_time_unit=self.opts.write_time_unit,
                 )
                 old_t = np.array([d.timestamp_ns for d in dps], np.int64)
@@ -152,7 +152,13 @@ class Shard:
                     times[k, len(nt):] = nt[-1]
                     n_points[k] = len(nt)
 
-        blocks = m3tsz_tpu.encode_bits(
+        if self.opts.int_optimized:
+            from m3_tpu.encoding.m3tsz import tpu_int
+
+            encode_fn = tpu_int.encode_bits_int
+        else:
+            encode_fn = m3tsz_tpu.encode_bits
+        blocks = encode_fn(
             jnp.asarray(times),
             jnp.asarray(vbits),
             jnp.asarray(sealed.starts),
