@@ -22,11 +22,16 @@ class NodeUnavailableError(ConnectionError):
     pass
 
 
+def parse_endpoint(endpoint: str) -> tuple[str, int]:
+    """(host, port) of a node endpoint; single source of the scheme guard
+    and default port for connections AND topology-change detection."""
+    u = urlparse(endpoint if "//" in endpoint else f"http://{endpoint}")
+    return u.hostname, u.port or 9000
+
+
 class HTTPNodeConnection:
     def __init__(self, endpoint: str, timeout_s: float = 10.0):
-        u = urlparse(endpoint if "//" in endpoint else f"http://{endpoint}")
-        self.host = u.hostname
-        self.port = u.port or 9000
+        self.host, self.port = parse_endpoint(endpoint)
         self.timeout_s = timeout_s
         self._tl = threading.local()
         # every thread's socket, so close() can tear all of them down

@@ -161,9 +161,7 @@ class CoordinatorService:
     def _refresh_topology(self) -> None:
         """Pick up placement changes (node add/remove/endpoint) between
         ticks."""
-        from urllib.parse import urlparse
-
-        from m3_tpu.client.http_conn import HTTPNodeConnection
+        from m3_tpu.client.http_conn import HTTPNodeConnection, parse_endpoint
         from m3_tpu.cluster import placement as pl
         from m3_tpu.cluster.topology import TopologyMap
 
@@ -178,10 +176,9 @@ class CoordinatorService:
             if not inst.endpoint:
                 continue
             cur = session.connections.get(iid)
-            u = urlparse(inst.endpoint if "//" in inst.endpoint
-                         else f"http://{inst.endpoint}")
-            if cur is not None and (cur.host, cur.port) != (u.hostname,
-                                                            u.port or 9000):
+            if cur is not None and (cur.host, cur.port) != parse_endpoint(
+                inst.endpoint
+            ):
                 cur.close()  # instance restarted on a new endpoint
                 cur = None
             if cur is None:

@@ -1,0 +1,57 @@
+"""Decoded-block LRU cache — the WiredList role.
+
+The reference keeps recently-read compressed blocks wired in memory with a
+global LRU (/root/reference/src/dbnode/storage/block/wired_list.go:77-131);
+here the cached unit is the DECODED (times, value_bits) pair per
+(namespace, shard, block_start, series_id) — the expensive step on the
+read path is the per-series stream decode, so that is what is amortized.
+One instance per Database, shared by every shard; entries for a block are
+invalidated when a flush writes a replacement volume.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class BlockCache:
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return hit
+
+    def put(self, key, value) -> None:
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    def invalidate_block(self, namespace: str, shard_id: int,
+                         block_start: int) -> int:
+        """Drop every cached series of one (shard, block) — called when a
+        flush replaces the block's fileset volume."""
+        prefix = (namespace, shard_id, block_start)
+        with self._lock:
+            doomed = [k for k in self._entries if k[:3] == prefix]
+            for k in doomed:
+                del self._entries[k]
+        return len(doomed)
+
+    def __len__(self) -> int:
+        return len(self._entries)

@@ -41,18 +41,29 @@ class Database:
         self._commitlogs: dict[str, commitlog.CommitLogWriter] = {}
         # block windows logged into the ACTIVE commitlog, per namespace
         self._log_windows: dict[str, set[int]] = {}
-        # rotated logs awaiting deletion: ns -> [(path, windows-it-covers)]
-        self._retired_logs: dict[str, list[tuple[str, set[int]]]] = {}
+        # rotated logs awaiting deletion:
+        # ns -> [(path, windows-it-covers, retired_at_ns)]
+        self._retired_logs: dict[str, list[tuple[str, set[int], int]]] = {}
+        # (ns, window) -> time of the last snapshot covering every shard
+        self._snapshot_times: dict[tuple[str, int], int] = {}
         self._open = False
         self._shard_set = ShardSet(self.opts.n_shards, self.opts.owned_shards)
         # optional storage-layer QueryLimits shared by all read paths
         self.limits = None
+        from m3_tpu.storage.cache import BlockCache
+
+        # decoded-block LRU shared by every shard (WiredList role)
+        self.block_cache = BlockCache(self.opts.block_cache_entries)
 
     # -- lifecycle --
 
     @property
     def fs_root(self) -> str:
         return os.path.join(self.path, "data")
+
+    @property
+    def snapshots_root(self) -> str:
+        return os.path.join(self.path, "snapshots")
 
     def commitlog_dir(self, namespace: str) -> str:
         return os.path.join(self.path, "commitlog", namespace)
@@ -63,6 +74,8 @@ class Database:
         ns = Namespace(name, opts or NamespaceOptions(), self.opts, self._shard_set,
                        self.fs_root)
         ns.database = self
+        for shard in ns.shards.values():
+            shard.cache = self.block_cache
         self.namespaces[name] = ns
         if ns.opts.writes_to_commitlog and self._open:
             self._open_commitlog(name)
@@ -94,6 +107,7 @@ class Database:
                         cutoff_ns=r.block_start(now_ns - r.retention_ns),
                     )
                 ns.bootstrap_from_fs(now_ns, skip_index_blocks=restored)
+                self._restore_snapshots(name, ns, now_ns)
                 self._replay_commitlogs(name, ns, now_ns)
             if ns.opts.writes_to_commitlog:
                 self._open_commitlog(name)
@@ -125,18 +139,22 @@ class Database:
                 shard.write(e.series_id, e.time_ns, e.value_bits, e.encoded_tags)
                 if ns.index is not None and e.encoded_tags:
                     ns.index.insert(e.series_id, decode_tags(e.encoded_tags), e.time_ns)
-            retired.append((path, windows))
+            retired.append((path, windows, now_ns if now_ns is not None else 0))
 
     def _cleanup_retired_logs(self, name: str, ns: Namespace, now_ns: int) -> None:
         r = ns.opts.retention
         remaining = []
-        for path, windows in self._retired_logs.get(name, []):
+        for path, windows, retired_at in self._retired_logs.get(name, []):
             covered = all(
                 (
                     w + r.block_size_ns + r.buffer_past_ns <= now_ns
                     and all(s.buffer.points_in(w) == 0 for s in ns.shards.values())
                 )
                 or w < r.block_start(now_ns - r.retention_ns)  # past retention
+                # a snapshot taken STRICTLY after the log was retired holds
+                # every datapoint the log did (same-instant snapshots race
+                # concurrent writers; the next tick's snapshot covers them)
+                or self._snapshot_times.get((name, w), -1) > retired_at
                 for w in windows
             )
             if covered:
@@ -145,8 +163,122 @@ class Database:
                 except OSError:
                     pass
             else:
-                remaining.append((path, windows))
+                remaining.append((path, windows, retired_at))
         self._retired_logs[name] = remaining
+
+    # -- snapshots --
+
+    def snapshot(self, now_ns: int) -> dict[str, int]:
+        """Snapshot every open (unflushed) buffer window of every
+        snapshot-enabled namespace. Returns windows snapshotted per ns."""
+        from m3_tpu.storage.fileset import list_filesets
+
+        snap_id = int(now_ns // 1_000_000)  # monotonic across restarts
+        counts: dict[str, int] = {}
+        for name, ns in self.namespaces.items():
+            if not ns.opts.snapshot_enabled:
+                continue
+            # a window is COVERED only when every shard holding it either
+            # snapshotted it now or was already clean since its last
+            # successful snapshot — a single failed shard must not let the
+            # commitlog (or that shard's previous snapshot) be reclaimed
+            ok_windows: set[int] = set()
+            failed_windows: set[int] = set()
+            for shard in ns.shards.values():
+                done_here: set[int] = set()
+                for bs in shard.buffer.block_starts():
+                    seq = shard.write_seq(bs)
+                    if shard.snapshotted_seq(bs) == seq:
+                        ok_windows.add(bs)  # unchanged since last snapshot
+                        continue
+                    if shard.snapshot(bs, self.snapshots_root, snap_id):
+                        shard.mark_snapshotted(bs, seq)
+                        ok_windows.add(bs)
+                        done_here.add(bs)
+                    else:
+                        failed_windows.add(bs)
+                # reclaim superseded volumes ONLY where this shard's new
+                # snapshot landed
+                for old_bs, old_vol in list_filesets(
+                    self.snapshots_root, name, shard.shard_id,
+                    all_volumes=True,
+                ):
+                    if old_bs in done_here and old_vol < snap_id:
+                        self._remove_snapshot(name, shard.shard_id, old_bs,
+                                              old_vol)
+            covered = ok_windows - failed_windows
+            for w in covered:
+                self._snapshot_times[(name, w)] = now_ns
+            counts[name] = len(covered)
+        return counts
+
+    def _remove_snapshot(self, name: str, shard_id: int, bs: int,
+                         vol: int) -> None:
+        from m3_tpu.storage.fileset import SUFFIXES, fileset_path
+
+        # checkpoint first: a half-deleted snapshot must read as incomplete
+        for suffix in ("checkpoint",) + tuple(s for s in SUFFIXES
+                                              if s != "checkpoint"):
+            try:
+                os.remove(fileset_path(self.snapshots_root, name, shard_id,
+                                       bs, vol, suffix))
+            except OSError:
+                pass
+
+    def _restore_snapshots(self, name: str, ns: Namespace, now_ns: int) -> None:
+        """Load the latest snapshot of each in-flight window into the
+        buffers (before commitlog replay; duplicates dedup on merge)."""
+        from m3_tpu.encoding.m3tsz import decode as scalar_decode
+        from m3_tpu.storage.fileset import FilesetReader, list_filesets
+
+        cutoff = ns.opts.retention.block_start(
+            now_ns - ns.opts.retention.retention_ns)
+        from m3_tpu.utils.ident import decode_tags
+
+        for shard in ns.shards.values():
+            for bs, vol in list_filesets(self.snapshots_root, name,
+                                         shard.shard_id):
+                if bs < cutoff:
+                    continue
+                try:
+                    reader = FilesetReader(self.snapshots_root, name,
+                                           shard.shard_id, bs, vol)
+                except (FileNotFoundError, ValueError):
+                    continue
+                for i in range(reader.n_series):
+                    sid, tags, stream = reader.read_at(i)
+                    n_restored = 0
+                    for d in scalar_decode(
+                        stream, int_optimized=ns.opts.int_optimized,
+                        default_time_unit=ns.opts.write_time_unit,
+                    ):
+                        shard.buffer.write(
+                            sid, d.timestamp_ns,
+                            int(np.float64(d.value).view(np.uint64)), tags,
+                        )
+                        n_restored += 1
+                    # restored points count as writes (dirty tracking) and
+                    # re-index like commitlog replay does — the persisted
+                    # index segment may be corrupt/missing for this block
+                    shard._write_seq[bs] = shard._write_seq.get(bs, 0) + n_restored
+                    if tags and n_restored:
+                        ns.index_insert_spanning(sid, decode_tags(tags), bs)
+                reader.close()
+
+    def _cleanup_snapshots(self, name: str, ns: Namespace, now_ns: int) -> None:
+        """Drop snapshots whose window is flushed-and-drained or expired."""
+        from m3_tpu.storage.fileset import list_filesets
+
+        r = ns.opts.retention
+        cutoff = r.block_start(now_ns - r.retention_ns)
+        for shard in ns.shards.values():
+            open_windows = set(shard.buffer.block_starts())
+            for bs, vol in list_filesets(self.snapshots_root, name,
+                                         shard.shard_id, all_volumes=True):
+                if bs >= cutoff and bs in open_windows:
+                    continue  # still in flight
+                self._remove_snapshot(name, shard.shard_id, bs, vol)
+                self._snapshot_times.pop((name, bs), None)
 
     def close(self) -> None:
         for log in self._commitlogs.values():
@@ -256,14 +388,19 @@ class Database:
     # -- maintenance --
 
     def tick(self, now_ns: int | None = None) -> dict:
-        """One mediator cycle: warm flush of cold windows + retention expiry
-        + commitlog rotation after a successful flush."""
+        """One mediator cycle: warm flush of cold windows + snapshot of
+        in-flight windows + retention expiry + commitlog rotation (a log
+        retires once its windows are flushed OR snapshotted after it was
+        rotated — the reference flush model, storage/README.md)."""
         now_ns = now_ns if now_ns is not None else time.time_ns()
         flushed = expired = 0
+        snapped = self.snapshot(now_ns)
         for name, ns in self.namespaces.items():
             n = ns.flush(now_ns)
             flushed += n
             expired += ns.expire(now_ns)
+            self._cleanup_snapshots(name, ns, now_ns)
+            ns_snapped = snapped.get(name, 0)
             if ns.index is not None:
                 from m3_tpu.index import persist as index_persist
 
@@ -275,19 +412,21 @@ class Database:
                 index_persist.expire_index_files(
                     self.fs_root, name, cutoff, ns.opts.index.block_size_ns
                 )
-            if n and name in self._commitlogs:
-                # flushed windows are durable in filesets: retire the active
-                # log (recording the windows it covers) and start a new one;
-                # retired logs are deleted once every window has flushed
+            if ((n or ns_snapped) and name in self._commitlogs
+                    and self._log_windows.get(name)):
+                # the active log's windows are durable (fileset volume or
+                # snapshot): retire it (recording windows + when) and start
+                # a new one; retirement completes in _cleanup_retired_logs
                 old = self._commitlogs[name]
                 old.close()
                 self._retired_logs.setdefault(name, []).append(
-                    (old.path, self._log_windows.get(name, set()))
+                    (old.path, self._log_windows.get(name, set()), now_ns)
                 )
                 self._open_commitlog(name)
             if name in self._commitlogs:
                 self._cleanup_retired_logs(name, ns, now_ns)
-        return {"flushed": flushed, "expired": expired}
+        return {"flushed": flushed, "expired": expired,
+                "snapshotted": sum(snapped.values())}
 
     def aggregate_tiles(self, source_ns: str, target_ns: str,
                         start_ns: int, end_ns: int, tile_ns: int,

@@ -31,7 +31,8 @@ from dataclasses import dataclass
 
 from m3_tpu.utils.hash import murmur3_32
 
-SUFFIXES = ("info", "data", "index", "summaries", "bloom", "digest", "checkpoint")
+SUFFIXES = ("info", "data", "index", "summaries", "bloom", "offsets",
+            "digest", "checkpoint")
 _SUMMARY_EVERY = 32
 
 
@@ -113,11 +114,13 @@ class FilesetWriter:
 
         index = bytearray()
         summaries = bytearray()
+        offsets = bytearray()  # per-entry byte offset into the index file
         bloom = BloomFilter(max(1, len(self._entries)))
         for i, e in enumerate(self._entries):
             if i % _SUMMARY_EVERY == 0:
                 summaries += struct.pack(">I", len(e.series_id)) + e.series_id
                 summaries += struct.pack(">Q", len(index))
+            offsets += struct.pack("<Q", len(index))
             index += struct.pack(">I", len(e.series_id)) + e.series_id
             index += struct.pack(">I", len(e.encoded_tags)) + e.encoded_tags
             index += struct.pack(">QQ", e.offset, e.length)
@@ -139,6 +142,7 @@ class FilesetWriter:
             "index": bytes(index),
             "summaries": bytes(summaries),
             "bloom": bloom.to_bytes(),
+            "offsets": bytes(offsets),
         }
         digests = {}
         for suffix, payload in files.items():
@@ -168,10 +172,23 @@ class FilesetWriter:
 
 
 class FilesetReader:
-    """Reads a complete fileset: bloom -> index binary search -> data slice."""
+    """Reads a complete fileset WITHOUT materializing the index.
+
+    The round-1 reader parsed every index entry into Python lists at open —
+    wrong for multi-million-series shards. This reader mmaps the index and
+    data files and looks series up the way the reference seeker does
+    (/root/reference/src/dbnode/persist/fs/seek.go): bloom gate ->
+    summaries binary search -> short scan of at most _SUMMARY_EVERY
+    entries in the mapped index -> data slice. Ordinal access uses the
+    per-entry offsets file (one u64 per series; built by a single scan for
+    legacy sets without one)."""
 
     def __init__(self, root: str, namespace: str, shard: int, block_start: int,
                  volume: int = 0, verify: bool = True):
+        import mmap as _mmap
+
+        import numpy as np
+
         self.root = root
         self.namespace = namespace
         self.shard = shard
@@ -193,34 +210,48 @@ class FilesetReader:
             if zlib.adler32(digest_payload) != want:
                 raise ValueError("digest file corrupt (checkpoint mismatch)")
             digests = json.loads(digest_payload)
-            for suffix in ("info", "data", "index", "summaries", "bloom"):
+            for suffix, want_digest in digests.items():
                 with open(self._path(suffix), "rb") as f:
-                    if zlib.adler32(f.read()) != digests[suffix]:
+                    if zlib.adler32(f.read()) != want_digest:
                         raise ValueError(f"{suffix} file corrupt (digest mismatch)")
 
         with open(self._path("bloom"), "rb") as f:
             self.bloom = BloomFilter.from_bytes(f.read())
-        with open(self._path("index"), "rb") as f:
+
+        def _map(suffix: str):
+            f = open(self._path(suffix), "rb")
+            try:
+                if os.fstat(f.fileno()).st_size == 0:
+                    return f, b""
+                return f, _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+            except Exception:
+                f.close()
+                raise
+
+        self._index_file, self._index = _map("index")
+        self._data_file, self._data = _map("data")
+        # summaries: small (1/_SUMMARY_EVERY of entries) — parsed eagerly
+        with open(self._path("summaries"), "rb") as f:
             raw = f.read()
-        self._ids: list[bytes] = []
-        self._tags: list[bytes] = []
-        self._spans: list[tuple[int, int]] = []
+        self._summary_ids: list[bytes] = []
+        self._summary_offs: list[int] = []
         off = 0
         while off < len(raw):
             (idlen,) = struct.unpack_from(">I", raw, off)
             off += 4
-            sid = raw[off : off + idlen]
+            self._summary_ids.append(raw[off : off + idlen])
             off += idlen
-            (tlen,) = struct.unpack_from(">I", raw, off)
-            off += 4
-            tags = raw[off : off + tlen]
-            off += tlen
-            data_off, data_len = struct.unpack_from(">QQ", raw, off)
-            off += 16
-            self._ids.append(sid)
-            self._tags.append(tags)
-            self._spans.append((data_off, data_len))
-        self._data_file = open(self._path("data"), "rb")
+            (ixoff,) = struct.unpack_from(">Q", raw, off)
+            off += 8
+            self._summary_offs.append(ixoff)
+        # per-entry index offsets: mmap'd numpy view when the file exists,
+        # else built lazily by one scan (legacy filesets)
+        self._offsets = None
+        if os.path.exists(self._path("offsets")):
+            with open(self._path("offsets"), "rb") as f:
+                raw_off = f.read()
+            if raw_off:
+                self._offsets = np.frombuffer(raw_off, dtype="<u8")
 
     def _path(self, suffix: str) -> str:
         return fileset_path(
@@ -229,55 +260,119 @@ class FilesetReader:
 
     @property
     def n_series(self) -> int:
-        return len(self._ids)
+        return int(self.info["n_series"])
+
+    def _parse_entry(self, off: int):
+        """(series_id, tags, data_off, data_len, next_off) at index
+        offset off."""
+        ix = self._index
+        (idlen,) = struct.unpack_from(">I", ix, off)
+        off += 4
+        sid = bytes(ix[off : off + idlen])
+        off += idlen
+        (tlen,) = struct.unpack_from(">I", ix, off)
+        off += 4
+        tags = bytes(ix[off : off + tlen])
+        off += tlen
+        data_off, data_len = struct.unpack_from(">QQ", ix, off)
+        return sid, tags, data_off, data_len, off + 16
+
+    def _entry_offsets(self):
+        import numpy as np
+
+        if self._offsets is None:  # legacy fileset: one sequential scan
+            offs = np.empty(self.n_series, np.uint64)
+            off = 0
+            for i in range(self.n_series):
+                offs[i] = off
+                (idlen,) = struct.unpack_from(">I", self._index, off)
+                (tlen,) = struct.unpack_from(">I", self._index, off + 4 + idlen)
+                off += 4 + idlen + 4 + tlen + 16
+            self._offsets = offs
+        return self._offsets
+
+    def _find(self, series_id: bytes):
+        """Index offset of the entry for series_id, or None — summaries
+        bisect then a bounded scan."""
+        if not self._summary_ids:
+            return None
+        si = bisect_left(self._summary_ids, series_id)
+        if si == len(self._summary_ids) or self._summary_ids[si] != series_id:
+            si -= 1  # scan forward from the preceding summary
+        if si < 0:
+            return None
+        off = self._summary_offs[si]
+        end = len(self._index)
+        for _ in range(_SUMMARY_EVERY):
+            if off >= end:
+                return None
+            sid, _tags, _do, _dl, nxt = self._parse_entry(off)
+            if sid == series_id:
+                return off
+            if sid > series_id:
+                return None
+            off = nxt
+        return None
 
     def series_ids(self) -> list[bytes]:
-        return list(self._ids)
+        offs = self._entry_offsets()
+        return [self._parse_entry(int(o))[0] for o in offs]
 
     def read(self, series_id: bytes) -> bytes | None:
-        """Stream bytes for a series, or None. Bloom gate then bisect."""
+        """Stream bytes for a series, or None. Bloom gate, then seek."""
         if not self.bloom.may_contain(series_id):
             return None
-        i = bisect_left(self._ids, series_id)
-        if i >= len(self._ids) or self._ids[i] != series_id:
+        off = self._find(series_id)
+        if off is None:
             return None
-        off, length = self._spans[i]
-        self._data_file.seek(off)
-        return self._data_file.read(length)
+        _sid, _tags, data_off, data_len, _nxt = self._parse_entry(off)
+        return bytes(self._data[data_off : data_off + data_len])
 
     def read_at(self, i: int) -> tuple[bytes, bytes, bytes]:
         """(id, encoded_tags, stream) for index position i."""
-        off, length = self._spans[i]
-        self._data_file.seek(off)
-        return self._ids[i], self._tags[i], self._data_file.read(length)
+        off = int(self._entry_offsets()[i])
+        sid, tags, data_off, data_len, _ = self._parse_entry(off)
+        return sid, tags, bytes(self._data[data_off : data_off + data_len])
 
     def entry_at(self, i: int) -> tuple[bytes, bytes]:
         """(id, encoded_tags) without touching the data file."""
-        return self._ids[i], self._tags[i]
+        off = int(self._entry_offsets()[i])
+        sid, tags, _do, _dl, _ = self._parse_entry(off)
+        return sid, tags
 
     def tags_of(self, series_id: bytes) -> bytes | None:
-        i = bisect_left(self._ids, series_id)
-        if i < len(self._ids) and self._ids[i] == series_id:
-            return self._tags[i]
-        return None
+        off = self._find(series_id)
+        if off is None:
+            return None
+        return self._parse_entry(off)[1]
 
     def close(self) -> None:
+        for m in (self._index, self._data):
+            if not isinstance(m, bytes):
+                m.close()
+        self._index_file.close()
         self._data_file.close()
 
 
-def list_filesets(root: str, namespace: str, shard: int) -> list[tuple[int, int]]:
-    """Complete (block_start, volume) pairs for a shard, ascending; keeps
-    only the max volume per block_start."""
+def list_filesets(root: str, namespace: str, shard: int,
+                  all_volumes: bool = False) -> list[tuple[int, int]]:
+    """Complete (block_start, volume) pairs for a shard, ascending. By
+    default only the max volume per block_start; all_volumes=True lists
+    every complete volume (snapshot reclamation)."""
     d = os.path.join(root, namespace, str(shard))
     if not os.path.isdir(d):
         return []
-    best: dict[int, int] = {}
+    found: list[tuple[int, int]] = []
     for name in os.listdir(d):
         if not name.startswith("fileset-") or not name.endswith("-checkpoint.db"):
             continue
         parts = name[len("fileset-") : -len(".db")].split("-")
         if len(parts) != 3:
             continue
-        bs, vol = int(parts[0]), int(parts[1])
+        found.append((int(parts[0]), int(parts[1])))
+    if all_volumes:
+        return sorted(found)
+    best: dict[int, int] = {}
+    for bs, vol in found:
         best[bs] = max(best.get(bs, -1), vol)
     return sorted(best.items())

@@ -49,6 +49,8 @@ class Namespace:
         if shard is None:
             shard = Shard(shard_id, self.name, self.opts, self.db_opts,
                           self.fs_root)
+            if self.database is not None:
+                shard.cache = self.database.block_cache
             self.shards[shard_id] = shard
             shard.bootstrap_from_fs(now_ns)
             shard.bootstrapped = True

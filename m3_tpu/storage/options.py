@@ -56,3 +56,6 @@ class DatabaseOptions:
     # device batch geometry for seal/flush encodes
     max_points_per_block: int = 4096
     commitlog_flush_every_bytes: int = 1 << 20
+    # decoded-block LRU entries shared across shards (0 disables; the
+    # WiredList role, reference block/wired_list.go)
+    block_cache_entries: int = 8192

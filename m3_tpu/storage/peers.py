@@ -313,6 +313,8 @@ def repair_shard_block(db, namespace: str, shard_id: int, block_start: int,
     shard._filesets[block_start] = FilesetReader(
         shard.fs_root, namespace, shard_id, block_start, volume
     )
+    if shard.cache is not None:  # cached decodes predate the repair
+        shard.cache.invalidate_block(namespace, shard_id, block_start)
     # peer-only series become queryable
     if ns.index is not None:
         from m3_tpu.utils.ident import decode_tags

@@ -32,12 +32,28 @@ class Shard:
         self.buffer = ShardBuffer(opts.retention.block_size_ns)
         self._filesets: dict[int, FilesetReader] = {}  # block_start -> reader
         self.bootstrapped = False
+        self.cache = None  # decoded-block LRU, set by the owning Database
+        # per-window write sequence vs last-snapshotted sequence: lets the
+        # snapshot loop skip windows with no new writes (dirty tracking)
+        self._write_seq: dict[int, int] = {}
+        self._snap_seq: dict[int, int] = {}
 
     # -- write --
 
     def write(self, series_id: bytes, t_ns: int, value_bits: int,
               encoded_tags: bytes = b"") -> int:
+        bs = self.opts.retention.block_start(t_ns)
+        self._write_seq[bs] = self._write_seq.get(bs, 0) + 1
         return self.buffer.write(series_id, t_ns, value_bits, encoded_tags)
+
+    def write_seq(self, block_start: int) -> int:
+        return self._write_seq.get(block_start, 0)
+
+    def snapshotted_seq(self, block_start: int) -> int | None:
+        return self._snap_seq.get(block_start)
+
+    def mark_snapshotted(self, block_start: int, seq: int) -> None:
+        self._snap_seq[block_start] = seq
 
     # -- read --
 
@@ -49,19 +65,32 @@ class Shard:
         for bs, reader in self._filesets.items():
             if bs + reader.block_size_ns <= start_ns or bs >= end_ns:
                 continue
+            key = (self.namespace, self.shard_id, bs, series_id)
+            cached = self.cache.get(key) if self.cache is not None else None
+            if cached is not None:
+                ct, cv = cached
+                if len(ct):
+                    parts_t.append(ct)
+                    parts_v.append(cv)
+                continue
             stream = reader.read(series_id)
+            ct = np.empty(0, np.int64)
+            cv = np.empty(0, np.uint64)
             if stream:
                 dps = scalar_decode(
                     stream, int_optimized=self.opts.int_optimized,
                     default_time_unit=self.opts.write_time_unit,
                 )
                 if dps:
-                    parts_t.append(np.array([d.timestamp_ns for d in dps], np.int64))
-                    parts_v.append(
-                        np.array(
-                            [np.float64(d.value) for d in dps], np.float64
-                        ).view(np.uint64)
-                    )
+                    ct = np.array([d.timestamp_ns for d in dps], np.int64)
+                    cv = np.array(
+                        [np.float64(d.value) for d in dps], np.float64
+                    ).view(np.uint64)
+            if self.cache is not None:  # negative results cached too
+                self.cache.put(key, (ct, cv))
+            if len(ct):
+                parts_t.append(ct)
+                parts_v.append(cv)
         bt, bv = self.buffer.read(series_id, start_ns, end_ns)
         if len(bt):
             parts_t.append(bt)
@@ -78,6 +107,50 @@ class Shard:
         for reader in self._filesets.values():
             ids.update(reader.series_ids())
         return ids
+
+    # -- snapshots --
+
+    def snapshot(self, block_start: int, snapshot_root: str,
+                 snapshot_id: int) -> bool:
+        """Write the window's CURRENT buffer contents as a snapshot fileset
+        under snapshot_root (volume = snapshot_id, monotonic). The buffer
+        keeps the data — snapshots exist so commitlogs can retire early and
+        restarts recover in-flight blocks without replaying the whole WAL
+        (the flush-model snapshot role, reference storage/README.md,
+        persist/fs/snapshot_metadata_{read,write}.go)."""
+        import jax.numpy as jnp
+
+        from m3_tpu.encoding.m3tsz import tpu as m3tsz_tpu
+
+        sealed = self.buffer.seal(block_start, drop=False)
+        if sealed is None:
+            return False
+        ids = [self.buffer.series_ids[i] for i in sealed.series_indices]
+        tags = [self.buffer.series_tags[i] for i in sealed.series_indices]
+        if self.opts.int_optimized:
+            from m3_tpu.encoding.m3tsz import tpu_int
+
+            encode_fn = tpu_int.encode_bits_int
+        else:
+            encode_fn = m3tsz_tpu.encode_bits
+        blocks = encode_fn(
+            jnp.asarray(sealed.times),
+            jnp.asarray(sealed.value_bits),
+            jnp.asarray(sealed.starts),
+            jnp.asarray(sealed.n_points),
+            self.opts.write_time_unit,
+        )
+        if bool(blocks.overflow):
+            return False
+        streams = m3tsz_tpu.blocks_to_bytes(blocks)
+        writer = FilesetWriter(
+            snapshot_root, self.namespace, self.shard_id, block_start,
+            self.opts.retention.block_size_ns, snapshot_id,
+        )
+        for sid, stags, stream in zip(ids, tags, streams):
+            writer.write_series(sid, stags, stream)
+        writer.close()
+        return True
 
     # -- flush --
 
@@ -186,6 +259,9 @@ class Shard:
         self._filesets[block_start] = FilesetReader(
             self.fs_root, self.namespace, self.shard_id, block_start, volume
         )
+        if self.cache is not None:  # cached decodes are for the old volume
+            self.cache.invalidate_block(self.namespace, self.shard_id,
+                                        block_start)
         self.buffer.drop_window(block_start)  # volume durable: buffer copy done
         return True
 

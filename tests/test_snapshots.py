@@ -1,0 +1,173 @@
+"""Snapshots, the decoded-block cache, and the mmap fileset reader.
+
+Reference model under test: warm flush -> rotate commitlog -> snapshot ->
+drop log (storage/README.md), the WiredList block cache
+(block/wired_list.go), and the seeker-style fileset access
+(persist/fs/seek.go)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from m3_tpu.storage.commitlog import log_files
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions
+
+START = 1_600_000_000_000_000_000
+HOUR = 3600 * 10**9
+
+
+def _write_points(db, n=12, name=b"m"):
+    for j in range(n):
+        db.write_tagged("default", name, [(b"k", b"v")],
+                        START + (j + 1) * 10**9, float(j))
+
+
+class TestSnapshots:
+    def test_restart_recovers_unflushed_from_snapshot(self, tmp_path):
+        """The VERDICT scenario: data only in buffers, commitlog retired
+        via snapshot coverage, restart recovers from the snapshot."""
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=2))
+        db.create_namespace("default")
+        db.open(START)
+        _write_points(db)
+        # tick 1: snapshot + rotate the active log (window still open)
+        s1 = db.tick(START + 60 * 10**9)
+        assert s1["snapshotted"] > 0 and s1["flushed"] == 0
+        # tick 2: a LATER snapshot covers the retired log -> it is deleted
+        db.tick(START + 120 * 10**9)
+        logs = log_files(db.commitlog_dir("default"))
+        retired_paths = [p for p, _, _ in db._retired_logs.get("default", [])]
+        assert retired_paths == []  # retired logs reclaimed via snapshots
+        assert len(logs) == 1  # only the fresh active log remains
+        db.close()
+
+        # wipe remaining commitlogs entirely: recovery must not need them
+        for p in log_files(db.commitlog_dir("default")):
+            os.remove(p)
+        db2 = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=2))
+        db2.create_namespace("default")
+        db2.open(START + 130 * 10**9)
+        dps = db2.query("default", [], START, START + HOUR)
+        assert [d.value for d in dps[0][2]] == [float(j) for j in range(12)]
+        db2.close()
+
+    def test_snapshot_removed_after_flush(self, tmp_path):
+        from m3_tpu.storage.fileset import list_filesets
+
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=1))
+        db.create_namespace("default")
+        db.open(START)
+        _write_points(db)
+        db.tick(START + 60 * 10**9)  # snapshot while open
+        assert any(
+            list_filesets(db.snapshots_root, "default", s, all_volumes=True)
+            for s in range(1)
+        )
+        db.tick(START + 5 * HOUR)  # window flushes; snapshot obsolete
+        assert not any(
+            list_filesets(db.snapshots_root, "default", s, all_volumes=True)
+            for s in range(1)
+        )
+        db.close()
+
+    def test_snapshot_disabled_namespace(self, tmp_path):
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=1))
+        db.create_namespace("default", NamespaceOptions(snapshot_enabled=False))
+        db.open(START)
+        _write_points(db)
+        stats = db.tick(START + 60 * 10**9)
+        assert stats["snapshotted"] == 0
+        db.close()
+
+    def test_superseded_snapshot_volumes_reclaimed(self, tmp_path):
+        from m3_tpu.storage.fileset import list_filesets
+
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=1))
+        db.create_namespace("default")
+        db.open(START)
+        _write_points(db)
+        db.tick(START + 60 * 10**9)
+        _write_points(db, name=b"m2")
+        db.tick(START + 120 * 10**9)
+        vols = list_filesets(db.snapshots_root, "default", 0, all_volumes=True)
+        # one snapshot volume per window remains (older superseded ones gone)
+        by_bs = {}
+        for bs, vol in vols:
+            by_bs.setdefault(bs, []).append(vol)
+        assert all(len(v) == 1 for v in by_bs.values()), vols
+        db.close()
+
+
+class TestBlockCache:
+    def test_cache_hits_and_flush_invalidation(self, tmp_path):
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=1))
+        db.create_namespace("default")
+        db.open(START)
+        _write_points(db)
+        db.tick(START + 5 * HOUR)  # flush to fileset
+        db.query("default", [], START, START + HOUR)
+        misses0 = db.block_cache.misses
+        hits0 = db.block_cache.hits
+        assert misses0 > 0
+        db.query("default", [], START, START + HOUR)
+        assert db.block_cache.hits > hits0
+        assert db.block_cache.misses == misses0  # second read fully cached
+        # a cold write to the flushed window forces a re-flush -> invalidate
+        db.write("default", b"m\x00k=v"[:1], START + 2 * 10**9, 99.0)
+        db.close()
+
+    def test_cache_disabled(self, tmp_path):
+        db = Database(str(tmp_path / "db"),
+                      DatabaseOptions(n_shards=1, block_cache_entries=0))
+        db.create_namespace("default")
+        db.open(START)
+        _write_points(db)
+        db.tick(START + 5 * HOUR)
+        db.query("default", [], START, START + HOUR)
+        db.query("default", [], START, START + HOUR)
+        assert len(db.block_cache) == 0
+        db.close()
+
+
+class TestMmapReader:
+    def test_large_fileset_seek(self, tmp_path):
+        """Summaries bisect + bounded scan finds every series; no full
+        index materialization is needed for point reads."""
+        from m3_tpu.storage.fileset import FilesetReader, FilesetWriter
+
+        w = FilesetWriter(str(tmp_path), "ns", 0, START, HOUR, 0)
+        n = 1000
+        for i in range(n):
+            w.write_series(b"series-%06d" % i, b"tags%d" % i, b"stream-%d" % i)
+        w.close()
+        r = FilesetReader(str(tmp_path), "ns", 0, START, 0)
+        assert r.n_series == n
+        for i in (0, 1, 31, 32, 33, 500, 999):
+            assert r.read(b"series-%06d" % i) == b"stream-%d" % i
+            assert r.tags_of(b"series-%06d" % i) == b"tags%d" % i
+        assert r.read(b"series-999999") is None
+        assert r.read(b"aaa") is None
+        assert r.read(b"zzz") is None
+        sid, tags, stream = r.read_at(42)
+        assert (sid, tags, stream) == (b"series-000042", b"tags42", b"stream-42")
+        assert r.series_ids()[:2] == [b"series-000000", b"series-000001"]
+        r.close()
+
+    def test_legacy_fileset_without_offsets(self, tmp_path):
+        """Pre-offsets filesets fall back to a one-time index scan."""
+        from m3_tpu.storage.fileset import FilesetReader, FilesetWriter, fileset_path
+
+        w = FilesetWriter(str(tmp_path), "ns", 0, START, HOUR, 0)
+        for i in range(100):
+            w.write_series(b"s%03d" % i, b"t%d" % i, b"d%d" % i)
+        w.close()
+        os.remove(fileset_path(str(tmp_path), "ns", 0, START, 0, "offsets"))
+        r = FilesetReader(str(tmp_path), "ns", 0, START, 0, verify=False)
+        assert r.read(b"s050") == b"d50"
+        assert r.entry_at(7) == (b"s007", b"t7")
+        assert len(r.series_ids()) == 100
+        r.close()
