@@ -136,19 +136,97 @@ class KVStore:
 
 
 class FileKVStore(KVStore):
-    """KV durably journaled to a JSON file (single-host etcd stand-in)."""
+    """KV durably journaled to a JSON file (single-host etcd stand-in).
+
+    Safe for MULTIPLE PROCESSES sharing the file (the test/dev cluster
+    topology): reads reload the file when its identity changed on disk,
+    and mutations hold an OS file lock across reload-apply-persist so
+    cross-process check_and_set keeps its CAS meaning. (Watches remain
+    process-local; services poll by version, which is the cross-process
+    change-detection mechanism.)"""
 
     def __init__(self, path: str):
         super().__init__()
         self._path = path
+        self._lock_path = path + ".lock"
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        if os.path.exists(path):
-            with open(path) as f:
-                raw = json.load(f)
-            self._data = {
-                k: VersionedValue(v["version"], bytes.fromhex(v["data"]))
-                for k, v in raw.items()
-            }
+        self._loaded_sig = ()
+        self._reload()
+
+    def _file_sig(self):
+        try:
+            st = os.stat(self._path)
+            return (st.st_mtime_ns, st.st_size, st.st_ino)
+        except FileNotFoundError:
+            return None
+
+    def _reload(self) -> None:
+        sig = self._file_sig()
+        if sig == self._loaded_sig:
+            return
+        if sig is None:
+            self._data = {}
+            self._loaded_sig = None
+            return
+        for _attempt in range(3):  # os.replace races re-read harmlessly
+            try:
+                with open(self._path) as f:
+                    raw = json.load(f)
+                break
+            except (json.JSONDecodeError, FileNotFoundError):
+                sig = self._file_sig()
+        else:
+            return
+        self._data = {
+            k: VersionedValue(v["version"], bytes.fromhex(v["data"]))
+            for k, v in raw.items()
+        }
+        self._loaded_sig = sig
+
+    import contextlib as _contextlib
+
+    @_contextlib.contextmanager
+    def _file_lock(self):
+        import fcntl
+
+        with open(self._lock_path, "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)
+
+    # reads observe external writers
+    def get(self, key: str) -> VersionedValue:
+        with self._lock:
+            self._reload()
+            return super().get(key)
+
+    def keys(self, prefix: str = "") -> list[str]:
+        with self._lock:
+            self._reload()
+            return super().keys(prefix)
+
+    # mutations are serialized across processes
+    def set(self, key: str, data: bytes) -> int:
+        with self._lock, self._file_lock():
+            self._reload()
+            return super().set(key, data)
+
+    def set_if_not_exists(self, key: str, data: bytes) -> int:
+        with self._lock, self._file_lock():
+            self._reload()
+            return super().set_if_not_exists(key, data)
+
+    def check_and_set(self, key: str, expect_version: int, data: bytes) -> int:
+        with self._lock, self._file_lock():
+            self._reload()
+            return super().check_and_set(key, expect_version, data)
+
+    def delete(self, key: str) -> None:
+        with self._lock, self._file_lock():
+            self._reload()
+            super().delete(key)
 
     def _persist(self) -> None:
         tmp = self._path + ".tmp"
@@ -163,3 +241,4 @@ class FileKVStore(KVStore):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._path)
+        self._loaded_sig = self._file_sig()

@@ -1,0 +1,217 @@
+"""Topic metadata in KV + topic-routed publishing.
+
+Role parity with the reference msg/topic (types.go: a topic names a shard
+space and the consumer services subscribed to it, each Shared or
+Replicated) and the producer's consumer-service writers
+(msg/producer/writer/consumer_service_writer.go): the round-1 gap was
+shard->consumer routing hardcoded per connection. A TopicProducer resolves
+each consumer service's PLACEMENT from KV to find which instance owns each
+topic shard and routes publishes accordingly.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from m3_tpu.cluster import placement as pl
+from m3_tpu.msg.producer import Producer
+
+SHARED = "shared"          # each message goes to ONE owner of its shard
+REPLICATED = "replicated"  # each message goes to EVERY owner of its shard
+
+_TOPIC_PREFIX = "topics/"
+
+
+@dataclass
+class ConsumerService:
+    service_id: str  # its placement lives at placements/<service_id>
+    consumption_type: str = SHARED
+
+
+@dataclass
+class Topic:
+    name: str
+    n_shards: int
+    consumer_services: list[ConsumerService] = field(default_factory=list)
+    version: int = 0
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "name": self.name,
+            "n_shards": self.n_shards,
+            "consumer_services": [
+                {"service_id": c.service_id,
+                 "consumption_type": c.consumption_type}
+                for c in self.consumer_services
+            ],
+            "version": self.version,
+        }).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "Topic":
+        doc = json.loads(raw)
+        return cls(
+            name=doc["name"],
+            n_shards=doc["n_shards"],
+            consumer_services=[
+                ConsumerService(c["service_id"],
+                                c.get("consumption_type", SHARED))
+                for c in doc.get("consumer_services", [])
+            ],
+            version=doc.get("version", 0),
+        )
+
+
+def topic_key(name: str) -> str:
+    return _TOPIC_PREFIX + name
+
+
+def get_topic(kv, name: str) -> Topic | None:
+    from m3_tpu.cluster.kv import KeyNotFound
+
+    try:
+        vv = kv.get(topic_key(name))
+    except KeyNotFound:
+        return None
+    return Topic.from_json(vv.data)
+
+
+def create_topic(kv, topic: Topic) -> int:
+    topic.version += 1
+    return kv.set_if_not_exists(topic_key(topic.name), topic.to_json())
+
+
+def put_topic(kv, topic: Topic) -> int:
+    topic.version += 1
+    return kv.set(topic_key(topic.name), topic.to_json())
+
+
+def delete_topic(kv, name: str) -> None:
+    kv.delete(topic_key(name))
+
+
+def list_topics(kv) -> list[str]:
+    return [k[len(_TOPIC_PREFIX):] for k in kv.keys(_TOPIC_PREFIX)]
+
+
+def _cas_update_topic(kv, name: str, fn, max_retries: int = 10) -> Topic:
+    """CAS read-modify-write: concurrent consumer edits must not lose each
+    other (same discipline as cluster/placement.cas_update_placement)."""
+    from m3_tpu.cluster.kv import KeyNotFound, VersionMismatch
+
+    for _ in range(max_retries):
+        try:
+            vv = kv.get(topic_key(name))
+        except KeyNotFound:
+            raise KeyError(f"no topic {name!r}") from None
+        t = Topic.from_json(vv.data)
+        t = fn(t)
+        t.version += 1
+        try:
+            kv.check_and_set(topic_key(name), vv.version, t.to_json())
+            return t
+        except VersionMismatch:
+            continue
+    raise RuntimeError(f"topic CAS contention on {name!r}")
+
+
+def add_consumer(kv, name: str, consumer: ConsumerService) -> Topic:
+    def add(t: Topic) -> Topic:
+        if not any(c.service_id == consumer.service_id
+                   for c in t.consumer_services):
+            t.consumer_services.append(consumer)
+        return t
+
+    return _cas_update_topic(kv, name, add)
+
+
+def remove_consumer(kv, name: str, service_id: str) -> Topic:
+    def drop(t: Topic) -> Topic:
+        t.consumer_services = [
+            c for c in t.consumer_services if c.service_id != service_id
+        ]
+        return t
+
+    return _cas_update_topic(kv, name, drop)
+
+
+class TopicProducer:
+    """Publishes to every consumer service of a topic, routing each shard
+    to the instance(s) owning it in the consumer service's placement."""
+
+    def __init__(self, kv, topic_name: str, producer_factory=None):
+        self.kv = kv
+        self.topic_name = topic_name
+        self._factory = producer_factory or (
+            lambda endpoint: Producer(endpoint))
+        self._producers: dict[str, Producer] = {}  # endpoint str -> producer
+        self._routing: list[tuple[str, dict[int, list[str]]]] = []
+        self._topic_version = -1
+        self._placement_versions: dict[str, int] = {}
+        self.refresh()
+
+    def refresh(self) -> None:
+        """Re-resolve topic + consumer placements from KV (call on watch
+        ticks; skips the rebuild when every version is unchanged)."""
+        t = get_topic(self.kv, self.topic_name)
+        if t is None:
+            raise KeyError(f"no topic {self.topic_name!r}")
+        placements = {}
+        versions: dict[str, int] = {}
+        for c in t.consumer_services:
+            loaded = pl.load_placement(self.kv, f"placements/{c.service_id}")
+            if loaded is None:
+                continue
+            placements[c.service_id] = loaded[0]
+            versions[c.service_id] = loaded[1]
+        if (t.version == self._topic_version
+                and versions == self._placement_versions):
+            return
+        routing: list[tuple[str, dict[int, list[str]]]] = []
+        for c in t.consumer_services:
+            placement = placements.get(c.service_id)
+            if placement is None:
+                continue
+            shard_map: dict[int, list[str]] = {}
+            for inst in placement.instances.values():
+                if not inst.endpoint:
+                    continue
+                for sid in inst.shards:
+                    shard_map.setdefault(sid, []).append(inst.endpoint)
+            routing.append((c.consumption_type, shard_map))
+        self._routing = routing
+        self._topic_version = t.version
+        self._placement_versions = versions
+        self.n_shards = t.n_shards
+
+    def _producer_for(self, endpoint: str) -> Producer:
+        p = self._producers.get(endpoint)
+        if p is None:
+            from m3_tpu.client.http_conn import parse_endpoint
+
+            p = self._factory(parse_endpoint(endpoint))
+            self._producers[endpoint] = p
+        return p
+
+    def publish(self, shard: int, payload: bytes) -> int:
+        """Route to every consumer service; Shared sends to the shard's
+        first owner, Replicated to all owners. Returns sends issued."""
+        sent = 0
+        for ctype, shard_map in self._routing:
+            owners = shard_map.get(shard % self.n_shards, [])
+            if not owners:
+                continue
+            targets = owners if ctype == REPLICATED else owners[:1]
+            for endpoint in targets:
+                self._producer_for(endpoint).publish(shard, payload)
+                sent += 1
+        return sent
+
+    @property
+    def unacked(self) -> int:
+        return sum(p.unacked for p in self._producers.values())
+
+    def close(self) -> None:
+        for p in self._producers.values():
+            p.close()

@@ -1,0 +1,307 @@
+"""Cluster admin HTTP surface: database/namespace/placement/topic CRUD.
+
+Role parity with the reference coordinator admin routes
+(/root/reference/src/query/api/v1/httpd/handler.go:175-247 — database
+create, namespace CRUD, placement init/add/remove/replace via
+cluster/placementhandler, topic CRUD) so a cluster is stood up with curl
+exactly like the reference quickstart. Namespaces live in a KV registry
+that storage nodes watch (the dynamic namespace-registry role,
+dbnode/namespace/dynamic); placements/topics use the KV helpers in
+cluster/placement.py and msg/topic.py.
+"""
+
+from __future__ import annotations
+
+import json
+
+from m3_tpu.cluster import placement as pl
+from m3_tpu.cluster.placement import Instance
+from m3_tpu.msg import topic as topiclib
+
+NAMESPACE_KEY = "namespaces/m3db"
+
+
+def load_namespace_registry(kv) -> dict[str, dict]:
+    from m3_tpu.cluster.kv import KeyNotFound
+
+    try:
+        vv = kv.get(NAMESPACE_KEY)
+    except KeyNotFound:
+        return {}
+    return json.loads(vv.data)
+
+
+def store_namespace_registry(kv, registry: dict[str, dict]) -> int:
+    return kv.set(NAMESPACE_KEY, json.dumps(registry).encode())
+
+
+def update_namespace_registry(kv, fn, max_retries: int = 10) -> dict:
+    """CAS read-modify-write of the registry: concurrent admin calls must
+    not lose each other's namespaces."""
+    from m3_tpu.cluster.kv import KeyNotFound, VersionMismatch
+
+    for _ in range(max_retries):
+        try:
+            vv = kv.get(NAMESPACE_KEY)
+            registry, version = json.loads(vv.data), vv.version
+        except KeyNotFound:
+            registry, version = {}, 0
+        registry = fn(dict(registry))
+        try:
+            kv.check_and_set(NAMESPACE_KEY, version,
+                             json.dumps(registry).encode())
+            return registry
+        except VersionMismatch:
+            continue
+    raise RuntimeError("namespace registry CAS contention")
+
+
+class AdminAPI:
+    """Admin handlers; mounted under the coordinator HTTP server."""
+
+    def __init__(self, db, kv=None, placement_key: str | None = None):
+        self.db = db
+        self.kv = kv
+        self.placement_key = placement_key or pl.PLACEMENT_KEY
+
+    def handle(self, method: str, path: str, q: dict, body: bytes):
+        """Returns (status, payload) or None when the path isn't admin."""
+        try:
+            return self._route(method, path, q, body)
+        except KeyError as e:
+            return 404, json.dumps({"error": str(e)}).encode()
+        except Exception as e:  # noqa: BLE001
+            return 400, json.dumps({"error": str(e)}).encode()
+
+    def _route(self, method, path, q, body):
+        doc = json.loads(body) if body else {}
+        if path == "/api/v1/database/create" and method == "POST":
+            return self._database_create(doc)
+        if path == "/api/v1/services/m3db/namespace":
+            if method == "GET":
+                return self._namespace_list()
+            if method == "POST":
+                return self._namespace_create(doc)
+        if path.startswith("/api/v1/services/m3db/namespace/") and method == "DELETE":
+            return self._namespace_delete(path.rsplit("/", 1)[1])
+        if path == "/api/v1/services/m3db/placement":
+            if method == "GET":
+                return self._placement_get()
+            if method == "POST":
+                return self._placement_add(doc)
+        if path == "/api/v1/services/m3db/placement/init" and method == "POST":
+            return self._placement_init(doc)
+        if path == "/api/v1/services/m3db/placement/replace" and method == "POST":
+            return self._placement_replace(doc)
+        if path.startswith("/api/v1/services/m3db/placement/") and method == "DELETE":
+            return self._placement_remove(path.rsplit("/", 1)[1])
+        if path == "/api/v1/topic":
+            if method == "GET":
+                return self._topic_get(q)
+            if method == "POST":
+                return self._topic_init(doc)
+            if method == "DELETE":
+                return self._topic_delete(q)
+        if path == "/api/v1/topic/consumer" and method == "POST":
+            return self._topic_add_consumer(doc)
+        if path.startswith("/api/v1/topic/consumer/") and method == "DELETE":
+            return self._topic_remove_consumer(q, path.rsplit("/", 1)[1])
+        return None
+
+    # -- database / namespaces --
+
+    def _ns_options_doc(self, doc: dict) -> dict:
+        return {
+            "retention": {
+                "period": doc.get("retentionTime", doc.get("retention", "48h")),
+                "block_size": doc.get("blockSize", "2h"),
+            },
+            "int_optimized": bool(doc.get("intOptimized", False)),
+        }
+
+    def _create_local_namespace(self, name: str, opts_doc: dict) -> None:
+        create = getattr(self.db, "create_namespace", None)
+        if create is None:
+            return
+        from m3_tpu.services.coordinator import namespace_options
+
+        create(name, namespace_options(opts_doc))
+
+    def _validate_ns_options(self, opts_doc: dict) -> None:
+        """Reject unparseable options BEFORE they land in the registry —
+        a bad duration there would crash-loop every storage node's sync."""
+        from m3_tpu.services.coordinator import namespace_options
+
+        namespace_options(opts_doc)
+
+    def _register_namespace(self, name: str, opts_doc: dict) -> None:
+        self._validate_ns_options(opts_doc)
+        if self.kv is not None:
+            def add(reg):
+                reg[name] = opts_doc
+                return reg
+
+            update_namespace_registry(self.kv, add)
+        self._create_local_namespace(name, opts_doc)
+
+    def _database_create(self, doc: dict):
+        """The one-shot quickstart: namespace (+ placement for type=cluster)."""
+        name = doc.get("namespaceName", "default")
+        self._register_namespace(name, self._ns_options_doc(doc))
+        out = {"namespace": name}
+        if doc.get("type") == "cluster" and self.kv is not None and doc.get("instances"):
+            _, pdoc = self._placement_init(doc)
+            out["placement"] = json.loads(pdoc)
+        return 200, json.dumps(out).encode()
+
+    def _namespace_list(self):
+        if self.kv is not None:
+            registry = load_namespace_registry(self.kv)
+        else:
+            registry = {name: {} for name in getattr(self.db, "namespaces", {})}
+        return 200, json.dumps({"registry": registry}).encode()
+
+    def _namespace_create(self, doc: dict):
+        name = doc["name"]
+        self._register_namespace(name, doc.get("options")
+                                 or self._ns_options_doc(doc))
+        return 200, json.dumps({"created": name}).encode()
+
+    def _namespace_delete(self, name: str):
+        if self.kv is not None:
+            missing = []
+
+            def drop(reg):
+                if name not in reg:
+                    missing.append(True)
+                else:
+                    del reg[name]
+                return reg
+
+            update_namespace_registry(self.kv, drop)
+            if missing:
+                raise KeyError(f"namespace {name!r} not registered")
+        drop_local = getattr(self.db, "drop_namespace", None)
+        if drop_local is not None:
+            drop_local(name)
+        else:
+            namespaces = getattr(self.db, "namespaces", None)
+            if namespaces is not None:
+                namespaces.pop(name, None)
+        return 200, json.dumps({"deleted": name}).encode()
+
+    # -- placements --
+
+    def _require_kv(self):
+        if self.kv is None:
+            raise ValueError("placement/topic admin requires a KV store "
+                             "(cluster mode)")
+
+    def _placement_doc(self, p) -> bytes:
+        return json.dumps(json.loads(p.to_json())).encode()
+
+    def _placement_get(self):
+        self._require_kv()
+        loaded = pl.load_placement(self.kv, self.placement_key)
+        if loaded is None:
+            raise KeyError("no placement")
+        return 200, self._placement_doc(loaded[0])
+
+    @staticmethod
+    def _instance(doc: dict) -> Instance:
+        return Instance(
+            id=doc["id"],
+            isolation_group=doc.get("isolation_group",
+                                    doc.get("isolationGroup", "default")),
+            weight=int(doc.get("weight", 1)),
+            endpoint=doc.get("endpoint", ""),
+        )
+
+    def _placement_init(self, doc: dict):
+        self._require_kv()
+        instances = [self._instance(d) for d in doc["instances"]]
+        p = pl.initial_placement(
+            instances,
+            n_shards=int(doc.get("num_shards", doc.get("numShards", 8))),
+            replica_factor=int(doc.get("replication_factor",
+                                       doc.get("replicationFactor", 1))),
+        )
+        pl.store_placement(self.kv, p, self.placement_key)
+        return 200, self._placement_doc(p)
+
+    def _placement_add(self, doc: dict):
+        self._require_kv()
+        inst = self._instance(doc.get("instance", doc))
+        new = pl.cas_update_placement(
+            self.kv, lambda p: pl.add_instance(p, inst), self.placement_key)
+        return 200, self._placement_doc(new)
+
+    def _placement_remove(self, instance_id: str):
+        self._require_kv()
+        new = pl.cas_update_placement(
+            self.kv, lambda p: pl.remove_instance(p, instance_id),
+            self.placement_key)
+        return 200, self._placement_doc(new)
+
+    def _placement_replace(self, doc: dict):
+        self._require_kv()
+        old_id = doc["leavingInstanceID"] if "leavingInstanceID" in doc else doc["old_id"]
+        inst = self._instance(doc.get("candidate", doc.get("instance", doc)))
+        new = pl.cas_update_placement(
+            self.kv, lambda p: pl.replace_instance(p, old_id, inst),
+            self.placement_key)
+        return 200, self._placement_doc(new)
+
+    # -- topics --
+
+    def _topic_name(self, q: dict, doc: dict | None = None) -> str:
+        if doc and doc.get("name"):
+            return doc["name"]
+        return q.get("topic", ["aggregated_metrics"])[0]
+
+    def _topic_get(self, q):
+        self._require_kv()
+        t = topiclib.get_topic(self.kv, self._topic_name(q))
+        if t is None:
+            raise KeyError("no such topic")
+        return 200, t.to_json()
+
+    def _topic_init(self, doc: dict):
+        self._require_kv()
+        name = doc.get("name", "aggregated_metrics")
+        if topiclib.get_topic(self.kv, name) is not None:
+            # re-init would wipe registered consumer services
+            return 409, json.dumps(
+                {"error": f"topic {name!r} already exists"}).encode()
+        t = topiclib.Topic(
+            name=name,
+            n_shards=int(doc.get("numberOfShards", doc.get("n_shards", 64))),
+        )
+        topiclib.create_topic(self.kv, t)
+        return 200, t.to_json()
+
+    def _topic_delete(self, q):
+        self._require_kv()
+        name = self._topic_name(q)
+        topiclib.delete_topic(self.kv, name)
+        return 200, json.dumps({"deleted": name}).encode()
+
+    def _topic_add_consumer(self, doc: dict):
+        self._require_kv()
+        c = doc.get("consumerService", doc)
+        t = topiclib.add_consumer(
+            self.kv, self._topic_name({}, doc),
+            topiclib.ConsumerService(
+                c.get("serviceID", {}).get("name")
+                if isinstance(c.get("serviceID"), dict)
+                else c.get("service_id", c.get("serviceID", "")),
+                c.get("consumptionType",
+                      c.get("consumption_type", topiclib.SHARED)).lower(),
+            ),
+        )
+        return 200, t.to_json()
+
+    def _topic_remove_consumer(self, q, service_id: str):
+        self._require_kv()
+        t = topiclib.remove_consumer(self.kv, self._topic_name(q), service_id)
+        return 200, t.to_json()

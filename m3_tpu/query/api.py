@@ -98,6 +98,8 @@ class CoordinatorAPI:
         # optional DownsamplerAndWriter: ingest then fans out through the
         # embedded downsampler (coordinator service wiring)
         self.writer = None
+        # optional AdminAPI (namespace/placement/topic CRUD; query/admin.py)
+        self.admin = None
 
     def _write(self, name: bytes, tags, t_ns: int, value: float):
         if self.writer is not None:
@@ -130,8 +132,23 @@ class CoordinatorAPI:
                 limits.end_query()
 
     def _route(self, method, path, q, body):
-        if path in ("/health", "/ready"):
+        if path == "/health":
             return 200, "application/json", b'{"ok":true}'
+        if path == "/ready":
+            # ready == the storage below is open/bootstrapped
+            ready = bool(getattr(self.db, "_open", True))
+            return (200 if ready else 503), "application/json", json.dumps(
+                {"ready": ready}
+            ).encode()
+        if self.admin is not None and (
+            path.startswith("/api/v1/services/")
+            or path.startswith("/api/v1/database/")
+            or path.startswith("/api/v1/topic")
+        ):
+            res = self.admin.handle(method, path, q, body)
+            if res is not None:
+                status, payload = res
+                return status, "application/json", payload
         if path == "/metrics":
             from m3_tpu.utils.instrument import default_registry
 
@@ -452,6 +469,12 @@ class CoordinatorAPI:
 
             def do_POST(self):  # noqa: N802
                 self._do("POST")
+
+            def do_DELETE(self):  # noqa: N802
+                self._do("DELETE")
+
+            def do_PUT(self):  # noqa: N802
+                self._do("PUT")
 
             def log_message(self, *a):  # quiet
                 pass

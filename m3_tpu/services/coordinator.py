@@ -127,6 +127,12 @@ class CoordinatorService:
         self.api = CoordinatorAPI(self.db, db_cfg.get("namespace", "default"),
                                   limits=limits)
         self.api.writer = self.writer  # ingest fans out through downsampler
+        from m3_tpu.query.admin import AdminAPI
+
+        self.api.admin = AdminAPI(
+            self.db, kv=self.kv,
+            placement_key=cl_cfg.get("placement_key"),
+        )
         self.carbon: CarbonIngester | None = None
         self._stop = threading.Event()
 

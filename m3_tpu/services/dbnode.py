@@ -338,11 +338,57 @@ class DBNodeService:
         p, version = self._load_placement()
         return p is not None and version != self._placement_version
 
+    def sync_namespaces(self) -> None:
+        """Reconcile local namespaces with the KV registry (the dynamic
+        namespace-registry watch, reference dbnode/namespace/dynamic):
+        admin-created namespaces appear on every node without restarts."""
+        from m3_tpu.cluster.kv import KeyNotFound
+        from m3_tpu.query.admin import NAMESPACE_KEY, load_namespace_registry
+
+        try:
+            version = self.kv.get(NAMESPACE_KEY).version
+        except KeyNotFound:
+            return
+        if version == getattr(self, "_ns_registry_version", -1):
+            return
+        registry = load_namespace_registry(self.kv)
+        created = getattr(self, "_registry_namespaces", set())
+        for name, opts_doc in registry.items():
+            if name in self.db.namespaces:
+                created.add(name)
+                continue
+            try:
+                opts = namespace_options(opts_doc)
+            except Exception as e:  # noqa: BLE001 - a malformed registry
+                # entry (admin validates, but defense in depth) must not
+                # crash-loop every storage node
+                self.log.info("ignoring malformed registry namespace",
+                              name=name, error=str(e))
+                continue
+            self.db.create_namespace(name, opts)
+            created.add(name)
+            self.log.info("namespace created from registry", name=name)
+        # only drop namespaces the REGISTRY created — config-declared ones
+        # (e.g. the default) are not the registry's to delete
+        for name in list(created):
+            if name not in registry and name in self.db.namespaces:
+                self.db.drop_namespace(name)
+                created.discard(name)
+                self.log.info("namespace dropped from registry", name=name)
+        self._registry_namespaces = created
+        self._ns_registry_version = version
+
     def run(self) -> None:
         self.db.open()
         self.log.info("bootstrapped")
         if self.kv is not None:
-            self.sync_placement()
+            try:
+                self.sync_namespaces()
+                self.sync_placement()
+            except Exception as e:  # noqa: BLE001 - a KV hiccup at boot
+                # must not kill the node; the tick loop retries
+                self.log.info("initial cluster sync failed; will retry",
+                              error=str(e))
         http_cfg = self.config.get("http", {}) or {}
         port = self.api.serve(http_cfg.get("host", "0.0.0.0"),
                               http_cfg.get("port", 9000))
@@ -355,8 +401,10 @@ class DBNodeService:
                 if self._stop.is_set():
                     break
                 try:
-                    if self.kv is not None and self._placement_changed():
-                        self.sync_placement()
+                    if self.kv is not None:
+                        self.sync_namespaces()
+                        if self._placement_changed():
+                            self.sync_placement()
                     with scope.timer("tick"):
                         stats = self.db.tick()
                     scope.counter("blocks_flushed", stats["flushed"])

@@ -81,6 +81,20 @@ class Database:
             self._open_commitlog(name)
         return ns
 
+    def drop_namespace(self, name: str) -> None:
+        """Remove a namespace, closing its commitlog writer and retiring
+        its log-tracking state (files on disk are left for the operator)."""
+        ns = self.namespaces.pop(name, None)
+        if ns is None:
+            return
+        log = self._commitlogs.pop(name, None)
+        if log is not None:
+            log.close()
+        self._log_windows.pop(name, None)
+        self._retired_logs.pop(name, None)
+        for key in [k for k in self._snapshot_times if k[0] == name]:
+            del self._snapshot_times[key]
+
     def _open_commitlog(self, namespace: str) -> None:
         d = self.commitlog_dir(namespace)
         path = os.path.join(d, f"commitlog-{int(time.time()*1e9)}.db")

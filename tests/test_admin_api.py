@@ -1,0 +1,229 @@
+"""Admin API: curl-style cluster setup (namespace/placement/topic CRUD,
+database create, /ready) and topic-routed msg publishing.
+
+Reference flow under test: the quickstart's curl sequence against
+api/v1/httpd/handler.go:175-247 routes."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.msg import topic as topiclib
+from m3_tpu.query.api import CoordinatorAPI
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import DatabaseOptions
+
+START = 1_600_000_000_000_000_000
+
+
+def _req(port, method, path, doc=None):
+    body = json.dumps(doc).encode() if doc is not None else None
+    r = urllib.request.urlopen(urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method=method,
+        headers={"Content-Type": "application/json"},
+    ), timeout=10)
+    return json.loads(r.read() or b"{}")
+
+
+@pytest.fixture
+def api(tmp_path):
+    from m3_tpu.query.admin import AdminAPI
+
+    db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+    db.create_namespace("default")
+    db.open(START)
+    api = CoordinatorAPI(db)
+    api.admin = AdminAPI(db, kv=KVStore())
+    port = api.serve(port=0)
+    yield api, port
+    api.shutdown()
+    db.close()
+
+
+class TestNamespaceAdmin:
+    def test_create_list_delete(self, api):
+        a, port = api
+        _req(port, "POST", "/api/v1/services/m3db/namespace",
+             {"name": "agg_1m", "retentionTime": "120h"})
+        out = _req(port, "GET", "/api/v1/services/m3db/namespace")
+        assert "agg_1m" in out["registry"]
+        assert "agg_1m" in a.db.namespaces  # created locally too
+        _req(port, "DELETE", "/api/v1/services/m3db/namespace/agg_1m")
+        out = _req(port, "GET", "/api/v1/services/m3db/namespace")
+        assert "agg_1m" not in out["registry"]
+
+    def test_bad_retention_rejected_before_registry(self, api):
+        a, port = api
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(port, "POST", "/api/v1/services/m3db/namespace",
+                 {"name": "bad", "retentionTime": "12 hours"})
+        assert ei.value.code == 400
+        out = _req(port, "GET", "/api/v1/services/m3db/namespace")
+        assert "bad" not in out["registry"]  # never landed in KV
+
+    def test_database_create(self, api):
+        _, port = api
+        out = _req(port, "POST", "/api/v1/database/create",
+                   {"namespaceName": "quick", "retentionTime": "12h"})
+        assert out["namespace"] == "quick"
+
+    def test_ready(self, api):
+        _, port = api
+        out = _req(port, "GET", "/ready")
+        assert out["ready"] is True
+
+
+class TestPlacementAdmin:
+    def test_init_add_remove(self, api):
+        _, port = api
+        out = _req(port, "POST", "/api/v1/services/m3db/placement/init", {
+            "num_shards": 4, "replication_factor": 1,
+            "instances": [
+                {"id": "node0", "isolation_group": "g0",
+                 "endpoint": "http://127.0.0.1:9101"},
+                {"id": "node1", "isolation_group": "g1",
+                 "endpoint": "http://127.0.0.1:9102"},
+            ],
+        })
+        assert set(out["instances"]) == {"node0", "node1"}
+        out = _req(port, "POST", "/api/v1/services/m3db/placement",
+                   {"id": "node2", "isolation_group": "g2",
+                    "endpoint": "http://127.0.0.1:9103"})
+        assert "node2" in out["instances"]
+        out = _req(port, "DELETE", "/api/v1/services/m3db/placement/node2")
+        inst = out["instances"]
+        # node2 drains: its shards are LEAVING (or it is gone entirely)
+        if "node2" in inst:
+            states = {s["state"] for s in inst["node2"]["shards"]}
+            assert states <= {"LEAVING"}
+        out = _req(port, "GET", "/api/v1/services/m3db/placement")
+        assert "node0" in out["instances"]
+
+    def test_placement_requires_kv(self, tmp_path):
+        from m3_tpu.query.admin import AdminAPI
+
+        db = Database(str(tmp_path / "db2"), DatabaseOptions(n_shards=2))
+        db.create_namespace("default")
+        db.open(START)
+        api = CoordinatorAPI(db)
+        api.admin = AdminAPI(db, kv=None)
+        port = api.serve(port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _req(port, "GET", "/api/v1/services/m3db/placement")
+            assert ei.value.code == 400
+        finally:
+            api.shutdown()
+            db.close()
+
+
+class TestTopicAdmin:
+    def test_topic_crud(self, api):
+        _, port = api
+        out = _req(port, "POST", "/api/v1/topic",
+                   {"name": "aggregated_metrics", "numberOfShards": 16})
+        assert out["n_shards"] == 16
+        # re-init must NOT wipe the topic (would drop consumer services)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(port, "POST", "/api/v1/topic",
+                 {"name": "aggregated_metrics", "numberOfShards": 4})
+        assert ei.value.code == 409
+        out = _req(port, "POST", "/api/v1/topic/consumer", {
+            "name": "aggregated_metrics",
+            "consumerService": {
+                "serviceID": {"name": "m3coordinator"},
+                "consumptionType": "SHARED",
+            },
+        })
+        assert out["consumer_services"][0]["service_id"] == "m3coordinator"
+        out = _req(port, "GET", "/api/v1/topic?topic=aggregated_metrics")
+        assert out["name"] == "aggregated_metrics"
+        _req(port, "DELETE",
+             "/api/v1/topic/consumer/m3coordinator?topic=aggregated_metrics")
+        out = _req(port, "GET", "/api/v1/topic?topic=aggregated_metrics")
+        assert out["consumer_services"] == []
+        _req(port, "DELETE", "/api/v1/topic?topic=aggregated_metrics")
+        with pytest.raises(urllib.error.HTTPError):
+            _req(port, "GET", "/api/v1/topic?topic=aggregated_metrics")
+
+
+class TestTopicProducer:
+    def test_routing_from_placement(self):
+        """TopicProducer resolves shard->instance endpoints from each
+        consumer service's placement in KV."""
+        from m3_tpu.cluster import placement as pl
+        from m3_tpu.cluster.placement import Instance, initial_placement
+
+        kv = KVStore()
+        t = topiclib.Topic("agg", n_shards=4)
+        t.consumer_services.append(
+            topiclib.ConsumerService("svcA", topiclib.SHARED))
+        t.consumer_services.append(
+            topiclib.ConsumerService("svcB", topiclib.REPLICATED))
+        topiclib.put_topic(kv, t)
+        pA = initial_placement(
+            [Instance("a0", isolation_group="g0",
+                      endpoint="127.0.0.1:7001")], 4, 1)
+        pB = initial_placement(
+            [Instance("b0", isolation_group="g0", endpoint="127.0.0.1:7002"),
+             Instance("b1", isolation_group="g1", endpoint="127.0.0.1:7003")],
+            4, 2)
+        pl.store_placement(kv, pA, "placements/svcA")
+        pl.store_placement(kv, pB, "placements/svcB")
+
+        published = []
+
+        class FakeProducer:
+            def __init__(self, endpoint):
+                self.endpoint = endpoint
+                self.unacked = 0
+
+            def publish(self, shard, payload):
+                published.append((self.endpoint, shard, payload))
+
+            def close(self):
+                pass
+
+        tp = topiclib.TopicProducer(kv, "agg", producer_factory=FakeProducer)
+        sent = tp.publish(2, b"x")
+        # SHARED svcA: one send; REPLICATED svcB: both replicas
+        assert sent == 3
+        eps = sorted(ep for ep, _, _ in published)
+        assert eps == [("127.0.0.1", 7001), ("127.0.0.1", 7002),
+                       ("127.0.0.1", 7003)]
+        tp.close()
+
+    def test_dbnode_namespace_registry_sync(self, tmp_path):
+        from m3_tpu.query.admin import (
+            load_namespace_registry,
+            store_namespace_registry,
+        )
+        from m3_tpu.services.dbnode import DBNodeService
+
+        kv = KVStore()
+        from m3_tpu.cluster.placement import Instance, initial_placement
+        from m3_tpu.cluster import placement as pl
+
+        p = initial_placement([Instance("n0", isolation_group="g")], 2, 1)
+        pl.store_placement(kv, p)
+        svc = DBNodeService(
+            {"db": {"path": str(tmp_path / "n0"), "n_shards": 2,
+                    "namespaces": [{"name": "default"}]},
+             "cluster": {"instance_id": "n0"}},
+            kv=kv,
+        )
+        svc.db.open(START)
+        store_namespace_registry(kv, {"agg_10m": {"retention": {"period": "120h"}}})
+        svc.sync_namespaces()
+        assert "agg_10m" in svc.db.namespaces
+        # registry deletion drops it; config-declared default survives
+        store_namespace_registry(kv, {})
+        svc.sync_namespaces()
+        assert "agg_10m" not in svc.db.namespaces
+        assert "default" in svc.db.namespaces
+        svc.db.close()
