@@ -21,6 +21,11 @@ from m3_tpu.msg import topic as topiclib
 NAMESPACE_KEY = "namespaces/m3db"
 
 
+class NotFoundError(KeyError):
+    """Deliberate resource-not-found (maps to HTTP 404; a missing request
+    field is a plain KeyError and maps to 400)."""
+
+
 def load_namespace_registry(kv) -> dict[str, dict]:
     from m3_tpu.cluster.kv import KeyNotFound
 
@@ -68,9 +73,10 @@ class AdminAPI:
         """Returns (status, payload) or None when the path isn't admin."""
         try:
             return self._route(method, path, q, body)
-        except KeyError as e:
-            return 404, json.dumps({"error": str(e)}).encode()
-        except Exception as e:  # noqa: BLE001
+        except NotFoundError as e:
+            return 404, json.dumps({"error": str(e).strip("'")}).encode()
+        except Exception as e:  # noqa: BLE001 - incl. KeyError on a missing
+            # request field, which is a BAD REQUEST, not a 404
             return 400, json.dumps({"error": str(e)}).encode()
 
     def _route(self, method, path, q, body):
@@ -169,18 +175,15 @@ class AdminAPI:
 
     def _namespace_delete(self, name: str):
         if self.kv is not None:
-            missing = []
-
             def drop(reg):
                 if name not in reg:
-                    missing.append(True)
-                else:
-                    del reg[name]
+                    # abort INSIDE the CAS fn: no spurious registry write,
+                    # and a retry that finds the name deletes it normally
+                    raise NotFoundError(f"namespace {name!r} not registered")
+                del reg[name]
                 return reg
 
             update_namespace_registry(self.kv, drop)
-            if missing:
-                raise KeyError(f"namespace {name!r} not registered")
         drop_local = getattr(self.db, "drop_namespace", None)
         if drop_local is not None:
             drop_local(name)
@@ -204,7 +207,7 @@ class AdminAPI:
         self._require_kv()
         loaded = pl.load_placement(self.kv, self.placement_key)
         if loaded is None:
-            raise KeyError("no placement")
+            raise NotFoundError("no placement")
         return 200, self._placement_doc(loaded[0])
 
     @staticmethod
@@ -263,7 +266,7 @@ class AdminAPI:
         self._require_kv()
         t = topiclib.get_topic(self.kv, self._topic_name(q))
         if t is None:
-            raise KeyError("no such topic")
+            raise NotFoundError("no such topic")
         return 200, t.to_json()
 
     def _topic_init(self, doc: dict):
@@ -289,7 +292,8 @@ class AdminAPI:
     def _topic_add_consumer(self, doc: dict):
         self._require_kv()
         c = doc.get("consumerService", doc)
-        t = topiclib.add_consumer(
+        try:
+            t = topiclib.add_consumer(
             self.kv, self._topic_name({}, doc),
             topiclib.ConsumerService(
                 c.get("serviceID", {}).get("name")
@@ -297,11 +301,17 @@ class AdminAPI:
                 else c.get("service_id", c.get("serviceID", "")),
                 c.get("consumptionType",
                       c.get("consumption_type", topiclib.SHARED)).lower(),
-            ),
-        )
+                ),
+            )
+        except KeyError as e:
+            raise NotFoundError(str(e)) from None
         return 200, t.to_json()
 
     def _topic_remove_consumer(self, q, service_id: str):
         self._require_kv()
-        t = topiclib.remove_consumer(self.kv, self._topic_name(q), service_id)
+        try:
+            t = topiclib.remove_consumer(self.kv, self._topic_name(q),
+                                         service_id)
+        except KeyError as e:
+            raise NotFoundError(str(e)) from None
         return 200, t.to_json()

@@ -29,6 +29,7 @@ from m3_tpu.query.promql import (
     MatrixSelector,
     NumberLiteral,
     StringLiteral,
+    SubqueryExpr,
     UnaryExpr,
     VectorMatching,
     VectorSelector,
@@ -78,7 +79,8 @@ class Engine:
 
     def __init__(self, db, namespace: str = "default",
                  lookback_ns: int = DEFAULT_LOOKBACK_NS,
-                 limits: "QueryLimits | None" = None):
+                 limits: "QueryLimits | None" = None,
+                 subquery_step_ns: int = 60 * NS):
         self.db = db
         self.namespace = namespace
         self.lookback_ns = lookback_ns
@@ -89,6 +91,9 @@ class Engine:
         if limits is not None:
             db.limits = limits
         self.limits = limits or getattr(db, "limits", None) or QueryLimits()
+        # default subquery resolution when [range:] omits the step
+        # (upstream: the global evaluation interval)
+        self.subquery_step_ns = subquery_step_ns
 
     # -- public API --
 
@@ -123,9 +128,22 @@ class Engine:
 
     # -- fetch --
 
+    def _resolve_ts(self, sel, eval_ts: np.ndarray) -> np.ndarray:
+        """Selector evaluation timestamps: apply the @ modifier (pin every
+        step to one instant; start()/end() resolve to the query range
+        bounds) and then the offset."""
+        at = getattr(sel, "at_ns", None)
+        if at is not None:
+            if at == "start":
+                at = int(eval_ts[0])
+            elif at == "end":
+                at = int(eval_ts[-1])
+            eval_ts = np.full_like(eval_ts, at)
+        return eval_ts - sel.offset_ns
+
     def _fetch(self, sel: VectorSelector, eval_ts: np.ndarray, range_ns: int):
         """(labels, RaggedSeries) for samples covering the windows."""
-        shifted = eval_ts - sel.offset_ns
+        shifted = self._resolve_ts(sel, eval_ts)
         t_min = int(shifted[0]) - max(range_ns, self.lookback_ns)
         t_max = int(shifted[-1]) + 1
         ns = self.db.namespaces[self.namespace]
@@ -152,9 +170,10 @@ class Engine:
             return StringValue(e.value)
         if isinstance(e, VectorSelector):
             labels, raws = self._fetch(e, eval_ts, 0)
-            vals = windows.instant_values(raws, eval_ts - e.offset_ns, self.lookback_ns)
+            vals = windows.instant_values(raws, self._resolve_ts(e, eval_ts),
+                                          self.lookback_ns)
             return _compact(Vector(labels, vals))
-        if isinstance(e, MatrixSelector):
+        if isinstance(e, (MatrixSelector, SubqueryExpr)):
             raise EvalError("range vector must be an argument of a function")
         if isinstance(e, UnaryExpr):
             v = self._eval(e.expr, eval_ts)
@@ -216,47 +235,80 @@ class Engine:
         "tanh": np.tanh,
     }
 
-    def _range_arg(self, e: Call, idx: int = 0) -> MatrixSelector:
-        if len(e.args) <= idx or not isinstance(e.args[idx], MatrixSelector):
+    def _range_arg(self, e: Call, idx: int = 0):
+        if len(e.args) <= idx or not isinstance(
+            e.args[idx], (MatrixSelector, SubqueryExpr)
+        ):
             raise EvalError(f"{e.func}() expects a range vector argument")
         return e.args[idx]
+
+    def _eval_range_arg(self, arg, eval_ts: np.ndarray):
+        """(labels, RaggedSeries, shifted_eval_ts, range_ns) for a range
+        vector argument — a plain matrix selector fetch, or a SUBQUERY
+        evaluated at step-aligned instants and rewrapped as ragged samples
+        so every temporal function runs unchanged on it."""
+        if isinstance(arg, MatrixSelector):
+            labels, raws = self._fetch(arg.selector, eval_ts, arg.range_ns)
+            return labels, raws, self._resolve_ts(arg.selector, eval_ts), arg.range_ns
+        # subquery: evaluate the inner expr once over the union of aligned
+        # instants covering every parent step's window
+        shifted = self._resolve_ts(arg, eval_ts)
+        step = arg.step_ns or self.subquery_step_ns
+        lo = int(shifted.min()) - arg.range_ns
+        hi = int(shifted.max())
+        first = (lo // step + 1) * step  # first aligned instant > lo
+        last = (hi // step) * step
+        if last < first:
+            grid = np.array([first], dtype=np.int64)
+        else:
+            grid = np.arange(first, last + 1, step, dtype=np.int64)
+        self.limits.check_steps(len(grid))
+        inner = self._eval(arg.expr, grid)
+        if not isinstance(inner, Vector):
+            raise EvalError("subquery requires an instant-vector expression")
+        per_series = []
+        labels = []
+        for i, lb in enumerate(inner.labels):
+            row = inner.values[i]
+            keep = ~np.isnan(row)
+            if not keep.any():
+                continue
+            labels.append(lb)
+            per_series.append((grid[keep], row[keep]))
+        return labels, RaggedSeries.from_lists(per_series), shifted, arg.range_ns
 
     def _eval_call(self, e: Call, eval_ts: np.ndarray):
         fn = e.func
         if fn in self._RANGE_FNS:
             kind, is_counter, is_rate = self._RANGE_FNS[fn]
-            ms = self._range_arg(e)
-            labels, raws = self._fetch(ms.selector, eval_ts, ms.range_ns)
-            shifted = eval_ts - ms.selector.offset_ns
+            labels, raws, shifted, range_ns = self._eval_range_arg(
+                self._range_arg(e), eval_ts)
             if kind == "extrap":
-                vals = windows.extrapolated_rate(raws, shifted, ms.range_ns,
+                vals = windows.extrapolated_rate(raws, shifted, range_ns,
                                                  is_counter, is_rate)
             else:
-                vals = windows.instant_delta(raws, shifted, ms.range_ns,
+                vals = windows.instant_delta(raws, shifted, range_ns,
                                              is_counter, is_rate)
             return _compact(Vector(labels, vals).drop_name())
         if fn in self._OVER_TIME:
-            ms = self._range_arg(e)
-            labels, raws = self._fetch(ms.selector, eval_ts, ms.range_ns)
-            shifted = eval_ts - ms.selector.offset_ns
-            vals = windows.over_time(self._OVER_TIME[fn], raws, shifted, ms.range_ns)
+            labels, raws, shifted, range_ns = self._eval_range_arg(
+                self._range_arg(e), eval_ts)
+            vals = windows.over_time(self._OVER_TIME[fn], raws, shifted, range_ns)
             out = Vector(labels, vals)
             return _compact(out if fn in _KEEPS_NAME else out.drop_name())
         if fn == "quantile_over_time":
             phi = self._scalar_param(e.args[0], eval_ts)
-            ms = self._range_arg(e, 1)
-            labels, raws = self._fetch(ms.selector, eval_ts, ms.range_ns)
-            shifted = eval_ts - ms.selector.offset_ns
-            vals = _quantile_over_time(raws, shifted, ms.range_ns, phi)
+            labels, raws, shifted, range_ns = self._eval_range_arg(
+                self._range_arg(e, 1), eval_ts)
+            vals = _quantile_over_time(raws, shifted, range_ns, phi)
             return _compact(Vector(labels, vals).drop_name())
         if fn in ("deriv", "predict_linear"):
-            ms = self._range_arg(e)
-            labels, raws = self._fetch(ms.selector, eval_ts, ms.range_ns)
-            shifted = eval_ts - ms.selector.offset_ns
+            labels, raws, shifted, range_ns = self._eval_range_arg(
+                self._range_arg(e), eval_ts)
             off = None
             if fn == "predict_linear":
                 off = self._scalar_param(e.args[1], eval_ts)
-            vals = windows.linear_regression(raws, shifted, ms.range_ns, off)
+            vals = windows.linear_regression(raws, shifted, range_ns, off)
             return _compact(Vector(labels, vals).drop_name())
         if fn in self._MATH:
             v = self._eval(e.args[0], eval_ts)

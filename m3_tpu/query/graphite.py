@@ -695,3 +695,491 @@ def _sort_by_maxima(eng, args, *_):
 @register("sortByName")
 def _sort_by_name(eng, args, *_):
     return sorted(args[0], key=lambda s: s.name)
+
+
+# ---------------------------------------------------------------------------
+# long-tail builtins (the most-used remainder of the reference's 110,
+# query/graphite/native/builtin_functions.go)
+# ---------------------------------------------------------------------------
+
+import contextlib as _contextlib
+import re as _re
+import warnings as _warnings
+
+
+@_contextlib.contextmanager
+def _quiet():
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore")
+        yield
+
+
+def _graphite_percentile(values: np.ndarray, n: float) -> float:
+    """Graphite's _getPercentile (no interpolation): rank on the sorted
+    non-null points (same math as the reference's percentile helpers)."""
+    pts = np.sort(values[~np.isnan(values)])
+    if len(pts) == 0:
+        return np.nan
+    fractional = (n / 100.0) * (len(pts) + 1)
+    rank = int(fractional)
+    if fractional - rank > 0:
+        rank += 1
+    rank = min(max(rank, 1), len(pts))
+    return float(pts[rank - 1])
+
+
+def _safe_stat(fn, values):
+    with _quiet():
+        out = fn(values)
+    return out
+
+
+@register("group")
+def _group(eng, args, *_):
+    out = []
+    for a in args:
+        out.extend(a)
+    return out
+
+
+@register("identity")
+def _identity(eng, args, start, end, step):
+    grid = np.arange(start, end, step, dtype=np.int64)
+    name = args[0] if args and isinstance(args[0], (str, bytes)) else "identity"
+    name = name.encode() if isinstance(name, str) else name
+    return [Series(name, grid, (grid // NS).astype(np.float64))]
+
+
+@register("threshold")
+def _threshold(eng, args, start, end, step):
+    grid = np.arange(start, end, step, dtype=np.int64)
+    label = args[1] if len(args) > 1 else str(args[0])
+    return [Series(str(label).encode(), grid, np.full(len(grid), float(args[0])))]
+
+
+@register("aliasSub")
+def _alias_sub(eng, args, *_):
+    series, search, replace = args[0], args[1], args[2]
+    rx = _re.compile(search.encode() if isinstance(search, str) else search)
+    rep = replace.encode() if isinstance(replace, str) else replace
+    # graphite uses \1 backrefs; python re.sub supports them directly
+    return [Series(rx.sub(rep, s.name), s.times, s.values) for s in series]
+
+
+@register("aliasByMetric")
+def _alias_by_metric(eng, args, *_):
+    return [Series(s.name.split(b".")[-1], s.times, s.values) for s in args[0]]
+
+
+@register("substr")
+def _substr(eng, args, *_):
+    series = args[0]
+    start_i = int(args[1]) if len(args) > 1 else 0
+    stop_i = int(args[2]) if len(args) > 2 else 0
+    out = []
+    for s in series:
+        parts = s.name.split(b".")
+        sliced = parts[start_i:] if stop_i == 0 else parts[start_i:stop_i]
+        out.append(Series(b".".join(sliced), s.times, s.values))
+    return out
+
+
+def _filter_series(series, stat_fn, pred):
+    out = []
+    for s in series:
+        v = _safe_stat(stat_fn, s.values)
+        if not np.isnan(v) and pred(v):
+            out.append(s)
+    return out
+
+
+@register("averageBelow")
+def _average_below(eng, args, *_):
+    return _filter_series(args[0], np.nanmean, lambda v: v <= args[1])
+
+
+@register("currentBelow")
+def _current_below(eng, args, *_):
+    def last(vals):
+        ok = vals[~np.isnan(vals)]
+        return ok[-1] if len(ok) else np.nan
+
+    return _filter_series(args[0], last, lambda v: v <= args[1])
+
+
+@register("maximumAbove")
+def _maximum_above(eng, args, *_):
+    return _filter_series(args[0], np.nanmax, lambda v: v > args[1])
+
+
+@register("maximumBelow")
+def _maximum_below(eng, args, *_):
+    return _filter_series(args[0], np.nanmax, lambda v: v <= args[1])
+
+
+@register("minimumAbove")
+def _minimum_above(eng, args, *_):
+    return _filter_series(args[0], np.nanmin, lambda v: v > args[1])
+
+
+@register("minimumBelow")
+def _minimum_below(eng, args, *_):
+    return _filter_series(args[0], np.nanmin, lambda v: v <= args[1])
+
+
+def _top_n(series, n, stat_fn, reverse):
+    # all-NaN series must rank LAST in either direction
+    sentinel = -np.inf if reverse else np.inf
+    keyed = []
+    for s in series:
+        v = _safe_stat(stat_fn, s.values)
+        keyed.append((v if not np.isnan(v) else sentinel, s))
+    keyed.sort(key=lambda kv: kv[0], reverse=reverse)
+    return [s for _, s in keyed[: int(n)]]
+
+
+@register("highestAverage")
+def _highest_average(eng, args, *_):
+    return _top_n(args[0], args[1], np.nanmean, True)
+
+
+@register("lowestAverage")
+def _lowest_average(eng, args, *_):
+    return _top_n(args[0], args[1], np.nanmean, False)
+
+
+@register("highestMin")
+def _highest_min(eng, args, *_):
+    return _top_n(args[0], args[1], np.nanmin, True)
+
+
+@register("lowestMax")
+def _lowest_max(eng, args, *_):
+    return _top_n(args[0], args[1], np.nanmax, False)
+
+
+@register("sortByMinima")
+def _sort_by_minima(eng, args, *_):
+    with _quiet():
+        return sorted(args[0], key=lambda s: _safe_stat(np.nanmin, s.values))
+
+
+@register("sortByTotal")
+def _sort_by_total(eng, args, *_):
+    with _quiet():
+        return sorted(args[0], key=lambda s: -_safe_stat(np.nansum, s.values))
+
+
+def _moving(series, window, fn):
+    out = []
+    for s in series:
+        v = s.values
+        acc = np.full(len(v), np.nan)
+        for i in range(len(v)):
+            lo = max(0, i - int(window) + 1)
+            sel = v[lo : i + 1]
+            if (~np.isnan(sel)).any():
+                acc[i] = _safe_stat(fn, sel)
+        out.append(Series(s.name, s.times, acc))
+    return out
+
+
+@register("movingMedian")
+def _moving_median(eng, args, *_):
+    return _moving(args[0], args[1], np.nanmedian)
+
+
+@register("movingMax")
+def _moving_max(eng, args, *_):
+    return _moving(args[0], args[1], np.nanmax)
+
+
+@register("movingMin")
+def _moving_min(eng, args, *_):
+    return _moving(args[0], args[1], np.nanmin)
+
+
+@register("movingSum")
+def _moving_sum(eng, args, *_):
+    return _moving(args[0], args[1], np.nansum)
+
+
+@register("stdev")
+def _stdev(eng, args, *_):
+    return _moving(args[0], args[1], np.nanstd)
+
+
+@register("delay")
+def _delay(eng, args, *_):
+    series, steps = args[0], int(args[1])
+    out = []
+    for s in series:
+        v = np.full(len(s.values), np.nan)
+        if steps >= 0:
+            v[steps:] = s.values[: len(v) - steps] if steps else s.values
+        else:
+            v[:steps] = s.values[-steps:]
+        out.append(Series(s.name, s.times, v))
+    return out
+
+
+@register("changed")
+def _changed(eng, args, *_):
+    out = []
+    for s in args[0]:
+        v = s.values
+        prev = np.concatenate([[np.nan], v[:-1]])
+        ch = ((v != prev) & ~(np.isnan(v) & np.isnan(prev))).astype(float)
+        ch[np.isnan(prev)] = 0.0
+        out.append(Series(s.name, s.times, ch))
+    return out
+
+
+@register("isNonNull")
+def _is_non_null(eng, args, *_):
+    return [Series(s.name, s.times, (~np.isnan(s.values)).astype(float))
+            for s in args[0]]
+
+
+@register("removeAboveValue")
+def _remove_above_value(eng, args, *_):
+    return [Series(s.name, s.times,
+                   np.where(s.values > args[1], np.nan, s.values))
+            for s in args[0]]
+
+
+@register("removeBelowValue")
+def _remove_below_value(eng, args, *_):
+    return [Series(s.name, s.times,
+                   np.where(s.values < args[1], np.nan, s.values))
+            for s in args[0]]
+
+
+@register("removeAbovePercentile")
+def _remove_above_percentile(eng, args, *_):
+    out = []
+    for s in args[0]:
+        p = _graphite_percentile(s.values, float(args[1]))
+        out.append(Series(s.name, s.times,
+                          np.where(s.values > p, np.nan, s.values)))
+    return out
+
+
+@register("removeBelowPercentile")
+def _remove_below_percentile(eng, args, *_):
+    out = []
+    for s in args[0]:
+        p = _graphite_percentile(s.values, float(args[1]))
+        out.append(Series(s.name, s.times,
+                          np.where(s.values < p, np.nan, s.values)))
+    return out
+
+
+@register("nPercentile")
+def _n_percentile(eng, args, *_):
+    out = []
+    for s in args[0]:
+        p = _graphite_percentile(s.values, float(args[1]))
+        name = b"nPercentile(%s, %g)" % (s.name, float(args[1]))
+        out.append(Series(name, s.times, np.full(len(s.values), p)))
+    return out
+
+
+@register("percentileOfSeries")
+def _percentile_of_series(eng, args, *_):
+    series, n = args[0], float(args[1])
+    if not series:
+        return []
+    stack = np.stack([s.values for s in series])
+    vals = np.array([_graphite_percentile(stack[:, i], n)
+                     for i in range(stack.shape[1])])
+    return [Series(b"percentileOfSeries(%s, %g)" % (series[0].name, n),
+                   series[0].times, vals)]
+
+
+@register("rangeOfSeries")
+def _range_of_series(eng, args, *_):
+    series = []
+    for a in args:
+        series.extend(a)
+    with _quiet():
+        return _combine(series, lambda st: np.nanmax(st, axis=0) - np.nanmin(st, axis=0),
+                        b"rangeOfSeries")
+
+
+@register("multiplySeries")
+def _multiply_series(eng, args, *_):
+    series = []
+    for a in args:
+        series.extend(a)
+    with _quiet():
+        return _combine(series, _nan_masked(lambda st: np.nanprod(st, axis=0)),
+                        b"multiplySeries")
+
+
+@register("stddevSeries")
+def _stddev_series(eng, args, *_):
+    with _quiet():
+        return _combine(args[0], lambda st: np.nanstd(st, axis=0), b"stddevSeries")
+
+
+@register("logarithm")
+@register("log")
+def _logarithm(eng, args, *_):
+    base = float(args[1]) if len(args) > 1 else 10.0
+    with _quiet():
+        return [Series(s.name, s.times, np.log(s.values) / np.log(base))
+                for s in args[0]]
+
+
+@register("squareRoot")
+def _square_root(eng, args, *_):
+    with _quiet():
+        return [Series(s.name, s.times, np.sqrt(s.values)) for s in args[0]]
+
+
+@register("pow")
+def _pow(eng, args, *_):
+    with _quiet():
+        return [Series(s.name, s.times, s.values ** float(args[1]))
+                for s in args[0]]
+
+
+@register("scaleToSeconds")
+def _scale_to_seconds(eng, args, start, end, step):
+    factor = float(args[1]) / (step / NS)
+    return [Series(s.name, s.times, s.values * factor) for s in args[0]]
+
+
+@register("consolidateBy")
+@register("cumulative")
+def _consolidate_by(eng, args, *_):
+    # consolidation policy applies at render-resolution reduction, which
+    # this engine performs at fetch; accepted for dashboard compatibility
+    return args[0]
+
+
+@register("drawAsInfinite")
+@register("secondYAxis")
+@register("stacked")
+def _render_hint(eng, args, *_):
+    # pure render-style hints: series pass through unchanged
+    return args[0]
+
+
+@register("averageSeriesWithWildcards")
+def _average_series_with_wildcards(eng, args, *_):
+    return _series_with_wildcards(args, np.nanmean)
+
+
+@register("sumSeriesWithWildcards")
+def _sum_series_with_wildcards(eng, args, *_):
+    return _series_with_wildcards(args, np.nansum)
+
+
+def _nan_masked(op):
+    """All-NaN columns stay NaN (nansum/nanprod would fabricate 0/1)."""
+    def apply(stack):
+        out = op(stack)
+        return np.where(np.isnan(stack).all(axis=0), np.nan, out)
+
+    return apply
+
+
+def _series_with_wildcards(args, op):
+    series = args[0]
+    positions = sorted(int(a) for a in args[1:])
+    groups: dict[bytes, list] = {}
+    for s in series:
+        parts = [p for i, p in enumerate(s.name.split(b".")) if i not in positions]
+        groups.setdefault(b".".join(parts), []).append(s)
+    out = []
+    with _quiet():
+        for name, members in groups.items():
+            combined = _combine(
+                members, _nan_masked(lambda st: op(st, axis=0)), name)
+            out.extend(combined)
+    return out
+
+
+@register("groupByNodes")
+def _group_by_nodes(eng, args, *_):
+    series, agg = args[0], args[1]
+    nodes = [int(a) for a in args[2:]]
+    op = {"sum": np.nansum, "avg": np.nanmean, "average": np.nanmean,
+          "max": np.nanmax, "min": np.nanmin}[agg]
+    groups: dict[bytes, list] = {}
+    for s in series:
+        parts = s.name.split(b".")
+        key = b".".join(parts[n] for n in nodes if -len(parts) <= n < len(parts))
+        groups.setdefault(key, []).append(s)
+    out = []
+    with _quiet():
+        for name, members in groups.items():
+            out.extend(_combine(
+                members, _nan_masked(lambda st: op(st, axis=0)), name))
+    return out
+
+
+@register("weightedAverage")
+def _weighted_average(eng, args, *_):
+    avg_series, weight_series = args[0], args[1]
+    nodes = [int(a) for a in args[2:]]
+
+    def key(s):
+        parts = s.name.split(b".")
+        return b".".join(parts[n] for n in nodes if -len(parts) <= n < len(parts))
+
+    weights = {key(s): s for s in weight_series}
+    num = None
+    den = None
+    with _quiet():
+        for s in avg_series:
+            w = weights.get(key(s))
+            if w is None:
+                continue
+            prod = s.values * w.values
+            num = prod if num is None else np.nansum([num, prod], axis=0)
+            den = w.values.copy() if den is None else np.nansum([den, w.values], axis=0)
+        if num is None:
+            return []
+        vals = num / den
+    return [Series(b"weightedAverage", avg_series[0].times, vals)]
+
+
+@register("mostDeviant")
+def _most_deviant(eng, args, *_):
+    series, n = args[0], int(args[1])
+    with _quiet():
+        keyed = sorted(
+            series,
+            key=lambda s: -(np.nanstd(s.values) if (~np.isnan(s.values)).any()
+                            else -np.inf),
+        )
+    return keyed[:n]
+
+
+@register("linearRegression")
+def _linear_regression(eng, args, *_):
+    out = []
+    for s in args[0]:
+        v = s.values
+        ok = ~np.isnan(v)
+        if ok.sum() < 2:
+            out.append(s)
+            continue
+        x = (s.times / NS).astype(np.float64)
+        slope, intercept = np.polyfit(x[ok], v[ok], 1)
+        out.append(Series(s.name, s.times, slope * x + intercept))
+    return out
+
+
+@register("averageOutsidePercentile")
+def _average_outside_percentile(eng, args, *_):
+    series, n = args[0], float(args[1])
+    n = max(n, 100.0 - n)
+    with _quiet():
+        avgs = [_safe_stat(np.nanmean, s.values) for s in series]
+    lo = _graphite_percentile(np.asarray(avgs, float), 100.0 - n)
+    hi = _graphite_percentile(np.asarray(avgs, float), n)
+    return [s for s, a in zip(series, avgs) if not (lo < a < hi)]

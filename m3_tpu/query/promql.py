@@ -39,12 +39,27 @@ class VectorSelector(Expr):
     name: str | None
     matchers: list[Matcher]
     offset_ns: int = 0
+    # @ modifier: absolute ns timestamp, or "start"/"end" (resolved by the
+    # engine to the query range bounds)
+    at_ns: "int | str | None" = None
 
 
 @dataclass
 class MatrixSelector(Expr):
     selector: VectorSelector
     range_ns: int = 0
+
+
+@dataclass
+class SubqueryExpr(Expr):
+    """expr[range:step] — evaluate expr at step-aligned instants over the
+    trailing range, yielding a range vector (upstream subquery semantics)."""
+
+    expr: Expr
+    range_ns: int
+    step_ns: int | None = None  # None -> engine's default resolution
+    offset_ns: int = 0
+    at_ns: "int | str | None" = None
 
 
 @dataclass
@@ -289,6 +304,22 @@ class Parser:
                 rng = parse_duration(d.text) if d.kind == "DURATION" else int(
                     float(d.text) * 1e9
                 )
+                pt = self.peek()
+                if pt.kind == "IDENT" and pt.text.startswith(":"):
+                    # subquery: expr[range:step]. The lexer folds ':' (and
+                    # any attached step like ':1m') into one IDENT because
+                    # colons are legal in metric names.
+                    self.next()
+                    rest = pt.text[1:]
+                    if rest:
+                        step = parse_duration(rest)
+                    elif self.peek().kind == "DURATION":
+                        step = parse_duration(self.next().text)
+                    else:
+                        step = None
+                    self.expect("]")
+                    e = SubqueryExpr(e, rng, step)
+                    continue
                 self.expect("]")
                 if not isinstance(e, VectorSelector):
                     raise ParseError("range selector requires a vector selector")
@@ -303,14 +334,39 @@ class Parser:
                 if d.kind != "DURATION":
                     raise ParseError(f"expected duration after offset, got {d.text!r}")
                 off = parse_duration(d.text) * (-1 if neg else 1)
-                if isinstance(e, VectorSelector):
+                if isinstance(e, (VectorSelector, SubqueryExpr)):
                     e.offset_ns = off
                 elif isinstance(e, MatrixSelector):
                     e.selector.offset_ns = off
                 else:
                     raise ParseError("offset requires a selector")
+            elif t.text == "@":
+                self.next()
+                at = self._parse_at()
+                if isinstance(e, (VectorSelector, SubqueryExpr)):
+                    e.at_ns = at
+                elif isinstance(e, MatrixSelector):
+                    e.selector.at_ns = at
+                else:
+                    raise ParseError("@ modifier requires a selector")
             else:
                 return e
+
+    def _parse_at(self) -> "int | str":
+        """@ <unix-seconds> | @ start() | @ end()"""
+        t = self.next()
+        neg = False
+        if t.text == "-":
+            neg = True
+            t = self.next()
+        if t.kind == "NUMBER":
+            v = float(t.text)
+            return int((-v if neg else v) * 1e9)
+        if t.kind == "IDENT" and t.text in ("start", "end") and not neg:
+            self.expect("(")
+            self.expect(")")
+            return t.text
+        raise ParseError(f"expected timestamp, start() or end() after @, got {t.text!r}")
 
     def parse_atom(self) -> Expr:
         t = self.peek()

@@ -355,7 +355,9 @@ class DBNodeService:
         created = getattr(self, "_registry_namespaces", set())
         for name, opts_doc in registry.items():
             if name in self.db.namespaces:
-                created.add(name)
+                # pre-existing (config-declared or already synced): do NOT
+                # claim it for the registry — a later registry delete must
+                # not drop a config-declared namespace
                 continue
             try:
                 opts = namespace_options(opts_doc)

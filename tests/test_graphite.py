@@ -246,3 +246,111 @@ class TestReviewRegressions:
             assert kinds == {("b", 0), ("b", 1)}
         finally:
             api.shutdown()
+
+
+class TestLongTailBuiltins:
+    """The round-2 additions toward the reference's 110 builtins."""
+
+    def _eng(self, db, data):
+        seed(db, data)
+        return GraphiteEngine(db)
+
+    def render(self, eng, target, n=3):
+        return eng.render(target, START, START + n * MIN, MIN)
+
+    def test_filters_and_sorts(self, db):
+        eng = self._eng(db, {"m.a": [1, 1, 1], "m.b": [5, 5, 5],
+                             "m.c": [10, 10, 10]})
+        assert [s.name for s in self.render(eng, "maximumAbove(m.*, 4)")] == [
+            b"m.b", b"m.c"]
+        assert [s.name for s in self.render(eng, "maximumBelow(m.*, 5)")] == [
+            b"m.a", b"m.b"]
+        assert [s.name for s in self.render(eng, "minimumAbove(m.*, 1)")] == [
+            b"m.b", b"m.c"]
+        assert [s.name for s in self.render(eng, "averageBelow(m.*, 5)")] == [
+            b"m.a", b"m.b"]
+        assert [s.name for s in self.render(eng, "highestAverage(m.*, 2)")] == [
+            b"m.c", b"m.b"]
+        assert [s.name for s in self.render(eng, "lowestAverage(m.*, 1)")] == [
+            b"m.a"]
+        assert [s.name for s in self.render(eng, "sortByTotal(m.*)")] == [
+            b"m.c", b"m.b", b"m.a"]
+
+    def test_alias_family(self, db):
+        eng = self._eng(db, {"web.host1.cpu": [1, 2, 3]})
+        assert self.render(eng, "aliasByMetric(web.host1.cpu)")[0].name == b"cpu"
+        assert self.render(
+            eng, 'aliasSub(web.host1.cpu, "host(\\d+)", "h\\1")'
+        )[0].name == b"web.h1.cpu"
+        assert self.render(eng, "substr(web.host1.cpu, 1)")[0].name == b"host1.cpu"
+        assert self.render(eng, "substr(web.host1.cpu, 0, 2)")[0].name == b"web.host1"
+
+    def test_moving_family(self, db):
+        eng = self._eng(db, {"mv.a": [1, 2, 3, 4, 5, 6]})
+        out = self.render(eng, "movingSum(mv.a, 3)", n=6)[0]
+        np.testing.assert_allclose(out.values, [1, 3, 6, 9, 12, 15])
+        out = self.render(eng, "movingMax(mv.a, 2)", n=6)[0]
+        np.testing.assert_allclose(out.values, [1, 2, 3, 4, 5, 6])
+        out = self.render(eng, "movingMedian(mv.a, 3)", n=6)[0]
+        np.testing.assert_allclose(out.values, [1, 1.5, 2, 3, 4, 5])
+
+    def test_remove_value_filters(self, db):
+        eng = self._eng(db, {"rv.a": [1, 5, 10]})
+        out = self.render(eng, "removeAboveValue(rv.a, 5)")[0]
+        assert np.isnan(out.values[2]) and out.values[1] == 5
+        out = self.render(eng, "removeBelowValue(rv.a, 5)")[0]
+        assert np.isnan(out.values[0]) and out.values[2] == 10
+
+    def test_percentiles(self, db):
+        eng = self._eng(db, {"pc.a": [1, 2, 3], "pc.b": [10, 20, 30]})
+        out = self.render(eng, "percentileOfSeries(pc.*, 50)")[0]
+        # graphite rank semantics: fractional rank 1.5 rounds UP to rank 2
+        np.testing.assert_allclose(out.values, [10, 20, 30])
+        out = self.render(eng, "nPercentile(pc.b, 50)")[0]
+        np.testing.assert_allclose(out.values, 20.0)
+
+    def test_series_combines(self, db):
+        eng = self._eng(db, {"sc.a": [1, 2, 3], "sc.b": [4, 5, 6]})
+        out = self.render(eng, "rangeOfSeries(sc.*)")[0]
+        np.testing.assert_allclose(out.values, [3, 3, 3])
+        out = self.render(eng, "multiplySeries(sc.*)")[0]
+        np.testing.assert_allclose(out.values, [4, 10, 18])
+        out = self.render(eng, "stddevSeries(sc.*)")[0]
+        np.testing.assert_allclose(out.values, [1.5, 1.5, 1.5])
+
+    def test_math_transforms(self, db):
+        eng = self._eng(db, {"mt.a": [1, 10, 100]})
+        out = self.render(eng, "logarithm(mt.a)")[0]
+        np.testing.assert_allclose(out.values, [0, 1, 2])
+        out = self.render(eng, "squareRoot(mt.a)")[0]
+        np.testing.assert_allclose(out.values, [1, np.sqrt(10), 10])
+        out = self.render(eng, "pow(mt.a, 2)")[0]
+        np.testing.assert_allclose(out.values, [1, 100, 10000])
+        out = self.render(eng, "scaleToSeconds(mt.a, 1)")[0]
+        np.testing.assert_allclose(out.values, [1 / 60, 10 / 60, 100 / 60])
+
+    def test_wildcards_grouping(self, db):
+        eng = self._eng(db, {"dc1.web.cpu": [1, 1, 1], "dc2.web.cpu": [2, 2, 2],
+                             "dc1.db.cpu": [4, 4, 4]})
+        out = self.render(eng, "sumSeriesWithWildcards(*.*.cpu, 0)")
+        got = {s.name: s.values[0] for s in out}
+        assert got == {b"web.cpu": 3.0, b"db.cpu": 4.0}
+        out = self.render(eng, 'groupByNodes(*.*.cpu, "sum", 1)')
+        got = {s.name: s.values[0] for s in out}
+        assert got == {b"web": 3.0, b"db": 4.0}
+
+    def test_misc(self, db):
+        eng = self._eng(db, {"ms.a": [1, 1, 2]})
+        out = self.render(eng, "changed(ms.a)")[0]
+        np.testing.assert_allclose(out.values, [0, 0, 1])
+        out = self.render(eng, "isNonNull(ms.a)")[0]
+        np.testing.assert_allclose(out.values, [1, 1, 1])
+        out = self.render(eng, "delay(ms.a, 1)")[0]
+        assert np.isnan(out.values[0]) and out.values[1] == 1.0
+        out = self.render(eng, "threshold(5)")[0]
+        np.testing.assert_allclose(out.values, 5.0)
+        out = self.render(eng, "consolidateBy(ms.a, 'sum')")[0]
+        np.testing.assert_allclose(out.values, [1, 1, 2])
+        out = self.render(eng, "linearRegression(ms.a)")[0]
+        # least squares on y=[1,1,2] at x=[0,60,120]s: slope 1/120, b 5/6
+        np.testing.assert_allclose(out.values, [5 / 6, 4 / 3, 11 / 6], rtol=1e-6)

@@ -471,3 +471,80 @@ class TestQueryLimits:
                 assert "limit" in body["error"]
         finally:
             api.shutdown()
+
+
+class TestSubqueriesAndAt:
+    def test_parse_subquery_forms(self):
+        from m3_tpu.query.promql import SubqueryExpr, parse
+
+        e = parse("rate(m[5m])[30m:5m]")
+        assert isinstance(e, SubqueryExpr)
+        assert e.range_ns == 30 * MIN * 10**9 // 10**9 * 10**9 or e.range_ns == 1800 * 10**9
+        assert e.step_ns == 300 * 10**9
+        e = parse("m[10m:]")
+        assert isinstance(e, SubqueryExpr) and e.step_ns is None
+        e = parse("m[10m: 30s]")
+        assert e.step_ns == 30 * 10**9
+        e = parse("max_over_time(rate(m[1m])[10m:1m] offset 5m)")
+        sq = e.args[0]
+        assert isinstance(sq, SubqueryExpr) and sq.offset_ns == 300 * 10**9
+
+    def test_parse_at_modifier(self):
+        from m3_tpu.query.promql import parse
+
+        e = parse("m @ 1600000000")
+        assert e.at_ns == 1_600_000_000 * 10**9
+        e = parse("m @ start()")
+        assert e.at_ns == "start"
+        e = parse("rate(m[5m] @ end())")
+        assert e.args[0].selector.at_ns == "end"
+
+    def test_subquery_max_of_rate(self, db):
+        """max_over_time(rate(ctr[2m])[20m:1m]): the classic pattern."""
+        # counter rising 1/s for 10m then 3/s for 10m
+        pts = []
+        v = 0.0
+        for j in range(121):
+            t = START + j * 10 * 10**9
+            pts.append((t, v))
+            v += 10.0 if j < 60 else 30.0
+        write_series(db, b"ctr", [(b"k", b"v")], pts)
+        eng = Engine(db)
+        end = START + 1200 * 10**9
+        res, _ = eng.query_range("max_over_time(rate(ctr[2m])[20m:1m])",
+                                 end, end, MIN)
+        # max rate over the window is the late-phase 3/s
+        assert abs(res.values[0, 0] - 3.0) < 1e-9
+
+    def test_subquery_avg_matches_direct(self, db):
+        """avg_over_time(m[10m:1m]) where m is 1-min-sampled equals the
+        plain average of those samples."""
+        pts = [(START + j * 60 * 10**9, float(j)) for j in range(11)]
+        write_series(db, b"g", [(b"k", b"v")], pts)
+        eng = Engine(db)
+        end = START + 600 * 10**9
+        res, _ = eng.query_instant("avg_over_time(g[10m:1m])", end)
+        # aligned instants in (end-10m, end]: minutes 1..10 -> values 1..10
+        assert abs(res.values[0, 0] - 5.5) < 1e-9
+
+    def test_at_pins_evaluation_time(self, db):
+        pts = [(START + j * 60 * 10**9, float(j)) for j in range(11)]
+        write_series(db, b"p", [(b"k", b"v")], pts)
+        eng = Engine(db)
+        at_s = (START + 300 * 10**9) // 10**9
+        res, _ = eng.query_range(f"p @ {at_s}", START + 60 * 10**9,
+                                 START + 600 * 10**9, MIN)
+        # every step returns the value at the pinned instant (j=5)
+        vals = res.values[0]
+        assert np.allclose(vals, 5.0)
+
+    def test_at_start_end(self, db):
+        pts = [(START + j * 60 * 10**9, float(j)) for j in range(11)]
+        write_series(db, b"q", [(b"k", b"v")], pts)
+        eng = Engine(db)
+        res, _ = eng.query_range("q @ end()", START + 60 * 10**9,
+                                 START + 600 * 10**9, MIN)
+        assert np.allclose(res.values[0], 10.0)
+        res, _ = eng.query_range("q @ start()", START + 60 * 10**9,
+                                 START + 600 * 10**9, MIN)
+        assert np.allclose(res.values[0], 1.0)
