@@ -1,0 +1,335 @@
+"""PromQL correctness comparator — the scripts/comparator role.
+
+The reference diffs identical queries against a real Prometheus over
+seeded data (/root/reference/scripts/comparator/README.md). This harness
+does the same over HTTP against the coordinator, with three result
+sources:
+
+1. ANALYTIC mode (always on): deterministic seeded series whose query
+   answers are derivable in closed form (linear counters -> exact rates,
+   constant gauges, exact histogram quantiles, binary-op identities).
+   True correctness checking with no Prometheus dependency.
+2. SNAPSHOT mode: the full query corpus's responses pinned to a fixture
+   file; any numeric drift across changes fails. Regenerate with
+   --update after INTENTIONAL semantic changes.
+3. LIVE mode (--prom-url): seed the same series into a real Prometheus
+   (remote write) and diff query_range responses — the reference's exact
+   methodology, for environments that have one.
+
+Usage:
+    python -m m3_tpu.tools.comparator [--update] [--prom-url URL]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import urllib.request
+
+START = 1_600_000_000  # unix seconds; aligned, deterministic
+NS = 10**9
+
+# ---------------------------------------------------------------------------
+# seeded data: every series is a closed-form function of time
+# ---------------------------------------------------------------------------
+
+
+def seed_points():
+    """[(metric, tags, [(t_s, value)])] over 20 minutes at 15s."""
+    ts = [START + i * 15 for i in range(81)]
+    out = []
+    # perfect counters: rate == slope
+    out.append(("ctr", {"job": "a", "slope": "2"}, [(t, 2.0 * (t - START)) for t in ts]))
+    out.append(("ctr", {"job": "b", "slope": "5"}, [(t, 5.0 * (t - START)) for t in ts]))
+    # counter with one reset at t=+600s
+    def reset_val(t):
+        dt = t - START
+        return 3.0 * dt if dt < 600 else 3.0 * (dt - 600)
+
+    out.append(("ctr_reset", {"job": "a"}, [(t, reset_val(t)) for t in ts]))
+    # constant gauge + linear gauge + sinusoid
+    out.append(("gauge_const", {"k": "v"}, [(t, 42.0) for t in ts]))
+    out.append(("gauge_lin", {"k": "v"}, [(t, float(t - START)) for t in ts]))
+    out.append(("gauge_sin", {"k": "v"},
+                [(t, math.sin((t - START) / 120.0)) for t in ts]))
+    # histogram with fixed per-interval bucket increments
+    for le, per in (("0.1", 10), ("0.5", 30), ("1", 60), ("+Inf", 100)):
+        out.append(("req_bucket", {"le": le},
+                    [(t, per * (t - START) / 15.0) for t in ts]))
+    return out
+
+
+QUERIES = [
+    # (name, promql, needs)
+    ("rate_linear", "rate(ctr[2m])"),
+    ("increase_linear", "increase(ctr[2m])"),
+    ("irate_linear", "irate(ctr[1m])"),
+    ("delta_gauge", "delta(gauge_lin[2m])"),
+    ("rate_reset", "rate(ctr_reset[2m])"),
+    ("sum_rate", "sum(rate(ctr[2m]))"),
+    ("sum_by", "sum by (job) (rate(ctr[2m]))"),
+    ("avg_over_time", "avg_over_time(gauge_const[5m])"),
+    ("min_max", "max_over_time(gauge_lin[5m]) - min_over_time(gauge_lin[5m])"),
+    ("count_over_time", "count_over_time(gauge_const[5m])"),
+    ("stddev_const", "stddev_over_time(gauge_const[5m])"),
+    ("quantile_ot", "quantile_over_time(0.5, gauge_lin[5m])"),
+    ("binary_vector", "ctr / ignoring(slope) group_left gauge_const"),
+    ("scalar_arith", "gauge_const * 2 + 1"),
+    ("comparison_filter", 'rate(ctr[2m]) > 3'),
+    ("bool_compare", "gauge_const == bool 42"),
+    ("clamp", "clamp(gauge_lin, 100, 500)"),
+    ("abs_neg", "abs(0 - gauge_lin)"),
+    ("histogram_q50", "histogram_quantile(0.5, rate(req_bucket[2m]))"),
+    ("histogram_q90", "histogram_quantile(0.9, rate(req_bucket[2m]))"),
+    ("topk", "topk(1, rate(ctr[2m]))"),
+    ("subquery_max", "max_over_time(rate(ctr[2m])[10m:1m])"),
+    ("at_modifier", f"gauge_lin @ {START + 300}"),
+    ("offset", "gauge_lin offset 5m"),
+    ("deriv", "deriv(gauge_lin[5m])"),
+    ("predict", "predict_linear(gauge_lin[5m], 60)"),
+    ("resets", "resets(ctr_reset[15m])"),
+    ("changes", "changes(gauge_const[5m])"),
+    ("sort", "sort(rate(ctr[2m]))"),
+    ("vector_and", "ctr and ctr{job=\"a\"}"),
+    ("absent_present", "present_over_time(gauge_const[5m])"),
+]
+
+# analytic expectations: name -> fn(t_s) -> {series_key: value} where
+# series_key is the sorted-label string; None value = skip that step
+EPS = 1e-6
+
+
+def _analytic_expectations():
+    q_start, q_end, q_step = START + 600, START + 1140, 60
+
+    def const(v):
+        return lambda t: v
+
+    return {
+        # linear counters: extrapolated rate == slope exactly (regular
+        # samples, interior windows)
+        "rate_linear": {"job=a,slope=2": const(2.0),
+                        "job=b,slope=5": const(5.0)},
+        "increase_linear": {"job=a,slope=2": const(240.0),
+                            "job=b,slope=5": const(600.0)},
+        "irate_linear": {"job=a,slope=2": const(2.0),
+                         "job=b,slope=5": const(5.0)},
+        "delta_gauge": {"k=v": const(120.0)},
+        "sum_rate": {"": const(7.0)},
+        "sum_by": {"job=a": const(2.0), "job=b": const(5.0)},
+        "avg_over_time": {"k=v": const(42.0)},
+        "count_over_time": {"k=v": const(20.0)},
+        "stddev_const": {"k=v": const(0.0)},
+        "scalar_arith": {"k=v": const(85.0)},
+        "bool_compare": {"k=v": const(1.0)},
+        "subquery_max": {"job=a,slope=2": const(2.0),
+                         "job=b,slope=5": const(5.0)},
+        "at_modifier": {"k=v": const(300.0)},
+        "offset": {"k=v": lambda t: float(t - START - 300)},
+        "deriv": {"k=v": const(1.0)},
+        "predict": {"k=v": lambda t: float(t - START + 60)},
+        "changes": {"k=v": const(0.0)},
+        # histogram: within-bucket linear interpolation of exact rates
+        # rates/s: 0.1->2/3, 0.5->2, 1->4, inf->20/3; q50: target 10/3
+        # falls in (2,4] bucket (0.5,1]: 0.5 + (10/3-2)/2 * 0.5 = 0.8333..
+        "histogram_q50": {"": const(0.5 + (20 / 3 * 0.5 - 2.0) / 2.0 * 0.5)},
+        "absent_present": {"k=v": const(1.0)},
+    }, (q_start, q_end, q_step)
+
+
+def _series_key(metric: dict) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(metric.items())
+                    if k != "__name__")
+
+
+def run_queries(base_url: str, q_start: int, q_end: int, q_step: int):
+    """name -> {series_key: [(t, value)]} from a /api/v1/query_range API."""
+    out = {}
+    for name, query in QUERIES:
+        u = (f"{base_url}/api/v1/query_range?query="
+             f"{urllib.request.quote(query, safe='')}"
+             f"&start={q_start}&end={q_end}&step={q_step}")
+        doc = json.loads(urllib.request.urlopen(u, timeout=30).read())
+        if doc.get("status") != "success":
+            out[name] = {"__error__": [(0, doc.get("error", "?"))]}
+            continue
+        res = {}
+        for series in doc["data"]["result"]:
+            key = _series_key(series.get("metric", {}))
+            res[key] = [(int(t), float(v)) for t, v in series.get("values", [])]
+        out[name] = res
+    return out
+
+
+def seed_via_http(base_url: str) -> int:
+    n = 0
+    for metric, tags, pts in seed_points():
+        for t, v in pts:
+            body = json.dumps({"metric": metric, "tags": tags,
+                               "timestamp": t, "value": v}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base_url}/api/v1/json/write", data=body, method="POST"),
+                timeout=30)
+            n += 1
+    return n
+
+
+def check_analytic(results) -> list[str]:
+    """Differences between results and the closed-form expectations."""
+    expect, _rng = _analytic_expectations()
+    diffs = []
+    for name, series_expect in expect.items():
+        got = results.get(name)
+        if got is None or "__error__" in got:
+            diffs.append(f"{name}: query failed: {got}")
+            continue
+        for key, fn in series_expect.items():
+            rows = got.get(key)
+            if rows is None:
+                diffs.append(f"{name}/{key}: series missing (have {sorted(got)})")
+                continue
+            for t, v in rows:
+                want = fn(t)
+                if want is None:
+                    continue
+                if not math.isclose(v, want, rel_tol=1e-9, abs_tol=EPS):
+                    diffs.append(
+                        f"{name}/{key} @ {t}: got {v!r}, want {want!r}")
+                    break
+    return diffs
+
+
+def diff_results(a, b, label_a="ours", label_b="theirs") -> list[str]:
+    diffs = []
+    for name in sorted(set(a) | set(b)):
+        ra, rb = a.get(name, {}), b.get(name, {})
+        keys = set(ra) | set(rb)
+        for key in sorted(keys):
+            va, vb = ra.get(key), rb.get(key)
+            if va is None or vb is None:
+                diffs.append(f"{name}/{key}: only in "
+                             f"{label_a if vb is None else label_b}")
+                continue
+            if len(va) != len(vb):
+                diffs.append(f"{name}/{key}: {len(va)} vs {len(vb)} points")
+                continue
+            for (ta, xa), (tb, xb) in zip(va, vb):
+                same_nan = math.isnan(xa) and math.isnan(xb)
+                if ta != tb or (not same_nan
+                                and not math.isclose(xa, xb, rel_tol=1e-9,
+                                                     abs_tol=1e-9)):
+                    diffs.append(f"{name}/{key} @ {ta}: {xa!r} vs {xb!r}")
+                    break
+    return diffs
+
+
+SNAPSHOT_PATH = os.path.join(os.path.dirname(__file__), "..", "..", "tests",
+                             "fixtures", "comparator_snapshot.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", action="store_true",
+                    help="regenerate the pinned snapshot")
+    ap.add_argument("--prom-url", default=None,
+                    help="live Prometheus base URL to diff against")
+    ap.add_argument("--base-url", default=None,
+                    help="coordinator base URL (default: in-process)")
+    args = ap.parse_args(argv)
+
+    expect, (q_start, q_end, q_step) = _analytic_expectations()
+    owns_api = args.base_url is None
+    if owns_api:
+        import tempfile
+
+        from m3_tpu.query.api import CoordinatorAPI
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.options import DatabaseOptions
+
+        tmp = tempfile.mkdtemp(prefix="comparator-")
+        db = Database(tmp, DatabaseOptions(n_shards=2))
+        db.create_namespace("default")
+        db.open(START * NS)
+        api = CoordinatorAPI(db)
+        port = api.serve(port=0)
+        base_url = f"http://127.0.0.1:{port}"
+    else:
+        base_url = args.base_url
+
+    try:
+        seed_via_http(base_url)
+        results = run_queries(base_url, q_start, q_end, q_step)
+
+        rc = 0
+        diffs = check_analytic(results)
+        if diffs:
+            print(f"ANALYTIC: {len(diffs)} mismatches")
+            for d in diffs[:40]:
+                print("  " + d)
+            rc = 1
+        else:
+            print(f"ANALYTIC: ok ({len(_analytic_expectations()[0])} checked)")
+
+        snap_path = os.path.abspath(SNAPSHOT_PATH)
+        if args.update:
+            with open(snap_path, "w") as f:
+                json.dump(results, f, indent=1, sort_keys=True)
+            print(f"SNAPSHOT: updated {snap_path}")
+        elif os.path.exists(snap_path):
+            with open(snap_path) as f:
+                pinned = {
+                    name: {k: [(int(t), float(v)) for t, v in rows]
+                           for k, rows in res.items()}
+                    for name, res in json.load(f).items()
+                }
+            sdiffs = diff_results(results, pinned, "current", "snapshot")
+            if sdiffs:
+                print(f"SNAPSHOT: {len(sdiffs)} drifts vs pinned")
+                for d in sdiffs[:40]:
+                    print("  " + d)
+                rc = 1
+            else:
+                print(f"SNAPSHOT: ok ({len(pinned)} queries)")
+
+        if args.prom_url:
+            seed_via_prometheus(args.prom_url)
+            theirs = run_queries(args.prom_url, q_start, q_end, q_step)
+            pdiffs = diff_results(results, theirs, "m3_tpu", "prometheus")
+            if pdiffs:
+                print(f"PROMETHEUS: {len(pdiffs)} mismatches")
+                for d in pdiffs[:40]:
+                    print("  " + d)
+                rc = 1
+            else:
+                print("PROMETHEUS: ok")
+        return rc
+    finally:
+        if owns_api:
+            api.shutdown()
+            db.close()
+
+
+def seed_via_prometheus(prom_url: str) -> None:
+    """Push the seed series to a live Prometheus via remote write."""
+    from m3_tpu.utils import protowire, snappy
+
+    series = []
+    for metric, tags, pts in seed_points():
+        labels = sorted(
+            [(b"__name__", metric.encode())]
+            + [(k.encode(), v.encode()) for k, v in tags.items()]
+        )
+        series.append(protowire.PromTimeSeries(
+            labels=labels, samples=[(t * 1000, v) for t, v in pts]))
+    payload = snappy.compress(protowire.encode_write_request(series))
+    urllib.request.urlopen(urllib.request.Request(
+        f"{prom_url}/api/v1/write", data=payload, method="POST",
+        headers={"Content-Type": "application/x-protobuf",
+                 "Content-Encoding": "snappy"},
+    ), timeout=30)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

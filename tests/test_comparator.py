@@ -1,0 +1,55 @@
+"""Comparator harness in CI: analytic closed-form correctness + snapshot
+drift over the full query corpus (the scripts/comparator role)."""
+
+from __future__ import annotations
+
+import pytest
+
+from m3_tpu.tools import comparator
+
+
+@pytest.fixture(scope="module")
+def results(tmp_path_factory):
+    from m3_tpu.query.api import CoordinatorAPI
+    from m3_tpu.storage.database import Database
+    from m3_tpu.storage.options import DatabaseOptions
+
+    tmp = tmp_path_factory.mktemp("comparator")
+    db = Database(str(tmp), DatabaseOptions(n_shards=2))
+    db.create_namespace("default")
+    db.open(comparator.START * comparator.NS)
+    api = CoordinatorAPI(db)
+    port = api.serve(port=0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        comparator.seed_via_http(base)
+        _, (qs, qe, qstep) = comparator._analytic_expectations()
+        yield comparator.run_queries(base, qs, qe, qstep)
+    finally:
+        api.shutdown()
+        db.close()
+
+
+def test_no_query_errors(results):
+    errors = {n: r["__error__"] for n, r in results.items() if "__error__" in r}
+    assert errors == {}
+
+
+def test_analytic_correctness(results):
+    diffs = comparator.check_analytic(results)
+    assert diffs == []
+
+
+def test_snapshot_drift(results):
+    import json
+    import os
+
+    path = os.path.abspath(comparator.SNAPSHOT_PATH)
+    assert os.path.exists(path), "run python -m m3_tpu.tools.comparator --update"
+    with open(path) as f:
+        pinned = {
+            name: {k: [(int(t), float(v)) for t, v in rows]
+                   for k, rows in res.items()}
+            for name, res in json.load(f).items()
+        }
+    assert comparator.diff_results(results, pinned) == []
