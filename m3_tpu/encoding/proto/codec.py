@@ -1,0 +1,278 @@
+"""Proto message stream codec: per-field compression behind M3TSZ
+timestamps.
+
+Wire layout per stream:
+  64-bit start (the m3tsz prefix) then per datapoint:
+    m3tsz timestamp field (delta-of-delta),
+    changed-fields bitmask (one bit per schema field, schema order),
+    each CHANGED field's payload by type (see below).
+  End-of-stream: the m3tsz marker (timestamp opcode 0x100 + EOS).
+
+Field payloads (reference scheme roles, encoder.go/custom_marshal.go):
+  DOUBLE  m3tsz XOR float vs the field's previous value
+  INT64   zigzag varint of (value - previous)
+  BOOL    1 bit
+  BYTES   1 bit dict-hit + (index in ceil(log2(cap)) bits | varint len+raw)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from m3_tpu.encoding.m3tsz import constants as c
+from m3_tpu.encoding.m3tsz.decoder import _TimestampIterator, read_varint
+from m3_tpu.encoding.m3tsz.encoder import (
+    FloatXOREncoder,
+    TimestampEncoder,
+    write_special_marker,
+    write_varint,
+)
+from m3_tpu.encoding.proto.schema import FieldType, Schema
+from m3_tpu.utils.bitstream import IStream, OStream
+from m3_tpu.utils.xtime import TimeUnit
+
+_DICT_CAP = 16  # LRU entries per bytes field (reference byte-field dicts)
+_DICT_BITS = 4
+
+
+@dataclass
+class ProtoDatapoint:
+    timestamp_ns: int
+    message: dict  # field name -> value
+
+
+class _BytesDict:
+    def __init__(self) -> None:
+        self.entries: list[bytes] = []
+
+    def find(self, v: bytes) -> int:
+        try:
+            return self.entries.index(v)
+        except ValueError:
+            return -1
+
+    def push(self, v: bytes) -> None:
+        if v in self.entries:
+            self.entries.remove(v)
+        self.entries.append(v)
+        if len(self.entries) > _DICT_CAP:
+            self.entries.pop(0)
+
+
+class ProtoEncoder:
+    """Single-series proto stream encoder."""
+
+    def __init__(self, start_ns: int, schema: Schema,
+                 default_time_unit: TimeUnit = TimeUnit.SECOND) -> None:
+        self._os = OStream()
+        self._ts = TimestampEncoder(start_ns, default_time_unit)
+        self.schema = schema
+        self._prev: dict[int, object] = {}
+        self._floats: dict[int, FloatXOREncoder] = {
+            f.number: FloatXOREncoder() for f in schema.fields
+            if f.type == FieldType.DOUBLE
+        }
+        self._dicts: dict[int, _BytesDict] = {
+            f.number: _BytesDict() for f in schema.fields
+            if f.type == FieldType.BYTES
+        }
+        self.num_encoded = 0
+
+    def encode(self, t_ns: int, message: dict,
+               unit: TimeUnit = TimeUnit.SECOND) -> None:
+        self._ts.write_time(self._os, t_ns, b"", unit)
+        first = self.num_encoded == 0
+        changed = []
+        for f in self.schema.fields:
+            v = _normalize(f, message.get(f.name))
+            prev = self._prev.get(f.number)
+            if first:
+                diff = True
+            elif f.type == FieldType.DOUBLE:
+                # bit-pattern compare: 0.0 == -0.0 and NaN != NaN under
+                # float equality, both wrong for change detection
+                diff = c.float_to_bits(v) != c.float_to_bits(prev)
+            else:
+                diff = v != prev
+            changed.append(diff)
+        for flag in changed:
+            self._os.write_bit(1 if flag else 0)
+        for f, flag in zip(self.schema.fields, changed):
+            if not flag:
+                continue
+            v = _normalize(f, message.get(f.name))
+            self._write_field(f, v, first)
+            self._prev[f.number] = v
+        self.num_encoded += 1
+
+    def _write_field(self, f, v, first: bool) -> None:
+        os = self._os
+        if f.type == FieldType.DOUBLE:
+            enc = self._floats[f.number]
+            if first:
+                enc.write_full_float(os, c.float_to_bits(v))
+            else:
+                enc.write_next_float(os, c.float_to_bits(v))
+        elif f.type == FieldType.INT64:
+            prev = self._prev.get(f.number, 0)
+            write_varint(os, v - (prev if not first else 0))
+        elif f.type == FieldType.BOOL:
+            os.write_bit(1 if v else 0)
+        elif f.type == FieldType.BYTES:
+            d = self._dicts[f.number]
+            idx = d.find(v)
+            if idx >= 0:
+                os.write_bit(1)
+                os.write_bits(idx, _DICT_BITS)
+            else:
+                os.write_bit(0)
+                write_varint(os, len(v))
+                for b in v:
+                    os.write_bits(b, 8)
+            d.push(v)
+
+    def stream(self) -> bytes:
+        if self._os.bit_length == 0:
+            return b""
+        raw, pos = self._os.raw()
+        tail = OStream()
+        if pos not in (0, 8):
+            tail.write_bits(raw[-1] >> (8 - pos), pos)
+            head = raw[:-1]
+        else:
+            head = raw
+        write_special_marker(tail, c.MARKER_END_OF_STREAM)
+        return head + tail.bytes_padded()
+
+
+class ProtoDecoder:
+    """Iterates ProtoDatapoints from a proto stream."""
+
+    def __init__(self, data: bytes, schema: Schema,
+                 default_time_unit: TimeUnit = TimeUnit.SECOND) -> None:
+        self._stream = IStream(data)
+        self._ts = _TimestampIterator(default_time_unit)
+        self.schema = schema
+        self._prev: dict[int, object] = {}
+        self._prev_bits: dict[int, int] = {}
+        self._prev_xor: dict[int, int] = {}
+        self._first = True
+
+    def __iter__(self):
+        while True:
+            try:
+                self._ts.read_timestamp(self._stream)
+            except EOFError:
+                return
+            if self._ts.done:  # EOS marker
+                return
+            msg = {}
+            changed = [self._stream.read_bits(1) == 1
+                       for _ in self.schema.fields]
+            for f, flag in zip(self.schema.fields, changed):
+                if flag:
+                    v = self._read_field(f)
+                    self._prev[f.number] = v
+                msg[f.name] = self._prev.get(f.number, _zero(f))
+            self._first = False
+            yield ProtoDatapoint(self._ts.prev_time, msg)
+
+    def _read_field(self, f):
+        s = self._stream
+        if f.type == FieldType.DOUBLE:
+            if f.number not in self._prev_bits:
+                bits = s.read_bits(64)
+                self._prev_bits[f.number] = bits
+                self._prev_xor[f.number] = bits
+                return c.bits_to_float(bits)
+            bits = self._read_next_float(f.number)
+            return c.bits_to_float(bits)
+        if f.type == FieldType.INT64:
+            delta = read_varint(s)
+            base = self._prev.get(f.number, 0)
+            return base + delta
+        if f.type == FieldType.BOOL:
+            return s.read_bits(1) == 1
+        if f.type == FieldType.BYTES:
+            d = self._dict(f.number)
+            if s.read_bits(1) == 1:
+                v = d.entries[s.read_bits(_DICT_BITS)]
+            else:
+                n = read_varint(s)
+                v = bytes(s.read_bits(8) for _ in range(n))
+            d.push(v)
+            return v
+        raise ValueError(f.type)
+
+    def _dict(self, number: int) -> _BytesDict:
+        dicts = getattr(self, "_dicts", None)
+        if dicts is None:
+            dicts = self._dicts = {}
+        d = dicts.get(number)
+        if d is None:
+            d = dicts[number] = _BytesDict()
+        return d
+
+    def _read_next_float(self, number: int) -> int:
+        """m3tsz XOR read against this field's own state."""
+        s = self._stream
+        prev_bits = self._prev_bits[number]
+        prev_xor = self._prev_xor[number]
+        if s.read_bits(1) == c.OPCODE_ZERO_VALUE_XOR:
+            xor = 0
+        elif s.read_bits(1) == 0:  # contained '10'
+            from m3_tpu.utils.bitstream import leading_zeros64, trailing_zeros64
+
+            pl, pt = leading_zeros64(prev_xor), trailing_zeros64(prev_xor)
+            m = 64 - pl - pt
+            xor = s.read_bits(m) << pt
+        else:  # uncontained '11'
+            lead = s.read_bits(6)
+            m = s.read_bits(6) + 1
+            mant = s.read_bits(m)
+            xor = mant << (64 - lead - m)
+        bits = prev_bits ^ xor
+        self._prev_bits[number] = bits
+        # the encoder records the xor unconditionally (including 0)
+        self._prev_xor[number] = xor
+        return bits
+
+
+def _normalize(f, v):
+    if v is None:
+        return _zero(f)
+    if f.type == FieldType.DOUBLE:
+        return float(v)
+    if f.type == FieldType.INT64:
+        return int(v)
+    if f.type == FieldType.BOOL:
+        return bool(v)
+    if f.type == FieldType.BYTES:
+        return bytes(v)
+    raise ValueError(f.type)
+
+
+def _zero(f):
+    return {
+        FieldType.DOUBLE: 0.0,
+        FieldType.INT64: 0,
+        FieldType.BOOL: False,
+        FieldType.BYTES: b"",
+    }[f.type]
+
+
+def encode_messages(start_ns: int, schema: Schema,
+                    points: list[tuple[int, dict]],
+                    unit: TimeUnit = TimeUnit.SECOND) -> bytes:
+    enc = ProtoEncoder(start_ns, schema, unit)
+    for t, msg in points:
+        enc.encode(t, msg, unit)
+    return enc.stream()
+
+
+def decode(data: bytes, schema: Schema,
+           unit: TimeUnit = TimeUnit.SECOND) -> list[ProtoDatapoint]:
+    if not data:
+        return []
+    return list(ProtoDecoder(data, schema, unit))

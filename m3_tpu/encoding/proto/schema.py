@@ -1,0 +1,79 @@
+"""Message schemas + per-namespace registry (dbnode/namespace schema
+registry role, reference namespace/types.go:254 SchemaRegistry)."""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass
+
+
+class FieldType(enum.Enum):
+    DOUBLE = "double"
+    INT64 = "int64"
+    BOOL = "bool"
+    BYTES = "bytes"
+
+
+@dataclass(frozen=True)
+class Field:
+    number: int  # stable field id (proto field-number role)
+    name: str
+    type: FieldType
+
+
+@dataclass(frozen=True)
+class Schema:
+    name: str
+    fields: tuple[Field, ...]
+
+    def __post_init__(self):
+        nums = [f.number for f in self.fields]
+        if len(set(nums)) != len(nums):
+            raise ValueError("duplicate field numbers")
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "name": self.name,
+            "fields": [
+                {"number": f.number, "name": f.name, "type": f.type.value}
+                for f in self.fields
+            ],
+        }).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "Schema":
+        doc = json.loads(raw)
+        return cls(
+            name=doc["name"],
+            fields=tuple(
+                Field(f["number"], f["name"], FieldType(f["type"]))
+                for f in doc["fields"]
+            ),
+        )
+
+
+class SchemaRegistry:
+    """namespace -> deployed Schema, optionally persisted in KV under
+    schemas/<namespace> (the dynamic schema registry role)."""
+
+    _KV_PREFIX = "schemas/"
+
+    def __init__(self, kv=None):
+        self.kv = kv
+        self._local: dict[str, Schema] = {}
+
+    def set(self, namespace: str, schema: Schema) -> None:
+        self._local[namespace] = schema
+        if self.kv is not None:
+            self.kv.set(self._KV_PREFIX + namespace, schema.to_json())
+
+    def get(self, namespace: str) -> Schema | None:
+        if self.kv is not None:
+            from m3_tpu.cluster.kv import KeyNotFound
+
+            try:
+                return Schema.from_json(self.kv.get(self._KV_PREFIX + namespace).data)
+            except KeyNotFound:
+                pass
+        return self._local.get(namespace)

@@ -42,6 +42,24 @@ DEFAULT_LOOKBACK_NS = 5 * 60 * NS
 # budget; re-exported here for the existing query-facing API
 from m3_tpu.storage.limits import QueryLimitError, QueryLimits  # noqa: E402
 
+def _resolve_at_sentinels(e, start_ns: int, end_ns: int) -> None:
+    """Replace @ start()/end() with the TOP-LEVEL query range bounds
+    everywhere in the AST (upstream semantics: the sentinels always refer
+    to the outer query, even inside subqueries)."""
+    at = getattr(e, "at_ns", None)
+    if at == "start":
+        e.at_ns = start_ns
+    elif at == "end":
+        e.at_ns = end_ns
+    for attr in ("expr", "selector", "lhs", "rhs", "param"):
+        child = getattr(e, attr, None)
+        if isinstance(child, Expr):
+            _resolve_at_sentinels(child, start_ns, end_ns)
+    for child in getattr(e, "args", ()) or ():
+        if isinstance(child, Expr):
+            _resolve_at_sentinels(child, start_ns, end_ns)
+
+
 # functions that keep the metric name on their output
 _KEEPS_NAME = {"sort", "sort_desc", "last_over_time"}
 
@@ -112,6 +130,7 @@ class Engine:
         limits.start_query()
         try:
             expr = promql.parse(q)
+            _resolve_at_sentinels(expr, int(eval_ts[0]), int(eval_ts[-1]))
             return self._eval(expr, eval_ts), eval_ts
         finally:
             limits.end_query()
@@ -122,6 +141,7 @@ class Engine:
         limits.start_query()
         try:
             expr = promql.parse(q)
+            _resolve_at_sentinels(expr, t_ns, t_ns)
             return self._eval(expr, eval_ts), eval_ts
         finally:
             limits.end_query()
@@ -130,15 +150,12 @@ class Engine:
 
     def _resolve_ts(self, sel, eval_ts: np.ndarray) -> np.ndarray:
         """Selector evaluation timestamps: apply the @ modifier (pin every
-        step to one instant; start()/end() resolve to the query range
-        bounds) and then the offset."""
+        step to one instant) and then the offset. start()/end() sentinels
+        were already resolved against the TOP-LEVEL query range at parse
+        resolution — inside a subquery they must not see the inner grid."""
         at = getattr(sel, "at_ns", None)
         if at is not None:
-            if at == "start":
-                at = int(eval_ts[0])
-            elif at == "end":
-                at = int(eval_ts[-1])
-            eval_ts = np.full_like(eval_ts, at)
+            eval_ts = np.full_like(eval_ts, int(at))
         return eval_ts - sel.offset_ns
 
     def _fetch(self, sel: VectorSelector, eval_ts: np.ndarray, range_ns: int):

@@ -703,7 +703,6 @@ def _sort_by_name(eng, args, *_):
 # ---------------------------------------------------------------------------
 
 import contextlib as _contextlib
-import re as _re
 import warnings as _warnings
 
 
@@ -736,10 +735,7 @@ def _safe_stat(fn, values):
 
 @register("group")
 def _group(eng, args, *_):
-    out = []
-    for a in args:
-        out.extend(a)
-    return out
+    return _flatten(args)
 
 
 @register("identity")
@@ -760,7 +756,7 @@ def _threshold(eng, args, start, end, step):
 @register("aliasSub")
 def _alias_sub(eng, args, *_):
     series, search, replace = args[0], args[1], args[2]
-    rx = _re.compile(search.encode() if isinstance(search, str) else search)
+    rx = re.compile(search.encode() if isinstance(search, str) else search)
     rep = replace.encode() if isinstance(replace, str) else replace
     # graphite uses \1 backrefs; python re.sub supports them directly
     return [Series(rx.sub(rep, s.name), s.times, s.values) for s in series]
@@ -925,12 +921,18 @@ def _delay(eng, args, *_):
 
 @register("changed")
 def _changed(eng, args, *_):
+    # graphite semantics: None points emit 0, and comparison is against
+    # the LAST NON-NULL value (a change across a gap still counts)
     out = []
     for s in args[0]:
         v = s.values
-        prev = np.concatenate([[np.nan], v[:-1]])
-        ch = ((v != prev) & ~(np.isnan(v) & np.isnan(prev))).astype(float)
-        ch[np.isnan(prev)] = 0.0
+        # forward-fill previous non-null value at each position
+        idx = np.where(np.isnan(v), 0, np.arange(len(v)) + 1)
+        np.maximum.accumulate(idx, out=idx)
+        prev_nn = np.concatenate([[np.nan], np.where(idx[:-1] > 0,
+                                                     v[np.maximum(idx[:-1] - 1, 0)],
+                                                     np.nan)])
+        ch = ((v != prev_nn) & ~np.isnan(v) & ~np.isnan(prev_nn)).astype(float)
         out.append(Series(s.name, s.times, ch))
     return out
 
@@ -999,9 +1001,7 @@ def _percentile_of_series(eng, args, *_):
 
 @register("rangeOfSeries")
 def _range_of_series(eng, args, *_):
-    series = []
-    for a in args:
-        series.extend(a)
+    series = _flatten(args)
     with _quiet():
         return _combine(series, lambda st: np.nanmax(st, axis=0) - np.nanmin(st, axis=0),
                         b"rangeOfSeries")
@@ -1009,9 +1009,7 @@ def _range_of_series(eng, args, *_):
 
 @register("multiplySeries")
 def _multiply_series(eng, args, *_):
-    series = []
-    for a in args:
-        series.extend(a)
+    series = _flatten(args)
     with _quiet():
         return _combine(series, _nan_masked(lambda st: np.nanprod(st, axis=0)),
                         b"multiplySeries")

@@ -26,6 +26,7 @@ import argparse
 import json
 import math
 import os
+import urllib.error
 import urllib.request
 
 START = 1_600_000_000  # unix seconds; aligned, deterministic
@@ -151,7 +152,15 @@ def run_queries(base_url: str, q_start: int, q_end: int, q_step: int):
         u = (f"{base_url}/api/v1/query_range?query="
              f"{urllib.request.quote(query, safe='')}"
              f"&start={q_start}&end={q_end}&step={q_step}")
-        doc = json.loads(urllib.request.urlopen(u, timeout=30).read())
+        try:
+            doc = json.loads(urllib.request.urlopen(u, timeout=30).read())
+        except urllib.error.HTTPError as e:  # coordinator returns errors as 4xx
+            try:
+                err = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                err = str(e)
+            out[name] = {"__error__": [(0, err)]}
+            continue
         if doc.get("status") != "success":
             out[name] = {"__error__": [(0, doc.get("error", "?"))]}
             continue
@@ -164,15 +173,24 @@ def run_queries(base_url: str, q_start: int, q_end: int, q_step: int):
 
 
 def seed_via_http(base_url: str) -> int:
+    """One batched Prometheus remote-write request (not 810 point POSTs)."""
+    from m3_tpu.utils import protowire, snappy
+
+    series = []
     n = 0
     for metric, tags, pts in seed_points():
-        for t, v in pts:
-            body = json.dumps({"metric": metric, "tags": tags,
-                               "timestamp": t, "value": v}).encode()
-            urllib.request.urlopen(urllib.request.Request(
-                f"{base_url}/api/v1/json/write", data=body, method="POST"),
-                timeout=30)
-            n += 1
+        labels = sorted(
+            [(b"__name__", metric.encode())]
+            + [(k.encode(), v.encode()) for k, v in tags.items()]
+        )
+        series.append(protowire.PromTimeSeries(
+            labels=labels, samples=[(t * 1000, v) for t, v in pts]))
+        n += len(pts)
+    payload = snappy.compress(protowire.encode_write_request(series))
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base_url}/api/v1/prom/remote/write", data=payload, method="POST",
+        headers={"Content-Type": "application/x-protobuf"},
+    ), timeout=60)
     return n
 
 

@@ -343,6 +343,11 @@ class TestLongTailBuiltins:
         eng = self._eng(db, {"ms.a": [1, 1, 2]})
         out = self.render(eng, "changed(ms.a)")[0]
         np.testing.assert_allclose(out.values, [0, 0, 1])
+        # graphite gap semantics: None emits 0, change ACROSS a gap counts
+        from m3_tpu.query.graphite import FUNCTIONS, Series as GSeries
+        gap = GSeries(b"g", np.arange(3), np.array([1.0, np.nan, 2.0]))
+        np.testing.assert_allclose(
+            FUNCTIONS["changed"](None, [[gap]])[0].values, [0, 0, 1])
         out = self.render(eng, "isNonNull(ms.a)")[0]
         np.testing.assert_allclose(out.values, [1, 1, 1])
         out = self.render(eng, "delay(ms.a, 1)")[0]
