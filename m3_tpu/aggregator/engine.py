@@ -39,6 +39,10 @@ class ElemKey:
     policy: StoragePolicy
     aggregations: tuple[AggregationType, ...]
     transform: TransformationType | None = None
+    # (aggregations, resolution_ns) of a SECOND aggregation stage the
+    # first stage's window outputs forward into (multi-stage pipelines,
+    # reference forwarded_writer.go)
+    forward: tuple[tuple[AggregationType, ...], int] | None = None
 
 
 @dataclass
@@ -50,6 +54,9 @@ class Elem:
     # previous emitted window aggregate per aggregation (for binary
     # transforms like PerSecond), keyed by aggregation type
     prev: dict[AggregationType, tuple[int, float]] = field(default_factory=dict)
+    # extra window-close lag: second-stage elems wait for their source
+    # stage's resolution so late first-stage flushes still land
+    extra_lag_ns: int = 0
 
 
 @dataclass
@@ -123,19 +130,27 @@ class Aggregator:
         # samples landing in them are rejected (reference buffer-past rule)
         self._watermark_ns = 0
         self._elem_res: list[int] = []
+        self._elem_lag: list[int] = []
+        # completion time of the previous flush: second-stage windows may
+        # only close once EVERY source window feeding them was forwarded,
+        # i.e. when their end precedes the previous flush's watermark
+        self._last_flush_ns = 0
 
     # -- add path --
 
     def _shard_for(self, series_id: bytes) -> int:
         return murmur3_32(series_id) % self.n_shards
 
-    def _elem(self, key: ElemKey, tags, metric_type: MetricType) -> Elem:
+    def _elem(self, key: ElemKey, tags, metric_type: MetricType,
+              extra_lag_ns: int = 0) -> Elem:
         e = self._elems.get(key)
         if e is None:
-            e = Elem(len(self._elem_list), key, tuple(tags), metric_type)
+            e = Elem(len(self._elem_list), key, tuple(tags), metric_type,
+                     extra_lag_ns=extra_lag_ns)
             self._elems[key] = e
             self._elem_list.append(e)
             self._elem_res.append(key.policy.resolution_ns)
+            self._elem_lag.append(extra_lag_ns)
         return e
 
     def add(
@@ -163,10 +178,14 @@ class Aggregator:
                 )
                 self._append(series_id, elem, t_ns, value)
         for _rule, target, rolled_id, rolled_tags in result.rollups:
+            forward = None
+            if target.forward_aggregations and target.forward_resolution_ns:
+                forward = (tuple(target.forward_aggregations),
+                           target.forward_resolution_ns)
             for policy in target.policies:
                 elem = self._elem(
                     ElemKey(rolled_id, policy, tuple(target.aggregations),
-                            target.transform),
+                            target.transform, forward),
                     [(b"__name__", target.new_name), *rolled_tags],
                     metric_type,
                 )
@@ -197,6 +216,8 @@ class Aggregator:
             self._watermark_ns = max(self._watermark_ns, now_ns)
             res_by_elem = (np.array(self._elem_res, np.int64)
                            if self._elem_res else np.zeros(0, np.int64))
+            lag_by_elem = (np.array(self._elem_lag, np.int64)
+                           if self._elem_lag else np.zeros(0, np.int64))
             taken = {sid: buf.take() for sid, buf in self._shards.items()}
             carries = {sid: self._carry.pop(sid, None) for sid in self._shards}
         for shard_id in taken:
@@ -210,7 +231,16 @@ class Aggregator:
                 continue
             res = res_by_elem[e_idx]
             window_end = (times // res + 1) * res
-            closed = window_end + self.buffer_past_ns <= now_ns
+            # second-stage elems (nonzero lag marker) close against the
+            # PREVIOUS flush time: every source window ending before that
+            # was forwarded during that flush and is visible now — exact
+            # completeness regardless of tick cadence
+            second = lag_by_elem[e_idx] > 0
+            closed = np.where(
+                second,
+                window_end + self.buffer_past_ns <= self._last_flush_ns,
+                window_end + self.buffer_past_ns <= now_ns,
+            )
             if not closed.all():
                 keep = ~closed
                 with self._lock:
@@ -224,6 +254,7 @@ class Aggregator:
             )
             out.extend(self._emit(ge, gw, stats, vq, offsets))
         out.sort(key=lambda m: (m.timestamp_ns, m.series_id))
+        self._last_flush_ns = max(self._last_flush_ns, now_ns)
         return out
 
     def _emit(self, ge, gw, stats, vq, offsets) -> list[AggregatedMetric]:
@@ -263,6 +294,13 @@ class Aggregator:
                     tags = tuple(
                         (k, v + suffix if k == b"__name__" else v) for k, v in tags
                     )
+                if elem.key.forward is not None:
+                    # multi-stage pipeline: the first-stage window aggregate
+                    # is FORWARDED into the coarser second stage instead of
+                    # emitted (forwarded_writer.go role, in-process here;
+                    # cross-instance forwarding rides the msg topic)
+                    self._forward(elem, suffix, tags, w_end, res, value)
+                    continue
                 out.append(
                     AggregatedMetric(
                         series_id=elem.key.series_id + suffix,
@@ -273,6 +311,25 @@ class Aggregator:
                     )
                 )
         return out
+
+    def _forward(self, elem: Elem, suffix: bytes, tags, w_end: int,
+                 res: int, value: float) -> None:
+        """AddForwarded: route a first-stage window aggregate into its
+        second-stage elem. Timestamped at the source window START so it
+        lands in the second-stage window covering that span; the
+        second-stage elem closes windows one source-resolution late to
+        tolerate first-stage flush lag."""
+        fwd_aggs, fwd_res = elem.key.forward
+        policy = StoragePolicy(fwd_res, elem.key.policy.retention_ns)
+        fkey = ElemKey(elem.key.series_id + suffix, policy, fwd_aggs)
+        with self._lock:
+            felem = self._elem(fkey, tags, elem.metric_type,
+                               extra_lag_ns=res)
+            shard = self._shards[self._shard_for(fkey.series_id)]
+            if shard.n >= self.max_buffered_per_shard:
+                self.num_dropped += 1
+                return
+            shard.append(felem.index, w_end - res, value)
 
     @property
     def n_elems(self) -> int:

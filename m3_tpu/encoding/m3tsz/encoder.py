@@ -123,6 +123,22 @@ def write_special_marker(os: OStream, marker: int) -> None:
     os.write_bits(marker, c.NUM_MARKER_VALUE_BITS)
 
 
+def finalize_stream(os: OStream) -> bytes:
+    """Cap a bit stream with the end-of-stream marker (shared by the
+    m3tsz and proto encoders)."""
+    if os.bit_length == 0:
+        return b""
+    raw, pos = os.raw()
+    tail = OStream()
+    if pos not in (0, 8):
+        tail.write_bits(raw[-1] >> (8 - pos), pos)
+        head = raw[:-1]
+    else:
+        head = raw
+    write_special_marker(tail, c.MARKER_END_OF_STREAM)
+    return head + tail.bytes_padded()
+
+
 class FloatXOREncoder:
     """Gorilla-style XOR float stream state."""
 
@@ -332,17 +348,7 @@ class Encoder:
 
     def stream(self) -> bytes:
         """Finalized stream: data capped with the end-of-stream marker."""
-        if self._os.bit_length == 0:
-            return b""
-        raw, pos = self._os.raw()
-        tail = OStream()
-        if pos not in (0, 8):
-            tail.write_bits(raw[-1] >> (8 - pos), pos)
-            head = raw[:-1]
-        else:
-            head = raw
-        write_special_marker(tail, c.MARKER_END_OF_STREAM)
-        return head + tail.bytes_padded()
+        return finalize_stream(self._os)
 
     @property
     def last_value(self) -> float:

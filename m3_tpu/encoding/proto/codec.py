@@ -17,7 +17,6 @@ Field payloads (reference scheme roles, encoder.go/custom_marshal.go):
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 
 from m3_tpu.encoding.m3tsz import constants as c
@@ -25,7 +24,7 @@ from m3_tpu.encoding.m3tsz.decoder import _TimestampIterator, read_varint
 from m3_tpu.encoding.m3tsz.encoder import (
     FloatXOREncoder,
     TimestampEncoder,
-    write_special_marker,
+    finalize_stream,
     write_varint,
 )
 from m3_tpu.encoding.proto.schema import FieldType, Schema
@@ -133,17 +132,7 @@ class ProtoEncoder:
             d.push(v)
 
     def stream(self) -> bytes:
-        if self._os.bit_length == 0:
-            return b""
-        raw, pos = self._os.raw()
-        tail = OStream()
-        if pos not in (0, 8):
-            tail.write_bits(raw[-1] >> (8 - pos), pos)
-            head = raw[:-1]
-        else:
-            head = raw
-        write_special_marker(tail, c.MARKER_END_OF_STREAM)
-        return head + tail.bytes_padded()
+        return finalize_stream(self._os)
 
 
 class ProtoDecoder:
@@ -157,7 +146,7 @@ class ProtoDecoder:
         self._prev: dict[int, object] = {}
         self._prev_bits: dict[int, int] = {}
         self._prev_xor: dict[int, int] = {}
-        self._first = True
+        self._dicts: dict[int, _BytesDict] = {}
 
     def __iter__(self):
         while True:
@@ -175,7 +164,6 @@ class ProtoDecoder:
                     v = self._read_field(f)
                     self._prev[f.number] = v
                 msg[f.name] = self._prev.get(f.number, _zero(f))
-            self._first = False
             yield ProtoDatapoint(self._ts.prev_time, msg)
 
     def _read_field(self, f):
@@ -206,12 +194,9 @@ class ProtoDecoder:
         raise ValueError(f.type)
 
     def _dict(self, number: int) -> _BytesDict:
-        dicts = getattr(self, "_dicts", None)
-        if dicts is None:
-            dicts = self._dicts = {}
-        d = dicts.get(number)
+        d = self._dicts.get(number)
         if d is None:
-            d = dicts[number] = _BytesDict()
+            d = self._dicts[number] = _BytesDict()
         return d
 
     def _read_next_float(self, number: int) -> int:

@@ -79,10 +79,13 @@ class NamespaceIndex:
 
     def query(self, query: Query, start_ns: int, end_ns: int, limit: int | None = None):
         """Docs whose series matched in any overlapping index block."""
-        segments = []
-        for blk in self._overlapping(start_ns, end_ns):
-            segments.extend(blk.segments())
-        return search(segments, query, limit)
+        from m3_tpu.utils import trace
+
+        with trace.span(trace.INDEX_QUERY):
+            segments = []
+            for blk in self._overlapping(start_ns, end_ns):
+                segments.extend(blk.segments())
+            return search(segments, query, limit)
 
     def aggregate_field_names(self, start_ns: int, end_ns: int) -> list[bytes]:
         names: set[bytes] = set()

@@ -36,6 +36,12 @@ class RollupTarget:
     # optional pipeline transform applied between aggregation and emit
     # (metrics/pipeline + transformation roles: e.g. PerSecond for rates)
     transform: "TransformationType | None" = None
+    # optional SECOND aggregation stage: first-stage window aggregates are
+    # forwarded (the numForwardedTimes multi-stage pipeline role,
+    # reference aggregator/forwarded_writer.go + metrics/pipeline) into a
+    # coarser window aggregated with these types
+    forward_aggregations: tuple[AggregationType, ...] = ()
+    forward_resolution_ns: int = 0
 
 
 @dataclass

@@ -77,6 +77,72 @@ def _parse_series_selector(sel: str) -> list[Matcher]:
     return vs.matchers
 
 
+def _parse_influx_line(line: bytes):
+    """'measurement,tag=v field=1.5,other=2i 1600000000000000000' ->
+    (measurement, [(k, v)], [(field, float)], t_ns|None), or None."""
+    try:
+        # split on unescaped spaces: sections = ident, fields, [timestamp]
+        sections = _split_unescaped(line, b" ")
+        if len(sections) < 2:
+            return None
+        ident_parts = _split_unescaped(sections[0], b",")
+        measurement = _influx_unescape(ident_parts[0])
+        tags = []
+        for part in ident_parts[1:]:
+            k, _, v = part.partition(b"=")
+            tags.append((_influx_unescape(k), _influx_unescape(v)))
+        fields = []
+        for part in _split_unescaped(sections[1], b","):
+            k, _, v = part.partition(b"=")
+            if v.endswith(b"i") or v.endswith(b"u"):
+                fv = float(int(v[:-1]))
+            elif v in (b"t", b"T", b"true", b"True"):
+                fv = 1.0
+            elif v in (b"f", b"F", b"false", b"False"):
+                fv = 0.0
+            elif v.startswith(b'"'):
+                continue  # string fields have no numeric representation
+            else:
+                fv = float(v)
+            fields.append((_influx_unescape(k), fv))
+        if not fields:
+            return None
+        t_ns = int(sections[2]) if len(sections) > 2 else None
+        return measurement, sorted(tags), fields, t_ns
+    except (ValueError, IndexError):
+        return None
+
+
+def _split_unescaped(raw: bytes, sep: bytes) -> list[bytes]:
+    """Split on sep outside escapes AND outside double-quoted strings
+    (string field values may contain commas/spaces)."""
+    out = []
+    cur = bytearray()
+    i = 0
+    in_quotes = False
+    while i < len(raw):
+        c = raw[i:i + 1]
+        if c == b"\\" and i + 1 < len(raw):
+            cur += raw[i:i + 2]
+            i += 2
+            continue
+        if c == b'"':
+            in_quotes = not in_quotes
+            cur += c
+        elif c == sep and not in_quotes:
+            out.append(bytes(cur))
+            cur = bytearray()
+        else:
+            cur += c
+        i += 1
+    out.append(bytes(cur))
+    return [p for p in out if p]
+
+
+def _influx_unescape(raw: bytes) -> bytes:
+    return raw.replace(b"\\,", b",").replace(b"\\ ", b" ").replace(b"\\=", b"=")
+
+
 def _fmt_value(v: float) -> str:
     if np.isnan(v):
         return "NaN"
@@ -156,12 +222,21 @@ class CoordinatorAPI:
                     default_registry().render_prometheus())
         if path == "/debug/dump":
             return self._debug_dump()
+        if path == "/debug/traces":
+            from m3_tpu.utils.trace import default_tracer
+
+            limit = int(q.get("limit", ["200"])[0])
+            return 200, "application/json", json.dumps(
+                {"spans": default_tracer().recent(limit)}
+            ).encode()
         if path == "/api/v1/prom/remote/write" and method == "POST":
             return self._remote_write(body)
         if path == "/api/v1/prom/remote/read" and method == "POST":
             return self._remote_read(body)
         if path == "/api/v1/json/write" and method == "POST":
             return self._json_write(body)
+        if path == "/api/v1/influxdb/write" and method == "POST":
+            return self._influx_write(q, body)
         if path == "/api/v1/query_range":
             return self._query_range(q)
         if path == "/api/v1/query":
@@ -301,6 +376,46 @@ class CoordinatorAPI:
             t_ns = time.time_ns()
         self._write(name, tags, t_ns, float(doc["value"]))
         return 200, "application/json", b'{"status":"success"}'
+
+    def _influx_write(self, q, body: bytes):
+        """InfluxDB line protocol ingest (the reference influxdb handler,
+        api/v1/handler/influxdb/write.go): each field of a line becomes a
+        series named measurement_field, tags become labels."""
+        import gzip
+
+        if body[:2] == b"\x1f\x8b":
+            body = gzip.decompress(body)
+        precision = q.get("precision", ["ns"])[0]
+        mult = {"ns": 1, "u": 10**3, "us": 10**3, "ms": 10**6,
+                "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9}.get(precision)
+        if mult is None:
+            return 400, "application/json", json.dumps(
+                {"status": "error", "error": f"invalid precision {precision!r}"}
+            ).encode()
+        n = 0
+        errors = 0
+        for line in body.splitlines():
+            line = line.strip()
+            if not line or line.startswith(b"#"):
+                continue
+            parsed = _parse_influx_line(line)
+            if parsed is None:
+                errors += 1
+                continue
+            measurement, tags, fields, t_ns = parsed
+            if t_ns is None:
+                t_ns = time.time_ns()
+            else:
+                t_ns *= mult
+            for fname, fval in fields:
+                name = measurement + b"_" + fname if fname != b"value" else measurement
+                self._write(name, tags, t_ns, fval)
+                n += 1
+        if errors and not n:
+            return 400, "application/json", json.dumps(
+                {"status": "error", "error": f"{errors} unparseable lines"}
+            ).encode()
+        return 204, "application/json", b""
 
     # -- read --
 

@@ -128,10 +128,13 @@ class Engine:
         limits = self._active_limits()
         limits.check_steps(len(eval_ts))
         limits.start_query()
+        from m3_tpu.utils import trace
+
         try:
-            expr = promql.parse(q)
-            _resolve_at_sentinels(expr, int(eval_ts[0]), int(eval_ts[-1]))
-            return self._eval(expr, eval_ts), eval_ts
+            with trace.span(trace.ENGINE_QUERY, steps=len(eval_ts)):
+                expr = promql.parse(q)
+                _resolve_at_sentinels(expr, int(eval_ts[0]), int(eval_ts[-1]))
+                return self._eval(expr, eval_ts), eval_ts
         finally:
             limits.end_query()
 

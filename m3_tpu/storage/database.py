@@ -378,6 +378,13 @@ class Database:
         The QueryIDs -> ReadEncoded flow of the reference
         (storage/database.go:1005,1068) collapsed into one call.
         """
+        from m3_tpu.utils import trace
+
+        with trace.span(trace.DB_QUERY, namespace=namespace):
+            return self._query_traced(namespace, matchers, start_ns, end_ns,
+                                      limit)
+
+    def _query_traced(self, namespace, matchers, start_ns, end_ns, limit):
         from m3_tpu.index.query import matchers_to_query
 
         ns = self.namespaces[namespace]

@@ -169,6 +169,13 @@ class Shard:
         series are decoded, merged with the buffer's, and a higher volume is
         written — the role of the reference's fs merger (persist/fs/merger.go).
         """
+        from m3_tpu.utils import trace
+
+        with trace.span(trace.SHARD_FLUSH, shard=self.shard_id,
+                        block_start=block_start):
+            return self._flush_traced(block_start)
+
+    def _flush_traced(self, block_start: int) -> bool:
         import jax.numpy as jnp
 
         from m3_tpu.encoding.m3tsz import decode as scalar_decode

@@ -1,0 +1,107 @@
+"""Named tracepoints + in-process span recording.
+
+Role parity with the reference's OpenTracing plumbing
+(/root/reference/src/dbnode/tracepoint/tracepoint.go named operation
+constants, x/context StartSampledTraceSpan, x/opentracing/tracing.go): hot
+paths open named spans that nest via a thread-local stack and land in a
+bounded ring buffer exposed at /debug/traces. Sampling keeps the
+steady-state cost to a perf_counter call; an OTLP-style exporter can drain
+the ring without touching the serving path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+# tracepoint name constants (the tracepoint.go role)
+DB_WRITE = "storage.db.write"
+DB_QUERY = "storage.db.query"
+INDEX_QUERY = "index.query"
+SHARD_FLUSH = "storage.shard.flush"
+ENGINE_QUERY = "query.engine.query_range"
+SESSION_FETCH = "client.session.fetch_many"
+AGG_FLUSH = "aggregator.flush"
+
+
+@dataclass
+class Span:
+    name: str
+    start_ns: int
+    duration_ns: int = 0
+    parent: str | None = None
+    tags: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "start_unix_ns": self.start_ns,
+            "duration_us": round(self.duration_ns / 1000, 1),
+            "parent": self.parent,
+            **({"tags": self.tags} if self.tags else {}),
+        }
+
+
+class Tracer:
+    """Bounded recorder; one per process (default_tracer())."""
+
+    def __init__(self, capacity: int = 2048, sample_every: int = 1):
+        self.capacity = capacity
+        self.sample_every = max(1, sample_every)
+        self._spans: deque[Span] = deque(maxlen=capacity)
+        self._tl = threading.local()
+        self._lock = threading.Lock()
+        self._counter = 0
+        self.enabled = True
+
+    def _stack(self) -> list:
+        st = getattr(self._tl, "stack", None)
+        if st is None:
+            st = self._tl.stack = []
+        return st
+
+    @contextmanager
+    def span(self, name: str, **tags):
+        if not self.enabled:
+            yield None
+            return
+        self._counter += 1  # racy increment is fine for sampling
+        if self._counter % self.sample_every:
+            yield None
+            return
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        sp = Span(name, time.time_ns(), parent=parent, tags=dict(tags))
+        stack.append(name)
+        t0 = time.perf_counter_ns()
+        try:
+            yield sp
+        finally:
+            sp.duration_ns = time.perf_counter_ns() - t0
+            stack.pop()
+            with self._lock:
+                self._spans.append(sp)
+
+    def recent(self, limit: int = 200) -> list[dict]:
+        with self._lock:
+            spans = list(self._spans)[-limit:]
+        return [s.to_dict() for s in spans]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+_default = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _default
+
+
+def span(name: str, **tags):
+    """Open a span on the process tracer: `with trace.span(trace.DB_WRITE):`"""
+    return _default.span(name, **tags)

@@ -273,3 +273,86 @@ class TestLateArrivals:
         out = agg.flush(START + 120 * SEC)
         assert out == []
         assert agg.num_late_dropped == 1
+
+
+class TestMultiStagePipelines:
+    def test_forwarded_second_stage(self):
+        """per-host sum @10s forwarded into a global max @60s (the
+        numForwardedTimes two-stage pipeline)."""
+        rules = RuleSet(rollup_rules=[
+            RollupRule("r", TagFilter.parse("__name__:reqs"), (
+                RollupTarget(
+                    new_name=b"reqs_max1m_of_sum10s",
+                    group_by=(b"svc",),
+                    aggregations=(A.SUM,),
+                    policies=(StoragePolicy.parse("10s:2d"),),
+                    forward_aggregations=(A.MAX,),
+                    forward_resolution_ns=60 * SEC,
+                ),
+            )),
+        ])
+        agg = Aggregator(rules, n_shards=2)
+        # minute window [0, 60): six 10s windows with sums 2,4,6,8,10,12
+        for w in range(6):
+            for k in range(w + 1):
+                for host in (b"h1", b"h2"):
+                    agg.add(MetricType.COUNTER, b"reqs|host=" + host,
+                            [(b"__name__", b"reqs"), (b"svc", b"s"),
+                             (b"host", host)],
+                            START + w * 10 * SEC + k, 1.0)
+        # first stage closes all six windows; forwards into stage 2
+        out1 = agg.flush(START + 70 * SEC)
+        assert out1 == []  # nothing emits directly from a forwarding elem
+        # second stage closes (window end 60s + lag 10s <= 80s)
+        out2 = agg.flush(START + 80 * SEC)
+        assert len(out2) == 1
+        m = out2[0]
+        assert m.series_id == b"reqs_max1m_of_sum10s|svc=s"
+        assert m.timestamp_ns == START + 60 * SEC
+        assert m.value == 12.0  # max of the six per-10s sums (2..12)
+        assert m.policy.resolution_ns == 60 * SEC
+
+    def test_single_stage_unaffected(self):
+        rules = RuleSet(rollup_rules=[
+            RollupRule("r", TagFilter.parse("__name__:lat"), (
+                RollupTarget(b"lat_sum", (b"svc",),
+                             (A.SUM,),
+                             (StoragePolicy.parse("10s:2d"),)),
+            )),
+        ])
+        agg = Aggregator(rules, n_shards=2)
+        agg.add(MetricType.GAUGE, b"lat|a=1",
+                [(b"__name__", b"lat"), (b"svc", b"x")], START + SEC, 5.0)
+        out = agg.flush(START + 30 * SEC)
+        assert len(out) == 1 and out[0].value == 5.0
+
+    def test_second_stage_waits_for_late_first_stage(self):
+        """A second-stage window never emits partially: it closes only
+        against the PREVIOUS flush watermark, so irregular tick cadence
+        cannot split one window into two emissions."""
+        rules = RuleSet(rollup_rules=[
+            RollupRule("r", TagFilter.parse("__name__:reqs"), (
+                RollupTarget(b"roll", (b"svc",), (A.SUM,),
+                             (StoragePolicy.parse("10s:2d"),),
+                             forward_aggregations=(A.MAX,),
+                             forward_resolution_ns=60 * SEC),
+            )),
+        ])
+        agg = Aggregator(rules, n_shards=2)
+        for w in range(6):
+            agg.add(MetricType.COUNTER, b"reqs|h=1",
+                    [(b"__name__", b"reqs"), (b"svc", b"s")],
+                    START + w * 10 * SEC + 1, float(w + 1))
+        # flush at 55s: source windows 0..4 forward; window [50,60) still open
+        out = agg.flush(START + 55 * SEC)
+        assert out == []
+        # flush at 85s: second window [0,60) must NOT close yet — its last
+        # source window only forwards during THIS flush
+        out = agg.flush(START + 85 * SEC)
+        assert out == []
+        # next flush: all six forwards visible -> one complete emission
+        out = agg.flush(START + 95 * SEC)
+        assert len(out) == 1
+        assert out[0].value == 6.0 and out[0].timestamp_ns == START + 60 * SEC
+        # and never again
+        assert agg.flush(START + 200 * SEC) == []

@@ -168,3 +168,54 @@ class TestHTTP:
         r = get(api, f"/api/v1/query?query=g*2&time={START_S+10}")
         assert r["data"]["resultType"] == "vector"
         assert float(r["data"]["result"][0]["value"][1]) == 5.0
+
+
+class TestInfluxWrite:
+    def test_line_protocol_ingest(self, api):
+        from m3_tpu.index.query import Matcher, MatchType
+
+        t0 = int(START_S) + 1
+        lines = (
+            b"cpu,host=h1,dc=east usage=0.5,idle=99i %d000000000\n" % t0
+            + b"mem,host=h1 value=2048 %d000000000\n" % (t0 + 1)
+            + b"weird\\ name,k=a\\,b value=7 %d000000000\n" % (t0 + 2)
+        )
+        req = urllib.request.Request(
+            api.base + "/api/v1/influxdb/write", data=lines, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 204
+        db = api.db
+        lo, hi = START, START + 60 * 10**9
+        res = db.query("default",
+                       [Matcher(MatchType.EQUAL, b"__name__", b"cpu_usage")],
+                       lo, hi)
+        assert len(res) == 1 and res[0][2][0].value == 0.5
+        assert dict(res[0][1])[b"host"] == b"h1"
+        res = db.query("default",
+                       [Matcher(MatchType.EQUAL, b"__name__", b"mem")], lo, hi)
+        assert res[0][2][0].value == 2048.0  # 'value' field keeps bare name
+        res = db.query("default",
+                       [Matcher(MatchType.EQUAL, b"__name__", b"weird name")],
+                       lo, hi)
+        assert dict(res[0][1])[b"k"] == b"a,b"
+
+    def test_precision_and_errors(self, api):
+        import urllib.error
+
+        from m3_tpu.index.query import Matcher, MatchType
+
+        t0 = int(START_S) + 5
+        req = urllib.request.Request(
+            api.base + "/api/v1/influxdb/write?precision=s",
+            data=b"secs value=1 %d" % t0, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 204
+        res = api.db.query(
+            "default", [Matcher(MatchType.EQUAL, b"__name__", b"secs")],
+            START, START + 60 * 10**9)
+        assert res[0][2][0].timestamp_ns == t0 * 10**9
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                api.base + "/api/v1/influxdb/write",
+                data=b"garbage with no fields", method="POST"), timeout=10)
+        assert ei.value.code == 400
