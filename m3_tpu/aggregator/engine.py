@@ -43,6 +43,10 @@ class ElemKey:
     # first stage's window outputs forward into (multi-stage pipelines,
     # reference forwarded_writer.go)
     forward: tuple[tuple[AggregationType, ...], int] | None = None
+    # second-stage elems carry their SOURCE stage's resolution so two
+    # first-stage policies forwarding into equal target policies stay
+    # distinct instead of conflating their streams
+    source_resolution_ns: int = 0
 
 
 @dataclass
@@ -54,9 +58,9 @@ class Elem:
     # previous emitted window aggregate per aggregation (for binary
     # transforms like PerSecond), keyed by aggregation type
     prev: dict[AggregationType, tuple[int, float]] = field(default_factory=dict)
-    # extra window-close lag: second-stage elems wait for their source
-    # stage's resolution so late first-stage flushes still land
-    extra_lag_ns: int = 0
+    # marks an elem as a second pipeline stage (its windows close against
+    # the PREVIOUS flush watermark, not now — see flush())
+    second_stage: bool = False
 
 
 @dataclass
@@ -130,7 +134,7 @@ class Aggregator:
         # samples landing in them are rejected (reference buffer-past rule)
         self._watermark_ns = 0
         self._elem_res: list[int] = []
-        self._elem_lag: list[int] = []
+        self._elem_second: list[bool] = []
         # completion time of the previous flush: second-stage windows may
         # only close once EVERY source window feeding them was forwarded,
         # i.e. when their end precedes the previous flush's watermark
@@ -142,15 +146,15 @@ class Aggregator:
         return murmur3_32(series_id) % self.n_shards
 
     def _elem(self, key: ElemKey, tags, metric_type: MetricType,
-              extra_lag_ns: int = 0) -> Elem:
+              second_stage: bool = False) -> Elem:
         e = self._elems.get(key)
         if e is None:
             e = Elem(len(self._elem_list), key, tuple(tags), metric_type,
-                     extra_lag_ns=extra_lag_ns)
+                     second_stage=second_stage)
             self._elems[key] = e
             self._elem_list.append(e)
             self._elem_res.append(key.policy.resolution_ns)
-            self._elem_lag.append(extra_lag_ns)
+            self._elem_second.append(second_stage)
         return e
 
     def add(
@@ -216,8 +220,8 @@ class Aggregator:
             self._watermark_ns = max(self._watermark_ns, now_ns)
             res_by_elem = (np.array(self._elem_res, np.int64)
                            if self._elem_res else np.zeros(0, np.int64))
-            lag_by_elem = (np.array(self._elem_lag, np.int64)
-                           if self._elem_lag else np.zeros(0, np.int64))
+            second_by_elem = (np.array(self._elem_second, bool)
+                              if self._elem_second else np.zeros(0, bool))
             taken = {sid: buf.take() for sid, buf in self._shards.items()}
             carries = {sid: self._carry.pop(sid, None) for sid in self._shards}
         for shard_id in taken:
@@ -231,11 +235,11 @@ class Aggregator:
                 continue
             res = res_by_elem[e_idx]
             window_end = (times // res + 1) * res
-            # second-stage elems (nonzero lag marker) close against the
-            # PREVIOUS flush time: every source window ending before that
-            # was forwarded during that flush and is visible now — exact
-            # completeness regardless of tick cadence
-            second = lag_by_elem[e_idx] > 0
+            # second-stage elems close against the PREVIOUS flush time:
+            # every source window ending before that was forwarded during
+            # that flush and is visible now — exact completeness
+            # regardless of tick cadence
+            second = second_by_elem[e_idx]
             closed = np.where(
                 second,
                 window_end + self.buffer_past_ns <= self._last_flush_ns,
@@ -316,15 +320,16 @@ class Aggregator:
                  res: int, value: float) -> None:
         """AddForwarded: route a first-stage window aggregate into its
         second-stage elem. Timestamped at the source window START so it
-        lands in the second-stage window covering that span; the
-        second-stage elem closes windows one source-resolution late to
-        tolerate first-stage flush lag."""
+        lands in the second-stage window covering that span; second-stage
+        windows close against the previous flush watermark (see flush())
+        so late first-stage outputs always land first."""
         fwd_aggs, fwd_res = elem.key.forward
         policy = StoragePolicy(fwd_res, elem.key.policy.retention_ns)
-        fkey = ElemKey(elem.key.series_id + suffix, policy, fwd_aggs)
+        fkey = ElemKey(elem.key.series_id + suffix, policy, fwd_aggs,
+                       source_resolution_ns=res)
         with self._lock:
             felem = self._elem(fkey, tags, elem.metric_type,
-                               extra_lag_ns=res)
+                               second_stage=True)
             shard = self._shards[self._shard_for(fkey.series_id)]
             if shard.n >= self.max_buffered_per_shard:
                 self.num_dropped += 1

@@ -92,23 +92,28 @@ def _parse_influx_line(line: bytes):
             k, _, v = part.partition(b"=")
             tags.append((_influx_unescape(k), _influx_unescape(v)))
         fields = []
+        field_errors = 0
         for part in _split_unescaped(sections[1], b","):
             k, _, v = part.partition(b"=")
-            if v.endswith(b"i") or v.endswith(b"u"):
-                fv = float(int(v[:-1]))
-            elif v in (b"t", b"T", b"true", b"True"):
-                fv = 1.0
-            elif v in (b"f", b"F", b"false", b"False"):
-                fv = 0.0
-            elif v.startswith(b'"'):
-                continue  # string fields have no numeric representation
-            else:
-                fv = float(v)
+            try:
+                if v.endswith(b"i") or v.endswith(b"u"):
+                    fv = float(int(v[:-1]))
+                elif v in (b"t", b"T", b"true", b"True"):
+                    fv = 1.0
+                elif v in (b"f", b"F", b"false", b"False"):
+                    fv = 0.0
+                elif v.startswith(b'"'):
+                    continue  # string fields have no numeric representation
+                else:
+                    fv = float(v)
+            except ValueError:
+                field_errors += 1  # one bad field must not drop the line
+                continue
             fields.append((_influx_unescape(k), fv))
         if not fields:
             return None
         t_ns = int(sections[2]) if len(sections) > 2 else None
-        return measurement, sorted(tags), fields, t_ns
+        return measurement, sorted(tags), fields, t_ns, field_errors
     except (ValueError, IndexError):
         return None
 
@@ -402,7 +407,8 @@ class CoordinatorAPI:
             if parsed is None:
                 errors += 1
                 continue
-            measurement, tags, fields, t_ns = parsed
+            measurement, tags, fields, t_ns, field_errors = parsed
+            errors += field_errors
             if t_ns is None:
                 t_ns = time.time_ns()
             else:
@@ -411,9 +417,13 @@ class CoordinatorAPI:
                 name = measurement + b"_" + fname if fname != b"value" else measurement
                 self._write(name, tags, t_ns, fval)
                 n += 1
-        if errors and not n:
+        if errors:
+            # influx-style partial-write semantics: good points ARE
+            # written; the client still learns something was dropped
             return 400, "application/json", json.dumps(
-                {"status": "error", "error": f"{errors} unparseable lines"}
+                {"status": "error",
+                 "error": f"partial write: {errors} unparseable "
+                          f"lines/fields, {n} points written"}
             ).encode()
         return 204, "application/json", b""
 

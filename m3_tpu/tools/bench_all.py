@@ -1,0 +1,236 @@
+"""BASELINE.md configs #1-#5 as one harness.
+
+Prints one JSON line per config (same shape as bench.py). Sizes are
+env-tunable; defaults are sized to finish on CPU in a few minutes —
+on a real TPU set M3_BENCH_SCALE=1 for the full north-star shapes.
+
+    python -m m3_tpu.tools.bench_all [--configs 1,2,3,4,5]
+
+Baselines: the native C++ codec for #1 (same as bench.py); the HOST numpy
+implementations of the same computation for #2/#3/#5 (dispatch-forced), so
+vs_baseline is the device-vs-host speedup; pure-Python re.fullmatch vocab
+scan for #4 (what a naive engine would do).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _scale() -> float:
+    try:
+        return float(os.environ.get("M3_BENCH_SCALE", "0.1"))
+    except ValueError:
+        return 0.1
+
+
+def _emit(metric: str, dp_per_sec: float, baseline: float) -> None:
+    print(json.dumps({
+        "metric": metric,
+        "value": round(dp_per_sec / 1e6, 3),
+        "unit": "M datapoints/sec",
+        "vs_baseline": round(dp_per_sec / baseline, 3) if baseline else 0.0,
+    }), flush=True)
+
+
+def _time(fn, iters=3):
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    _block(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _block(out):
+    try:
+        import jax
+
+        jax.block_until_ready(out)
+    except Exception:
+        pass
+
+
+def config1_codec_roundtrip():
+    """100k-series M3TSZ encode/decode round-trip vs the C++ baseline."""
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _example_batch
+    from m3_tpu.encoding.m3tsz import native, tpu
+    from m3_tpu.utils.xtime import TimeUnit
+
+    B = max(int(100_000 * _scale()), 1024)
+    T = 120
+    times, vbits, start, n_points = _example_batch(B=B, T=T)
+    jt, jv = jnp.asarray(times), jnp.asarray(vbits)
+    js, jn = jnp.asarray(start), jnp.asarray(n_points)
+    cap = (64 + 80 * T + 11 + 63) // 64
+
+    def run():
+        blocks = tpu.encode_bits(jt, jv, js, jn, TimeUnit.SECOND, cap)
+        dec = tpu.decode(blocks.words, TimeUnit.SECOND, max_points=T)
+        return blocks.words, dec.times
+
+    dt = _time(run)
+    rate = B * T / dt
+    base = None
+    if native.available():
+        base = native.bench_roundtrip(
+            times[:4000], vbits.view(np.float64)[:4000], int(start[0]),
+            TimeUnit.SECOND)
+    _emit(f"#1 m3tsz roundtrip {B}x{T}", rate, base or 10e6)
+
+
+def config2_rollup():
+    """1M-series counter+gauge rollup 10s -> 1m (device vs host numpy)."""
+    from m3_tpu.ops import windowed_agg
+
+    n = max(int(6_000_000 * _scale()), 100_000)  # 1M series x 6 samples
+    rng = np.random.default_rng(0)
+    n_series = n // 6
+    e = rng.integers(0, n_series, n)
+    w = rng.integers(0, 6, n)
+    v = rng.normal(100, 10, n)
+    t = rng.integers(0, 10**9, n)
+
+    os.environ["M3_TPU_DEVICE_OPS"] = "1"
+    dt_dev = _time(lambda: windowed_agg.aggregate_groups(e, w, v, times=t)[2]["sum"])
+    os.environ["M3_TPU_DEVICE_OPS"] = "0"
+    dt_host = _time(lambda: windowed_agg.aggregate_groups(e, w, v, times=t)[2]["sum"])
+    os.environ.pop("M3_TPU_DEVICE_OPS", None)
+    _emit(f"#2 rollup {n} samples -> {n_series} series", n / dt_dev,
+          n / dt_host)
+
+
+def config3_promql_rate_sum(tmp=None):
+    """PromQL rate()+sum by() over a wide fetch (device vs host temporal)."""
+    from m3_tpu.query.windows import NS, RaggedSeries
+    from m3_tpu.query import windows
+
+    S = max(int(100_000 * _scale()), 4_000)
+    T = 240  # 1h at 15s
+    per = []
+    rng = np.random.default_rng(1)
+    base_t = np.arange(T, dtype=np.int64) * 15 * NS
+    for s in range(S):
+        v = rng.integers(1, 10, T).astype(np.float64).cumsum()
+        per.append((base_t, v))
+    raws = RaggedSeries.from_lists(per)
+    eval_ts = np.arange(300, 3600, 60, dtype=np.int64) * NS
+    n_dp = S * T
+
+    os.environ["M3_TPU_DEVICE_OPS"] = "1"
+    dt_dev = _time(lambda: windows.extrapolated_rate(raws, eval_ts, 300 * NS,
+                                                     True, True))
+    os.environ["M3_TPU_DEVICE_OPS"] = "0"
+    dt_host = _time(lambda: windows.extrapolated_rate(raws, eval_ts, 300 * NS,
+                                                      True, True))
+    os.environ.pop("M3_TPU_DEVICE_OPS", None)
+    _emit(f"#3 rate() {S} series x {T} pts", n_dp / dt_dev, n_dp / dt_host)
+
+
+def config4_regex_postings():
+    """High-cardinality regex queries over packed postings vs naive scan."""
+    import re
+
+    from m3_tpu.index import packed
+    from m3_tpu.index.segment import Document
+
+    n = max(int(10_000_000 * _scale()), 200_000)
+    docs = [Document(i, b"s%08d" % i, [(b"pod", b"pod-%08d" % i)])
+            for i in range(n)]
+    seg = packed.build(docs)
+    pats = [rb"pod-0000\d\d\d\d", rb"pod-000[0-4]\d+", rb"pod-.*99",
+            rb"pod-0(1|2)\d+", rb"pod-00001[0-9]{3}"]
+    pats = (pats * 10)[:50]
+
+    def run_packed():
+        total = 0
+        for p in pats:
+            seg._regex_cache.clear()
+            total += len(seg.postings_regexp(b"pod", re.compile(p)))
+        return total
+
+    t0 = time.perf_counter()
+    run_packed()
+    dt = time.perf_counter() - t0
+    # naive baseline: per-term fullmatch of ONE pattern, extrapolated to 50
+    terms = seg.terms(b"pod")[: min(n, 200_000)]
+    rx = re.compile(pats[0])
+    t0 = time.perf_counter()
+    sum(1 for t in terms if rx.fullmatch(t))
+    naive_per_query = (time.perf_counter() - t0) * (n / len(terms))
+    _emit(f"#4 50 regex queries over {n}-term postings",
+          50 * n / dt, 50 * n / (50 * naive_per_query))
+
+
+def config5_sharded_quantile():
+    """4-shard timer quantile rollup with cross-shard psum on a mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import m3_tpu.ops  # noqa: F401  (x64)
+
+    n_dev = min(4, len(jax.devices()))
+    devices = np.array(jax.devices()[:n_dev])
+    mesh = Mesh(devices, axis_names=("shard",))
+    S = max(int(10_000_000 * _scale()) // 64, 4096)
+    S -= S % n_dev
+    T = 64
+    rng = np.random.default_rng(2)
+    vals = rng.gamma(2.0, 10.0, (S, T))
+    gids = (np.arange(S) % 128).astype(np.int32)
+
+    @jax.jit
+    def quantile_rollup(v, g):
+        # per-series p99-ish via sort, then cross-shard group sums (psum
+        # rides the mesh partitioning through jnp operations under jit)
+        q = jnp.sort(v, axis=1)[:, int(T * 0.99)]
+        seg = jax.ops.segment_sum(q, g, num_segments=128)
+        cnt = jax.ops.segment_sum(jnp.ones_like(q), g, num_segments=128)
+        return seg / cnt
+
+    sharded = NamedSharding(mesh, P("shard", None))
+    jv = jax.device_put(jnp.asarray(vals), sharded)
+    jg = jax.device_put(jnp.asarray(gids), NamedSharding(mesh, P("shard")))
+    with mesh:
+        dt = _time(lambda: quantile_rollup(jv, jg))
+    # host numpy baseline of the same computation
+    def host():
+        q = np.sort(vals, axis=1)[:, int(T * 0.99)]
+        out = np.zeros(128)
+        np.add.at(out, gids, q)
+        return out
+
+    t0 = time.perf_counter()
+    host()
+    dt_host = time.perf_counter() - t0
+    _emit(f"#5 {n_dev}-shard timer quantile rollup {S}x{T}",
+          S * T / dt, S * T / dt_host)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="1,2,3,4,5")
+    args = ap.parse_args(argv)
+    fns = {"1": config1_codec_roundtrip, "2": config2_rollup,
+           "3": config3_promql_rate_sum, "4": config4_regex_postings,
+           "5": config5_sharded_quantile}
+    for c in args.configs.split(","):
+        c = c.strip()
+        try:
+            fns[c]()
+        except Exception as e:  # noqa: BLE001 - one config must not kill the rest
+            print(json.dumps({"metric": f"#{c} failed: {e}"[:200],
+                              "value": 0.0, "unit": "M datapoints/sec",
+                              "vs_baseline": 0.0}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -219,3 +219,22 @@ class TestInfluxWrite:
                 api.base + "/api/v1/influxdb/write",
                 data=b"garbage with no fields", method="POST"), timeout=10)
         assert ei.value.code == 400
+
+    def test_partial_write_reports_error(self, api):
+        import urllib.error
+
+        from m3_tpu.index.query import Matcher, MatchType
+
+        t0 = int(START_S) + 8
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                api.base + "/api/v1/influxdb/write",
+                data=b"cpu good=1,bad=abc %d000000000" % t0, method="POST"),
+                timeout=10)
+        assert ei.value.code == 400
+        assert b"partial write" in ei.value.read()
+        # the parseable field WAS written despite the bad sibling
+        res = api.db.query(
+            "default", [Matcher(MatchType.EQUAL, b"__name__", b"cpu_good")],
+            START, START + 60 * 10**9)
+        assert res[0][2][0].value == 1.0
