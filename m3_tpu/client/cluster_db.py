@@ -101,6 +101,10 @@ class ClusterDatabase:
         return self.session.write_tagged(
             namespace, metric_name, tags, t_ns, value)
 
+    def write_tagged_batch(self, namespace: str, entries) -> int:
+        """[(metric_name, tags, t_ns, value)] with one request per host."""
+        return self.session.write_many(namespace, entries)
+
     # -- read paths --
 
     def query(self, namespace: str, matchers, start_ns: int, end_ns: int,

@@ -102,6 +102,26 @@ class HTTPNodeConnection:
         rows = self._request("GET", f"/read?{qs}") or []
         return [Datapoint(int(t), float(v)) for t, v in rows]
 
+    def write_batch(self, namespace: str, entries) -> list[str | None]:
+        """entries: [(metric, tags, t_ns, value)]; returns per-entry error
+        strings (None = ok). One round-trip for the whole batch."""
+        doc = {
+            "namespace": namespace,
+            "entries": [
+                {
+                    "metric_b64": base64.b64encode(m).decode(),
+                    "tags_b64": [[base64.b64encode(k).decode(),
+                                  base64.b64encode(v).decode()]
+                                 for k, v in tags],
+                    "timestamp_ns": int(t),
+                    "value": float(v),
+                }
+                for m, tags, t, v in entries
+            ],
+        }
+        out = self._request("POST", "/write_batch", json.dumps(doc).encode())
+        return out["results"]
+
     def read_batch(self, namespace: str, series_ids: list[bytes],
                    start_ns: int, end_ns: int) -> list[list[Datapoint]]:
         """One round-trip for many series (the host-queue batching role)."""

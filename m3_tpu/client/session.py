@@ -93,6 +93,65 @@ class Session:
             )
         return result
 
+    def write_many(self, namespace: str, entries) -> int:
+        """Quorum-replicated BATCHED writes: one request per host carrying
+        every entry whose shard that host owns (the host-queue op-batching
+        role, reference client/host_queue.go:199-280). entries:
+        [(metric_name, tags, t_ns, value)]. Returns entries written at the
+        consistency level; raises ConsistencyError naming the failures."""
+        from m3_tpu.utils.ident import tags_to_id
+
+        need = required_acks(self.write_consistency,
+                             self.topology.replica_factor)
+        shard_of = []
+        for metric_name, tags, t_ns, value in entries:
+            shard_of.append(self._shard(tags_to_id(metric_name, tags)))
+        acks = [0] * len(entries)
+        errors: list[tuple[str, object]] = []
+        # replicas present in the placement but missing a connection can
+        # never ack; record them so a quorum failure names its cause
+        needed_shards = set(shard_of)
+        for host in sorted({
+            h for s in needed_shards for h in self.topology.hosts_for_shard(s)
+        }):
+            if host not in self.connections:
+                errors.append((host, ConnectionError(f"no connection to {host}")))
+        for host, conn in self.connections.items():
+            inst = self.topology.placement.instances.get(host)
+            owned = set(inst.shards) if inst else set()
+            idxs = [i for i, s in enumerate(shard_of) if s in owned]
+            if not idxs:
+                continue
+            batch = [entries[i] for i in idxs]
+            writer = getattr(conn, "write_batch", None)
+            try:
+                if writer is not None:
+                    results = writer(namespace, batch)
+                else:  # test doubles expose write_tagged only
+                    results = []
+                    for m, tags, t, v in batch:
+                        try:
+                            conn.write_tagged(namespace, m, list(tags), t, v)
+                            results.append(None)
+                        except Exception as e:  # noqa: BLE001
+                            results.append(str(e))
+            except Exception as e:  # noqa: BLE001 - whole host failed
+                errors.append((host, e))
+                continue
+            for i, err in zip(idxs, results):
+                if err is None:
+                    acks[i] += 1
+                else:
+                    errors.append((host, err))
+        failed = [i for i, a in enumerate(acks) if a < need]
+        if failed:
+            raise ConsistencyError(
+                f"batched write: {len(failed)}/{len(entries)} entries below "
+                f"{self.write_consistency.value} "
+                f"(first failures: {errors[:3]})"
+            )
+        return len(entries)
+
     # -- read path --
 
     def fetch(self, namespace: str, series_id: bytes, start_ns: int, end_ns: int):

@@ -356,7 +356,7 @@ class CoordinatorAPI:
     def _remote_write(self, body: bytes):
         payload = snappy.decompress(body)
         series = protowire.decode_write_request(payload)
-        n = 0
+        entries = []
         for ts in series:
             name = b""
             tags = []
@@ -366,8 +366,16 @@ class CoordinatorAPI:
                 else:
                     tags.append((k, v))
             for ts_ms, value in ts.samples:
-                self._write(name, tags, ts_ms * 1_000_000, value)
-                n += 1
+                entries.append((name, tags, ts_ms * 1_000_000, value))
+        batch = getattr(self.db, "write_tagged_batch", None)
+        if self.writer is None and batch is not None:
+            # no downsampler rules to run per-sample: one op-batched
+            # request per storage node (host-queue batching role)
+            n = batch(self.namespace, entries)
+        else:
+            for name, tags, t_ns, value in entries:
+                self._write(name, tags, t_ns, value)
+            n = len(entries)
         return 200, "application/json", json.dumps({"status": "success", "samples": n}).encode()
 
     def _json_write(self, body: bytes):

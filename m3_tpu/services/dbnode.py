@@ -53,6 +53,25 @@ class NodeAPI:
                     int(doc["timestamp_ns"]), float(doc["value"]),
                 )
                 return 200, b'{"ok":true}'
+            if path == "/write_batch" and method == "POST":
+                # op-batched writes (the host-queue batching role,
+                # reference client/host_queue.go write batching)
+                doc = json.loads(body)
+                namespace = doc.get("namespace", "default")
+                results = []
+                for e in doc["entries"]:
+                    try:
+                        tags = [(base64.b64decode(k), base64.b64decode(v))
+                                for k, v in e["tags_b64"]]
+                        self.db.write_tagged(
+                            namespace,
+                            base64.b64decode(e.get("metric_b64", "")), tags,
+                            int(e["timestamp_ns"]), float(e["value"]),
+                        )
+                        results.append(None)
+                    except Exception as ex:  # noqa: BLE001 - per-entry error
+                        results.append(str(ex))
+                return 200, json.dumps({"results": results}).encode()
             if path == "/read_batch" and method == "POST":
                 doc = json.loads(body)
                 out = []

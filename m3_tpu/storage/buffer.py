@@ -14,6 +14,7 @@ multiple encoders at flush.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -80,6 +81,9 @@ class SealedWindow:
     value_bits: np.ndarray  # [B, T] uint64 (padded)
     n_points: np.ndarray  # [B] int32
     starts: np.ndarray = field(default=None)  # [B] int64, all == block_start
+    # raw log rows this seal covered: drop_window_prefix(bs, raw_count)
+    # removes exactly these, preserving concurrent appends after the seal
+    raw_count: int = 0
 
     @property
     def n_series(self) -> int:
@@ -95,44 +99,50 @@ class ShardBuffer:
         self.series_ids: list[bytes] = []
         self.series_tags: list[bytes] = []  # encoded tag blobs
         self._logs: dict[int, _ColumnLog] = {}
+        # one lock per shard buffer (the reference's per-shard lock):
+        # HTTP handler threads write while the tick thread seals/expires
+        self._lock = threading.RLock()
 
     # -- write path --
 
     def series_index(self, series_id: bytes, encoded_tags: bytes = b"") -> int:
-        idx = self._series.get(series_id)
-        if idx is None:
-            idx = len(self.series_ids)
-            self._series[series_id] = idx
-            self.series_ids.append(series_id)
-            self.series_tags.append(encoded_tags)
-        return idx
+        with self._lock:
+            idx = self._series.get(series_id)
+            if idx is None:
+                idx = len(self.series_ids)
+                self._series[series_id] = idx
+                self.series_ids.append(series_id)
+                self.series_tags.append(encoded_tags)
+            return idx
 
     def write(self, series_id: bytes, t_ns: int, vbits: int, encoded_tags: bytes = b"") -> int:
         """Returns the buffer-level series index (stable for this buffer)."""
-        idx = self.series_index(series_id, encoded_tags)
-        bs = t_ns - (t_ns % self._block_size_ns)
-        log = self._logs.get(bs)
-        if log is None:
-            log = self._logs[bs] = _ColumnLog()
-        log.append(idx, t_ns, vbits)
-        return idx
+        with self._lock:
+            idx = self.series_index(series_id, encoded_tags)
+            bs = t_ns - (t_ns % self._block_size_ns)
+            log = self._logs.get(bs)
+            if log is None:
+                log = self._logs[bs] = _ColumnLog()
+            log.append(idx, t_ns, vbits)
+            return idx
 
     # -- read path --
 
     def read(self, series_id: bytes, start_ns: int, end_ns: int):
         """All buffered (t, vbits) for a series in [start, end), merged
         across block windows, deduped last-write-wins."""
-        idx = self._series.get(series_id)
-        if idx is None:
-            return np.empty(0, np.int64), np.empty(0, np.uint64)
-        ts_parts, vb_parts = [], []
-        for bs, log in self._logs.items():
-            if bs + self._block_size_ns <= start_ns or bs >= end_ns:
-                continue
-            sidx, times, vbits = log.view()
-            sel = sidx == idx
-            ts_parts.append(times[sel])
-            vb_parts.append(vbits[sel])
+        with self._lock:
+            idx = self._series.get(series_id)
+            if idx is None:
+                return np.empty(0, np.int64), np.empty(0, np.uint64)
+            ts_parts, vb_parts = [], []
+            for bs, log in self._logs.items():
+                if bs + self._block_size_ns <= start_ns or bs >= end_ns:
+                    continue
+                sidx, times, vbits = log.view()
+                sel = sidx == idx
+                ts_parts.append(times[sel])
+                vb_parts.append(vbits[sel])
         if not ts_parts:
             return np.empty(0, np.int64), np.empty(0, np.uint64)
         return merge_dedup(
@@ -142,7 +152,8 @@ class ShardBuffer:
     # -- seal/flush path --
 
     def block_starts(self) -> list[int]:
-        return sorted(self._logs)
+        with self._lock:
+            return sorted(self._logs)
 
     def points_in(self, block_start: int) -> int:
         log = self._logs.get(block_start)
@@ -154,10 +165,14 @@ class ShardBuffer:
         Stable-sorts by (series, time), dedupes last-write-wins, pads to the
         max points of any series in the window.
         """
-        log = self._logs.get(block_start)
-        if log is None or log.n == 0:
-            return None
-        sidx, times, vbits = (a.copy() for a in log.view())
+        with self._lock:
+            log = self._logs.get(block_start)
+            if log is None or log.n == 0:
+                return None
+            raw_count = log.n
+            sidx, times, vbits = (a.copy() for a in log.view())
+            if drop:
+                del self._logs[block_start]
         order = np.lexsort((np.arange(len(sidx)), times, sidx))
         sidx, times, vbits = sidx[order], times[order], vbits[order]
         # dedupe: same series + same timestamp -> keep the last append
@@ -181,8 +196,6 @@ class ShardBuffer:
         # masked lanes still see sane deltas
         pad_mask = np.arange(T)[None, :] >= counts[:, None]
         out_t = np.where(pad_mask, out_t.max(axis=1, keepdims=True), out_t)
-        if drop:
-            del self._logs[block_start]
         return SealedWindow(
             block_start=block_start,
             series_indices=uniq.astype(np.int32),
@@ -190,18 +203,38 @@ class ShardBuffer:
             value_bits=out_v,
             n_points=counts.astype(np.int32),
             starts=np.full(B, block_start, dtype=np.int64),
+            raw_count=raw_count,
         )
 
     def drop_window(self, block_start: int) -> None:
-        self._logs.pop(block_start, None)
+        with self._lock:
+            self._logs.pop(block_start, None)
+
+    def drop_window_prefix(self, block_start: int, n: int) -> None:
+        """Drop the first n appended rows of a window — the rows a seal
+        covered — KEEPING anything appended concurrently after the seal
+        (they flush with the next volume instead of vanishing)."""
+        with self._lock:
+            log = self._logs.get(block_start)
+            if log is None:
+                return
+            if log.n <= n:
+                del self._logs[block_start]
+                return
+            rest = _ColumnLog()
+            sidx, times, vbits = log.view()
+            for i in range(n, log.n):
+                rest.append(int(sidx[i]), int(times[i]), int(vbits[i]))
+            self._logs[block_start] = rest
 
     def expire_before(self, cutoff_block_start: int) -> int:
-        dropped = 0
-        for bs in list(self._logs):
-            if bs < cutoff_block_start:
-                dropped += self._logs[bs].n
-                del self._logs[bs]
-        return dropped
+        with self._lock:
+            dropped = 0
+            for bs in list(self._logs):
+                if bs < cutoff_block_start:
+                    dropped += self._logs[bs].n
+                    del self._logs[bs]
+            return dropped
 
     @property
     def n_series(self) -> int:

@@ -8,6 +8,8 @@ and batched device encodes (SURVEY.md §7.2).
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from m3_tpu.storage.buffer import ShardBuffer, merge_dedup
@@ -34,17 +36,25 @@ class Shard:
         self.bootstrapped = False
         self.cache = None  # decoded-block LRU, set by the owning Database
         # per-window write sequence vs last-snapshotted sequence: lets the
-        # snapshot loop skip windows with no new writes (dirty tracking)
+        # snapshot loop skip windows with no new writes (dirty tracking);
+        # guarded by _seq_lock (lost increments would mark dirty windows
+        # clean and skip their snapshots)
         self._write_seq: dict[int, int] = {}
         self._snap_seq: dict[int, int] = {}
+        self._seq_lock = threading.Lock()
 
     # -- write --
 
     def write(self, series_id: bytes, t_ns: int, value_bits: int,
               encoded_tags: bytes = b"") -> int:
         bs = self.opts.retention.block_start(t_ns)
-        self._write_seq[bs] = self._write_seq.get(bs, 0) + 1
-        return self.buffer.write(series_id, t_ns, value_bits, encoded_tags)
+        idx = self.buffer.write(series_id, t_ns, value_bits, encoded_tags)
+        # seq bumps AFTER the point is in the buffer: a snapshot racing in
+        # between re-snapshots next pass instead of marking the window
+        # clean without the point
+        with self._seq_lock:
+            self._write_seq[bs] = self._write_seq.get(bs, 0) + 1
+        return idx
 
     def write_seq(self, block_start: int) -> int:
         return self._write_seq.get(block_start, 0)
@@ -62,7 +72,8 @@ class Shard:
         from m3_tpu.encoding.m3tsz import decode as scalar_decode
 
         parts_t, parts_v = [], []
-        for bs, reader in self._filesets.items():
+        # snapshot: the tick thread swaps fileset volumes concurrently
+        for bs, reader in list(self._filesets.items()):
             if bs + reader.block_size_ns <= start_ns or bs >= end_ns:
                 continue
             key = (self.namespace, self.shard_id, bs, series_id)
@@ -269,7 +280,9 @@ class Shard:
         if self.cache is not None:  # cached decodes are for the old volume
             self.cache.invalidate_block(self.namespace, self.shard_id,
                                         block_start)
-        self.buffer.drop_window(block_start)  # volume durable: buffer copy done
+        # volume durable: drop exactly the rows this seal covered —
+        # concurrent appends after the seal copy stay buffered
+        self.buffer.drop_window_prefix(block_start, sealed.raw_count)
         return True
 
     # -- bootstrap --

@@ -253,3 +253,58 @@ class TestAddNode:
         finally:
             svc3.api.shutdown()
             svc3.db.close()
+
+
+class TestBatchedWrites:
+    def test_write_many_replicates(self, cluster):
+        kv, nodes = cluster
+        sess = make_session(kv)
+        entries = [(b"bm", [(b"i", str(i).encode())], START + i * 10**9, float(i))
+                   for i in range(30)]
+        assert sess.write_many("default", entries) == 30
+        for svc in nodes.values():
+            ids = set()
+            for ns in svc.db.namespaces.values():
+                ids |= ns.series_ids()
+            assert len(ids) == 30  # RF=3: every node holds every series
+
+    def test_write_many_consistency_failure(self, cluster):
+        kv, nodes = cluster
+        nodes["node1"].api.shutdown()
+        nodes["node2"].api.shutdown()
+        sess = make_session(kv, write_cl=ConsistencyLevel.MAJORITY)
+        with pytest.raises(ConsistencyError):
+            sess.write_many("default", [(b"x", [(b"k", b"v")],
+                                         START + 10**9, 1.0)])
+
+    def test_remote_write_uses_batch_path(self, cluster):
+        """Prometheus remote write over the cluster goes through the
+        op-batched per-host requests."""
+        import urllib.request
+
+        from m3_tpu.query.api import CoordinatorAPI
+        from m3_tpu.utils import protowire, snappy
+
+        kv, nodes = cluster
+        cdb = ClusterDatabase(make_session(kv))
+        api = CoordinatorAPI(cdb)
+        port = api.serve(host="127.0.0.1", port=0)
+        try:
+            series = [protowire.PromTimeSeries(
+                labels=[(b"__name__", b"rw"), (b"i", str(i).encode())],
+                samples=[((START // 10**6) + j * 1000, float(j))
+                         for j in range(5)],
+            ) for i in range(10)]
+            payload = snappy.compress(protowire.encode_write_request(series))
+            r = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/prom/remote/write",
+                data=payload, method="POST",
+                headers={"Content-Type": "application/x-protobuf"}), timeout=15)
+            assert json.loads(r.read())["samples"] == 50
+            out = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/query?query=count(rw)"
+                f"&time={START // 10**9 + 3}", timeout=15).read())
+            assert float(out["data"]["result"][0]["value"][1]) == 10.0
+        finally:
+            api.shutdown()
+            cdb.close()
