@@ -8,12 +8,25 @@ a real chip.
 
 import os
 
-# Force CPU: the axon sitecustomize exports JAX_PLATFORMS=axon at interpreter
-# startup, so setdefault would lose; tests must not burn TPU compile time.
+# Force CPU. Setting os.environ["JAX_PLATFORMS"] here is NOT enough: the
+# axon sitecustomize imports jax at interpreter startup and registers the
+# TPU relay backend, so jax's config snapshot already reads "axon,cpu" by
+# the time conftest runs. With the relay up, tests would silently run on
+# the remote TPU; with it wedged, the first jit in every test process
+# hangs forever. jax.config.update("jax_platforms", ...) takes effect any
+# time before the first backend initialization, which is the one reliable
+# post-import lever.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover - jax-less environments skip jax tests
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
