@@ -221,10 +221,18 @@ class ShardBuffer:
             if log.n <= n:
                 del self._logs[block_start]
                 return
+            # bulk copy the surviving suffix: this runs under the shard
+            # lock, so a per-row python loop would stall every writer
             rest = _ColumnLog()
-            sidx, times, vbits = log.view()
-            for i in range(n, log.n):
-                rest.append(int(sidx[i]), int(times[i]), int(vbits[i]))
+            m = log.n - n
+            cap = max(_GROW, m)
+            rest.sidx = np.empty(cap, dtype=np.int32)
+            rest.times = np.empty(cap, dtype=np.int64)
+            rest.vbits = np.empty(cap, dtype=np.uint64)
+            rest.sidx[:m] = log.sidx[n:log.n]
+            rest.times[:m] = log.times[n:log.n]
+            rest.vbits[:m] = log.vbits[n:log.n]
+            rest.n = m
             self._logs[block_start] = rest
 
     def expire_before(self, cutoff_block_start: int) -> int:

@@ -298,6 +298,9 @@ class Database:
         for log in self._commitlogs.values():
             log.close()
         self._commitlogs.clear()
+        for ns in self.namespaces.values():
+            for shard in ns.shards.values():
+                shard.close()  # releases current + retired fileset readers
         self._open = False
 
     # -- shard assignment (placement-driven; storage/cluster role) --

@@ -214,11 +214,21 @@ def repair_shard_block(db, namespace: str, shard_id: int, block_start: int,
     blocks; here divergent series are decoded from every replica, merged
     last-write-wins, re-encoded, and written as a higher volume.
     """
+    ns = db.namespaces[namespace]
+    shard = ns.shards[shard_id]
+    # serialized with flush/expire: both assign the block's next volume
+    # number and swap _filesets[block_start] (see Shard._maint_lock)
+    with shard._maint_lock:
+        return _repair_shard_block_locked(
+            db, ns, shard, namespace, shard_id, block_start, peers
+        )
+
+
+def _repair_shard_block_locked(db, ns, shard, namespace, shard_id,
+                               block_start, peers) -> RepairResult:
     from m3_tpu.encoding.m3tsz import Encoder
     from m3_tpu.encoding.m3tsz import decode as scalar_decode
 
-    ns = db.namespaces[namespace]
-    shard = ns.shards[shard_id]
     reader = shard._filesets.get(block_start)
     local_meta = {}
     if reader is not None:
@@ -309,7 +319,9 @@ def repair_shard_block(db, namespace: str, shard_id: int, block_start: int,
     from m3_tpu.storage.fileset import FilesetReader
 
     if reader is not None:
-        reader.close()
+        # retire, don't close: a concurrent Shard.read may still hold this
+        # reader from its snapshot (see Shard._retire)
+        shard._retire(reader)
     shard._filesets[block_start] = FilesetReader(
         shard.fs_root, namespace, shard_id, block_start, volume
     )

@@ -33,6 +33,19 @@ class Shard:
         self.fs_root = fs_root
         self.buffer = ShardBuffer(opts.retention.block_size_ns)
         self._filesets: dict[int, FilesetReader] = {}  # block_start -> reader
+        # readers swapped out by flush/expire/repair: concurrent reads may
+        # still hold them from their list() snapshot, so closing immediately
+        # would fail those reads on a dead mmap. Each is closed only after
+        # RETIRE_GRACE_S (far longer than any single-series decode), and the
+        # list is lock-guarded because repair retires from RPC threads while
+        # the tick thread drains.
+        self._retired: list[tuple[float, FilesetReader]] = []
+        self._retired_lock = threading.Lock()
+        # serializes volume assignment + fileset swap between the tick
+        # thread's flush/expire and repair running on RPC threads: without
+        # it two maintenance passes can both write volume v+1 for the same
+        # block (interleaved files, shared cache key for divergent data)
+        self._maint_lock = threading.RLock()
         self.bootstrapped = False
         self.cache = None  # decoded-block LRU, set by the owning Database
         # per-window write sequence vs last-snapshotted sequence: lets the
@@ -76,7 +89,11 @@ class Shard:
         for bs, reader in list(self._filesets.items()):
             if bs + reader.block_size_ns <= start_ns or bs >= end_ns:
                 continue
-            key = (self.namespace, self.shard_id, bs, series_id)
+            # volume in the key: a read racing a flush may put() a decode of
+            # the OLD volume after the swap; under a versioned key that
+            # stale entry lands where no future read (which uses the new
+            # reader's volume) will find it
+            key = (self.namespace, self.shard_id, bs, reader.volume, series_id)
             cached = self.cache.get(key) if self.cache is not None else None
             if cached is not None:
                 ct, cv = cached
@@ -186,11 +203,42 @@ class Shard:
                         block_start=block_start):
             return self._flush_traced(block_start)
 
+    # grace before a swapped-out reader is really closed; class attribute so
+    # tests can shrink it
+    RETIRE_GRACE_S = 30.0
+
+    def _retire(self, reader: FilesetReader) -> None:
+        import time
+
+        with self._retired_lock:
+            self._retired.append((time.monotonic(), reader))
+
+    def _drain_retired(self) -> None:
+        """Close readers retired at least RETIRE_GRACE_S ago; any read that
+        captured them in its snapshot has finished by now."""
+        import time
+
+        now = time.monotonic()
+        doomed = []
+        with self._retired_lock:
+            keep = []
+            for ts, r in self._retired:
+                (doomed if now - ts >= self.RETIRE_GRACE_S else keep).append((ts, r))
+            self._retired = keep
+        for _, r in doomed:
+            r.close()
+
     def _flush_traced(self, block_start: int) -> bool:
+        with self._maint_lock:
+            return self._flush_locked(block_start)
+
+    def _flush_locked(self, block_start: int) -> bool:
         import jax.numpy as jnp
 
         from m3_tpu.encoding.m3tsz import decode as scalar_decode
         from m3_tpu.encoding.m3tsz import tpu as m3tsz_tpu
+
+        self._drain_retired()
 
         # Seal WITHOUT dropping: the buffer window is the only copy until the
         # fileset volume is durably on disk; a failed flush must leave it
@@ -273,7 +321,7 @@ class Shard:
         writer.close()
 
         if prev is not None:
-            prev.close()
+            self._retire(prev)
         self._filesets[block_start] = FilesetReader(
             self.fs_root, self.namespace, self.shard_id, block_start, volume
         )
@@ -336,17 +384,36 @@ class Shard:
         r = self.opts.retention
         cutoff = r.block_start(now_ns - r.retention_ns)
         dropped = 0
-        for bs in list(self._filesets):
-            if bs < cutoff:
-                self._filesets[bs].close()
-                del self._filesets[bs]
-                self._delete_fileset_files(bs)
-                dropped += 1
-        for bs, _vol in list_filesets(self.fs_root, self.namespace, self.shard_id):
-            if bs < cutoff and bs not in self._filesets:
-                self._delete_fileset_files(bs)
+        with self._maint_lock:
+            self._drain_retired()
+            for bs in list(self._filesets):
+                if bs < cutoff:
+                    # retire, don't close: a concurrent read may hold this
+                    # reader; its open fds/mmaps keep the unlinked files
+                    # readable until the grace period closes it
+                    self._retire(self._filesets[bs])
+                    del self._filesets[bs]
+                    self._delete_fileset_files(bs)
+                    dropped += 1
+            for bs, _vol in list_filesets(self.fs_root, self.namespace, self.shard_id):
+                if bs < cutoff and bs not in self._filesets:
+                    self._delete_fileset_files(bs)
         self.buffer.expire_before(cutoff)
         return dropped
+
+    def close(self) -> None:
+        """Release every fileset reader (current and retired, grace
+        ignored): after close the shard serves no reads, so the deferred-
+        close protection no longer applies and holding the fds/mmaps would
+        leak them for the rest of the process."""
+        with self._maint_lock:
+            with self._retired_lock:
+                retired, self._retired = self._retired, []
+            for _, reader in retired:
+                reader.close()
+            for reader in self._filesets.values():
+                reader.close()
+            self._filesets.clear()
 
     @property
     def flushed_block_starts(self) -> list[int]:

@@ -96,6 +96,42 @@ class TestStorageRaces:
         finally:
             db.close()
 
+    def test_retired_reader_grace(self, tmp_path, monkeypatch):
+        """A reflush must not close the swapped-out volume reader under a
+        concurrent read: the old reader stays usable for RETIRE_GRACE_S and
+        is closed by the first maintenance pass after the grace expires."""
+        from m3_tpu.storage.shard import Shard
+
+        opts = NamespaceOptions(
+            retention=RetentionOptions(
+                retention_ns=3600 * SEC, block_size_ns=60 * SEC,
+                buffer_past_ns=0, buffer_future_ns=10**15,
+            ),
+        )
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=1))
+        db.create_namespace("default", opts)
+        db.open(START)
+        try:
+            shard = db.namespaces["default"].shards[0]
+            bs = opts.retention.block_start(START)
+            bits = np.float64(1.5).view(np.uint64).item()
+            shard.write(b"s", START, bits)
+            assert shard.flush(bs)
+            old = shard._filesets[bs]
+            shard.write(b"s", START + SEC, bits)
+            assert shard.flush(bs)  # volume 1: retires (not closes) old
+            assert old.read(b"s"), "reader closed inside its grace period"
+            # within grace, further maintenance passes must not close it
+            shard._drain_retired()
+            assert old.read(b"s")
+            # after grace, the next pass closes it
+            monkeypatch.setattr(Shard, "RETIRE_GRACE_S", 0.0)
+            shard._drain_retired()
+            with pytest.raises(ValueError):
+                old.read(b"s")
+        finally:
+            db.close()
+
     def test_restart_after_storm_consistent(self, tmp_path):
         db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=2))
         db.create_namespace("default")
