@@ -10,14 +10,17 @@ parser (src/metrics/carbon/parser.go). Dotted paths map to positional tags
 adapter, so Graphite data lives in the same TSDB namespaces as Prometheus
 data.
 
-The function library here is the high-traffic core (~35 builtins);
-registering more is adding an entry to FUNCTIONS.
+The function library covers all 110 reference builtins (plus graphite-web
+aliases like round/time/randomWalk); registering more is adding an entry
+to FUNCTIONS. timeShift is a special form in GraphiteEngine._eval because
+it re-evaluates its subtree over a shifted window.
 """
 
 from __future__ import annotations
 
 import math
 import re
+import zlib
 from dataclasses import dataclass
 
 import numpy as np
@@ -245,7 +248,11 @@ def parse_target(expr: str, pos: int = 0):
     if m.group("str"):
         return ("str", m.group("str")[1:-1]), m.end()
     if m.group("path"):
-        return ("path", m.group("path")), m.end()
+        word = m.group("path")
+        # graphite-web parses bare true/false as boolean literals, not paths
+        if word in ("true", "True", "false", "False"):
+            return ("bool", word.lower() == "true"), m.end()
+        return ("path", word), m.end()
     raise GraphiteError(f"unexpected token at {pos} in {expr!r}")
 
 
@@ -293,6 +300,8 @@ class GraphiteEngine:
         if kind == "num":
             return ast[1]
         if kind == "str":
+            return ast[1]
+        if kind == "bool":
             return ast[1]
         if kind == "call":
             _, name, args = ast
@@ -464,8 +473,8 @@ def _integral(eng, args, *_):
 
 
 @register("movingAverage")
-def _moving_average(eng, args, *_):
-    series, window = args[0], int(args[1])
+def _moving_average(eng, args, start, end, step):
+    series, window = args[0], _window_points(args[1], step)
     out = []
     for s in series:
         v = s.values
@@ -866,6 +875,14 @@ def _sort_by_total(eng, args, *_):
         return sorted(args[0], key=lambda s: -_safe_stat(np.nansum, s.values))
 
 
+def _window_points(arg, step: int) -> int:
+    """Window argument -> point count: bare numbers are points, interval
+    strings ('5min') are divided by the render step (graphite semantics)."""
+    if isinstance(arg, str):
+        return max(int(_parse_interval(arg) // step), 1)
+    return max(int(arg), 1)
+
+
 def _moving(series, window, fn):
     out = []
     for s in series:
@@ -881,23 +898,23 @@ def _moving(series, window, fn):
 
 
 @register("movingMedian")
-def _moving_median(eng, args, *_):
-    return _moving(args[0], args[1], np.nanmedian)
+def _moving_median(eng, args, start, end, step):
+    return _moving(args[0], _window_points(args[1], step), np.nanmedian)
 
 
 @register("movingMax")
-def _moving_max(eng, args, *_):
-    return _moving(args[0], args[1], np.nanmax)
+def _moving_max(eng, args, start, end, step):
+    return _moving(args[0], _window_points(args[1], step), np.nanmax)
 
 
 @register("movingMin")
-def _moving_min(eng, args, *_):
-    return _moving(args[0], args[1], np.nanmin)
+def _moving_min(eng, args, start, end, step):
+    return _moving(args[0], _window_points(args[1], step), np.nanmin)
 
 
 @register("movingSum")
-def _moving_sum(eng, args, *_):
-    return _moving(args[0], args[1], np.nansum)
+def _moving_sum(eng, args, start, end, step):
+    return _moving(args[0], _window_points(args[1], step), np.nansum)
 
 
 @register("stdev")
@@ -1181,3 +1198,628 @@ def _average_outside_percentile(eng, args, *_):
     lo = _graphite_percentile(np.asarray(avgs, float), 100.0 - n)
     hi = _graphite_percentile(np.asarray(avgs, float), n)
     return [s for s, a in zip(series, avgs) if not (lo < a < hi)]
+
+
+# ---------------------------------------------------------------------------
+# remainder of the reference's builtin set: aggregate family, Holt-Winters,
+# moving windows, time/interval utilities
+# (query/graphite/native/builtin_functions.go:2841-3058)
+# ---------------------------------------------------------------------------
+
+_INTERVAL_UNITS = {
+    "s": NS, "sec": NS, "second": NS, "seconds": NS,
+    "min": 60 * NS, "minute": 60 * NS, "minutes": 60 * NS,
+    "h": 3600 * NS, "hour": 3600 * NS, "hours": 3600 * NS,
+    "d": 86400 * NS, "day": 86400 * NS, "days": 86400 * NS,
+    "w": 7 * 86400 * NS, "week": 7 * 86400 * NS, "weeks": 7 * 86400 * NS,
+    "mon": 30 * 86400 * NS, "month": 30 * 86400 * NS, "months": 30 * 86400 * NS,
+    "y": 365 * 86400 * NS, "year": 365 * 86400 * NS, "years": 365 * 86400 * NS,
+}
+
+_INTERVAL_RE = re.compile(r"(\d+)\s*([A-Za-z]+)")
+
+
+def _parse_interval(spec) -> int:
+    """Graphite interval ('10s', '1min', '1hour', '7d') -> ns; negative
+    sign allowed ('-1h' -> -3600s)."""
+    if isinstance(spec, (int, float)):
+        return int(spec * NS)  # bare numbers are seconds
+    s = spec.strip()
+    sign = -1 if s.startswith("-") else 1
+    s = s.lstrip("+-")
+    total, pos = 0, 0
+    for m in _INTERVAL_RE.finditer(s):
+        if m.start() != pos:
+            raise GraphiteError(f"invalid interval {spec!r}")
+        unit = m.group(2).lower()
+        if unit not in _INTERVAL_UNITS:
+            raise GraphiteError(f"invalid interval unit {spec!r}")
+        total += int(m.group(1)) * _INTERVAL_UNITS[unit]
+        pos = m.end()
+    if pos != len(s) or pos == 0:
+        raise GraphiteError(f"invalid interval {spec!r}")
+    return sign * total
+
+
+_AGG_BY_NAME = {
+    "average": np.nanmean, "avg": np.nanmean, "mean": np.nanmean,
+    "sum": np.nansum, "total": np.nansum,
+    "min": np.nanmin, "minimum": np.nanmin,
+    "max": np.nanmax, "maximum": np.nanmax,
+    "median": np.nanmedian,
+    "stddev": np.nanstd, "stdev": np.nanstd,
+    "count": lambda v, **kw: (~np.isnan(np.asarray(v))).sum(**{
+        k: v2 for k, v2 in kw.items() if k == "axis"}),
+    "range": lambda v, **kw: np.nanmax(v, **kw) - np.nanmin(v, **kw),
+    "rangeOf": lambda v, **kw: np.nanmax(v, **kw) - np.nanmin(v, **kw),
+    "multiply": np.nanprod,
+    # graphite safeDiff: first minus the sum of the rest
+    "diff": lambda v, **kw: (np.asarray(v)[0] - np.nansum(np.asarray(v)[1:], **kw)
+                             if len(np.asarray(v)) else np.nan),
+    "last": lambda v, **kw: _last_stat(np.asarray(v), **kw),
+    "current": lambda v, **kw: _last_stat(np.asarray(v), **kw),
+}
+
+
+def _last_stat(v, axis=None):
+    """Last non-NaN value (per row when axis=0 over a [S, T] stack)."""
+    if v.ndim == 1:
+        ok = ~np.isnan(v)
+        return v[np.where(ok)[0][-1]] if ok.any() else np.nan
+    out = np.full(v.shape[1], np.nan)
+    for j in range(v.shape[1]):
+        col = v[:, j]
+        ok = ~np.isnan(col)
+        if ok.any():
+            out[j] = col[np.where(ok)[0][-1]]
+    return out
+
+
+def _agg_op(name: str):
+    op = _AGG_BY_NAME.get(name)
+    if op is None:
+        raise GraphiteError(f"unknown aggregation function {name!r}")
+    return op
+
+
+def _series_stat(name: str, s: Series) -> float:
+    return _safe_stat(lambda v: _agg_op(name)(v), s.values)
+
+
+@register("aggregate")
+def _aggregate(eng, args, *_):
+    series, func = _flatten(args[:1]), args[1]
+    op = _agg_op(func)
+    name = f"{func}Series".encode() + b"(" + b",".join(s.name for s in series) + b")"
+    with _quiet():
+        return _combine(series, _nan_masked(lambda st: op(st, axis=0)), name)
+
+
+@register("aggregateLine")
+def _aggregate_line(eng, args, start, end, step):
+    series = args[0]
+    func = args[1] if len(args) > 1 else "average"
+    grid = np.arange(start, end, step, dtype=np.int64)
+    out = []
+    for s in series:
+        v = _series_stat(func, s)
+        name = b"aggregateLine(" + s.name + f",{v:g})".encode()
+        out.append(Series(name, grid, np.full(len(grid), v)))
+    return out
+
+
+@register("aggregateWithWildcards")
+def _aggregate_with_wildcards(eng, args, *_):
+    series, func = args[0], args[1]
+    op = _agg_op(func)
+    return _series_with_wildcards([series] + list(args[2:]),
+                                  lambda st, axis=0: op(st, axis=axis))
+
+
+@register("multiplySeriesWithWildcards")
+def _multiply_series_with_wildcards(eng, args, *_):
+    return _series_with_wildcards(args, np.nanprod)
+
+
+@register("applyByNode")
+def _apply_by_node(eng, args, start, end, step):
+    """Groups series by their first node+1 path nodes and evaluates the
+    template (with % replaced by the prefix) once per group."""
+    series, node, template = args[0], int(args[1]), args[2]
+    new_name = args[3] if len(args) > 3 else None
+    prefixes = []
+    for s in series:
+        prefix = b".".join(s.name.split(b".")[: node + 1]).decode()
+        if prefix not in prefixes:
+            prefixes.append(prefix)
+    out = []
+    for prefix in prefixes:
+        ast, pos = parse_target(template.replace("%", prefix))
+        got = eng._eval(ast, start, end, step)
+        if isinstance(got, list):
+            for g in got:
+                name = new_name.replace("%", prefix).encode() if new_name else g.name
+                out.append(Series(name, g.times, g.values))
+    return out
+
+
+@register("cactiStyle")
+def _cacti_style(eng, args, *_):
+    out = []
+    for s in args[0]:
+        cur = _series_stat("last", s)
+        mx = _series_stat("max", s)
+        mn = _series_stat("min", s)
+        name = s.name + f" Current:{cur:g} Max:{mx:g} Min:{mn:g}".encode()
+        out.append(Series(name, s.times, s.values))
+    return out
+
+
+@register("dashed")
+def _dashed(eng, args, *_):
+    length = args[1] if len(args) > 1 else 5.0
+    return [
+        Series(b"dashed(" + s.name + f",{length:g})".encode(), s.times, s.values)
+        for s in args[0]
+    ]
+
+
+@register("divideSeriesLists")
+def _divide_series_lists(eng, args, *_):
+    dividends, divisors = args[0], args[1]
+    if len(dividends) != len(divisors):
+        raise GraphiteError("divideSeriesLists: list lengths differ")
+    out = []
+    with _quiet():
+        for a, b in zip(dividends, divisors):
+            v = np.where(b.values == 0, np.nan, a.values / b.values)
+            out.append(Series(b"divideSeries(" + a.name + b"," + b.name + b")",
+                              a.times, v))
+    return out
+
+
+@register("powSeries")
+def _pow_series(eng, args, *_):
+    series = _flatten(args)
+    if not series:
+        return []
+    with _quiet():
+        acc = series[0].values.copy()
+        for s in series[1:]:
+            acc = np.power(acc, s.values)
+    name = b"powSeries(" + b",".join(s.name for s in series) + b")"
+    return [Series(name, series[0].times, acc)]
+
+
+@register("exponentialMovingAverage")
+def _exponential_moving_average(eng, args, start, end, step):
+    series, window = args[0], _window_points(args[1], step)
+    alpha = 2.0 / (window + 1)
+    out = []
+    for s in series:
+        v = s.values
+        ema = np.full(len(v), np.nan)
+        acc = None
+        for i in range(len(v)):
+            x = v[i]
+            if np.isnan(x):
+                ema[i] = acc if acc is not None else np.nan
+                continue
+            acc = x if acc is None else alpha * x + (1 - alpha) * acc
+            ema[i] = acc
+        out.append(Series(b"ema(" + s.name + f",{window})".encode(),
+                          s.times, ema))
+    return out
+
+
+@register("fallbackSeries")
+def _fallback_series(eng, args, *_):
+    return args[0] if args[0] else args[1]
+
+
+_FILTER_OPS = {
+    ">": np.greater, ">=": np.greater_equal, "<": np.less,
+    "<=": np.less_equal, "=": np.equal, "!=": np.not_equal,
+}
+
+
+@register("filterSeries")
+def _filter_series_builtin(eng, args, *_):
+    series, func, operator, threshold = args[0], args[1], args[2], float(args[3])
+    cmp = _FILTER_OPS.get(operator)
+    if cmp is None:
+        raise GraphiteError(f"unknown operator {operator!r}")
+    return [s for s in series if cmp(_series_stat(func, s), threshold)]
+
+
+@register("highest")
+def _highest(eng, args, *_):
+    series = args[0]
+    n = int(args[1]) if len(args) > 1 else 1
+    func = args[2] if len(args) > 2 else "average"
+    ranked = sorted(series, key=lambda s: -_nan_low(_series_stat(func, s)))
+    return ranked[:n]
+
+
+@register("lowest")
+def _lowest(eng, args, *_):
+    series = args[0]
+    n = int(args[1]) if len(args) > 1 else 1
+    func = args[2] if len(args) > 2 else "average"
+    ranked = sorted(series, key=lambda s: _nan_high(_series_stat(func, s)))
+    return ranked[:n]
+
+
+@register("sortBy")
+def _sort_by(eng, args, *_):
+    series = args[0]
+    func = args[1] if len(args) > 1 else "average"
+    reverse = len(args) > 2 and _truthy(args[2])
+    return sorted(series, key=lambda s: _nan_high(_series_stat(func, s)),
+                  reverse=reverse)
+
+
+def _truthy(arg) -> bool:
+    if isinstance(arg, str):
+        return arg.lower() in ("true", "1")
+    return bool(arg)
+
+
+def _nan_low(x: float) -> float:
+    return -np.inf if np.isnan(x) else x
+
+
+def _nan_high(x: float) -> float:
+    return np.inf if np.isnan(x) else x
+
+
+@register("hitcount")
+def _hitcount(eng, args, start, end, step):
+    """Rate (hits/sec) -> hit counts per interval bucket: sum(v * step_s)."""
+    series, interval = args[0], _parse_interval(args[1])
+    out = []
+    step_s = step / NS
+    for s in series:
+        if not len(s.times):
+            out.append(s)
+            continue
+        bucket = ((s.times - s.times[0]) // interval).astype(np.int64)
+        n_buckets = int(bucket[-1]) + 1
+        times = s.times[0] + np.arange(n_buckets) * interval
+        vals = np.full(n_buckets, np.nan)
+        with _quiet():
+            for b in range(n_buckets):
+                sel = s.values[bucket == b]
+                if (~np.isnan(sel)).any():
+                    vals[b] = np.nansum(sel) * step_s
+        name = b"hitcount(" + s.name + b",'" + str(args[1]).encode() + b"')"
+        out.append(Series(name, times, vals))
+    return out
+
+
+@register("smartSummarize")
+def _smart_summarize(eng, args, start, end, step):
+    """summarize() aligned to the render start (no bucket offset drift)."""
+    series, interval = args[0], _parse_interval(args[1])
+    func = args[2] if len(args) > 2 else "sum"
+    op = _agg_op(func)
+    out = []
+    for s in series:
+        bucket = ((s.times - start) // interval).astype(np.int64)
+        n_buckets = int(bucket[-1]) + 1 if len(bucket) else 0
+        times = start + np.arange(n_buckets) * interval
+        vals = np.full(n_buckets, np.nan)
+        with _quiet():
+            for b in range(n_buckets):
+                sel = s.values[bucket == b]
+                if (~np.isnan(sel)).any():
+                    vals[b] = op(sel)
+        name = (b"smartSummarize(" + s.name + b",'"
+                + str(args[1]).encode() + b"','" + func.encode() + b"')")
+        out.append(Series(name, times, vals))
+    return out
+
+
+@register("integralByInterval")
+def _integral_by_interval(eng, args, start, end, step):
+    """Cumulative sum resetting at each interval boundary."""
+    series, interval = args[0], _parse_interval(args[1])
+    out = []
+    for s in series:
+        v = np.where(np.isnan(s.values), 0.0, s.values)
+        bucket = ((s.times - start) // interval).astype(np.int64)
+        acc = np.cumsum(v)
+        if len(v):
+            # subtract the running total as of each bucket's first point
+            is_first = np.concatenate([[True], bucket[1:] != bucket[:-1]])
+            base = np.where(is_first, acc - v, -np.inf)
+            np.maximum.accumulate(base, out=base)
+            acc = acc - base
+        out.append(Series(b"integralByInterval(" + s.name + b")", s.times, acc))
+    return out
+
+
+@register("interpolate")
+def _interpolate(eng, args, *_):
+    series = args[0]
+    limit = int(args[1]) if len(args) > 1 else None
+    out = []
+    for s in series:
+        v = s.values.copy()
+        ok = ~np.isnan(v)
+        if ok.sum() >= 2:
+            idx = np.arange(len(v))
+            gaps = np.interp(idx, idx[ok], v[ok])
+            fill = ~ok
+            # leading/trailing NaN stay NaN (interp would clamp)
+            fill &= (idx >= idx[ok][0]) & (idx <= idx[ok][-1])
+            if limit is not None:
+                # only fill gaps of at most `limit` consecutive NaNs
+                run = np.zeros(len(v), dtype=np.int64)
+                count = 0
+                for i in range(len(v)):
+                    count = count + 1 if not ok[i] else 0
+                    run[i] = count
+                total = np.zeros(len(v), dtype=np.int64)
+                for i in range(len(v) - 1, -1, -1):
+                    total[i] = run[i] if (i == len(v) - 1 or run[i + 1] == 0) \
+                        else total[i + 1]
+                    if run[i] == 0:
+                        total[i] = 0
+                fill &= np.array([total[i] <= limit or ok[i]
+                                  for i in range(len(v))])
+            v[fill] = gaps[fill]
+        out.append(Series(s.name, s.times, v))
+    return out
+
+
+@register("legendValue")
+def _legend_value(eng, args, *_):
+    series, types = args[0], [a for a in args[1:] if isinstance(a, str)]
+    out = []
+    for s in series:
+        name = s.name
+        for t in types:
+            name += f" ({t}: {_series_stat(t, s):g})".encode()
+        out.append(Series(name, s.times, s.values))
+    return out
+
+
+@register("movingWindow")
+def _moving_window(eng, args, start, end, step):
+    series, window = args[0], _window_points(args[1], step)
+    func = args[2] if len(args) > 2 else "average"
+    op = _agg_op(func)
+    out = []
+    for s in _moving(series, window, op):
+        name = (b"movingWindow(" + s.name
+                + f",{window},'{func}')".encode())
+        out.append(Series(name, s.times, s.values))
+    return out
+
+
+@register("offsetToZero")
+def _offset_to_zero(eng, args, *_):
+    out = []
+    for s in args[0]:
+        m = _safe_stat(np.nanmin, s.values)
+        out.append(Series(b"offsetToZero(" + s.name + b")", s.times,
+                          s.values - m))
+    return out
+
+
+@register("randomWalk")
+@register("randomWalkFunction")
+def _random_walk(eng, args, start, end, step):
+    """Deterministic per name (seeded by it), so renders are reproducible."""
+    name = args[0] if args and isinstance(args[0], str) else "randomWalk"
+    grid = np.arange(start, end, step, dtype=np.int64)
+    rng = np.random.default_rng(zlib.adler32(name.encode()))
+    steps = rng.random(len(grid)) - 0.5
+    return [Series(name.encode(), grid, np.cumsum(steps))]
+
+
+@register("removeEmptySeries")
+def _remove_empty_series(eng, args, *_):
+    series = args[0]
+    x_files_factor = float(args[1]) if len(args) > 1 else 0.0
+    out = []
+    for s in series:
+        frac = (~np.isnan(s.values)).mean() if len(s.values) else 0.0
+        if frac > 0 and frac >= x_files_factor:
+            out.append(s)
+    return out
+
+
+@register("round")
+@register("roundFunction")
+def _round(eng, args, *_):
+    precision = int(args[1]) if len(args) > 1 else 0
+    return [
+        Series(s.name, s.times, np.round(s.values, precision))
+        for s in args[0]
+    ]
+
+
+@register("sustainedAbove")
+def _sustained_above(eng, args, start, end, step):
+    return _sustained(args, step, above=True)
+
+
+@register("sustainedBelow")
+def _sustained_below(eng, args, start, end, step):
+    return _sustained(args, step, above=False)
+
+
+def _sustained(args, step, above: bool):
+    """Keep only values that stayed above/below the threshold for at least
+    the interval; everything else becomes NaN."""
+    series, value, interval = args[0], float(args[1]), _parse_interval(args[2])
+    min_run = max(int(interval // step), 1)
+    out = []
+    for s in series:
+        v = s.values
+        with _quiet():
+            cond = (v > value) if above else (v < value)
+        cond = np.where(np.isnan(v), False, cond)
+        keep = np.zeros(len(v), dtype=bool)
+        i = 0
+        while i < len(v):
+            if cond[i]:
+                j = i
+                while j < len(v) and cond[j]:
+                    j += 1
+                if j - i >= min_run:
+                    keep[i:j] = True
+                i = j
+            else:
+                i += 1
+        tag = b"sustainedAbove" if above else b"sustainedBelow"
+        out.append(Series(tag + b"(" + s.name + f",{value:g})".encode(),
+                          s.times, np.where(keep, v, np.nan)))
+    return out
+
+
+@register("time")
+@register("timeFunction")
+def _time_fn(eng, args, start, end, step):
+    name = args[0] if args and isinstance(args[0], str) else "time"
+    step_override = int(args[1]) * NS if len(args) > 1 else step
+    grid = np.arange(start, end, step_override, dtype=np.int64)
+    return [Series(name.encode(), grid, (grid / NS).astype(np.float64))]
+
+
+@register("timeSlice")
+def _time_slice(eng, args, start, end, step):
+    """NaN outside the sliced window. Interval-string bounds are relative
+    to the render END (graphite resolves them against 'now'): '-3min' means
+    3 minutes before the end of the window. Numbers are epoch seconds."""
+    series = args[0]
+    lo = _slice_bound(args[1], start, end) if len(args) > 1 else start
+    hi = _slice_bound(args[2], start, end) if len(args) > 2 else end
+    out = []
+    for s in series:
+        sel = (s.times >= lo) & (s.times < hi)
+        out.append(Series(b"timeSlice(" + s.name + b")", s.times,
+                          np.where(sel, s.values, np.nan)))
+    return out
+
+
+def _slice_bound(arg, start, end) -> int:
+    """Interval strings resolve against the render end ('now'); bare
+    numbers are absolute epoch seconds."""
+    if isinstance(arg, str):
+        if arg == "now":
+            return end
+        return end + _parse_interval(arg)
+    return int(arg) * NS
+
+
+@register("useSeriesAbove")
+def _use_series_above(eng, args, start, end, step):
+    """For series whose max exceeds value, fetch the search->replace
+    renamed metric instead (reference example: reqs -> time)."""
+    series, value, search, replace = (
+        args[0], float(args[1]), args[2], args[3])
+    out = []
+    for s in series:
+        if _nan_low(_series_stat("max", s)) > value:
+            pattern = s.name.decode().replace(search, replace)
+            out.extend(eng.fetch(pattern, start, end, step))
+    return out
+
+
+# -- Holt-Winters (triple exponential smoothing, daily season; the
+#    reference's implementation follows graphite-web's, which bootstraps
+#    with 7 days of history — here the visible window itself bootstraps,
+#    and a window shorter than two seasons degrades to non-seasonal
+#    double smoothing. graphite/native/holt_winters.go role) --
+
+_HW_ALPHA, _HW_BETA, _HW_GAMMA = 0.1, 0.0035, 0.1
+
+
+def _holt_winters_analysis(v: np.ndarray, season_len: int):
+    n = len(v)
+    forecast = np.full(n, np.nan)
+    deviation = np.full(n, np.nan)
+    intercept = 0.0
+    slope = 0.0
+    seasonal = np.zeros(max(season_len, 1))
+    dev = np.zeros(max(season_len, 1))
+    seasonal_ok = season_len >= 1 and n >= 2 * season_len
+    started = False
+    for i in range(n):
+        x = v[i]
+        if np.isnan(x):
+            forecast[i] = intercept + slope + (seasonal[i % season_len]
+                                               if seasonal_ok else 0.0)
+            deviation[i] = dev[i % season_len] if seasonal_ok else 0.0
+            continue
+        if not started:
+            intercept, slope, started = x, 0.0, True
+            forecast[i] = x
+            deviation[i] = 0.0
+            continue
+        s_idx = i % season_len if seasonal_ok else 0
+        last_seasonal = seasonal[s_idx]
+        pred = intercept + slope + (last_seasonal if seasonal_ok else 0.0)
+        forecast[i] = pred
+        prev_intercept, prev_slope = intercept, slope
+        if seasonal_ok:
+            intercept = (_HW_ALPHA * (x - last_seasonal)
+                         + (1 - _HW_ALPHA) * (prev_intercept + prev_slope))
+            seasonal[s_idx] = (_HW_GAMMA * (x - intercept)
+                               + (1 - _HW_GAMMA) * last_seasonal)
+        else:
+            intercept = _HW_ALPHA * x + (1 - _HW_ALPHA) * (prev_intercept + prev_slope)
+        slope = _HW_BETA * (intercept - prev_intercept) + (1 - _HW_BETA) * prev_slope
+        dev[s_idx] = (_HW_GAMMA * abs(x - pred)
+                      + (1 - _HW_GAMMA) * dev[s_idx])
+        deviation[i] = dev[s_idx]
+    return forecast, deviation
+
+
+def _hw_season_len(s: Series, step: int) -> int:
+    return max(int(86400 * NS // step), 1)
+
+
+@register("holtWintersForecast")
+def _holt_winters_forecast(eng, args, start, end, step):
+    out = []
+    for s in args[0]:
+        forecast, _ = _holt_winters_analysis(s.values, _hw_season_len(s, step))
+        out.append(Series(b"holtWintersForecast(" + s.name + b")",
+                          s.times, forecast))
+    return out
+
+
+@register("holtWintersConfidenceBands")
+def _holt_winters_confidence_bands(eng, args, start, end, step):
+    delta = float(args[1]) if len(args) > 1 else 3.0
+    out = []
+    for s in args[0]:
+        forecast, deviation = _holt_winters_analysis(
+            s.values, _hw_season_len(s, step))
+        out.append(Series(b"holtWintersConfidenceUpper(" + s.name + b")",
+                          s.times, forecast + delta * deviation))
+        out.append(Series(b"holtWintersConfidenceLower(" + s.name + b")",
+                          s.times, forecast - delta * deviation))
+    return out
+
+
+@register("holtWintersAberration")
+def _holt_winters_aberration(eng, args, start, end, step):
+    delta = float(args[1]) if len(args) > 1 else 3.0
+    out = []
+    for s in args[0]:
+        forecast, deviation = _holt_winters_analysis(
+            s.values, _hw_season_len(s, step))
+        upper = forecast + delta * deviation
+        lower = forecast - delta * deviation
+        with _quiet():
+            ab = np.where(s.values > upper, s.values - upper,
+                          np.where(s.values < lower, s.values - lower, 0.0))
+        ab = np.where(np.isnan(s.values), np.nan, ab)
+        out.append(Series(b"holtWintersAberration(" + s.name + b")",
+                          s.times, ab))
+    return out
