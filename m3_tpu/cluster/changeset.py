@@ -1,0 +1,119 @@
+"""Managed change-set workflow over a KV key.
+
+Role parity with the reference's cluster/changeset: writers STAGE changes
+against a managed value; a committer APPLIES every staged change in one
+CAS'd transition of the value. Staging is a CAS-guarded append, so any
+number of writers stage concurrently without losing entries; a commit
+racing a concurrent value write fails with VersionMismatch and leaves the
+staged changes intact for a retry (exactly-once application: a successful
+commit removes exactly the changes it applied, preserving any staged
+concurrently with it).
+
+Layout: the managed value lives at <key>; staged changes at
+<key>/_changeset as {"changes": [...]}.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable
+
+from m3_tpu.cluster.kv import KeyNotFound, VersionMismatch
+
+
+class ChangeSetManager:
+    def __init__(self, kv, key: str):
+        self.kv = kv
+        self.key = key
+        self.changes_key = key + "/_changeset"
+
+    # -- value --
+
+    def get(self) -> tuple[dict, int]:
+        """(value, version); ({}, 0) when unset."""
+        try:
+            vv = self.kv.get(self.key)
+        except KeyNotFound:
+            return {}, 0
+        return json.loads(vv.data), vv.version
+
+    # -- staging --
+
+    def _read_changes(self) -> tuple[list[dict], int | None]:
+        try:
+            vv = self.kv.get(self.changes_key)
+        except KeyNotFound:
+            return [], None
+        return list(json.loads(vv.data).get("changes", [])), vv.version
+
+    def _write_changes(self, changes: list[dict], expect_version: int | None) -> None:
+        raw = json.dumps({"changes": changes}).encode()
+        if expect_version is None:
+            self.kv.set_if_not_exists(self.changes_key, raw)
+        else:
+            self.kv.check_and_set(self.changes_key, expect_version, raw)
+
+    def stage(self, change: dict, max_retries: int = 64) -> int:
+        """Append one change to the staged set; returns how many changes
+        are now staged. Concurrent stagers retry on CAS conflicts, so no
+        append is lost."""
+        for _ in range(max_retries):
+            changes, version = self._read_changes()
+            changes.append(change)
+            try:
+                self._write_changes(changes, version)
+                return len(changes)
+            except VersionMismatch:
+                continue  # another stager won; re-read and retry
+        raise VersionMismatch(f"stage contention on {self.changes_key}")
+
+    def staged(self) -> list[dict]:
+        return self._read_changes()[0]
+
+    # -- committing --
+
+    def commit(self, apply_fn: Callable[[dict, list[dict]], dict]) -> int:
+        """Apply every currently-staged change in one transition:
+        new_value = apply_fn(current_value, staged_changes). Returns the
+        new value's version (current version when nothing is staged).
+
+        Raises VersionMismatch if the value moved between read and write —
+        the staged changes stay put, so the caller re-commits against the
+        new value. On success exactly the applied changes are removed;
+        changes staged concurrently with the commit survive for the next
+        one."""
+        # value/version FIRST: a commit that races another commit then
+        # fails its CAS (the version predates the winner's write). Reading
+        # changes first would let the stale snapshot pass a fresh version
+        # check — double-applying the winner's changes and consuming
+        # unapplied ones.
+        value, version = self.get()
+        changes, _ = self._read_changes()
+        if not changes:
+            return version
+        new_value = apply_fn(value, changes)
+        raw = json.dumps(new_value).encode()
+        if version == 0:
+            new_version = self.kv.set_if_not_exists(self.key, raw)
+        else:
+            new_version = self.kv.check_and_set(self.key, version, raw)
+        self._consume(len(changes))
+        return new_version
+
+    def _consume(self, n: int, max_retries: int = 64) -> None:
+        """Remove the first n staged changes (the ones a commit applied);
+        appends are tail-only so they form a stable prefix."""
+        for _ in range(max_retries):
+            changes, version = self._read_changes()
+            if version is None:
+                return
+            rest = changes[n:]
+            try:
+                # an empty doc stays behind rather than a delete: deleting
+                # after the CAS would race a concurrent append and drop it
+                self._write_changes(rest, version)
+                return
+            except VersionMismatch:
+                continue  # a concurrent stage appended; retry the trim
+            except KeyNotFound:
+                return

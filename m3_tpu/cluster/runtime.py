@@ -1,0 +1,185 @@
+"""Runtime-dynamic options, adjustable live through the cluster KV.
+
+Role parity with the reference's runtime options manager + kvconfig keys
+(/root/reference/src/dbnode/runtime — RuntimeOptions with a listener-based
+Manager; /root/reference/src/dbnode/kvconfig — well-known KV keys watched so
+operators can retune a live cluster without restarts). The tunables here are
+the ones this framework's hot paths consult every pass: whole-query resource
+limits (storage/limits.py), the tick's flush/snapshot switches, and the
+fileset persist rate limit.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import asdict, dataclass, replace
+
+# the kvconfig key services watch (reference kvconfig/keys.go role)
+RUNTIME_KEY = "m3_tpu.runtime"
+
+
+@dataclass(frozen=True)
+class RuntimeOptions:
+    # whole-query budgets (0 = unlimited), applied to the node's QueryLimits
+    max_series: int = 0
+    max_datapoints: int = 0
+    max_steps: int = 0
+    # tick switches: pausing flush/snapshot is the emergency valve when a
+    # node's disk or device is struggling (reference runtime options)
+    flush_enabled: bool = True
+    snapshot_enabled: bool = True
+    # fileset persist pacing in MiB/s (0 = unlimited; the reference's
+    # persist rate limit, src/dbnode/ratelimit)
+    persist_rate_mbps: float = 0.0
+
+    def to_json(self) -> bytes:
+        return json.dumps(asdict(self), sort_keys=True).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "RuntimeOptions":
+        """Strictly-typed parse: a dataclass would accept any JSON value,
+        and a mistyped payload stored in the KV would then fail inside
+        every watcher's listener (where errors are swallowed) — the
+        operator would see a 200 and nothing would apply."""
+        doc = json.loads(raw)
+        known = {}
+        for k in doc:
+            if k not in cls.__dataclass_fields__:
+                continue
+            v = doc[k]
+            default = cls.__dataclass_fields__[k].default
+            if isinstance(default, bool):
+                if not isinstance(v, bool):
+                    raise ValueError(f"{k} must be a boolean, got {v!r}")
+            elif isinstance(default, int):
+                if isinstance(v, bool) or not isinstance(v, int):
+                    raise ValueError(f"{k} must be an integer, got {v!r}")
+            elif isinstance(default, float):
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    raise ValueError(f"{k} must be a number, got {v!r}")
+                v = float(v)
+            known[k] = v
+        return cls(**known)
+
+
+class RuntimeOptionsManager:
+    """Current options + listeners; optionally fed by a KV watch.
+
+    Listeners run synchronously under the manager lock on every change, in
+    registration order; they receive the new RuntimeOptions. A failing
+    listener does not block the others (its error is swallowed — a bad
+    option application must not wedge the KV watch thread)."""
+
+    def __init__(self, opts: RuntimeOptions | None = None):
+        self._opts = opts or RuntimeOptions()
+        self._lock = threading.Lock()
+        self._listeners: list = []
+        self._unwatch = None
+
+    def get(self) -> RuntimeOptions:
+        with self._lock:
+            return self._opts
+
+    def update(self, **fields) -> RuntimeOptions:
+        with self._lock:
+            self._opts = replace(self._opts, **fields)
+            opts = self._opts
+            listeners = list(self._listeners)
+        self._notify(listeners, opts)
+        return opts
+
+    def set(self, opts: RuntimeOptions) -> None:
+        with self._lock:
+            self._opts = opts
+            listeners = list(self._listeners)
+        self._notify(listeners, opts)
+
+    @staticmethod
+    def _notify(listeners, opts) -> None:
+        for fn in listeners:
+            try:
+                fn(opts)
+            except Exception:  # noqa: BLE001 - see class docstring
+                pass
+
+    def register_listener(self, fn) -> callable:
+        """fn(RuntimeOptions); called immediately with the current value
+        (so wiring a listener is also applying the current state), then on
+        every change. Returns an unregister callable."""
+        with self._lock:
+            self._listeners.append(fn)
+            opts = self._opts
+        self._notify([fn], opts)
+
+        def unregister():
+            with self._lock:
+                if fn in self._listeners:
+                    self._listeners.remove(fn)
+
+        return unregister
+
+    # -- KV integration --
+
+    def watch_kv(self, kv, key: str = RUNTIME_KEY):
+        """Apply the key's current value (if any) and follow updates.
+        Returns an unwatch callable."""
+
+        def on_change(_key, vv):
+            if vv is None:
+                return  # deletion keeps the last applied options
+            try:
+                self.set(RuntimeOptions.from_json(vv.data))
+            except (ValueError, TypeError):
+                pass  # malformed payloads must not kill the watch thread
+
+        # kv.watch delivers the current value at registration, so wiring
+        # the watch is also applying the key's present state
+        self._unwatch = kv.watch(key, on_change)
+        return self._unwatch
+
+
+def apply_to_query_limits(limits, opts: RuntimeOptions) -> None:
+    """Mutate a storage QueryLimits in place: accounting reads the fields
+    at check time, so updates govern the very next read."""
+    limits.max_series = int(opts.max_series)
+    limits.max_datapoints = int(opts.max_datapoints)
+    limits.max_steps = int(opts.max_steps)
+
+
+class PersistRateLimiter:
+    """Token-bucket pacing for fileset writes (bytes). rate_mbps == 0
+    disables. Thread-safe; updated live by a runtime listener."""
+
+    def __init__(self, rate_mbps: float = 0.0):
+        self._lock = threading.Lock()
+        self._tokens = 0.0
+        self._last = time.monotonic()
+        self.set_rate(rate_mbps)
+
+    def set_rate(self, rate_mbps: float) -> None:
+        with self._lock:
+            self._rate = float(rate_mbps) * (1 << 20)  # bytes/sec
+            self._burst = max(self._rate, 1 << 20)
+
+    def acquire(self, n_bytes: int) -> None:
+        """Blocks until n_bytes fit the budget (no-op when unlimited). A
+        single request larger than the burst cap is granted when the bucket
+        is full, driving the balance negative — otherwise an oversize
+        stream could never be satisfied and the flush holding the shard
+        maintenance lock would wedge forever."""
+        while True:
+            with self._lock:
+                if self._rate <= 0:
+                    return
+                now = time.monotonic()
+                self._tokens = min(
+                    self._burst, self._tokens + (now - self._last) * self._rate
+                )
+                self._last = now
+                if self._tokens >= n_bytes or self._tokens >= self._burst:
+                    self._tokens -= n_bytes
+                    return
+                needed = (min(n_bytes, self._burst) - self._tokens) / self._rate
+            time.sleep(min(needed, 0.25))

@@ -112,7 +112,70 @@ class AdminAPI:
             return self._topic_add_consumer(doc)
         if path.startswith("/api/v1/topic/consumer/") and method == "DELETE":
             return self._topic_remove_consumer(q, path.rsplit("/", 1)[1])
+        if path == "/api/v1/runtime":
+            if method == "GET":
+                return self._runtime_get()
+            if method in ("POST", "PUT"):
+                return self._runtime_set(doc)
         return None
+
+    # -- runtime options (kvconfig role) --
+
+    def _runtime_get(self):
+        from m3_tpu.cluster.kv import KeyNotFound
+        from m3_tpu.cluster.runtime import RUNTIME_KEY, RuntimeOptions
+
+        if self.kv is None:
+            raise ValueError("runtime options need a cluster KV")
+        try:
+            raw = self.kv.get(RUNTIME_KEY).data
+            opts = RuntimeOptions.from_json(raw)
+        except KeyNotFound:
+            opts = RuntimeOptions()
+        from dataclasses import asdict
+
+        return 200, json.dumps(asdict(opts)).encode()
+
+    def _runtime_set(self, doc: dict):
+        """Validates the payload by round-tripping it through
+        RuntimeOptions, then writes the kvconfig key; every watching
+        service applies it live."""
+        from m3_tpu.cluster.runtime import RUNTIME_KEY, RuntimeOptions
+
+        from m3_tpu.cluster.kv import KeyNotFound, VersionMismatch
+
+        if self.kv is None:
+            raise ValueError("runtime options need a cluster KV")
+        unknown = set(doc) - set(RuntimeOptions.__dataclass_fields__)
+        if unknown:
+            raise ValueError(f"unknown runtime fields: {sorted(unknown)}")
+        # partial update merged over the STORED options under CAS: two
+        # operators updating different fields concurrently must both land
+        for _ in range(16):
+            try:
+                vv = self.kv.get(RUNTIME_KEY)
+                current, cur_version = json.loads(vv.data), vv.version
+            except KeyNotFound:
+                current, cur_version = {}, None
+            current.update(doc)
+            opts = RuntimeOptions.from_json(json.dumps(current).encode())
+            try:
+                if cur_version is None:
+                    version = self.kv.set_if_not_exists(
+                        RUNTIME_KEY, opts.to_json())
+                else:
+                    version = self.kv.check_and_set(
+                        RUNTIME_KEY, cur_version, opts.to_json())
+                break
+            except VersionMismatch:
+                continue
+        else:
+            raise ValueError("runtime update contention; retry")
+        from dataclasses import asdict
+
+        return 200, json.dumps(
+            {"version": version, **asdict(opts)}
+        ).encode()
 
     # -- database / namespaces --
 

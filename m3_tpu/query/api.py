@@ -215,6 +215,7 @@ class CoordinatorAPI:
             path.startswith("/api/v1/services/")
             or path.startswith("/api/v1/database/")
             or path.startswith("/api/v1/topic")
+            or path == "/api/v1/runtime"
         ):
             res = self.admin.handle(method, path, q, body)
             if res is not None:

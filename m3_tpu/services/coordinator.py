@@ -124,6 +124,25 @@ class CoordinatorService:
             max_datapoints=int(lim_cfg.get("max_datapoints", 0)),
             max_steps=int(lim_cfg.get("max_steps", 0)),
         )
+        from m3_tpu.cluster.runtime import (
+            RuntimeOptions,
+            RuntimeOptionsManager,
+            apply_to_query_limits,
+        )
+
+        # seed the runtime manager from the config-file limits so wiring
+        # the listener re-applies (not resets) them; KV updates override
+        self.runtime = RuntimeOptionsManager(RuntimeOptions(
+            max_series=limits.max_series,
+            max_datapoints=limits.max_datapoints,
+            max_steps=limits.max_steps,
+        ))
+        self.runtime.register_listener(
+            lambda opts: apply_to_query_limits(limits, opts))
+        if hasattr(self.db, "apply_runtime"):  # local-storage mode
+            self.db.apply_runtime(self.runtime)
+        if self.kv is not None:
+            self.runtime.watch_kv(self.kv)
         self.api = CoordinatorAPI(self.db, db_cfg.get("namespace", "default"),
                                   limits=limits)
         self.api.writer = self.writer  # ingest fans out through downsampler

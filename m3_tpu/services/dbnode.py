@@ -242,6 +242,14 @@ class DBNodeService:
         )
         for ns in db_cfg.get("namespaces", [{"name": "default"}]) or []:
             self.db.create_namespace(ns["name"], namespace_options(ns.get("options")))
+        from m3_tpu.cluster.runtime import RuntimeOptionsManager
+
+        # live-tunable options: query limits, tick switches, persist pacing
+        # follow the kvconfig runtime key when a cluster KV is attached
+        self.runtime = RuntimeOptionsManager()
+        self.db.apply_runtime(self.runtime)
+        if self.kv is not None:
+            self.runtime.watch_kv(self.kv)
         self.api = NodeAPI(self.db)
         self._stop = threading.Event()
 

@@ -54,6 +54,14 @@ class Database:
 
         # decoded-block LRU shared by every shard (WiredList role)
         self.block_cache = BlockCache(self.opts.block_cache_entries)
+        from m3_tpu.cluster.runtime import PersistRateLimiter
+
+        # fileset write pacing shared by every shard (reference ratelimit
+        # role); rate comes from runtime options (0 = unlimited)
+        self.persist_limiter = PersistRateLimiter()
+        # live-tunable options (set via apply_runtime; None = all defaults)
+        self.runtime = None
+        self._runtime_opts = None
 
     # -- lifecycle --
 
@@ -76,6 +84,7 @@ class Database:
         ns.database = self
         for shard in ns.shards.values():
             shard.cache = self.block_cache
+            shard.persist_limiter = self.persist_limiter
         self.namespaces[name] = ns
         if ns.opts.writes_to_commitlog and self._open:
             self._open_commitlog(name)
@@ -411,6 +420,25 @@ class Database:
 
     # -- maintenance --
 
+    def apply_runtime(self, manager) -> None:
+        """Bind a RuntimeOptionsManager: query limits, tick switches, and
+        persist pacing follow its updates live (kvconfig role)."""
+        from m3_tpu.cluster.runtime import apply_to_query_limits
+        from m3_tpu.storage.limits import QueryLimits
+
+        self.runtime = manager
+
+        def on_opts(opts):
+            # mutate the CURRENTLY bound limits: engines rebind db.limits,
+            # and storage accounting reads the binding at check time
+            if self.limits is None:
+                self.limits = QueryLimits()
+            apply_to_query_limits(self.limits, opts)
+            self.persist_limiter.set_rate(opts.persist_rate_mbps)
+            self._runtime_opts = opts
+
+        manager.register_listener(on_opts)
+
     def tick(self, now_ns: int | None = None) -> dict:
         """One mediator cycle: warm flush of cold windows + snapshot of
         in-flight windows + retention expiry + commitlog rotation (a log
@@ -418,9 +446,12 @@ class Database:
         rotated — the reference flush model, storage/README.md)."""
         now_ns = now_ns if now_ns is not None else time.time_ns()
         flushed = expired = 0
-        snapped = self.snapshot(now_ns)
+        ropts = self._runtime_opts
+        snap_on = ropts is None or ropts.snapshot_enabled
+        flush_on = ropts is None or ropts.flush_enabled
+        snapped = self.snapshot(now_ns) if snap_on else {}
         for name, ns in self.namespaces.items():
-            n = ns.flush(now_ns)
+            n = ns.flush(now_ns) if flush_on else 0
             flushed += n
             expired += ns.expire(now_ns)
             self._cleanup_snapshots(name, ns, now_ns)

@@ -51,6 +51,7 @@ class Namespace:
                           self.fs_root)
             if self.database is not None:
                 shard.cache = self.database.block_cache
+                shard.persist_limiter = self.database.persist_limiter
             self.shards[shard_id] = shard
             shard.bootstrap_from_fs(now_ns)
             shard.bootstrapped = True

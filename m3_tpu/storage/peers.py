@@ -228,11 +228,16 @@ def repair_shard_block(db, namespace: str, shard_id: int, block_start: int,
     with shard._maint_lock:
         reader = shard._filesets.get(block_start)
     local_meta = {}
-    if reader is not None:
-        for i in range(reader.n_series):
-            sid, _tags, stream = reader.read_at(i)
-            local_meta[sid] = zlib.adler32(stream)
     result = RepairResult()
+    try:
+        if reader is not None:
+            for i in range(reader.n_series):
+                sid, _tags, stream = reader.read_at(i)
+                local_meta[sid] = zlib.adler32(stream)
+    except ValueError:
+        # captured reader closed by a concurrent flush + retire-grace
+        # expiry; stale pass, redo next cycle
+        return result
     peer_metas = []
     for p in peers:
         try:
@@ -259,12 +264,19 @@ def repair_shard_block(db, namespace: str, shard_id: int, block_start: int,
     merged: dict[bytes, tuple[bytes, bytes]] = {}
     for sid in divergent:
         parts_t, parts_v = [], []
-        tags = reader.tags_of(sid) if reader else None
         streams = []
-        if reader is not None:
-            own = reader.read(sid)
-            if own:
-                streams.append(own)
+        try:
+            tags = reader.tags_of(sid) if reader else None
+            own = reader.read(sid) if reader is not None else None
+        except ValueError:
+            # a merge slower than the retire grace can find the captured
+            # reader closed after a concurrent flush; the merge is stale
+            # either way (the swap check below would abandon it), so bail
+            # now and let the next repair cycle re-compare
+            result.repaired = 0
+            return result
+        if own:
+            streams.append(own)
         for p in peers:
             try:
                 stream, ptags = p.stream_block(namespace, shard_id, block_start, sid)
@@ -330,84 +342,6 @@ def repair_shard_block(db, namespace: str, shard_id: int, block_start: int,
         )
         if shard.cache is not None:  # cached decodes predate the repair
             shard.cache.invalidate_block(namespace, shard_id, block_start)
-    # peer-only series become queryable
-    if ns.index is not None:
-        from m3_tpu.utils.ident import decode_tags
-
-        for sid, (tags, _stream) in merged.items():
-            if tags:
-                ns.index_insert_spanning(sid, decode_tags(tags), block_start)
-    return result
-
-    unit = ns.opts.write_time_unit
-    merged: dict[bytes, tuple[bytes, bytes]] = {}
-    for sid in divergent:
-        parts_t, parts_v = [], []
-        tags = reader.tags_of(sid) if reader else None
-        streams = []
-        if reader is not None:
-            own = reader.read(sid)
-            if own:
-                streams.append(own)
-        for p in peers:
-            try:
-                stream, ptags = p.stream_block(namespace, shard_id, block_start, sid)
-            except Exception:
-                continue
-            if stream:
-                streams.append(stream)
-                tags = tags or ptags
-        for stream in streams:
-            dps = scalar_decode(stream, int_optimized=ns.opts.int_optimized,
-                                default_time_unit=unit)
-            if dps:
-                parts_t.append(np.array([d.timestamp_ns for d in dps], np.int64))
-                parts_v.append(
-                    np.array([d.value for d in dps], np.float64).view(np.uint64)
-                )
-        if not parts_t:
-            continue
-        times, vbits = merge_dedup(np.concatenate(parts_t), np.concatenate(parts_v))
-        enc = Encoder(block_start, int_optimized=ns.opts.int_optimized,
-                      default_time_unit=unit)
-        for t, vb in zip(times, vbits):
-            enc.encode(int(t), float(np.uint64(vb).view(np.float64)), unit)
-        merged[sid] = (tags or b"", enc.stream())
-        result.repaired += 1
-
-    if not merged:
-        # nothing could actually be streamed (e.g. peers unreachable):
-        # writing an empty volume would mask the block forever
-        result.repaired = 0
-        return result
-
-    # write a higher volume carrying merged + untouched series
-    volume = (reader.volume + 1) if reader else 0
-    writer = FilesetWriter(
-        shard.fs_root, namespace, shard_id, block_start,
-        ns.opts.retention.block_size_ns, volume,
-    )
-    seen = set()
-    for sid, (tags, stream) in sorted(merged.items()):
-        writer.write_series(sid, tags, stream)
-        seen.add(sid)
-    if reader is not None:
-        for i in range(reader.n_series):
-            sid, tags, stream = reader.read_at(i)
-            if sid not in seen:
-                writer.write_series(sid, tags, stream)
-    writer.close()
-    from m3_tpu.storage.fileset import FilesetReader
-
-    if reader is not None:
-        # retire, don't close: a concurrent Shard.read may still hold this
-        # reader from its snapshot (see Shard._retire)
-        shard._retire(reader)
-    shard._filesets[block_start] = FilesetReader(
-        shard.fs_root, namespace, shard_id, block_start, volume
-    )
-    if shard.cache is not None:  # cached decodes predate the repair
-        shard.cache.invalidate_block(namespace, shard_id, block_start)
     # peer-only series become queryable
     if ns.index is not None:
         from m3_tpu.utils.ident import decode_tags

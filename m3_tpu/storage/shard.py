@@ -48,6 +48,8 @@ class Shard:
         self._maint_lock = threading.RLock()
         self.bootstrapped = False
         self.cache = None  # decoded-block LRU, set by the owning Database
+        # fileset write pacing, set by the owning Database (runtime options)
+        self.persist_limiter = None
         # per-window write sequence vs last-snapshotted sequence: lets the
         # snapshot loop skip windows with no new writes (dirty tracking);
         # guarded by _seq_lock (lost increments would mark dirty windows
@@ -176,6 +178,7 @@ class Shard:
             self.opts.retention.block_size_ns, snapshot_id,
         )
         for sid, stags, stream in zip(ids, tags, streams):
+            self._pace_persist(len(stream))
             writer.write_series(sid, stags, stream)
         writer.close()
         return True
@@ -202,6 +205,10 @@ class Shard:
         with trace.span(trace.SHARD_FLUSH, shard=self.shard_id,
                         block_start=block_start):
             return self._flush_traced(block_start)
+
+    def _pace_persist(self, n_bytes: int) -> None:
+        if self.persist_limiter is not None:
+            self.persist_limiter.acquire(n_bytes)
 
     # grace before a swapped-out reader is really closed; class attribute so
     # tests can shrink it
@@ -315,8 +322,10 @@ class Shard:
             self.opts.retention.block_size_ns, volume,
         )
         for sid, stags, stream in zip(ids, tags, streams):
+            self._pace_persist(len(stream))
             writer.write_series(sid, stags, stream)
         for sid, stags, stream in extra:
+            self._pace_persist(len(stream))
             writer.write_series(sid, stags, stream)
         writer.close()
 

@@ -1,0 +1,254 @@
+"""Runtime-dynamic options (kvconfig role) + changeset workflow tests.
+
+Reference analogs: dbnode/runtime + kvconfig (live-tunable options via KV
+watches) and cluster/changeset (staged changes applied in one CAS'd
+transition)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from m3_tpu.cluster.changeset import ChangeSetManager
+from m3_tpu.cluster.kv import KVStore, VersionMismatch
+from m3_tpu.cluster.runtime import (
+    RUNTIME_KEY,
+    PersistRateLimiter,
+    RuntimeOptions,
+    RuntimeOptionsManager,
+)
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.limits import QueryLimitError
+from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions, RetentionOptions
+
+SEC = 10**9
+START = 1_600_000_000_000_000_000
+
+
+class TestRuntimeManager:
+    def test_listeners_get_current_then_updates(self):
+        mgr = RuntimeOptionsManager(RuntimeOptions(max_series=7))
+        seen = []
+        mgr.register_listener(lambda o: seen.append(o.max_series))
+        assert seen == [7]  # immediate application of current state
+        mgr.update(max_series=9)
+        assert seen == [7, 9]
+
+    def test_kv_watch_applies_current_and_updates(self):
+        kv = KVStore()
+        kv.set(RUNTIME_KEY, RuntimeOptions(max_datapoints=123).to_json())
+        mgr = RuntimeOptionsManager()
+        mgr.watch_kv(kv)
+        assert mgr.get().max_datapoints == 123  # bootstrap delivery
+        kv.set(RUNTIME_KEY, RuntimeOptions(max_datapoints=456).to_json())
+        assert mgr.get().max_datapoints == 456
+        kv.set(RUNTIME_KEY, b"not json")  # malformed: last good value holds
+        assert mgr.get().max_datapoints == 456
+
+    def test_persist_rate_limiter(self):
+        lim = PersistRateLimiter(rate_mbps=1.0)  # 1 MiB/s
+        lim.acquire(1 << 20)  # burst allowance covers the first MiB
+        t0 = time.monotonic()
+        lim.acquire(1 << 18)  # quarter MiB over budget -> ~0.25s wait
+        waited = time.monotonic() - t0
+        assert waited >= 0.15
+        lim.set_rate(0.0)  # live un-throttle unblocks immediately
+        t0 = time.monotonic()
+        lim.acquire(100 << 20)
+        assert time.monotonic() - t0 < 0.05
+
+
+class TestDatabaseRuntime:
+    @pytest.fixture
+    def db(self, tmp_path):
+        opts = NamespaceOptions(
+            retention=RetentionOptions(
+                retention_ns=3600 * SEC, block_size_ns=60 * SEC,
+                buffer_past_ns=0, buffer_future_ns=10**15,
+            ),
+        )
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=1))
+        db.create_namespace("default", opts)
+        db.open(START)
+        yield db
+        db.close()
+
+    def test_flush_switch_and_query_limits_follow_kv(self, db):
+        kv = KVStore()
+        mgr = RuntimeOptionsManager()
+        db.apply_runtime(mgr)
+        mgr.watch_kv(kv)
+        for i in range(5):
+            db.write_tagged("default", b"", [(b"n", b"a")],
+                            START + i * SEC, float(i))
+        # flush paused: tick flushes nothing even though windows are cold
+        # (now stays inside retention so expiry doesn't eat the window)
+        now = START + 300 * SEC
+        kv.set(RUNTIME_KEY, RuntimeOptions(flush_enabled=False).to_json())
+        stats = db.tick(now)
+        assert stats["flushed"] == 0
+        # un-pause live: the same tick call now flushes
+        kv.set(RUNTIME_KEY, RuntimeOptions(flush_enabled=True).to_json())
+        stats = db.tick(now)
+        assert stats["flushed"] >= 1
+        # query limits apply to the bound storage limits object
+        kv.set(RUNTIME_KEY,
+               RuntimeOptions(flush_enabled=True, max_series=1).to_json())
+        from m3_tpu.index.query import TermQuery
+
+        ns = db.namespaces["default"]
+        q = TermQuery(b"n", b"a")
+        with pytest.raises(QueryLimitError):
+            db.limits.start_query()
+            try:
+                # 1 series per call; the budget spans the whole query scope
+                ns.query_ids(q, START, START + 7200 * SEC)
+                ns.query_ids(q, START, START + 7200 * SEC)
+            finally:
+                db.limits.end_query()
+
+    def test_admin_endpoint_round_trip(self, db):
+        from m3_tpu.query.admin import AdminAPI
+
+        kv = KVStore()
+        mgr = RuntimeOptionsManager()
+        db.apply_runtime(mgr)
+        mgr.watch_kv(kv)
+        admin = AdminAPI(db, kv=kv)
+        code, payload = admin.handle(
+            "PUT", "/api/v1/runtime", {}, b'{"max_series": 42}')
+        assert code == 200
+        assert db.limits.max_series == 42
+        # partial update preserves prior fields
+        code, _ = admin.handle(
+            "PUT", "/api/v1/runtime", {}, b'{"max_steps": 5}')
+        assert code == 200
+        assert db.limits.max_series == 42 and db.limits.max_steps == 5
+        code, payload = admin.handle("GET", "/api/v1/runtime", {}, b"")
+        import json
+
+        doc = json.loads(payload)
+        assert doc["max_series"] == 42 and doc["max_steps"] == 5
+        # unknown fields rejected, nothing applied
+        code, _ = admin.handle("PUT", "/api/v1/runtime", {}, b'{"bogus": 1}')
+        assert code == 400
+        # mistyped fields rejected BEFORE storage: a stored bad payload
+        # would fail inside every watcher where errors are swallowed
+        for bad in (b'{"flush_enabled": "no"}', b'{"max_series": "lots"}',
+                    b'{"max_series": true}'):
+            code, _ = admin.handle("PUT", "/api/v1/runtime", {}, bad)
+            assert code == 400, bad
+        assert db.limits.max_series == 42  # untouched by rejected updates
+
+
+class TestChangeSet:
+    def test_stage_commit_round_trip(self):
+        kv = KVStore()
+        cs = ChangeSetManager(kv, "cfg")
+        assert cs.staged() == []
+        cs.stage({"op": "add", "key": "a", "value": 1})
+        cs.stage({"op": "add", "key": "b", "value": 2})
+        assert len(cs.staged()) == 2
+
+        def apply(value, changes):
+            out = dict(value)
+            for ch in changes:
+                out[ch["key"]] = ch["value"]
+            return out
+
+        v = cs.commit(apply)
+        assert v == 1
+        value, version = cs.get()
+        assert value == {"a": 1, "b": 2} and version == 1
+        # staged set consumed: a no-change commit is a no-op
+        assert cs.staged() == []
+        assert cs.commit(apply) == 1
+
+    def test_stage_after_commit_targets_new_version(self):
+        kv = KVStore()
+        cs = ChangeSetManager(kv, "cfg")
+        cs.stage({"key": "a", "value": 1})
+        cs.commit(lambda val, chs: {c["key"]: c["value"] for c in chs})
+        cs.stage({"key": "b", "value": 2})
+        assert cs.staged() == [{"key": "b", "value": 2}]
+        cs.commit(lambda val, chs: {**val,
+                                    **{c["key"]: c["value"] for c in chs}})
+        assert cs.get()[0] == {"a": 1, "b": 2}
+
+    def test_concurrent_stagers_all_land(self):
+        kv = KVStore()
+        cs = ChangeSetManager(kv, "cfg")
+        errs = []
+
+        def stage_many(k):
+            try:
+                for i in range(20):
+                    cs.stage({"w": k, "i": i})
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=stage_many, args=(k,))
+                   for k in range(4)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert not errs
+        assert len(cs.staged()) == 80
+
+    def test_racing_commit_loses_cleanly(self):
+        kv = KVStore()
+        a = ChangeSetManager(kv, "cfg")
+        b = ChangeSetManager(kv, "cfg")
+        a.stage({"key": "x", "value": 1})
+        apply = lambda val, chs: {**val, **{c["key"]: c["value"] for c in chs}}  # noqa: E731
+        a.commit(apply)
+        # b stages against the OLD version view, then re-reads: its staged
+        # set is fresh for the new version (stale sets are replaced)
+        b.stage({"key": "y", "value": 2})
+        b.commit(apply)
+        assert b.get()[0] == {"x": 1, "y": 2}
+        # a genuine lost race: value moves between read and commit; staged
+        # changes survive and a retry applies them to the moved value
+        c = ChangeSetManager(kv, "cfg")
+        c.stage({"key": "z", "value": 3})
+        value, version = c.get()
+        kv.check_and_set("cfg", version, b'{"moved": 1}')
+
+        orig_get = c.get
+
+        def racy_get():
+            # sees the pre-move state once, like a commit that lost a race
+            c.get = orig_get
+            return value, version
+
+        c.get = racy_get
+        with pytest.raises(VersionMismatch):
+            c.commit(apply)
+        assert c.staged() == [{"key": "z", "value": 3}]
+        c.commit(apply)
+        assert c.get()[0] == {"moved": 1, "z": 3}
+
+
+class TestPersistPacingWired:
+    def test_flush_paces_through_limiter(self, tmp_path):
+        opts = NamespaceOptions(
+            retention=RetentionOptions(
+                retention_ns=3600 * SEC, block_size_ns=60 * SEC,
+                buffer_past_ns=0, buffer_future_ns=10**15,
+            ),
+        )
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=1))
+        db.create_namespace("default", opts)
+        db.open(START)
+        try:
+            calls = []
+            real = db.persist_limiter.acquire
+            db.persist_limiter.acquire = lambda n: calls.append(n) or real(n)
+            db.write_tagged("default", b"", [(b"n", b"p")], START, 1.0)
+            db.tick(START + 7200 * SEC)
+            assert calls, "flush must pace each series stream"
+            assert all(n > 0 for n in calls)
+        finally:
+            db.close()
