@@ -3,14 +3,18 @@
 Role parity with the reference's cluster/changeset: writers STAGE changes
 against a managed value; a committer APPLIES every staged change in one
 CAS'd transition of the value. Staging is a CAS-guarded append, so any
-number of writers stage concurrently without losing entries; a commit
-racing a concurrent value write fails with VersionMismatch and leaves the
-staged changes intact for a retry (exactly-once application: a successful
-commit removes exactly the changes it applied, preserving any staged
-concurrently with it).
+number of writers stage concurrently without losing entries.
 
-Layout: the managed value lives at <key>; staged changes at
-<key>/_changeset as {"changes": [...]}.
+Exactly-once application is carried by the VALUE key itself: every staged
+change gets a monotonically-increasing id, and the committed value records
+`applied_upto`, the highest change id folded into it. A committer only
+applies changes with id > applied_upto, so a racing commit that reads the
+winner's value re-applies nothing, and trimming the staged list is mere
+garbage collection (benign under any race). A commit whose value CAS loses
+raises VersionMismatch with the staged changes intact for a retry.
+
+Layout: <key> holds {"data": <caller value>, "applied_upto": N};
+<key>/_changeset holds {"changes": [{"id": n, "change": {...}}, ...]}.
 """
 
 from __future__ import annotations
@@ -31,11 +35,16 @@ class ChangeSetManager:
 
     def get(self) -> tuple[dict, int]:
         """(value, version); ({}, 0) when unset."""
+        value, _applied, version = self._get_full()
+        return value, version
+
+    def _get_full(self) -> tuple[dict, int, int]:
         try:
             vv = self.kv.get(self.key)
         except KeyNotFound:
-            return {}, 0
-        return json.loads(vv.data), vv.version
+            return {}, 0, 0
+        doc = json.loads(vv.data)
+        return doc.get("data", {}), int(doc.get("applied_upto", 0)), vv.version
 
     # -- staging --
 
@@ -46,74 +55,79 @@ class ChangeSetManager:
             return [], None
         return list(json.loads(vv.data).get("changes", [])), vv.version
 
-    def _write_changes(self, changes: list[dict], expect_version: int | None) -> None:
-        raw = json.dumps({"changes": changes}).encode()
+    def _write_changes(self, entries: list[dict], expect_version: int | None) -> None:
+        raw = json.dumps({"changes": entries}).encode()
         if expect_version is None:
             self.kv.set_if_not_exists(self.changes_key, raw)
         else:
             self.kv.check_and_set(self.changes_key, expect_version, raw)
 
     def stage(self, change: dict, max_retries: int = 64) -> int:
-        """Append one change to the staged set; returns how many changes
-        are now staged. Concurrent stagers retry on CAS conflicts, so no
-        append is lost."""
+        """Append one change to the staged set; returns its change id.
+        Concurrent stagers retry on CAS conflicts, so no append is lost."""
         for _ in range(max_retries):
-            changes, version = self._read_changes()
-            changes.append(change)
+            entries, version = self._read_changes()
+            _, applied_upto, _ = self._get_full()
+            prev_max = max(
+                [e["id"] for e in entries] + [applied_upto]
+            ) if (entries or applied_upto) else 0
+            cid = prev_max + 1
+            entries.append({"id": cid, "change": change})
             try:
-                self._write_changes(changes, version)
-                return len(changes)
+                self._write_changes(entries, version)
+                return cid
             except VersionMismatch:
                 continue  # another stager won; re-read and retry
         raise VersionMismatch(f"stage contention on {self.changes_key}")
 
     def staged(self) -> list[dict]:
-        return self._read_changes()[0]
+        """Changes staged and not yet applied to the committed value."""
+        entries, _ = self._read_changes()
+        _, applied_upto, _ = self._get_full()
+        return [e["change"] for e in entries if e["id"] > applied_upto]
 
     # -- committing --
 
     def commit(self, apply_fn: Callable[[dict, list[dict]], dict]) -> int:
-        """Apply every currently-staged change in one transition:
-        new_value = apply_fn(current_value, staged_changes). Returns the
-        new value's version (current version when nothing is staged).
+        """Apply every pending change in one transition:
+        new_value = apply_fn(current_value, pending_changes). Returns the
+        new value's version (current version when nothing is pending).
 
         Raises VersionMismatch if the value moved between read and write —
         the staged changes stay put, so the caller re-commits against the
-        new value. On success exactly the applied changes are removed;
-        changes staged concurrently with the commit survive for the next
-        one."""
-        # value/version FIRST: a commit that races another commit then
-        # fails its CAS (the version predates the winner's write). Reading
-        # changes first would let the stale snapshot pass a fresh version
-        # check — double-applying the winner's changes and consuming
-        # unapplied ones.
-        value, version = self.get()
-        changes, _ = self._read_changes()
-        if not changes:
+        new value. Changes already folded into the value (id <=
+        applied_upto) are never re-applied, even by a commit racing the
+        one that applied them."""
+        value, applied_upto, version = self._get_full()
+        entries, _ = self._read_changes()
+        pending = [e for e in entries if e["id"] > applied_upto]
+        if not pending:
             return version
-        new_value = apply_fn(value, changes)
-        raw = json.dumps(new_value).encode()
+        new_value = apply_fn(value, [e["change"] for e in pending])
+        new_upto = max(e["id"] for e in pending)
+        raw = json.dumps({"data": new_value, "applied_upto": new_upto}).encode()
         if version == 0:
             new_version = self.kv.set_if_not_exists(self.key, raw)
         else:
             new_version = self.kv.check_and_set(self.key, version, raw)
-        self._consume(len(changes))
+        self._gc(new_upto)
         return new_version
 
-    def _consume(self, n: int, max_retries: int = 64) -> None:
-        """Remove the first n staged changes (the ones a commit applied);
-        appends are tail-only so they form a stable prefix."""
+    def _gc(self, applied_upto: int, max_retries: int = 64) -> None:
+        """Drop staged entries already folded into the value. Pure garbage
+        collection: correctness never depends on it (applied_upto gates
+        re-application), so losing a race here is harmless."""
         for _ in range(max_retries):
-            changes, version = self._read_changes()
+            entries, version = self._read_changes()
             if version is None:
                 return
-            rest = changes[n:]
+            rest = [e for e in entries if e["id"] > applied_upto]
+            if len(rest) == len(entries):
+                return
             try:
-                # an empty doc stays behind rather than a delete: deleting
-                # after the CAS would race a concurrent append and drop it
                 self._write_changes(rest, version)
                 return
             except VersionMismatch:
-                continue  # a concurrent stage appended; retry the trim
+                continue
             except KeyNotFound:
                 return

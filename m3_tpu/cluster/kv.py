@@ -141,9 +141,11 @@ class FileKVStore(KVStore):
     Safe for MULTIPLE PROCESSES sharing the file (the test/dev cluster
     topology): reads reload the file when its identity changed on disk,
     and mutations hold an OS file lock across reload-apply-persist so
-    cross-process check_and_set keeps its CAS meaning. (Watches remain
-    process-local; services poll by version, which is the cross-process
-    change-detection mechanism.)"""
+    cross-process check_and_set keeps its CAS meaning. Watches fire only
+    within the writing process by default; a service's periodic
+    refresh() call reloads the file and fires local watches for keys
+    other processes changed (the cross-process watch mechanism —
+    runtime options, rules, topics all ride it)."""
 
     def __init__(self, path: str):
         super().__init__()
@@ -195,6 +197,26 @@ class FileKVStore(KVStore):
                 yield
             finally:
                 fcntl.flock(lf, fcntl.LOCK_UN)
+
+    def refresh(self) -> int:
+        """Reload from disk and fire watches for every key another
+        process changed or deleted since the last load; returns how many
+        keys changed. Services call this each tick."""
+        with self._lock:
+            before = dict(self._data)
+            self._reload()
+            after = self._data
+            changed = []
+            for k, vv in after.items():
+                old = before.get(k)
+                if old is None or old.version != vv.version:
+                    changed.append((k, vv))
+            for k in before:
+                if k not in after:
+                    changed.append((k, None))
+            for k, vv in changed:
+                self._notify(k, vv)
+        return len(changed)
 
     # reads observe external writers
     def get(self, key: str) -> VersionedValue:

@@ -160,8 +160,14 @@ class PersistRateLimiter:
 
     def set_rate(self, rate_mbps: float) -> None:
         with self._lock:
+            was_disabled = getattr(self, "_rate", 0) <= 0
             self._rate = float(rate_mbps) * (1 << 20)  # bytes/sec
             self._burst = max(self._rate, 1 << 20)
+            if was_disabled:
+                # start full: the burst allowance covers the first writes
+                # instead of stalling them while the bucket fills
+                self._tokens = self._burst
+                self._last = time.monotonic()
 
     def acquire(self, n_bytes: int) -> None:
         """Blocks until n_bytes fit the budget (no-op when unlimited). A

@@ -1,0 +1,202 @@
+"""Versioned rule-set storage in the cluster KV + JSON doc codec.
+
+Role parity with the reference's rules store + R2 service data model
+(/root/reference/src/metrics/rules/store — versioned rule sets in KV;
+src/ctl/service/r2 — CRUD over them) and the matcher's KV-watched dynamic
+reload (src/metrics/matcher). The doc format is the same shape as the
+config file's `rules:` section, so a ruleset can move freely between
+static config and the KV-managed store.
+"""
+
+from __future__ import annotations
+
+import json
+
+from m3_tpu.cluster.kv import KeyNotFound, VersionMismatch
+from m3_tpu.metrics.aggregation import AggregationType
+from m3_tpu.metrics.filters import TagFilter
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.rules import MappingRule, RollupRule, RollupTarget, RuleSet
+from m3_tpu.metrics.transformation import TransformationType
+
+RULES_KEY = "m3_tpu.rules"
+
+
+# -- doc codec --------------------------------------------------------------
+
+
+def filter_to_str(f: TagFilter) -> str:
+    return " ".join(
+        f"{c.name.decode()}:{'!' if c.negate else ''}{c.pattern}"
+        for c in f.clauses
+    )
+
+
+def _mapping_to_doc(r: MappingRule) -> dict:
+    doc = {
+        "name": r.name,
+        "filter": filter_to_str(r.filter),
+        "policies": [str(p) for p in r.policies],
+    }
+    if r.aggregations:
+        doc["aggregations"] = [a.name for a in r.aggregations]
+    if r.drop:
+        doc["drop"] = True
+    return doc
+
+
+def _mapping_from_doc(doc: dict) -> MappingRule:
+    return MappingRule(
+        name=doc.get("name", ""),
+        filter=TagFilter.parse(doc["filter"]),
+        policies=tuple(StoragePolicy.parse(p) for p in doc.get("policies", [])),
+        aggregations=tuple(
+            AggregationType[a.upper()] for a in doc.get("aggregations", [])
+        ),
+        drop=bool(doc.get("drop", False)),
+    )
+
+
+def _target_to_doc(t: RollupTarget) -> dict:
+    doc = {
+        "name": t.new_name.decode(),
+        "group_by": [g.decode() for g in t.group_by],
+        "aggregations": [a.name for a in t.aggregations],
+        "policies": [str(p) for p in t.policies],
+    }
+    if t.transform is not None:
+        doc["transform"] = t.transform.name
+    if t.forward_aggregations:
+        doc["forward_aggregations"] = [a.name for a in t.forward_aggregations]
+    if t.forward_resolution_ns:
+        doc["forward_resolution_ns"] = t.forward_resolution_ns
+    return doc
+
+
+def _target_from_doc(doc: dict) -> RollupTarget:
+    transform = doc.get("transform")
+    return RollupTarget(
+        new_name=doc["name"].encode(),
+        group_by=tuple(g.encode() for g in doc.get("group_by", [])),
+        aggregations=tuple(
+            AggregationType[a.upper()] for a in doc.get("aggregations", ["SUM"])
+        ),
+        policies=tuple(StoragePolicy.parse(p) for p in doc.get("policies", [])),
+        transform=(TransformationType[transform.upper()]
+                   if transform else None),
+        forward_aggregations=tuple(
+            AggregationType[a.upper()]
+            for a in doc.get("forward_aggregations", [])
+        ),
+        forward_resolution_ns=int(doc.get("forward_resolution_ns", 0)),
+    )
+
+
+def _rollup_to_doc(r: RollupRule) -> dict:
+    return {
+        "name": r.name,
+        "filter": filter_to_str(r.filter),
+        "targets": [_target_to_doc(t) for t in r.targets],
+    }
+
+
+def _rollup_from_doc(doc: dict) -> RollupRule:
+    return RollupRule(
+        name=doc.get("name", ""),
+        filter=TagFilter.parse(doc["filter"]),
+        targets=tuple(_target_from_doc(t) for t in doc.get("targets", [])),
+    )
+
+
+def ruleset_to_doc(rs: RuleSet) -> dict:
+    return {
+        "mapping": [_mapping_to_doc(r) for r in rs.mapping_rules],
+        "rollup": [_rollup_to_doc(r) for r in rs.rollup_rules],
+    }
+
+
+def ruleset_from_doc(doc: dict | None) -> RuleSet:
+    rs = RuleSet()
+    if not doc:
+        return rs
+    rs.mapping_rules = [_mapping_from_doc(d) for d in doc.get("mapping", []) or []]
+    rs.rollup_rules = [_rollup_from_doc(d) for d in doc.get("rollup", []) or []]
+    return rs
+
+
+def validate_doc(doc: dict) -> None:
+    """Raises ValueError on a malformed doc (parse round-trip + rule-name
+    uniqueness, the reference store's validation role)."""
+    rs = ruleset_from_doc(doc)  # raises on bad filters/policies/enums
+    for kind, rules in (("mapping", rs.mapping_rules),
+                        ("rollup", rs.rollup_rules)):
+        names = [r.name for r in rules]
+        if len(names) != len(set(names)):
+            dupes = sorted({n for n in names if names.count(n) > 1})
+            raise ValueError(f"duplicate {kind} rule names: {dupes}")
+        if any(not n for n in names):
+            raise ValueError(f"every {kind} rule needs a name")
+
+
+# -- KV store ---------------------------------------------------------------
+
+
+def load_ruleset(kv, key: str = RULES_KEY) -> tuple[RuleSet, int]:
+    """(ruleset, kv_version); (empty, 0) when unset. The ruleset's
+    .version is the KV version so matcher caches invalidate on reload."""
+    try:
+        vv = kv.get(key)
+    except KeyNotFound:
+        return RuleSet(), 0
+    rs = ruleset_from_doc(json.loads(vv.data))
+    rs.version = vv.version
+    return rs, vv.version
+
+
+def store_ruleset_doc(kv, doc: dict, expect_version: int | None = None,
+                      key: str = RULES_KEY) -> int:
+    """Validate + write; CAS when expect_version is given."""
+    validate_doc(doc)
+    raw = json.dumps(doc, sort_keys=True).encode()
+    if expect_version is None:
+        return kv.set(key, raw)
+    if expect_version == 0:
+        return kv.set_if_not_exists(key, raw)
+    return kv.check_and_set(key, expect_version, raw)
+
+
+def update_ruleset_doc(kv, mutate, key: str = RULES_KEY, max_retries: int = 16
+                       ) -> tuple[dict, int]:
+    """CAS read-modify-write: doc = mutate(doc) under optimistic
+    concurrency. Returns (new_doc, new_version)."""
+    for _ in range(max_retries):
+        try:
+            vv = kv.get(key)
+            doc, version = json.loads(vv.data), vv.version
+        except KeyNotFound:
+            doc, version = {"mapping": [], "rollup": []}, 0
+        new_doc = mutate(doc)
+        try:
+            return new_doc, store_ruleset_doc(kv, new_doc, version, key)
+        except VersionMismatch:
+            continue
+    raise VersionMismatch(f"rules update contention on {key}")
+
+
+def watch_ruleset(kv, on_ruleset, key: str = RULES_KEY):
+    """on_ruleset(RuleSet) for the current value and every update
+    (malformed payloads are skipped). Returns an unwatch callable."""
+
+    def on_change(_key, vv):
+        if vv is None:
+            rs = RuleSet()
+            rs.version = -1  # distinct from any stored version
+        else:
+            try:
+                rs = ruleset_from_doc(json.loads(vv.data))
+            except (ValueError, KeyError, TypeError):
+                return
+            rs.version = vv.version
+        on_ruleset(rs)
+
+    return kv.watch(key, on_change)

@@ -117,7 +117,84 @@ class AdminAPI:
                 return self._runtime_get()
             if method in ("POST", "PUT"):
                 return self._runtime_set(doc)
+        if path == "/api/v1/rules":
+            if method == "GET":
+                return self._rules_get()
+            if method in ("POST", "PUT"):
+                return self._rules_replace(doc, q)
+        if path in ("/api/v1/rules/mapping", "/api/v1/rules/rollup") \
+                and method == "POST":
+            return self._rule_upsert(path.rsplit("/", 1)[1], doc)
+        if (path.startswith("/api/v1/rules/mapping/")
+                or path.startswith("/api/v1/rules/rollup/")) \
+                and method == "DELETE":
+            _, kind, name = path.rsplit("/", 2)
+            return self._rule_delete(kind, name)
         return None
+
+    # -- rules (R2 service role: CRUD over the KV rule store) --
+
+    def _require_kv(self):
+        if self.kv is None:
+            raise ValueError("rules need a cluster KV")
+
+    def _rules_get(self):
+        from m3_tpu.metrics import rules_store as rstore
+
+        self._require_kv()
+        rs, version = rstore.load_ruleset(self.kv)
+        doc = rstore.ruleset_to_doc(rs)
+        doc["version"] = version
+        return 200, json.dumps(doc).encode()
+
+    def _rules_replace(self, doc: dict, q: dict):
+        """Replace the whole ruleset; pass ?version= for optimistic
+        concurrency against a previous GET."""
+        from m3_tpu.metrics import rules_store as rstore
+
+        self._require_kv()
+        doc = {"mapping": doc.get("mapping", []),
+               "rollup": doc.get("rollup", [])}
+        expect = q.get("version")
+        version = rstore.store_ruleset_doc(
+            self.kv, doc, int(expect[0]) if expect else None)
+        return 200, json.dumps({"version": version}).encode()
+
+    def _rule_upsert(self, kind: str, doc: dict):
+        """Add or replace ONE rule by name (CAS'd read-modify-write)."""
+        from m3_tpu.metrics import rules_store as rstore
+
+        self._require_kv()
+        if not doc.get("name"):
+            raise ValueError("rule needs a name")
+
+        def mutate(full: dict) -> dict:
+            rules = [r for r in full.get(kind, []) if r.get("name") != doc["name"]]
+            rules.append(doc)
+            full[kind] = rules
+            return full
+
+        _, version = rstore.update_ruleset_doc(self.kv, mutate)
+        return 200, json.dumps({"version": version}).encode()
+
+    def _rule_delete(self, kind: str, name: str):
+        from m3_tpu.metrics import rules_store as rstore
+
+        self._require_kv()
+
+        def mutate(full: dict) -> dict:
+            before = full.get(kind, [])
+            after = [r for r in before if r.get("name") != name]
+            if len(after) == len(before):
+                # abort BEFORE any write: a 404'd delete must not bump the
+                # version (spurious reloads, broken optimistic PUTs) or
+                # create the key on an empty store
+                raise NotFoundError(name)
+            full[kind] = after
+            return full
+
+        _, version = rstore.update_ruleset_doc(self.kv, mutate)
+        return 200, json.dumps({"version": version}).encode()
 
     # -- runtime options (kvconfig role) --
 

@@ -216,6 +216,8 @@ class CoordinatorAPI:
             or path.startswith("/api/v1/database/")
             or path.startswith("/api/v1/topic")
             or path == "/api/v1/runtime"
+            or path == "/api/v1/rules"
+            or path.startswith("/api/v1/rules/")
         ):
             res = self.admin.handle(method, path, q, body)
             if res is not None:

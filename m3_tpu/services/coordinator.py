@@ -15,10 +15,7 @@ import argparse
 import threading
 
 from m3_tpu.aggregator.downsample import Downsampler, DownsamplerAndWriter
-from m3_tpu.metrics.aggregation import AggregationType
-from m3_tpu.metrics.filters import TagFilter
-from m3_tpu.metrics.policy import StoragePolicy
-from m3_tpu.metrics.rules import MappingRule, RollupRule, RollupTarget, RuleSet
+from m3_tpu.metrics.rules import RuleSet
 from m3_tpu.query.api import CoordinatorAPI
 from m3_tpu.query.graphite import CarbonIngester
 from m3_tpu.storage.database import Database
@@ -28,40 +25,11 @@ from m3_tpu.utils.instrument import Logger, default_registry
 
 
 def ruleset_from_config(doc: dict | None) -> RuleSet:
-    """Build mapping/rollup rules from the config's `rules:` section."""
-    rs = RuleSet()
-    if not doc:
-        return rs
-    for r in doc.get("mapping", []) or []:
-        rs.mapping_rules.append(
-            MappingRule(
-                name=r.get("name", ""),
-                filter=TagFilter.parse(r["filter"]),
-                policies=tuple(
-                    StoragePolicy.parse(p) for p in r.get("policies", [])
-                ),
-                aggregations=tuple(
-                    AggregationType[a.upper()] for a in r.get("aggregations", [])
-                ),
-                drop=bool(r.get("drop", False)),
-            )
-        )
-    for r in doc.get("rollup", []) or []:
-        targets = tuple(
-            RollupTarget(
-                new_name=t["name"].encode(),
-                group_by=tuple(g.encode() for g in t.get("group_by", [])),
-                aggregations=tuple(
-                    AggregationType[a.upper()] for a in t.get("aggregations", ["SUM"])
-                ),
-                policies=tuple(StoragePolicy.parse(p) for p in t.get("policies", [])),
-            )
-            for t in r.get("targets", [])
-        )
-        rs.rollup_rules.append(
-            RollupRule(r.get("name", ""), TagFilter.parse(r["filter"]), targets)
-        )
-    return rs
+    """Build mapping/rollup rules from the config's `rules:` section (the
+    same doc shape the KV rule store uses — one parser for both)."""
+    from m3_tpu.metrics.rules_store import ruleset_from_doc
+
+    return ruleset_from_doc(doc)
 
 
 def namespace_options(doc: dict | None) -> NamespaceOptions:
@@ -89,14 +57,19 @@ class CoordinatorService:
         cl_cfg = config.get("cluster", {}) or {}
         self.kv = kv
         self._placement_version = -1
-        if cl_cfg.get("enabled") or (kv is not None):
+        if self.kv is None and cl_cfg.get("kv_path"):
+            from m3_tpu.cluster.kv import FileKVStore
+
+            self.kv = FileKVStore(cl_cfg["kv_path"])
+        self._cluster_mode = bool(cl_cfg.get("enabled"))
+        if self._cluster_mode:
             # cluster mode: all reads/writes go through the quorum session
             # to the placement's storage nodes (reference query/server
-            # wiring m3.NewStorage over client sessions)
+            # wiring m3.NewStorage over client sessions). A KV without
+            # enabled=true serves the KV-backed features (rules, runtime,
+            # admin) over local storage.
             if self.kv is None:
-                from m3_tpu.cluster.kv import FileKVStore
-
-                self.kv = FileKVStore(cl_cfg["kv_path"])
+                raise RuntimeError("cluster.enabled needs a KV (kv_path)")
             self.db = self._build_cluster_db(cl_cfg)
         else:
             self.db = Database(
@@ -116,6 +89,13 @@ class CoordinatorService:
         self.writer = DownsamplerAndWriter(
             self.db, self.downsampler, db_cfg.get("namespace", "default")
         )
+        if self.kv is not None:
+            # KV-managed rules (R2 service / matcher-watch role): updates
+            # through /api/v1/rules apply to the live ingest path without
+            # a restart; config-file rules are only the boot value
+            from m3_tpu.metrics.rules_store import watch_ruleset
+
+            self._rules_unwatch = watch_ruleset(self.kv, self._apply_ruleset)
         lim_cfg = config.get("limits", {}) or {}
         from m3_tpu.query.engine import QueryLimits
 
@@ -154,6 +134,27 @@ class CoordinatorService:
         )
         self.carbon: CarbonIngester | None = None
         self._stop = threading.Event()
+
+    def _apply_ruleset(self, rs) -> None:
+        """KV rules watcher: swap the live matcher's ruleset (its version
+        bump invalidates the match cache), creating the downsampler on
+        first rules if the node booted without any."""
+        if not (rs.mapping_rules or rs.rollup_rules) and self.downsampler is None:
+            return
+        if self.downsampler is None:
+            self.downsampler = Downsampler(self.db, rs)
+            self.writer.downsampler = self.downsampler
+            self.log.info("downsampler created from KV rules",
+                          version=rs.version)
+            return
+        old = self.downsampler.aggregator.matcher.ruleset
+        # the KV version can collide with the boot ruleset's (both start
+        # at 1); the cache invalidates on CHANGE, so force a distinct one
+        rs.version = max(rs.version, old.version + 1)
+        self.downsampler.aggregator.matcher.ruleset = rs
+        self.log.info("ruleset reloaded", version=rs.version,
+                      mapping=len(rs.mapping_rules),
+                      rollup=len(rs.rollup_rules))
 
     def _build_cluster_db(self, cl_cfg: dict):
         from m3_tpu.client.cluster_db import ClusterDatabase
@@ -247,7 +248,11 @@ class CoordinatorService:
                     break
                 try:
                     with scope.timer("tick"):
-                        if self.kv is not None:
+                        if self.kv is not None and hasattr(self.kv, "refresh"):
+                            # cross-process KV (file-backed): pick up other
+                            # processes' writes and fire local watches
+                            self.kv.refresh()
+                        if self.kv is not None and self._cluster_mode:
                             self._refresh_topology()
                         if self.downsampler is not None:
                             flushed = self.downsampler.flush()

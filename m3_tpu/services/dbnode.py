@@ -431,6 +431,10 @@ class DBNodeService:
                     break
                 try:
                     if self.kv is not None:
+                        if hasattr(self.kv, "refresh"):
+                            # cross-process KV: fire local watches (runtime
+                            # options, rules) for other processes' writes
+                            self.kv.refresh()
                         self.sync_namespaces()
                         if self._placement_changed():
                             self.sync_placement()

@@ -1,0 +1,195 @@
+"""R2 rules service tests: KV rule store codec, CRUD endpoints, and the
+live matcher reload (reference src/ctl/service/r2 + src/metrics/matcher)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.metrics import rules_store as rstore
+from m3_tpu.metrics.aggregation import AggregationType
+from m3_tpu.metrics.rules import RuleSet
+from m3_tpu.metrics.transformation import TransformationType
+
+MAPPING = {
+    "name": "cpu-10s",
+    "filter": "__name__:cpu_*",
+    "policies": ["10s:2d"],
+    "aggregations": ["MEAN"],
+}
+ROLLUP = {
+    "name": "reqs-by-svc",
+    "filter": "__name__:requests endpoint:*",
+    "targets": [{
+        "name": "requests_by_service",
+        "group_by": ["service"],
+        "aggregations": ["SUM"],
+        "policies": ["1m:30d"],
+        "transform": "PERSECOND",
+        "forward_aggregations": ["MAX"],
+        "forward_resolution_ns": 300 * 10**9,
+    }],
+}
+
+
+class TestDocCodec:
+    def test_round_trip(self):
+        doc = {"mapping": [MAPPING], "rollup": [ROLLUP]}
+        rs = rstore.ruleset_from_doc(doc)
+        assert rs.mapping_rules[0].name == "cpu-10s"
+        assert rs.mapping_rules[0].aggregations == (AggregationType.MEAN,)
+        t = rs.rollup_rules[0].targets[0]
+        assert t.transform is TransformationType.PERSECOND
+        assert t.forward_aggregations == (AggregationType.MAX,)
+        assert t.forward_resolution_ns == 300 * 10**9
+        back = rstore.ruleset_to_doc(rs)
+        assert rstore.ruleset_to_doc(rstore.ruleset_from_doc(back)) == back
+
+    def test_validation(self):
+        rstore.validate_doc({"mapping": [MAPPING]})
+        with pytest.raises(ValueError):
+            rstore.validate_doc({"mapping": [MAPPING, MAPPING]})  # dup name
+        with pytest.raises(ValueError):
+            rstore.validate_doc({"mapping": [{**MAPPING, "name": ""}]})
+        with pytest.raises(ValueError):
+            rstore.validate_doc(
+                {"mapping": [{**MAPPING, "policies": ["bogus"]}]})
+        with pytest.raises(KeyError):
+            rstore.validate_doc(
+                {"mapping": [{**MAPPING, "aggregations": ["NOPE"]}]})
+
+    def test_kv_store_and_watch(self):
+        kv = KVStore()
+        seen = []
+        rstore.watch_ruleset(kv, lambda rs: seen.append(rs))
+        v = rstore.store_ruleset_doc(kv, {"mapping": [MAPPING]})
+        assert v == 1
+        rs, version = rstore.load_ruleset(kv)
+        assert version == 1 and rs.version == 1
+        assert len(seen) == 1 and seen[0].mapping_rules[0].name == "cpu-10s"
+        # malformed payloads are skipped by the watcher
+        kv.set(rstore.RULES_KEY, b'{"mapping": [{"filter": "no-colon"}]}')
+        assert len(seen) == 1
+
+
+class TestR2Endpoints:
+    @pytest.fixture
+    def admin(self, tmp_path):
+        from m3_tpu.query.admin import AdminAPI
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.options import DatabaseOptions
+
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=1))
+        db.create_namespace("default")
+        db.open(1_600_000_000_000_000_000)
+        yield AdminAPI(db, kv=KVStore())
+        db.close()
+
+    def test_crud_cycle(self, admin):
+        code, payload = admin.handle("GET", "/api/v1/rules", {}, b"")
+        assert code == 200 and json.loads(payload) == {
+            "mapping": [], "rollup": [], "version": 0}
+        code, _ = admin.handle("POST", "/api/v1/rules/mapping", {},
+                               json.dumps(MAPPING).encode())
+        assert code == 200
+        code, _ = admin.handle("POST", "/api/v1/rules/rollup", {},
+                               json.dumps(ROLLUP).encode())
+        assert code == 200
+        code, payload = admin.handle("GET", "/api/v1/rules", {}, b"")
+        doc = json.loads(payload)
+        assert [r["name"] for r in doc["mapping"]] == ["cpu-10s"]
+        assert [r["name"] for r in doc["rollup"]] == ["reqs-by-svc"]
+        # upsert replaces by name
+        code, _ = admin.handle(
+            "POST", "/api/v1/rules/mapping", {},
+            json.dumps({**MAPPING, "policies": ["30s:7d"]}).encode())
+        assert code == 200
+        doc = json.loads(admin.handle("GET", "/api/v1/rules", {}, b"")[1])
+        from m3_tpu.metrics.policy import StoragePolicy
+
+        # durations normalize on round-trip (7d prints as 1w)
+        assert (StoragePolicy.parse(doc["mapping"][0]["policies"][0])
+                == StoragePolicy.parse("30s:7d"))
+        # delete; unknown name 404s
+        code, _ = admin.handle(
+            "DELETE", "/api/v1/rules/mapping/cpu-10s", {}, b"")
+        assert code == 200
+        code, _ = admin.handle(
+            "DELETE", "/api/v1/rules/mapping/cpu-10s", {}, b"")
+        assert code == 404
+        # whole-set replace with optimistic concurrency
+        doc = json.loads(admin.handle("GET", "/api/v1/rules", {}, b"")[1])
+        code, _ = admin.handle(
+            "PUT", "/api/v1/rules", {"version": [str(doc["version"])]},
+            json.dumps({"mapping": [MAPPING], "rollup": []}).encode())
+        assert code == 200
+        code, _ = admin.handle(
+            "PUT", "/api/v1/rules", {"version": [str(doc["version"])]},
+            json.dumps({"mapping": [], "rollup": []}).encode())
+        assert code == 400  # stale version rejected
+
+    def test_bad_rule_rejected(self, admin):
+        code, _ = admin.handle("POST", "/api/v1/rules/mapping", {},
+                               json.dumps({"filter": "a:b"}).encode())
+        assert code == 400  # no name
+        code, _ = admin.handle(
+            "POST", "/api/v1/rules/mapping", {},
+            json.dumps({"name": "x", "filter": "nocolon"}).encode())
+        assert code == 400
+
+
+class TestLiveReload:
+    def test_coordinator_applies_kv_rules(self, tmp_path):
+        """A rule added through the KV store starts aggregating on the
+        live ingest path without a restart."""
+        import numpy as np
+
+        from m3_tpu.services.coordinator import CoordinatorService
+
+        cfg = {
+            "db": {"path": str(tmp_path / "db"), "n_shards": 2,
+                   "namespace": "default"},
+            "http": {"port": 0},
+        }
+        kv = KVStore()
+        svc = CoordinatorService(cfg, kv=kv)
+        try:
+            assert svc.downsampler is None  # no boot rules
+            rstore.store_ruleset_doc(kv, {"mapping": [{
+                "name": "gauges", "filter": "__name__:temp",
+                "policies": ["10s:2d"], "aggregations": ["MAX"],
+            }]})
+            assert svc.downsampler is not None  # created from KV rules
+            from m3_tpu.metrics.aggregation import MetricType
+
+            START = 1_600_000_000_000_000_000
+            tags = [(b"__name__", b"temp"), (b"host", b"a")]
+            for i, v in enumerate((3.0, 9.0, 5.0)):
+                svc.writer.write(MetricType.GAUGE, b"", tags,
+                                 START + i * 10**9, v)
+            svc.downsampler.flush(START + 3600 * 10**9)
+            agg_ns = "aggregated_10s_2d"
+            assert agg_ns in svc.db.namespaces
+            from m3_tpu.index.query import Matcher, MatchType
+
+            res = svc.db.query(
+                agg_ns, [Matcher(MatchType.EQUAL, b"__name__", b"temp")],
+                START - 10**9, START + 60 * 10**9)
+            assert res, "aggregated series must exist"
+            vals = [d.value for _sid, _t, dps in res for d in dps]
+            assert 9.0 in vals  # MAX aggregation applied
+            # live ruleset swap: updated policies take effect
+            rstore.store_ruleset_doc(kv, {"mapping": [{
+                "name": "gauges", "filter": "__name__:temp",
+                "policies": ["30s:7d"], "aggregations": ["MIN"],
+            }]})
+            from m3_tpu.metrics.policy import StoragePolicy
+
+            ds = svc.downsampler
+            assert (ds.aggregator.matcher.ruleset.mapping_rules[0].policies[0]
+                    == StoragePolicy.parse("30s:7d"))
+            assert np.isfinite(1.0)
+        finally:
+            svc.shutdown()

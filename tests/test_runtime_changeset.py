@@ -144,6 +144,27 @@ class TestDatabaseRuntime:
         assert db.limits.max_series == 42  # untouched by rejected updates
 
 
+class TestCrossProcessWatch:
+    def test_file_kv_refresh_fires_watches(self, tmp_path):
+        """Two FileKVStore handles on one path model two processes: a
+        write through one reaches the other's watchers via refresh() —
+        the mechanism carrying runtime/rules updates across services."""
+        from m3_tpu.cluster.kv import FileKVStore
+
+        path = str(tmp_path / "kv.json")
+        a, b = FileKVStore(path), FileKVStore(path)
+        seen = []
+        a.watch("k", lambda _k, vv: seen.append(vv.data if vv else None))
+        b.set("k", b"v1")
+        assert seen == []  # watches are process-local until refresh
+        assert a.refresh() == 1
+        assert seen == [b"v1"]
+        assert a.refresh() == 0  # idempotent: no re-fire without change
+        b.delete("k")
+        a.refresh()
+        assert seen == [b"v1", None]
+
+
 class TestChangeSet:
     def test_stage_commit_round_trip(self):
         kv = KVStore()
@@ -213,22 +234,50 @@ class TestChangeSet:
         # changes survive and a retry applies them to the moved value
         c = ChangeSetManager(kv, "cfg")
         c.stage({"key": "z", "value": 3})
-        value, version = c.get()
-        kv.check_and_set("cfg", version, b'{"moved": 1}')
+        value, applied, version = c._get_full()
+        import json as _json
 
-        orig_get = c.get
+        kv.check_and_set("cfg", version, _json.dumps(
+            {"data": {**value, "moved": 1},
+             "applied_upto": applied}).encode())
 
-        def racy_get():
+        orig = c._get_full
+
+        def racy_get_full():
             # sees the pre-move state once, like a commit that lost a race
-            c.get = orig_get
-            return value, version
+            c._get_full = orig
+            return value, applied, version
 
-        c.get = racy_get
+        c._get_full = racy_get_full
         with pytest.raises(VersionMismatch):
             c.commit(apply)
         assert c.staged() == [{"key": "z", "value": 3}]
         c.commit(apply)
-        assert c.get()[0] == {"moved": 1, "z": 3}
+        got = c.get()[0]
+        assert got["moved"] == 1 and got["z"] == 3
+
+    def test_no_double_apply_after_racing_commit(self):
+        """A commit that reads the staged set concurrently with another
+        commit must not re-apply already-folded changes (applied_upto
+        gating)."""
+        kv = KVStore()
+        a = ChangeSetManager(kv, "counter")
+        b = ChangeSetManager(kv, "counter")
+
+        def apply(val, chs):
+            return {"n": val.get("n", 0) + sum(c["inc"] for c in chs)}
+
+        a.stage({"inc": 5})
+        a.commit(apply)
+        assert a.get()[0] == {"n": 5}
+        # b's commit after a's: nothing pending -> no re-application
+        assert b.commit(apply) == a.get()[1]
+        assert b.get()[0] == {"n": 5}
+        # new change applies exactly once on top
+        b.stage({"inc": 2})
+        b.commit(apply)
+        a.commit(apply)  # nothing pending again
+        assert a.get()[0] == {"n": 7}
 
 
 class TestPersistPacingWired:
