@@ -25,6 +25,8 @@ from __future__ import annotations
 import functools
 from typing import NamedTuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -45,8 +47,8 @@ from m3_tpu.ops.bits import (
 )
 from m3_tpu.utils.xtime import TimeUnit, unit_value_ns
 
-_EOS_FIELD = jnp.uint64(0x100 << 2)  # 9-bit marker opcode + 2-bit EOS value
-_EOS_LEN = jnp.uint64(11)
+_EOS_FIELD = np.uint64(0x100 << 2)  # 9-bit marker opcode + 2-bit EOS value
+_EOS_LEN = np.uint64(11)
 
 # Max bits one datapoint can occupy: timestamp default bucket (4+64) +
 # uncontained XOR (2+6+6+64).
@@ -146,6 +148,7 @@ def encode(
     n_points: jnp.ndarray,
     unit: TimeUnit = TimeUnit.SECOND,
     capacity_words: int | None = None,
+    impl: str | None = None,
 ) -> EncodedBlocks:
     """Encode from float64 values.
 
@@ -156,8 +159,6 @@ def encode(
     representation the storage engine keeps anyway. decode's u64->f64
     direction runs fine on-device.
     """
-    import numpy as np
-
     unit_ns = unit_value_ns(unit)
     if (np.asarray(start) % unit_ns != 0).any():
         raise ValueError(
@@ -168,10 +169,9 @@ def encode(
     # the TPU X64 rewriter, so device-resident callers should hold bits and
     # call encode_bits directly instead of round-tripping through floats.
     vb = jnp.asarray(np.asarray(values, dtype=np.float64).view(np.uint64))
-    return encode_bits(times, vb, start, n_points, unit, capacity_words)
+    return encode_bits(times, vb, start, n_points, unit, capacity_words, impl)
 
 
-@functools.partial(jax.jit, static_argnames=("unit", "capacity_words"))
 def encode_bits(
     times: jnp.ndarray,  # [B, T] int64 unix nanos
     value_bits: jnp.ndarray,  # [B, T] uint64 IEEE-754 bit patterns
@@ -179,8 +179,25 @@ def encode_bits(
     n_points: jnp.ndarray,  # [B] int32 valid points per series
     unit: TimeUnit = TimeUnit.SECOND,
     capacity_words: int | None = None,
+    impl: str | None = None,
 ) -> EncodedBlocks:
-    """Batched M3TSZ float-mode encode of B series with up to T points each."""
+    """Batched M3TSZ float-mode encode of B series with up to T points
+    each. `impl` selects the packer backend (resolved per platform by
+    default); it keys the jit cache so env/impl changes retrace."""
+    return _encode_bits_jit(times, value_bits, start, n_points, unit,
+                            capacity_words, _resolve_impl(impl))
+
+
+@functools.partial(jax.jit, static_argnames=("unit", "capacity_words", "impl"))
+def _encode_bits_jit(
+    times: jnp.ndarray,
+    value_bits: jnp.ndarray,
+    start: jnp.ndarray,
+    n_points: jnp.ndarray,
+    unit: TimeUnit = TimeUnit.SECOND,
+    capacity_words: int | None = None,
+    impl: str = "tree",
+) -> EncodedBlocks:
     B, T = times.shape  # noqa: N806
     unit_ns = unit_value_ns(unit)
     default_bits = 32 if unit in (TimeUnit.SECOND, TimeUnit.MILLISECOND) else 64
@@ -215,10 +232,7 @@ def encode_bits(
 
     # --- layout ---
     dp_len = jnp.where(valid, ts_len + v_len, jnp.uint64(0))
-    # bit offset of each dp: 64-bit start prefix + exclusive cumsum
-    csum = jnp.cumsum(dp_len, axis=1)
-    offsets = jnp.uint64(64) + csum - dp_len
-    end_off = jnp.uint64(64) + csum[:, -1] if T > 0 else jnp.full((B,), 64, U64)
+    end_off = jnp.uint64(64) + jnp.sum(dp_len, axis=1)
     total_bits = end_off + _EOS_LEN
     # A start that isn't a multiple of the unit would make the scalar
     # encoder emit a time-unit-change marker (initial_time_unit -> NONE);
@@ -233,15 +247,50 @@ def encode_bits(
         overflow = overflow | jnp.any(valid & ~in32)
 
     words = _pack_stream(ts_hi, ts_lo, ts_len, v_hi, v_lo, v_len,
-                         valid, offsets, end_off, start, capacity_words)
+                         valid, start, capacity_words, impl)
     return EncodedBlocks(words=words, bit_lengths=total_bits, overflow=overflow)
 
 
+_DP_LIMBS = 7  # one datapoint's (ts + value) fields: <=196 bits -> 7 u32 limbs
+
+
+def _resolve_impl(impl: str | None = None) -> str:
+    """Implementation choice, resolved OUTSIDE jit so it can key the jit
+    cache: the log-tree/shifting-buffer u32 kernels ('tree') avoid the
+    scatter/gather + u64-emulation costs that dominate on TPU; CPU XLA
+    lowers the original scatter/gather design ('scatter') several times
+    faster. Overridable via M3_CODEC_IMPL=tree|scatter."""
+    import os
+
+    impl = impl or os.environ.get("M3_CODEC_IMPL")
+    if impl is not None and impl not in ("tree", "scatter"):
+        raise ValueError(f"unknown codec impl {impl!r}: want 'tree' or 'scatter'")
+    if impl is not None:
+        return impl
+    return "scatter" if jax.default_backend() == "cpu" else "tree"
+
+
 def _pack_stream(ts_hi, ts_lo, ts_len, v_hi, v_lo, v_len, valid,
-                 offsets, end_off, start, capacity_words: int) -> jnp.ndarray:
+                 start, capacity_words: int, impl: str) -> jnp.ndarray:
+    """Stream packer, dispatched on the statically-resolved impl."""
+    if impl == "tree":
+        return _pack_stream_tree(ts_hi, ts_lo, ts_len, v_hi, v_lo, v_len,
+                                 valid, start, capacity_words)
+    return _pack_stream_scatter(ts_hi, ts_lo, ts_len, v_hi, v_lo, v_len,
+                                valid, start, capacity_words)
+
+
+def _pack_stream_scatter(ts_hi, ts_lo, ts_len, v_hi, v_lo, v_len, valid,
+                         start, capacity_words: int) -> jnp.ndarray:
     """Assemble per-dp (timestamp, value) fields into word tensors via the
-    192-bit register + disjoint scatter-add scheme, and cap with EOS."""
+    192-bit register + disjoint scatter-add scheme, and cap with EOS.
+    CPU path: XLA:CPU lowers these scatters well; on TPU they cost ~12ns
+    per scattered element."""
     B, T = ts_len.shape  # noqa: N806
+    dp_len = jnp.where(valid, ts_len + v_len, jnp.uint64(0))
+    csum = jnp.cumsum(dp_len, axis=1)
+    offsets = jnp.uint64(64) + csum - dp_len
+    end_off = (jnp.uint64(64) + csum[:, -1]) if T > 0 else jnp.full((B,), 64, U64)
     zero_reg = (jnp.zeros((B, T), U64),) * 3
     reg = reg3_insert(zero_reg, jnp.uint64(0), ts_hi, ts_lo, ts_len)
     reg = reg3_insert(reg, ts_len, v_hi, v_lo, v_len)
@@ -258,7 +307,8 @@ def _pack_stream(ts_hi, ts_lo, ts_len, v_hi, v_lo, v_len, valid,
 
     # --- EOS marker ---
     eos_reg = reg3_insert(
-        (jnp.zeros((B,), U64),) * 3, jnp.uint64(0), jnp.zeros((B,), U64), _EOS_FIELD, _EOS_LEN
+        (jnp.zeros((B,), U64),) * 3, jnp.uint64(0), jnp.zeros((B,), U64),
+        jnp.uint64(_EOS_FIELD), jnp.uint64(_EOS_LEN)
     )
     eos_pieces = reg3_shift_right_to4(eos_reg, end_off & jnp.uint64(63))
     ew0 = (end_off >> jnp.uint64(6)).astype(jnp.int32)
@@ -267,6 +317,81 @@ def _pack_stream(ts_hi, ts_lo, ts_len, v_hi, v_lo, v_len, valid,
         words = words.at[bb, ew0 + k].add(piece, mode="drop")
 
     return words
+
+
+def _pack_stream_tree(ts_hi, ts_lo, ts_len, v_hi, v_lo, v_len, valid,
+                      start, capacity_words: int) -> jnp.ndarray:
+    """Assemble per-dp (timestamp, value) u64 bit fields into the output
+    word tensor by log-tree bit concatenation — no scatter.
+
+    Scatter on TPU costs ~12ns per scattered element (measured v5e), which
+    made the original 4-piece scatter-add packer the encode bottleneck.
+    Instead: each datapoint becomes a top-aligned u32 limb register; the
+    [start prefix] + T dp registers + [EOS] slot sequence is then combined
+    pairwise — result = A | (B >> lenA), with the variable shift decomposed
+    into log2 static rolls (ops/bits32.py) — doubling register width each
+    of the log2(T) levels until one register holds the whole stream. Pure
+    elementwise u32 work that XLA fuses and tiles.
+    """
+    from m3_tpu.ops import bits32 as b32
+
+    B, T = ts_len.shape  # noqa: N806
+    w32_cap = capacity_words * 2
+
+    ts_limbs = b32.field128_to_limbs(ts_hi, ts_lo, ts_len)  # [B, T, 4]
+    v_limbs = b32.field128_to_limbs(v_hi, v_lo, v_len)
+    ts_len32 = ts_len.astype(b32.U32)
+    dp = b32.pad_limbs(ts_limbs, _DP_LIMBS) | b32.shift_right_bits(
+        b32.pad_limbs(v_limbs, _DP_LIMBS), ts_len32, 128
+    )
+    dp_len = ts_len32 + v_len.astype(b32.U32)
+    dp = jnp.where(valid[..., None], dp, jnp.uint32(0))
+    dp_len = jnp.where(valid, dp_len, jnp.uint32(0))
+
+    # slot sequence: [start(64b)] + T dps + [EOS(11b)], padded to a power
+    # of two with zero-length slots (no-ops under concatenation).
+    # All slots derive from traced data (zeros as 0*traced) — materialized
+    # trace-time constants trip a jit fastpath bug ("supplied N buffers but
+    # compiled program expected M") on repeat calls.
+    s_hi, s_lo = b32.u64_to_pair(start.astype(I64).astype(U64))
+    zcol = jnp.zeros_like(s_hi)  # [B] (shape-independent of T: T=0 works)
+    start_slot = jnp.stack(
+        [s_hi, s_lo] + [zcol] * (_DP_LIMBS - 2), axis=-1
+    )[:, None, :]
+    eos_slot = jnp.stack(
+        [zcol + jnp.uint32(int(_EOS_FIELD) << 21)] + [zcol] * (_DP_LIMBS - 1),
+        axis=-1,
+    )[:, None, :]
+    n_slots = T + 2
+    n_pad = 1
+    while n_pad < n_slots:
+        n_pad *= 2
+    pad_slots = [
+        jnp.broadcast_to(zcol[:, None, None], (B, n_pad - n_slots, _DP_LIMBS))
+    ] if n_pad > n_slots else []
+    slots = jnp.concatenate([start_slot, dp, eos_slot] + pad_slots, axis=1)
+    zlen = zcol[:, None]  # [B, 1]
+    pad_lens = [
+        jnp.broadcast_to(zlen, (B, n_pad - n_slots))
+    ] if n_pad > n_slots else []
+    lens = jnp.concatenate(
+        [zlen + jnp.uint32(64), dp_len, zlen + jnp.uint32(int(_EOS_LEN))] + pad_lens,
+        axis=1,
+    )
+
+    width = _DP_LIMBS
+    while slots.shape[1] > 1:
+        width = min(width * 2, max(w32_cap, _DP_LIMBS))
+        a, bb = slots[:, 0::2], slots[:, 1::2]
+        len_a, len_b = lens[:, 0::2], lens[:, 1::2]
+        # clamp so pathological (overflowing) lengths still shift to zero
+        shift = jnp.minimum(len_a, jnp.uint32(32 * width))
+        slots = b32.pad_limbs(a, width) | b32.shift_right_bits(
+            b32.pad_limbs(bb, width), shift, 32 * width
+        )
+        lens = len_a + len_b
+    limbs = b32.pad_limbs(slots[:, 0], w32_cap)
+    return b32.pair_to_u64(limbs[:, 0::2], limbs[:, 1::2])
 
 
 def _decode_ts_fields(series_words, off, win, default_bits: int):
@@ -305,7 +430,12 @@ def _decode_ts_fields(series_words, off, win, default_bits: int):
 
 class DecodedBlocks(NamedTuple):
     times: jnp.ndarray  # [B, T] int64
-    values: jnp.ndarray  # [B, T] float64
+    # IEEE-754 bit patterns, NOT floats: the TPU X64 rewriter emulates f64
+    # as an f32 pair (f32 exponent range, ~48-bit mantissa), so a device
+    # f64 cannot round-trip arbitrary doubles. Bits are exact everywhere;
+    # convert with values_f64() on the host, or accept the documented
+    # precision loss converting on-device.
+    value_bits: jnp.ndarray  # [B, T] uint64
     valid: jnp.ndarray  # [B, T] bool
     n_points: jnp.ndarray  # [B] int32
     # True per series if a non-EOS special marker (annotation / time-unit
@@ -313,15 +443,61 @@ class DecodedBlocks(NamedTuple):
     # decoded by the scalar decoder instead.
     error: jnp.ndarray  # [B] bool
 
+    def values_f64(self) -> np.ndarray:
+        """Decoded values as float64 (host-side bitcast; always exact)."""
+        return np.asarray(jax.device_get(self.value_bits)).view(np.float64)
 
-@functools.partial(jax.jit, static_argnames=("unit", "max_points"))
+
+class DecodedValues(NamedTuple):
+    """Decode result carrying materialized float values (int-optimized
+    kernel, whose values are computed, not bit-copied)."""
+
+    times: jnp.ndarray  # [B, T] int64
+    values: jnp.ndarray  # [B, T] float64
+    valid: jnp.ndarray  # [B, T] bool
+    n_points: jnp.ndarray  # [B] int32
+    error: jnp.ndarray  # [B] bool
+
+
+def _sx(v: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Sign-extend the low n bits of a u32 to int64 (n <= 32, static)."""
+    s = np.uint32(1 << (n - 1))
+    m = np.uint32((1 << n) - 1) if n < 32 else np.uint32(0xFFFFFFFF)
+    x = (v.astype(jnp.uint32) & m) ^ s
+    return x.astype(I64) - jnp.int64(int(s))
+
+
 def decode(
     words: jnp.ndarray,  # [B, W] uint64
     unit: TimeUnit = TimeUnit.SECOND,
     max_points: int = 1024,
+    impl: str | None = None,
 ) -> DecodedBlocks:
-    """Batched M3TSZ float-mode decode: scan over points, vmapped over
-    series."""
+    """Batched M3TSZ float-mode decode (platform dispatch; `impl` as in
+    encode_bits)."""
+    return _decode_jit(words, unit, max_points, _resolve_impl(impl))
+
+
+@functools.partial(jax.jit, static_argnames=("unit", "max_points", "impl"))
+def _decode_jit(
+    words: jnp.ndarray,
+    unit: TimeUnit,
+    max_points: int,
+    impl: str,
+) -> DecodedBlocks:
+    if impl == "tree":
+        return _decode_shift(words, unit, max_points)
+    return _decode_gather(words, unit, max_points)
+
+
+def _decode_gather(
+    words: jnp.ndarray,  # [B, W] uint64
+    unit: TimeUnit = TimeUnit.SECOND,
+    max_points: int = 1024,
+) -> DecodedBlocks:
+    """CPU decode: scan over points, vmapped over series, with per-step
+    read_window gathers (XLA:CPU handles these well; on TPU each gather
+    costs ~16ns/element)."""
     unit_ns = unit_value_ns(unit)
     default_bits = 32 if unit in (TimeUnit.SECOND, TimeUnit.MILLISECOND) else 64
 
@@ -337,9 +513,8 @@ def decode(
             # a host-path feature this kernel doesn't decode -> error.
             is_marker = shr(win, jnp.uint64(55)) == jnp.uint64(0x100)
             marker_val = shr(win, jnp.uint64(53)) & jnp.uint64(3)
-            is_eos = is_marker & (marker_val == 0)
             err = err | (is_marker & (marker_val != 0) & ~done)
-            is_eos = is_eos | (is_marker & (marker_val != 0))
+            is_eos = is_marker
 
             # --- delta-of-delta ---
             dod_u, ts_len = _decode_ts_fields(series_words, off, win, default_bits)
@@ -404,9 +579,178 @@ def decode(
     ts, vs, ok, err = jax.vmap(decode_one)(words)
     return DecodedBlocks(
         times=ts,
-        values=bits_to_f64(vs),
+        value_bits=vs,
         valid=ok,
         n_points=ok.sum(axis=1).astype(jnp.int32),
+        error=err,
+    )
+
+
+def _decode_shift(
+    words: jnp.ndarray,  # [B, W] uint64
+    unit: TimeUnit = TimeUnit.SECOND,
+    max_points: int = 1024,
+) -> DecodedBlocks:
+    """Batched M3TSZ float-mode decode via a shifting stream buffer.
+
+    The format is sequential per stream, but per-step RANDOM ACCESS is not
+    required: the scan carries the remaining stream as a [B, W] u32 limb
+    register and consumes each datapoint from its top — static slices for
+    the parse, then a log-decomposed left shift by the datapoint's length.
+    This replaces the per-step `read_window` gathers of the original design
+    (~10 gathers x 16ns/element/step on v5e dominated decode) with pure
+    elementwise work that XLA tiles; throughput comes from the batch axis
+    and HBM bandwidth.
+    """
+    from m3_tpu.ops import bits32 as b32
+
+    unit_ns = unit_value_ns(unit)
+    default_bits = 32 if unit in (TimeUnit.SECOND, TimeUnit.MILLISECOND) else 64
+    B, W = words.shape  # noqa: N806
+
+    start = sign_extend64(words[:, 0], jnp.uint64(64))  # [B] int64
+    hi, lo = b32.u64_to_pair(words)
+    limbs = jnp.stack([hi, lo], axis=-1).reshape(B, 2 * W)
+    buf0 = limbs[:, 2:]  # the 64-bit start prefix is consumed up front
+    if buf0.shape[1] < 8:  # parse window needs 8 limbs; tiny streams pad
+        buf0 = b32.pad_limbs(buf0, 8)
+
+    u32 = jnp.uint32
+
+    def step(carry, i):
+        buf, r, prev_time, prev_dt, pb_h, pb_l, px_h, px_l, done, err = carry
+
+        # Align the next 224 bits at the cursor: funnel the first 8 limbs
+        # by r (< 32). A datapoint spans <= 146 bits; with ts_len <= 68 the
+        # value window needs bits [ts_len, ts_len + 96) <= 164 < 224.
+        w = [buf[:, j] for j in range(8)]
+        a = []
+        for j in range(7):
+            cur, nxt = w[j], w[j + 1]
+            a.append(jnp.where(r == 0, cur, b32.shl32(cur, r) | b32.shr32(nxt, 32 - r)))
+        a0, a1, a2 = a[0], a[1], a[2]
+
+        # special marker: 9-bit opcode 0x100; value 0 = EOS, else a
+        # host-path feature (annotation / time-unit change) -> error.
+        is_marker = (a0 >> u32(23)) == u32(0x100)
+        marker_val = (a0 >> u32(21)) & u32(3)
+        err = err | (is_marker & (marker_val != 0) & ~done)
+        is_eos = is_marker
+
+        # --- delta-of-delta (static bit positions within a0..a2) ---
+        zero_dod = (a0 >> u32(31)) == 0
+        in7 = (a0 >> u32(30)) == u32(0b10)
+        in9 = (a0 >> u32(29)) == u32(0b110)
+        in12 = (a0 >> u32(28)) == u32(0b1110)
+        d7 = _sx(a0 >> u32(23), 7)
+        d9 = _sx(a0 >> u32(20), 9)
+        d12 = _sx(a0 >> u32(16), 12)
+        if default_bits == 32:
+            ddef = _sx((a0 << u32(4)) | (a1 >> u32(28)), 32)
+        else:
+            ddef = sign_extend64(
+                b32.pair_to_u64(
+                    (a0 << u32(4)) | (a1 >> u32(28)),
+                    (a1 << u32(4)) | (a2 >> u32(28)),
+                ),
+                jnp.uint64(64),
+            )
+        dod = jnp.where(
+            zero_dod, jnp.int64(0),
+            jnp.where(in7, d7, jnp.where(in9, d9, jnp.where(in12, d12, ddef))),
+        )
+        ts_len = jnp.where(
+            zero_dod, u32(1),
+            jnp.where(in7, u32(9),
+                      jnp.where(in9, u32(12),
+                                jnp.where(in12, u32(16), u32(4 + default_bits)))),
+        )
+        new_dt = prev_dt + dod * unit_ns
+        new_time = prev_time + new_dt
+
+        # --- value field at bit offset ts_len: word-select + funnel ---
+        ws = ts_len >> u32(5)  # 0..2
+        tb = ts_len & u32(31)
+        v = []
+        for j in range(3):
+            c0 = jnp.where(ws == 0, a[j], jnp.where(ws == 1, a[j + 1], a[j + 2]))
+            c1 = jnp.where(ws == 0, a[j + 1], jnp.where(ws == 1, a[j + 2], a[j + 3]))
+            v.append(jnp.where(tb == 0, c0, b32.shl32(c0, tb) | b32.shr32(c1, 32 - tb)))
+        v0, v1, v2 = v
+
+        first = i == 0
+        vb1 = v0 >> u32(31)
+        vb2 = (v0 >> u32(30)) & u32(1)
+        xz = vb1 == 0
+        contained = (vb1 == 1) & (vb2 == 0)
+        pl = b32.pair_clz(px_h, px_l)
+        pt = b32.pair_ctz(px_h, px_l)
+        m_prev = u32(64) - pl - pt
+        # contained: mantissa window at field offset 2
+        cw_h = (v0 << u32(2)) | (v1 >> u32(30))
+        cw_l = (v1 << u32(2)) | (v2 >> u32(30))
+        cm_h, cm_l = b32.pair_shr(cw_h, cw_l, u32(64) - m_prev)
+        cx_h, cx_l = b32.pair_shl(cm_h, cm_l, pt)
+        c_len = u32(2) + m_prev
+        # uncontained: '11' + 6b lead + 6b (m-1) + m mantissa bits at offset 14
+        lead = (v0 >> u32(24)) & u32(0x3F)
+        mm = ((v0 >> u32(18)) & u32(0x3F)) + u32(1)
+        uw_h = (v0 << u32(14)) | (v1 >> u32(18))
+        uw_l = (v1 << u32(14)) | (v2 >> u32(18))
+        um_h, um_l = b32.pair_shr(uw_h, uw_l, u32(64) - mm)
+        trail = u32(64) - lead - mm
+        ux_h, ux_l = b32.pair_shl(um_h, um_l, trail)
+        u_len = u32(14) + mm
+
+        xor_h = jnp.where(xz, u32(0), jnp.where(contained, cx_h, ux_h))
+        xor_l = jnp.where(xz, u32(0), jnp.where(contained, cx_l, ux_l))
+        x_len = jnp.where(xz, u32(1), jnp.where(contained, c_len, u_len))
+
+        nb_h = jnp.where(first, v0, pb_h ^ xor_h)
+        nb_l = jnp.where(first, v1, pb_l ^ xor_l)
+        nx_h = jnp.where(first, v0, xor_h)
+        nx_l = jnp.where(first, v1, xor_l)
+        v_len = jnp.where(first, u32(64), x_len)
+
+        ok = ~done & ~is_eos
+        dp_len = ts_len + v_len
+        r2 = r + jnp.where(ok, dp_len, u32(0))
+        buf2 = b32.roll_left_words(buf, r2 >> u32(5), 6)
+        r3 = r2 & u32(31)
+
+        out_t = jnp.where(ok, new_time, jnp.int64(0))
+        carry = (
+            buf2,
+            r3,
+            jnp.where(ok, new_time, prev_time),
+            jnp.where(ok, new_dt, prev_dt),
+            jnp.where(ok, nb_h, pb_h),
+            jnp.where(ok, nb_l, pb_l),
+            jnp.where(ok, nx_h, px_h),
+            jnp.where(ok, nx_l, px_l),
+            done | is_eos,
+            err,
+        )
+        return carry, (out_t, jnp.where(ok, nb_h, u32(0)),
+                       jnp.where(ok, nb_l, u32(0)), ok)
+
+    zb = jnp.zeros((B,), u32)
+    init = (
+        buf0,
+        zb,
+        start,
+        jnp.zeros((B,), I64),
+        zb, zb, zb, zb,
+        jnp.zeros((B,), bool),
+        jnp.zeros((B,), bool),
+    )
+    carry, (ts, vh, vl, ok) = lax.scan(step, init, jnp.arange(max_points))
+    err = carry[-1]
+    return DecodedBlocks(
+        times=ts.T,
+        value_bits=b32.pair_to_u64(vh.T, vl.T),
+        valid=ok.T,
+        n_points=ok.T.sum(axis=1).astype(jnp.int32),
         error=err,
     )
 
@@ -428,8 +772,6 @@ def bytes_to_words(streams: list[bytes], capacity_words: int | None = None) -> j
     """Pack byte streams into a [B, W] uint64 word tensor for decode."""
     if capacity_words is None:
         capacity_words = max((len(s) + 7) // 8 for s in streams) if streams else 1
-    import numpy as np
-
     arr = np.zeros((len(streams), capacity_words), dtype=np.uint64)
     for i, s in enumerate(streams):
         padded = s + b"\x00" * (-len(s) % 8)

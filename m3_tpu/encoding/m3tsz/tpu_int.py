@@ -32,7 +32,7 @@ from jax import lax
 from m3_tpu.encoding.m3tsz import constants as c
 from m3_tpu.encoding.m3tsz.tpu import (
     _EOS_LEN,
-    DecodedBlocks,
+    DecodedValues,
     EncodedBlocks,
     _decode_ts_fields,
     _dod_fields,
@@ -387,7 +387,6 @@ def _int_value_fields(vb, v, n_points):
     return hi.T, lo.T, ln.T
 
 
-@functools.partial(jax.jit, static_argnames=("unit", "capacity_words"))
 def encode_bits_int(
     times: jnp.ndarray,  # [B, T] int64 unix nanos
     value_bits: jnp.ndarray,  # [B, T] uint64 IEEE-754 bit patterns
@@ -395,9 +394,27 @@ def encode_bits_int(
     n_points: jnp.ndarray,  # [B] int32
     unit: TimeUnit = TimeUnit.SECOND,
     capacity_words: int | None = None,
+    impl: str | None = None,
 ) -> EncodedBlocks:
     """Batched int-optimized M3TSZ encode (bit-identical to the scalar
-    encoder with int_optimized=True)."""
+    encoder with int_optimized=True). `impl` selects the packer backend
+    as in tpu.encode_bits."""
+    from m3_tpu.encoding.m3tsz.tpu import _resolve_impl
+
+    return _encode_bits_int_jit(times, value_bits, start, n_points, unit,
+                                capacity_words, _resolve_impl(impl))
+
+
+@functools.partial(jax.jit, static_argnames=("unit", "capacity_words", "impl"))
+def _encode_bits_int_jit(
+    times: jnp.ndarray,
+    value_bits: jnp.ndarray,
+    start: jnp.ndarray,
+    n_points: jnp.ndarray,
+    unit: TimeUnit = TimeUnit.SECOND,
+    capacity_words: int | None = None,
+    impl: str = "tree",
+) -> EncodedBlocks:
     B, T = times.shape  # noqa: N806
     unit_ns = unit_value_ns(unit)
     default_bits = 32 if unit in (TimeUnit.SECOND, TimeUnit.MILLISECOND) else 64
@@ -421,9 +438,7 @@ def encode_bits_int(
     v_hi, v_lo, v_len = _int_value_fields(vb, v, n_points)
 
     dp_len = jnp.where(valid, ts_len + v_len, _u64(0))
-    csum = jnp.cumsum(dp_len, axis=1)
-    offsets = _u64(64) + csum - dp_len
-    end_off = _u64(64) + csum[:, -1] if T > 0 else jnp.full((B,), 64, U64)
+    end_off = _u64(64) + jnp.sum(dp_len, axis=1)
     total_bits = end_off + _EOS_LEN
     misaligned = jnp.any(start.astype(I64) % unit_ns != 0)
     overflow = jnp.any(total_bits > _u64(capacity_words * 64)) | misaligned
@@ -432,7 +447,7 @@ def encode_bits_int(
         overflow = overflow | jnp.any(valid & ~in32)
 
     words = _pack_stream(ts_hi, ts_lo, ts_len, v_hi, v_lo, v_len,
-                         valid, offsets, end_off, start, capacity_words)
+                         valid, start, capacity_words, impl)
     return EncodedBlocks(words=words, bit_lengths=total_bits, overflow=overflow)
 
 
@@ -584,7 +599,7 @@ def decode_int(
         return ts, vs, ok, carry[-1]
 
     ts, vs, ok, err = jax.vmap(decode_one)(words)
-    return DecodedBlocks(
+    return DecodedValues(
         times=ts,
         values=vs,
         valid=ok,

@@ -16,9 +16,13 @@ import m3_tpu.ops  # noqa: F401  (enables x64)
 U64 = jnp.uint64
 I64 = jnp.int64
 
-_ZERO = jnp.uint64(0)
-_ONE = jnp.uint64(1)
-_SIXTYFOUR = jnp.uint64(64)
+# numpy scalars inline as trace literals; module-level jnp scalars become
+# hoisted jaxpr constants and trip a jit fastpath buffer-count bug.
+import numpy as _np
+
+_ZERO = _np.uint64(0)
+_ONE = _np.uint64(1)
+_SIXTYFOUR = _np.uint64(64)
 
 
 def u64(x) -> jnp.ndarray:

@@ -18,11 +18,17 @@ from m3_tpu.utils.xtime import TimeUnit  # noqa: E402
 START = 1_600_000_000_000_000_000
 
 
-def run_batch(times, values, start, n_points, unit):
-    """Encode on device, cross-check bytes vs scalar, decode on device."""
+def run_batch(times, values, start, n_points, unit, impl="scatter"):
+    """Encode on device, cross-check bytes vs scalar, decode on device.
+
+    Both kernel implementations must agree bit-for-bit: 'scatter' (the CPU
+    lowering) and 'tree'/'shift' (the TPU lowering) — run_batch is invoked
+    for each via the class-level parametrize below.
+    """
     B, T = times.shape
     blocks = tpu.encode(
-        jnp.asarray(times), values, jnp.asarray(start), jnp.asarray(n_points), unit
+        jnp.asarray(times), values, jnp.asarray(start), jnp.asarray(n_points), unit,
+        impl=impl,
     )
     assert not bool(blocks.overflow)
     streams = tpu.blocks_to_bytes(blocks)
@@ -31,12 +37,18 @@ def run_batch(times, values, start, n_points, unit):
         for t, v in zip(times[i][: n_points[i]], values[i][: n_points[i]]):
             enc.encode(int(t), float(v), unit)
         assert enc.stream() == streams[i], f"series {i} bytes differ from scalar encoder"
-    dec = tpu.decode(blocks.words, unit, max_points=T + 4)
-    dt, dv, dn = np.asarray(dec.times), np.asarray(dec.values), np.asarray(dec.n_points)
+    dec = tpu.decode(blocks.words, unit, max_points=T + 4, impl=impl)
+    dt, dn = np.asarray(dec.times), np.asarray(dec.n_points)
+    dv = dec.values_f64()
+    dbits = np.asarray(dec.value_bits)
+    vbits = values.astype(np.float64).view(np.uint64)
     for i in range(B):
         k = n_points[i]
         assert dn[i] == k
         np.testing.assert_array_equal(dt[i, :k], times[i, :k])
+        # bit-level equality is the real contract (exact on every backend,
+        # and distinguishes NaN payloads the float compare can't)
+        np.testing.assert_array_equal(dbits[i, :k], vbits[i, :k])
         for j in range(k):
             assert dv[i, j] == values[i, j] or (
                 np.isnan(dv[i, j]) and np.isnan(values[i, j])
@@ -56,54 +68,55 @@ def mk(rng):
     return make
 
 
+@pytest.mark.parametrize("impl", ["scatter", "tree"])
 class TestEncodeDecodeParity:
-    def test_gauge_seconds(self, rng, mk):
+    def test_gauge_seconds(self, rng, mk, impl):
         args = mk(8, 60, lambda s: rng.integers(1, 60, s) * 10**9, lambda s: rng.normal(100, 25, s))
-        run_batch(*args, TimeUnit.SECOND)
+        run_batch(*args, TimeUnit.SECOND, impl)
 
-    def test_random_nanos(self, rng, mk):
+    def test_random_nanos(self, rng, mk, impl):
         args = mk(
             8, 50,
             lambda s: rng.integers(1, 10**10, s),
             lambda s: rng.normal(size=s) * (10.0 ** rng.integers(-8, 8, s)),
         )
-        run_batch(*args, TimeUnit.NANOSECOND)
+        run_batch(*args, TimeUnit.NANOSECOND, impl)
 
-    def test_sparse_milliseconds(self, rng, mk):
+    def test_sparse_milliseconds(self, rng, mk, impl):
         args = mk(
             4, 40,
             lambda s: rng.integers(1, 10**4, s) * 10**6,
             lambda s: np.where(rng.random(s) < 0.3, 0.0, rng.normal(size=s)),
         )
-        run_batch(*args, TimeUnit.MILLISECOND)
+        run_batch(*args, TimeUnit.MILLISECOND, impl)
 
-    def test_constant_values(self, rng, mk):
+    def test_constant_values(self, rng, mk, impl):
         args = mk(4, 30, lambda s: rng.integers(1, 3, s) * 10**9, lambda s: np.full(s, 7.25))
-        run_batch(*args, TimeUnit.SECOND)
+        run_batch(*args, TimeUnit.SECOND, impl)
 
-    def test_ragged_batch(self, rng, mk):
+    def test_ragged_batch(self, rng, mk, impl):
         n = np.array([5, 20, 1, 13], dtype=np.int32)
         args = mk(4, 20, lambda s: rng.integers(1, 60, s) * 10**9, lambda s: rng.normal(size=s), n)
-        run_batch(*args, TimeUnit.SECOND)
+        run_batch(*args, TimeUnit.SECOND, impl)
 
-    def test_special_float_values(self, rng, mk):
+    def test_special_float_values(self, rng, mk, impl):
         vals = np.array(
             [[0.0, -0.0, np.inf, -np.inf, np.nan, 1e-300, 1e300, 1.0, 1.0, 2.0]] * 2
         )
         args = mk(2, 10, lambda s: rng.integers(1, 5, s) * 10**9, lambda s: vals)
-        run_batch(*args, TimeUnit.SECOND)
+        run_batch(*args, TimeUnit.SECOND, impl)
 
-    def test_large_dod_default_bucket(self, rng, mk):
+    def test_large_dod_default_bucket(self, rng, mk, impl):
         args = mk(2, 12, lambda s: rng.integers(1, 10**6, s) * 10**9, lambda s: rng.normal(size=s))
-        run_batch(*args, TimeUnit.SECOND)
+        run_batch(*args, TimeUnit.SECOND, impl)
 
-    def test_microseconds_aligned(self, rng, mk):
+    def test_microseconds_aligned(self, rng, mk, impl):
         args = mk(2, 12, lambda s: rng.integers(1, 10**10, s) * 1000, lambda s: rng.normal(size=s))
-        run_batch(*args, TimeUnit.MICROSECOND)
+        run_batch(*args, TimeUnit.MICROSECOND, impl)
 
-    def test_single_point_series(self, rng, mk):
+    def test_single_point_series(self, rng, mk, impl):
         args = mk(3, 1, lambda s: rng.integers(1, 60, s) * 10**9, lambda s: rng.normal(size=s))
-        run_batch(*args, TimeUnit.SECOND)
+        run_batch(*args, TimeUnit.SECOND, impl)
 
 
 class TestInterop:
@@ -134,7 +147,7 @@ class TestInterop:
         dec = tpu.decode(words, TimeUnit.SECOND, max_points=T + 2)
         np.testing.assert_array_equal(np.asarray(dec.n_points), T)
         np.testing.assert_array_equal(np.asarray(dec.times)[:, :T], times)
-        np.testing.assert_array_equal(np.asarray(dec.values)[:, :T], values)
+        np.testing.assert_array_equal(dec.values_f64()[:, :T], values)
 
     def test_truncation_lossiness_matches_scalar(self, rng):
         # Non-unit-aligned timestamps truncate identically on both paths.

@@ -16,12 +16,21 @@ START = 1_600_000_000_000_000_000
 
 
 def run_batch(times, values, start, n_points, unit=TimeUnit.SECOND):
-    """Device int-encode, byte-compare vs scalar, device-decode, compare."""
+    """Device int-encode, byte-compare vs scalar, device-decode, compare.
+
+    Both packer impls must emit identical bytes — 'scatter' is the CPU
+    default, 'tree' is what ships on TPU."""
     B, T = times.shape
     vb = jnp.asarray(np.asarray(values, np.float64).view(np.uint64))
     blocks = tpu_int.encode_bits_int(
         jnp.asarray(times), vb, jnp.asarray(start), jnp.asarray(n_points), unit
     )
+    blocks_tree = tpu_int.encode_bits_int(
+        jnp.asarray(times), vb, jnp.asarray(start), jnp.asarray(n_points), unit,
+        impl="tree",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(blocks.words), np.asarray(blocks_tree.words))
     assert not bool(blocks.overflow)
     streams = tpu.blocks_to_bytes(blocks)
     for i in range(B):
