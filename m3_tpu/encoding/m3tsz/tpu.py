@@ -34,7 +34,6 @@ from jax import lax
 from m3_tpu.ops.bits import (
     I64,
     U64,
-    bits_to_f64,
     clz64,
     ctz64,
     mask_low,

@@ -1,0 +1,215 @@
+"""Fanout storage: merge the local zone with remote-zone coordinators.
+
+The reference coordinator composes its local m3 storage with remote gRPC
+storages behind one Storage interface and merges series results
+(/root/reference/src/query/storage/fanout/storage.go; remote client
+query/remote/client.go). This facade does the same for this framework's
+storage contract — `namespaces[ns].query_ids / read / read_many` plus the
+label APIs — so the PromQL/Graphite engines and the HTTP API run unchanged
+over a multi-zone deployment.
+
+Semantics:
+- reads UNION series across zones; duplicate series ids merge their
+  samples timestamp-deduped (local zone wins ties — it is authoritative
+  for its own writes, matching the reference's local-preferred merge).
+- writes stay zone-local: cross-zone replication is a deployment concern
+  (the reference fanout likewise only fans out reads).
+- a remote zone failing closed is either skipped (default, recorded via a
+  warning counter — the reference's warn-on-partial-results mode) or
+  fatal (strict=True, its fail mode).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from m3_tpu.storage.buffer import merge_dedup
+from m3_tpu.utils.instrument import default_registry
+
+log = logging.getLogger(__name__)
+_scope = default_registry().root_scope("fanout")
+
+
+class FanoutError(RuntimeError):
+    """A remote zone failed and the fanout is configured strict."""
+
+
+class FanoutNamespace:
+    """One namespace viewed across the local db + remote zones."""
+
+    def __init__(self, fdb: "FanoutDatabase", name: str):
+        self._fdb = fdb
+        self.name = name
+
+    @property
+    def _local(self):
+        return self._fdb.local.namespaces[self.name]
+
+    # -- index scatter --
+
+    def _zone_call(self, zone, fn, *args):
+        try:
+            return fn(*args)
+        except Exception as e:  # noqa: BLE001 - per-zone failure policy
+            if self._fdb.strict:
+                raise FanoutError(f"remote zone {zone.name}: {e}") from e
+            _scope.subscope("zone", zone=zone.name).counter("errors")
+            log.warning("fanout: skipping zone %s: %s", zone.name, e)
+            return None
+
+    def query_ids(self, query, start_ns: int, end_ns: int, limit=None):
+        from m3_tpu.index.query import query_to_json
+
+        docs = list(self._local.query_ids(query, start_ns, end_ns, limit))
+        seen = {d.series_id for d in docs}
+        qj = query_to_json(query)
+        from m3_tpu.index.segment import Document
+
+        for zone in self._fdb.zones:
+            rows = self._zone_call(
+                zone, zone.query_ids, self.name, qj, start_ns, end_ns, limit)
+            if not rows:
+                continue
+            for sid, fields in rows:
+                if sid not in seen:
+                    seen.add(sid)
+                    docs.append(Document(0, sid, fields))
+        docs.sort(key=lambda d: d.series_id)
+        if limit is not None:
+            docs = docs[:limit]
+        return docs
+
+    # -- reads (replica-style sample merge across zones) --
+
+    def read_many(self, series_ids: list[bytes], start_ns: int, end_ns: int):
+        merged = list(self._local.read_many(series_ids, start_ns, end_ns))
+        for zone in self._fdb.zones:
+            remote = self._zone_call(
+                zone, zone.read_many, self.name, series_ids, start_ns, end_ns)
+            if remote is None:
+                continue
+            for i, (rt, rv) in enumerate(remote):
+                if len(rt) == 0:
+                    continue
+                lt, lv = merged[i]
+                if len(lt) == 0:
+                    merged[i] = (rt, rv)
+                else:
+                    # merge_dedup is last-write-wins on timestamp ties, so
+                    # remote samples go FIRST and the local zone wins
+                    merged[i] = merge_dedup(
+                        np.concatenate([rt, lt]), np.concatenate([rv, lv]))
+        return merged
+
+    def read(self, series_id: bytes, start_ns: int, end_ns: int):
+        [(t, v)] = self.read_many([series_id], start_ns, end_ns)
+        return t, v
+
+    # -- label APIs --
+
+    class _IndexFacade:
+        def __init__(self, ns: "FanoutNamespace"):
+            self._ns = ns
+
+        def aggregate_field_names(self, start_ns, end_ns):
+            ns = self._ns
+            out = set(ns._local.index.aggregate_field_names(start_ns, end_ns))
+            for zone in ns._fdb.zones:
+                vals = ns._zone_call(
+                    zone, zone.label_names, ns.name, start_ns, end_ns)
+                if vals:
+                    out.update(vals)
+            return sorted(out)
+
+        def aggregate_field_values(self, field, start_ns, end_ns):
+            ns = self._ns
+            out = set(ns._local.index.aggregate_field_values(
+                field, start_ns, end_ns))
+            for zone in ns._fdb.zones:
+                vals = ns._zone_call(
+                    zone, zone.label_values, ns.name, field, start_ns, end_ns)
+                if vals:
+                    out.update(vals)
+            return sorted(out)
+
+    @property
+    def index(self):
+        return FanoutNamespace._IndexFacade(self)
+
+    # passthrough attributes the engines occasionally consult (options,
+    # limits); the LOCAL zone is authoritative for both
+    def __getattr__(self, item):
+        local = self._fdb.local.namespaces
+        if self.name not in local:
+            # a remote-only namespace has no local attributes to offer;
+            # AttributeError (not KeyError) so getattr(ns, x, default) works
+            raise AttributeError(
+                f"namespace {self.name!r} has no local attribute {item!r}")
+        return getattr(local[self.name], item)
+
+
+class _Namespaces(dict):
+    """Facade mapping that MIRRORS the local db's namespace listing
+    (iteration/membership), while __getitem__ materializes a fanout view
+    for any name — a namespace existing only in a remote zone is still
+    queryable, matching the reference fanout's union semantics."""
+
+    def __init__(self, fdb: "FanoutDatabase"):
+        super().__init__()
+        self._fdb = fdb
+
+    def __missing__(self, name: str) -> FanoutNamespace:
+        ns = FanoutNamespace(self._fdb, name)
+        self[name] = ns
+        return ns
+
+    def _local_names(self):
+        return list(self._fdb.local.namespaces)
+
+    def __contains__(self, name) -> bool:  # type: ignore[override]
+        return name in self._fdb.local.namespaces
+
+    def __iter__(self):
+        return iter(self._local_names())
+
+    def __len__(self) -> int:
+        return len(self._fdb.local.namespaces)
+
+    def keys(self):
+        return self._local_names()
+
+    def items(self):
+        return [(n, self[n]) for n in self._local_names()]
+
+    def values(self):
+        return [self[n] for n in self._local_names()]
+
+
+class FanoutDatabase:
+    """Database facade: local zone + remote read fanout. Write/lifecycle
+    calls delegate to the local database untouched."""
+
+    def __init__(self, local, zones, strict: bool = False):
+        self.local = local
+        self.zones = list(zones)
+        self.strict = strict
+        self.namespaces = _Namespaces(self)
+
+    # local-zone passthroughs (writes, admin, lifecycle, limits)
+    def __getattr__(self, item):
+        return getattr(self.local, item)
+
+    @property
+    def limits(self):
+        return getattr(self.local, "limits", None)
+
+    @limits.setter
+    def limits(self, v) -> None:
+        self.local.limits = v
+
+    def close(self) -> None:
+        for z in self.zones:
+            z.close()
+        self.local.close()

@@ -1,0 +1,298 @@
+"""Cross-zone remote query storage over gRPC.
+
+The reference m3query/coordinator serves its storage to OTHER coordinators
+over gRPC and fans queries out to remote zones, merging results with the
+local zone (/root/reference/src/query/remote/{server,client}.go, fanout in
+query/storage/fanout/storage.go). This is that seam, redesigned for this
+framework: raw-bytes gRPC methods (grpcio generic handlers — no protobuf
+codegen) carrying hand-rolled protowire messages, with the data plane
+(timestamps / IEEE-754 value bits) as little-endian raw buffers so a
+million-sample response is two memcpys, not a million varints.
+
+Wire schema (protowire field numbers):
+
+  QueryIdsRequest:  1 namespace(utf8) 2 query_json(utf8) 3 start(varint)
+                    4 end(varint) 5 limit(varint, 0=none)
+  Doc:              1 series_id(bytes) 2.. repeated Field(bytes "name=value"
+                    pairs as: 2 name 3 value, repeated in order)
+  QueryIdsResponse: 1 repeated Doc(bytes, nested)
+  ReadManyRequest:  1 namespace(utf8) 2 repeated series_id(bytes)
+                    3 start(varint) 4 end(varint)
+  Series:           1 times(le int64 buffer) 2 value_bits(le uint64 buffer)
+  ReadManyResponse: 1 repeated Series(bytes, nested)
+  LabelsRequest:    1 namespace(utf8) 2 field(bytes) 3 start 4 end
+  LabelsResponse:   1 repeated value(bytes)
+
+Timestamps are unix nanos (non-negative), so plain varints suffice.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+
+import numpy as np
+
+from m3_tpu.utils.protowire import field_bytes, field_varint, iter_fields
+
+_SERVICE = "m3.remote.Query"
+
+
+def _method(name: str) -> str:
+    return f"/{_SERVICE}/{name}"
+
+
+# ---------------------------------------------------------------------------
+# message codecs
+# ---------------------------------------------------------------------------
+
+
+def _enc_query_ids_req(namespace: str, query_json: dict, start: int, end: int,
+                       limit: int | None) -> bytes:
+    return (
+        field_bytes(1, namespace.encode())
+        + field_bytes(2, json.dumps(query_json).encode())
+        + field_varint(3, start)
+        + field_varint(4, end)
+        + field_varint(5, limit or 0)
+    )
+
+
+def _dec_query_ids_req(payload: bytes):
+    ns, qj, start, end, limit = "", {}, 0, 0, 0
+    for fno, wt, val in iter_fields(payload):
+        if fno == 1:
+            ns = val.decode()
+        elif fno == 2:
+            qj = json.loads(val.decode())
+        elif fno == 3:
+            start = val
+        elif fno == 4:
+            end = val
+        elif fno == 5:
+            limit = val
+    return ns, qj, start, end, (limit or None)
+
+
+def _enc_doc(series_id: bytes, fields) -> bytes:
+    out = field_bytes(1, series_id)
+    for name, value in fields:
+        out += field_bytes(2, name) + field_bytes(3, value)
+    return out
+
+
+def _dec_doc(payload: bytes):
+    sid = b""
+    names, values = [], []
+    for fno, wt, val in iter_fields(payload):
+        if fno == 1:
+            sid = val
+        elif fno == 2:
+            names.append(val)
+        elif fno == 3:
+            values.append(val)
+    return sid, tuple(zip(names, values))
+
+
+def _enc_read_many_req(namespace: str, series_ids, start: int, end: int) -> bytes:
+    out = field_bytes(1, namespace.encode())
+    for sid in series_ids:
+        out += field_bytes(2, sid)
+    return out + field_varint(3, start) + field_varint(4, end)
+
+
+def _dec_read_many_req(payload: bytes):
+    ns, sids, start, end = "", [], 0, 0
+    for fno, wt, val in iter_fields(payload):
+        if fno == 1:
+            ns = val.decode()
+        elif fno == 2:
+            sids.append(val)
+        elif fno == 3:
+            start = val
+        elif fno == 4:
+            end = val
+    return ns, sids, start, end
+
+
+def _enc_series(times: np.ndarray, vbits: np.ndarray) -> bytes:
+    return (
+        field_bytes(1, np.asarray(times, np.int64).astype("<i8").tobytes())
+        + field_bytes(2, np.asarray(vbits, np.uint64).astype("<u8").tobytes())
+    )
+
+
+def _dec_series(payload: bytes):
+    times = np.empty(0, np.int64)
+    vbits = np.empty(0, np.uint64)
+    for fno, wt, val in iter_fields(payload):
+        if fno == 1:
+            times = np.frombuffer(val, "<i8").astype(np.int64)
+        elif fno == 2:
+            vbits = np.frombuffer(val, "<u8").astype(np.uint64)
+    return times, vbits
+
+
+def _enc_repeated(items: list[bytes]) -> bytes:
+    return b"".join(field_bytes(1, it) for it in items)
+
+
+def _dec_repeated(payload: bytes) -> list[bytes]:
+    return [val for fno, _, val in iter_fields(payload) if fno == 1]
+
+
+def _enc_labels_req(namespace: str, field: bytes, start: int, end: int) -> bytes:
+    return (field_bytes(1, namespace.encode()) + field_bytes(2, field)
+            + field_varint(3, start) + field_varint(4, end))
+
+
+def _dec_labels_req(payload: bytes):
+    ns, fld, start, end = "", b"", 0, 0
+    for fno, wt, val in iter_fields(payload):
+        if fno == 1:
+            ns = val.decode()
+        elif fno == 2:
+            fld = val
+        elif fno == 3:
+            start = val
+        elif fno == 4:
+            end = val
+    return ns, fld, start, end
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class RemoteQueryServer:
+    """Serves a database (single-node Database or ClusterDatabase facade)
+    to remote-zone coordinators. The reference analog registers the
+    compressed-fetch gRPC service on the coordinator
+    (query/remote/server.go); here the four read RPCs cover the engine's
+    whole storage contract (query_ids/read_many/labels)."""
+
+    def __init__(self, db, listen: str, max_workers: int = 8):
+        import grpc
+
+        self.db = db
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        handlers = {
+            "QueryIds": self._query_ids,
+            "ReadMany": self._read_many,
+            "LabelNames": self._labels,
+            "LabelValues": self._labels,
+            "Health": lambda req, ctx: b"ok",
+        }
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                name = details.method.rsplit("/", 1)[-1]
+                fn = handlers.get(name)
+                if fn is None:
+                    return None
+                return grpc.unary_unary_rpc_method_handler(fn)
+
+        self._server.add_generic_rpc_handlers((_Handler(),))
+        self.port = self._server.add_insecure_port(listen)
+        self._server.start()
+
+    def close(self) -> None:
+        # wait for in-flight handlers: the coordinator closes the database
+        # right after this, so returning early would race reads against it
+        self._server.stop(grace=0.5).wait()
+
+    # -- handlers (bytes in, bytes out) --
+
+    def _query_ids(self, req: bytes, ctx) -> bytes:
+        from m3_tpu.index.query import query_from_json
+
+        ns_name, qj, start, end, limit = _dec_query_ids_req(req)
+        ns = self.db.namespaces[ns_name]
+        docs = ns.query_ids(query_from_json(qj), start, end, limit)
+        return _enc_repeated([_enc_doc(d.series_id, d.fields) for d in docs])
+
+    def _read_many(self, req: bytes, ctx) -> bytes:
+        ns_name, sids, start, end = _dec_read_many_req(req)
+        ns = self.db.namespaces[ns_name]
+        results = ns.read_many(sids, start, end)
+        return _enc_repeated([_enc_series(t, v) for t, v in results])
+
+    def _labels(self, req: bytes, ctx) -> bytes:
+        ns_name, fld, start, end = _dec_labels_req(req)
+        ns = self.db.namespaces[ns_name]
+        if fld:
+            vals = ns.index.aggregate_field_values(fld, start, end)
+        else:
+            vals = ns.index.aggregate_field_names(start, end)
+        return _enc_repeated(list(vals))
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class RemoteZone:
+    """Client for one remote zone's coordinator (query/remote/client.go
+    role). Lazy channel; raw-bytes unary calls; thread-safe."""
+
+    def __init__(self, name: str, target: str, timeout_s: float = 10.0):
+        self.name = name
+        self.target = target
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._channel = None
+        self._stubs: dict[str, object] = {}
+
+    def _stub(self, method: str):
+        import grpc
+
+        with self._lock:
+            if self._channel is None:
+                self._channel = grpc.insecure_channel(self.target)
+            st = self._stubs.get(method)
+            if st is None:
+                st = self._channel.unary_unary(_method(method))
+                self._stubs[method] = st
+        return st
+
+    def close(self) -> None:
+        with self._lock:
+            if self._channel is not None:
+                self._channel.close()
+                self._channel = None
+                self._stubs.clear()
+
+    # -- storage surface (per-namespace calls used by FanoutNamespace) --
+
+    def query_ids(self, namespace: str, query_json: dict, start: int,
+                  end: int, limit=None):
+        resp = self._stub("QueryIds")(
+            _enc_query_ids_req(namespace, query_json, start, end, limit),
+            timeout=self.timeout_s)
+        return [_dec_doc(d) for d in _dec_repeated(resp)]
+
+    def read_many(self, namespace: str, series_ids, start: int, end: int):
+        resp = self._stub("ReadMany")(
+            _enc_read_many_req(namespace, series_ids, start, end),
+            timeout=self.timeout_s)
+        return [_dec_series(s) for s in _dec_repeated(resp)]
+
+    def label_names(self, namespace: str, start: int, end: int):
+        resp = self._stub("LabelNames")(
+            _enc_labels_req(namespace, b"", start, end), timeout=self.timeout_s)
+        return _dec_repeated(resp)
+
+    def label_values(self, namespace: str, field: bytes, start: int, end: int):
+        resp = self._stub("LabelValues")(
+            _enc_labels_req(namespace, field, start, end), timeout=self.timeout_s)
+        return _dec_repeated(resp)
+
+    def healthy(self) -> bool:
+        try:
+            return self._stub("Health")(b"", timeout=self.timeout_s) == b"ok"
+        except Exception:  # noqa: BLE001
+            return False

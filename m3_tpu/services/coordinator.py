@@ -80,6 +80,26 @@ class CoordinatorService:
                 db_cfg.get("namespace", "default"),
                 namespace_options(db_cfg.get("options")),
             )
+        # cross-zone remote read fanout (reference query/storage/fanout +
+        # query/remote): serve this zone's storage over gRPC and/or merge
+        # remote zones into the local query surface
+        rm_cfg = config.get("remote", {}) or {}
+        self.remote_server = None
+        if rm_cfg.get("listen"):
+            from m3_tpu.query.remote import RemoteQueryServer
+
+            self.remote_server = RemoteQueryServer(self.db, rm_cfg["listen"])
+        if rm_cfg.get("zones"):
+            from m3_tpu.query.fanout import FanoutDatabase
+            from m3_tpu.query.remote import RemoteZone
+
+            zones = [
+                RemoteZone(z["name"], z["target"],
+                           timeout_s=float(z.get("timeout_s", 10.0)))
+                for z in rm_cfg["zones"]
+            ]
+            self.db = FanoutDatabase(self.db, zones,
+                                     strict=bool(rm_cfg.get("strict")))
         ruleset = ruleset_from_config(config.get("rules"))
         self.downsampler = (
             Downsampler(self.db, ruleset)
@@ -270,6 +290,8 @@ class CoordinatorService:
         self.api.shutdown()
         if self.carbon:
             self.carbon.close()
+        if self.remote_server is not None:
+            self.remote_server.close()
         self.db.close()
         self.log.info("coordinator stopped")
 

@@ -1,0 +1,183 @@
+"""Cross-zone remote query storage (gRPC) + fanout merge.
+
+Reference behavior modeled: query/remote/{server,client}.go (coordinator
+serves its storage over gRPC) and query/storage/fanout/storage.go (reads
+union local + remote zones, duplicate series merge samples, failed zones
+skip or fail by mode)."""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+pytest.importorskip("grpc")
+
+from m3_tpu.index.query import TermQuery  # noqa: E402
+from m3_tpu.query.fanout import FanoutDatabase, FanoutError  # noqa: E402
+from m3_tpu.query.remote import RemoteQueryServer, RemoteZone  # noqa: E402
+from m3_tpu.storage.database import Database  # noqa: E402
+from m3_tpu.storage.options import NamespaceOptions  # noqa: E402
+
+T0 = 1_600_000_000_000_000_000
+NS = "default"
+
+
+def mk_db(series: dict[bytes, list[tuple[int, float]]]) -> Database:
+    db = Database(tempfile.mkdtemp())
+    db.create_namespace(NS, NamespaceOptions())
+    for sid, dps in series.items():
+        tags = [(b"host", sid.split(b".")[-1]), (b"__name__", b"cpu")]
+        for t, v in dps:
+            db.write_tagged(NS, sid, tags, t, v)
+    return db
+
+
+@pytest.fixture
+def zones():
+    """local has s1+s2; remote has s2 (overlapping + extra samples) + s3."""
+    local = mk_db({
+        b"cpu.a": [(T0 + i * 10**9, 1.0 + i) for i in range(5)],
+        b"cpu.b": [(T0 + i * 10**9, 10.0 + i) for i in range(5)],
+    })
+    remote_db = mk_db({
+        # overlaps cpu.b at T0..T0+4s with DIFFERENT values (local must
+        # win ties) and extends it with T0+5..7s
+        b"cpu.b": [(T0 + i * 10**9, 99.0) for i in range(8)],
+        b"cpu.c": [(T0 + i * 10**9, 30.0 + i) for i in range(5)],
+    })
+    server = RemoteQueryServer(remote_db, "127.0.0.1:0")
+    zone = RemoteZone("zone-b", f"127.0.0.1:{server.port}")
+    fdb = FanoutDatabase(local, [zone])
+    yield fdb, local, remote_db, server, zone
+    zone.close()
+    server.close()
+    local.close()
+    remote_db.close()
+
+
+class TestRemoteProtocol:
+    def test_health(self, zones):
+        _, _, _, _, zone = zones
+        assert zone.healthy()
+
+    def test_query_ids_and_read_roundtrip(self, zones):
+        _, _, _, server, zone = zones
+        from m3_tpu.index.query import query_to_json
+
+        q = query_to_json(TermQuery(b"__name__", b"cpu"))
+        rows = zone.query_ids(NS, q, T0, T0 + 100 * 10**9)
+        sids = sorted(sid for sid, _ in rows)
+        assert [s.split(b"|")[0] for s in sids] == [b"cpu.b", b"cpu.c"]
+        fields = dict(rows[0][1])
+        assert fields[b"__name__"] == b"cpu"
+
+        sid_c = [s for s in sids if s.startswith(b"cpu.c")][0]
+        out = zone.read_many(NS, [sid_c], T0, T0 + 100 * 10**9)
+        times, vbits = out[0]
+        assert len(times) == 5
+        np.testing.assert_array_equal(vbits.view(np.float64),
+                                      [30.0, 31.0, 32.0, 33.0, 34.0])
+
+    def test_label_apis(self, zones):
+        _, _, _, _, zone = zones
+        names = zone.label_names(NS, T0, T0 + 100 * 10**9)
+        assert b"host" in names and b"__name__" in names
+        vals = zone.label_values(NS, b"host", T0, T0 + 100 * 10**9)
+        assert b"b" in vals and b"c" in vals
+
+
+class TestFanout:
+    def q(self):
+        return TermQuery(b"__name__", b"cpu")
+
+    def test_union_series(self, zones):
+        fdb, *_ = zones
+        docs = fdb.namespaces[NS].query_ids(self.q(), T0, T0 + 100 * 10**9)
+        assert [d.series_id.split(b"|")[0] for d in docs] == [
+            b"cpu.a", b"cpu.b", b"cpu.c"]
+
+    def _sid(self, fdb, prefix):
+        docs = fdb.namespaces[NS].query_ids(self.q(), T0, T0 + 100 * 10**9)
+        return [d.series_id for d in docs
+                if d.series_id.startswith(prefix)][0]
+
+    def test_sample_merge_local_wins(self, zones):
+        fdb, *_ = zones
+        ns = fdb.namespaces[NS]
+        t, v = ns.read(self._sid(fdb, b"cpu.b"), T0, T0 + 100 * 10**9)
+        vals = v.view(np.float64)
+        # 8 distinct timestamps: first 5 local (10..14), last 3 remote (99)
+        assert len(t) == 8
+        np.testing.assert_array_equal(vals[:5], [10, 11, 12, 13, 14])
+        np.testing.assert_array_equal(vals[5:], [99, 99, 99])
+
+    def test_remote_only_series_readable(self, zones):
+        fdb, *_ = zones
+        t, v = fdb.namespaces[NS].read(self._sid(fdb, b"cpu.c"),
+                                       T0, T0 + 100 * 10**9)
+        assert len(t) == 5
+
+    def test_engine_runs_over_fanout(self, zones):
+        fdb, *_ = zones
+        from m3_tpu.query.engine import Engine
+
+        eng = Engine(fdb, NS)
+        vec, ts = eng.query_instant('sum(cpu)', T0 + 4 * 10**9)
+        # at T0+4s: local a=5, local b=14 (wins over remote 99), remote c=34
+        assert vec.values[0][0] == pytest.approx(5 + 14 + 34)
+
+    def test_labels_union(self, zones):
+        fdb, *_ = zones
+        names = fdb.namespaces[NS].index.aggregate_field_values(
+            b"host", T0, T0 + 100 * 10**9)
+        assert names == [b"a", b"b", b"c"]
+
+    def test_zone_down_skips_by_default(self, zones):
+        fdb, local, _, server, _ = zones
+        server.close()
+        docs = fdb.namespaces[NS].query_ids(self.q(), T0, T0 + 100 * 10**9)
+        assert [d.series_id.split(b"|")[0] for d in docs] == [
+            b"cpu.a", b"cpu.b"]
+
+    def test_zone_down_strict_raises(self, zones):
+        fdb, *_ , server, _zone = zones
+        server.close()
+        fdb.strict = True
+        with pytest.raises(FanoutError):
+            fdb.namespaces[NS].query_ids(self.q(), T0, T0 + 100 * 10**9)
+
+
+class TestCoordinatorWiring:
+    def test_two_zone_coordinators(self):
+        """Two coordinator services: zone B serves its storage over gRPC;
+        zone A fans out to it (the reference two-coordinator remote-read
+        deployment, scripts/docker-integration-tests/query_fanout)."""
+
+        from m3_tpu.services.coordinator import CoordinatorService
+
+        db_b = tempfile.mkdtemp()
+        svc_b = CoordinatorService({
+            "db": {"path": db_b, "namespace": NS},
+            "remote": {"listen": "127.0.0.1:0"},
+            "http": {"listen": "127.0.0.1:0"},
+        })
+        port_b = svc_b.remote_server.port
+        svc_a = CoordinatorService({
+            "db": {"path": tempfile.mkdtemp(), "namespace": NS},
+            "remote": {"zones": [
+                {"name": "zone-b", "target": f"127.0.0.1:{port_b}"}]},
+            "http": {"listen": "127.0.0.1:0"},
+        })
+        try:
+            svc_b.db.write_tagged(NS, b"mem.x", [(b"__name__", b"mem")],
+                                  T0 + 10**9, 42.0)
+            eng_a_docs = svc_a.db.namespaces[NS].query_ids(
+                TermQuery(b"__name__", b"mem"), T0, T0 + 10 * 10**9)
+            assert len(eng_a_docs) == 1
+            sid = eng_a_docs[0].series_id
+            assert sid.startswith(b"mem.x")
+            t, v = svc_a.db.namespaces[NS].read(sid, T0, T0 + 10 * 10**9)
+            assert v.view(np.float64).tolist() == [42.0]
+        finally:
+            svc_a.shutdown()
+            svc_b.shutdown()
