@@ -1,0 +1,325 @@
+"""Cluster environment manager: per-host agents + a deployment orchestrator.
+
+The reference's m3em runs an agent on every test host that places builds
+and configs, starts/stops node processes, and heartbeats back to a dtest
+orchestrator (/root/reference/src/m3em/{agent,node,cluster}, gRPC
+control). This is that role for this framework: an HTTP agent that
+manages service processes in a working directory, and a ClusterEnv
+orchestrator that drives N agents to deploy, exercise, and tear down a
+multi-process cluster (the dtest tier — src/cmd/tools/dtest).
+
+Design choices vs the reference:
+- HTTP control plane (this framework's transport everywhere else); the
+  agent surface is the same verbs: place file, start, stop, status,
+  heartbeat, teardown.
+- agents only launch `sys.executable -m <module> -f <config>` for an
+  allow-listed set of service modules — the dtest harness places CONFIGS,
+  not builds (one shared checkout; the reference places binaries because
+  its hosts are remote machines).
+
+Agent CLI:  python -m m3_tpu.tools.em --listen 127.0.0.1:0 --workdir DIR
+The chosen port is printed to stdout and written to DIR/agent.port so
+orchestrators spawning agents with port 0 can discover them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+ALLOWED_MODULES = (
+    "m3_tpu.services.dbnode",
+    "m3_tpu.services.coordinator",
+    "m3_tpu.services.aggregator",
+)
+
+
+class _Managed:
+    """One service process under agent management."""
+
+    def __init__(self, name: str, module: str, config_path: str, env: dict,
+                 workdir: str):
+        self.name = name
+        self.module = module
+        self.config_path = config_path
+        self.env = env
+        self.workdir = workdir
+        self.proc: subprocess.Popen | None = None
+        self.started_at = 0.0
+        self.log_path = os.path.join(workdir, f"{name}.log")
+
+    def start(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            raise RuntimeError(f"service {self.name} already running")
+        env = dict(os.environ)
+        env.update(self.env)
+        log = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", self.module, "-f", self.config_path],
+            cwd=self.workdir, env=env, stdout=log, stderr=log,
+            start_new_session=True,
+        )
+        log.close()
+        self.started_at = time.time()
+
+    def stop(self, sig: int = signal.SIGTERM, timeout_s: float = 10.0) -> int | None:
+        if self.proc is None:
+            return None
+        if self.proc.poll() is None:
+            self.proc.send_signal(sig)
+            try:
+                self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=5.0)
+        return self.proc.returncode
+
+    def status(self) -> dict:
+        running = self.proc is not None and self.proc.poll() is None
+        return {
+            "name": self.name,
+            "module": self.module,
+            "running": running,
+            "pid": self.proc.pid if running else None,
+            "returncode": None if running or self.proc is None else self.proc.returncode,
+            "uptime_s": round(time.time() - self.started_at, 1) if running else 0.0,
+        }
+
+
+class EmAgent:
+    """HTTP process-manager agent for one host/workdir."""
+
+    def __init__(self, workdir: str, listen: str = "127.0.0.1:0",
+                 agent_id: str = ""):
+        self.workdir = os.path.abspath(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.agent_id = agent_id or os.path.basename(self.workdir)
+        self.services: dict[str, _Managed] = {}
+        self._lock = threading.Lock()
+        agent = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, doc: dict) -> None:
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length") or 0)
+                return self.rfile.read(n) if n else b""
+
+            def do_GET(self):
+                try:
+                    self._send(*agent.handle("GET", self.path, b""))
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": str(e)})
+
+            def do_POST(self):
+                try:
+                    self._send(*agent.handle("POST", self.path, self._body()))
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": str(e)})
+
+            def do_PUT(self):
+                try:
+                    self._send(*agent.handle("PUT", self.path, self._body()))
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": str(e)})
+
+        host, port = listen.rsplit(":", 1)
+        self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        with open(os.path.join(self.workdir, "agent.port"), "w") as f:
+            f.write(str(self.port))
+
+    # -- request routing (method, path, body) -> (code, doc) --
+
+    def handle(self, method: str, path: str, body: bytes):
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        if method == "GET" and parts == ["health"]:
+            with self._lock:
+                return 200, {
+                    "agent_id": self.agent_id,
+                    "now": time.time(),
+                    "services": {n: m.status() for n, m in self.services.items()},
+                }
+        if method == "PUT" and len(parts) == 2 and parts[0] == "files":
+            name = os.path.basename(parts[1])  # no traversal
+            with open(os.path.join(self.workdir, name), "wb") as f:
+                f.write(body)
+            return 200, {"placed": name, "bytes": len(body)}
+        if method == "POST" and len(parts) == 3 and parts[0] == "services":
+            name = parts[1]
+            doc = json.loads(body.decode() or "{}")
+            if parts[2] == "start":
+                module = doc["module"]
+                if module not in ALLOWED_MODULES:
+                    return 400, {"error": f"module {module!r} not allowed"}
+                with self._lock:
+                    m = self.services.get(name)
+                    if m is None or m.proc is None or m.proc.poll() is not None:
+                        m = _Managed(
+                            name, module,
+                            os.path.join(self.workdir,
+                                         os.path.basename(doc["config"])),
+                            doc.get("env") or {}, self.workdir,
+                        )
+                        self.services[name] = m
+                    m.start()
+                    return 200, m.status()
+            if parts[2] == "stop":
+                with self._lock:
+                    m = self.services.get(name)
+                if m is None:
+                    return 404, {"error": f"unknown service {name}"}
+                rc = m.stop(getattr(signal, doc.get("signal", "SIGTERM")))
+                return 200, {"stopped": name, "returncode": rc}
+        if method == "GET" and len(parts) == 3 and parts[0] == "services":
+            name = parts[1]
+            with self._lock:
+                m = self.services.get(name)
+            if m is None:
+                return 404, {"error": f"unknown service {name}"}
+            if parts[2] == "status":
+                return 200, m.status()
+            if parts[2] == "logs":
+                try:
+                    with open(m.log_path, "rb") as f:
+                        f.seek(0, 2)
+                        size = f.tell()
+                        f.seek(max(0, size - 65536))
+                        tail = f.read().decode(errors="replace")
+                except OSError:
+                    tail = ""
+                return 200, {"log": tail}
+        if method == "POST" and parts == ["teardown"]:
+            self.teardown_services()
+            return 200, {"stopped": "all"}
+        return 404, {"error": f"no route {method} {path}"}
+
+    def teardown_services(self) -> None:
+        with self._lock:
+            managed = list(self.services.values())
+        for m in managed:
+            m.stop()
+
+    def close(self) -> None:
+        self.teardown_services()
+        self._server.shutdown()
+
+
+class AgentClient:
+    """Orchestrator-side handle to one agent."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 15.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _req(self, method: str, path: str, body: bytes = b"") -> dict:
+        req = urllib.request.Request(self.endpoint + path, data=body or None,
+                                     method=method)
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            return json.loads(r.read().decode())
+
+    def health(self) -> dict:
+        return self._req("GET", "/health")
+
+    def put_file(self, name: str, content: str | bytes) -> dict:
+        if isinstance(content, str):
+            content = content.encode()
+        return self._req("PUT", f"/files/{name}", content)
+
+    def start(self, service: str, module: str, config: str,
+              env: dict | None = None) -> dict:
+        body = json.dumps({"module": module, "config": config,
+                           "env": env or {}}).encode()
+        return self._req("POST", f"/services/{service}/start", body)
+
+    def stop(self, service: str, sig: str = "SIGTERM") -> dict:
+        return self._req("POST", f"/services/{service}/stop",
+                         json.dumps({"signal": sig}).encode())
+
+    def status(self, service: str) -> dict:
+        return self._req("GET", f"/services/{service}/status")
+
+    def logs(self, service: str) -> str:
+        return self._req("GET", f"/services/{service}/logs")["log"]
+
+    def teardown(self) -> dict:
+        return self._req("POST", "/teardown")
+
+
+class ClusterEnv:
+    """Deployment orchestrator over named agents (the m3em cluster +
+    dtest harness role)."""
+
+    def __init__(self, agents: dict[str, AgentClient]):
+        self.agents = agents
+
+    def heartbeats(self) -> dict[str, dict]:
+        out = {}
+        for name, agent in self.agents.items():
+            try:
+                out[name] = agent.health()
+            except Exception as e:  # noqa: BLE001 - a dead agent IS the signal
+                out[name] = {"error": str(e)}
+        return out
+
+    def teardown(self) -> None:
+        for agent in self.agents.values():
+            try:
+                agent.teardown()
+            except Exception:  # noqa: BLE001 - best effort on the way down
+                pass
+
+    @staticmethod
+    def wait_until(fn, timeout_s: float = 30.0, every_s: float = 0.25,
+                   desc: str = "condition"):
+        """Poll fn() until truthy; raises TimeoutError with desc."""
+        deadline = time.time() + timeout_s
+        last_err = None
+        while time.time() < deadline:
+            try:
+                out = fn()
+                if out:
+                    return out
+            except Exception as e:  # noqa: BLE001 - keep polling
+                last_err = e
+            time.sleep(every_s)
+        raise TimeoutError(f"timed out waiting for {desc}: {last_err}")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="cluster env manager agent")
+    ap.add_argument("--listen", default="127.0.0.1:0")
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--agent-id", default="")
+    args = ap.parse_args(argv)
+    agent = EmAgent(args.workdir, args.listen, args.agent_id)
+    print(f"agent {agent.agent_id} listening on port {agent.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        agent.close()
+
+
+if __name__ == "__main__":
+    main()
