@@ -182,8 +182,23 @@ def main() -> None:
         print(json.dumps(out))
         return
 
-    # parent: never imports jax; watchdogs the child and falls back to CPU
-    out = _run_child(False, _CHILD_TIMEOUT_S)
+    # parent: never imports jax; watchdogs the child and falls back to CPU.
+    # Preflight the tunnel first (plain sockets, ~3 s): the axon client
+    # polls GET :8083/init forever when no terminal is reachable, so
+    # skipping a doomed TPU child saves the whole 420 s budget for the
+    # CPU run instead of burning it on a hang (round-2 failure mode).
+    from m3_tpu.utils import tpu_preflight
+
+    pf = tpu_preflight.probe()
+    if pf.live:
+        out = _run_child(False, _CHILD_TIMEOUT_S)
+    else:
+        print(
+            f"tpu tunnel unreachable at preflight ({'; '.join(pf.detail)}); "
+            "skipping TPU child",
+            file=sys.stderr,
+        )
+        out = None
     bad = not out or not out.get("value") or "CORRECTNESS FAILED" in out.get("metric", "")
     if bad:
         print("retrying bench with scrubbed CPU env", file=sys.stderr)

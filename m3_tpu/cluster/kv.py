@@ -135,6 +135,21 @@ class KVStore:
         pass
 
 
+def kv_from_config(cfg: dict, addr_key: str = "kv_addr",
+                   path_key: str = "kv_path"):
+    """Build the configured KV backend: `kv_addr` selects the networked
+    m3kvd metadata plane (push watches, leases — cluster deployments),
+    `kv_path` the file-journaled single-host store, neither → None. One
+    helper so every service resolves KV config identically."""
+    if cfg.get(addr_key):
+        from m3_tpu.cluster.kvd import KvdClient  # lazy: needs grpc
+
+        return KvdClient(cfg[addr_key])
+    if cfg.get(path_key):
+        return FileKVStore(cfg[path_key])
+    return None
+
+
 class FileKVStore(KVStore):
     """KV durably journaled to a JSON file (single-host etcd stand-in).
 

@@ -127,6 +127,11 @@ def ruleset_from_doc(doc: dict | None) -> RuleSet:
 def validate_doc(doc: dict) -> None:
     """Raises ValueError on a malformed doc (parse round-trip + rule-name
     uniqueness, the reference store's validation role)."""
+    unknown = set(doc) - {"mapping", "rollup"}
+    if unknown:
+        # a typo'd key ("mappingRules") would otherwise silently store an
+        # EMPTY ruleset and wipe live aggregation
+        raise ValueError(f"unknown ruleset doc keys: {sorted(unknown)}")
     rs = ruleset_from_doc(doc)  # raises on bad filters/policies/enums
     for kind, rules in (("mapping", rs.mapping_rules),
                         ("rollup", rs.rollup_rules)):

@@ -45,7 +45,13 @@ class FanoutNamespace:
 
     @property
     def _local(self):
-        return self._fdb.local.namespaces[self.name]
+        """The local namespace, or None when this namespace exists only in
+        a remote zone — callers skip the local leg then (the remote-only
+        union semantics _Namespaces.__missing__ promises)."""
+        try:
+            return self._fdb.local.namespaces[self.name]
+        except KeyError:
+            return None
 
     # -- index scatter --
 
@@ -62,7 +68,8 @@ class FanoutNamespace:
     def query_ids(self, query, start_ns: int, end_ns: int, limit=None):
         from m3_tpu.index.query import query_to_json
 
-        docs = list(self._local.query_ids(query, start_ns, end_ns, limit))
+        local = self._local
+        docs = list(local.query_ids(query, start_ns, end_ns, limit)) if local else []
         seen = {d.series_id for d in docs}
         qj = query_to_json(query)
         from m3_tpu.index.segment import Document
@@ -84,7 +91,13 @@ class FanoutNamespace:
     # -- reads (replica-style sample merge across zones) --
 
     def read_many(self, series_ids: list[bytes], start_ns: int, end_ns: int):
-        merged = list(self._local.read_many(series_ids, start_ns, end_ns))
+        local = self._local
+        if local is not None:
+            merged = list(local.read_many(series_ids, start_ns, end_ns))
+        else:
+            empty_t = np.array([], dtype=np.int64)
+            empty_v = np.array([], dtype=np.float64)
+            merged = [(empty_t, empty_v) for _ in series_ids]
         for zone in self._fdb.zones:
             remote = self._zone_call(
                 zone, zone.read_many, self.name, series_ids, start_ns, end_ns)
@@ -115,7 +128,9 @@ class FanoutNamespace:
 
         def aggregate_field_names(self, start_ns, end_ns):
             ns = self._ns
-            out = set(ns._local.index.aggregate_field_names(start_ns, end_ns))
+            local = ns._local
+            out = set(local.index.aggregate_field_names(start_ns, end_ns)) \
+                if local else set()
             for zone in ns._fdb.zones:
                 vals = ns._zone_call(
                     zone, zone.label_names, ns.name, start_ns, end_ns)
@@ -125,8 +140,9 @@ class FanoutNamespace:
 
         def aggregate_field_values(self, field, start_ns, end_ns):
             ns = self._ns
-            out = set(ns._local.index.aggregate_field_values(
-                field, start_ns, end_ns))
+            local = ns._local
+            out = set(local.index.aggregate_field_values(
+                field, start_ns, end_ns)) if local else set()
             for zone in ns._fdb.zones:
                 vals = ns._zone_call(
                     zone, zone.label_values, ns.name, field, start_ns, end_ns)

@@ -48,13 +48,20 @@ def _method(name: str) -> str:
 # ---------------------------------------------------------------------------
 
 
+def _clamp_ts(ns: int) -> int:
+    """Varint fields are unsigned: a negative start_ns (lookback-adjusted
+    PromQL start near epoch 0) would mask to a huge u64 and make remote
+    zones silently return empty. No data predates the epoch, so clamp."""
+    return max(0, int(ns))
+
+
 def _enc_query_ids_req(namespace: str, query_json: dict, start: int, end: int,
                        limit: int | None) -> bytes:
     return (
         field_bytes(1, namespace.encode())
         + field_bytes(2, json.dumps(query_json).encode())
-        + field_varint(3, start)
-        + field_varint(4, end)
+        + field_varint(3, _clamp_ts(start))
+        + field_varint(4, _clamp_ts(end))
         + field_varint(5, limit or 0)
     )
 
@@ -99,7 +106,7 @@ def _enc_read_many_req(namespace: str, series_ids, start: int, end: int) -> byte
     out = field_bytes(1, namespace.encode())
     for sid in series_ids:
         out += field_bytes(2, sid)
-    return out + field_varint(3, start) + field_varint(4, end)
+    return out + field_varint(3, _clamp_ts(start)) + field_varint(4, _clamp_ts(end))
 
 
 def _dec_read_many_req(payload: bytes):
@@ -144,7 +151,7 @@ def _dec_repeated(payload: bytes) -> list[bytes]:
 
 def _enc_labels_req(namespace: str, field: bytes, start: int, end: int) -> bytes:
     return (field_bytes(1, namespace.encode()) + field_bytes(2, field)
-            + field_varint(3, start) + field_varint(4, end))
+            + field_varint(3, _clamp_ts(start)) + field_varint(4, _clamp_ts(end)))
 
 
 def _dec_labels_req(payload: bytes):

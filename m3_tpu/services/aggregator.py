@@ -17,7 +17,7 @@ import threading
 import time
 
 from m3_tpu.aggregator.engine import Aggregator
-from m3_tpu.cluster.kv import FileKVStore, KVStore
+from m3_tpu.cluster.kv import KVStore
 from m3_tpu.cluster.services import LeaderService
 from m3_tpu.metrics.aggregation import MetricType
 from m3_tpu.msg.consumer import Consumer
@@ -63,9 +63,13 @@ class AggregatorService:
             buffer_past_ns=int(config.get("buffer_past_s", 5)) * 10**9,
         )
         kv_cfg = config.get("kv", {}) or {}
-        self.kv = kv if kv is not None else (
-            FileKVStore(kv_cfg["path"]) if "path" in kv_cfg else KVStore()
-        )
+        if kv is not None:
+            self.kv = kv
+        else:
+            from m3_tpu.cluster.kv import kv_from_config
+
+            self.kv = kv_from_config(kv_cfg, addr_key="addr", path_key="path") \
+                or KVStore()
         self.election = LeaderService(
             self.kv, config.get("election_id", "m3agg"), self.instance_id,
             lease_ttl_s=float(config.get("lease_ttl_s", 10.0)),

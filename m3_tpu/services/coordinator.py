@@ -57,10 +57,10 @@ class CoordinatorService:
         cl_cfg = config.get("cluster", {}) or {}
         self.kv = kv
         self._placement_version = -1
-        if self.kv is None and cl_cfg.get("kv_path"):
-            from m3_tpu.cluster.kv import FileKVStore
+        if self.kv is None:
+            from m3_tpu.cluster.kv import kv_from_config
 
-            self.kv = FileKVStore(cl_cfg["kv_path"])
+            self.kv = kv_from_config(cl_cfg)
         self._cluster_mode = bool(cl_cfg.get("enabled"))
         if self._cluster_mode:
             # cluster mode: all reads/writes go through the quorum session
@@ -69,7 +69,7 @@ class CoordinatorService:
             # enabled=true serves the KV-backed features (rules, runtime,
             # admin) over local storage.
             if self.kv is None:
-                raise RuntimeError("cluster.enabled needs a KV (kv_path)")
+                raise RuntimeError("cluster.enabled needs a KV (kv_path or kv_addr)")
             self.db = self._build_cluster_db(cl_cfg)
         else:
             self.db = Database(

@@ -221,10 +221,10 @@ class DBNodeService:
         self.instance_id = cl_cfg.get("instance_id", "")
         self.placement_key = cl_cfg.get("placement_key")
         self.kv = kv
-        if self.kv is None and cl_cfg.get("kv_path"):
-            from m3_tpu.cluster.kv import FileKVStore
+        if self.kv is None:
+            from m3_tpu.cluster.kv import kv_from_config
 
-            self.kv = FileKVStore(cl_cfg["kv_path"])
+            self.kv = kv_from_config(cl_cfg)
         self._placement_version = -1
         if self.kv is not None:
             # placement-driven node: own NOTHING until the placement says

@@ -39,6 +39,7 @@ ALLOWED_MODULES = (
     "m3_tpu.services.dbnode",
     "m3_tpu.services.coordinator",
     "m3_tpu.services.aggregator",
+    "m3_tpu.cluster.kvd",
 )
 
 
@@ -169,19 +170,30 @@ class EmAgent:
             name = parts[1]
             doc = json.loads(body.decode() or "{}")
             if parts[2] == "start":
-                module = doc["module"]
-                if module not in ALLOWED_MODULES:
-                    return 400, {"error": f"module {module!r} not allowed"}
                 with self._lock:
-                    m = self.services.get(name)
-                    if m is None or m.proc is None or m.proc.poll() is not None:
-                        m = _Managed(
-                            name, module,
-                            os.path.join(self.workdir,
-                                         os.path.basename(doc["config"])),
-                            doc.get("env") or {}, self.workdir,
-                        )
-                        self.services[name] = m
+                    prior = self.services.get(name)
+                    # Placed state is sticky across restarts (the reference
+                    # m3em agent relaunches from the placed build+config:
+                    # src/m3em/agent): a restart request that omits module/
+                    # config/env reuses what the service was first started
+                    # with; only explicitly-provided non-empty values
+                    # override.
+                    module = doc.get("module") or (prior.module if prior else None)
+                    if module not in ALLOWED_MODULES:
+                        return 400, {"error": f"module {module!r} not allowed"}
+                    config = (
+                        os.path.join(self.workdir, os.path.basename(doc["config"]))
+                        if doc.get("config")
+                        else (prior.config_path if prior else None)
+                    )
+                    if config is None:
+                        return 400, {"error": "start needs a config"}
+                    env = doc.get("env") or (prior.env if prior else {})
+                    if prior is not None and prior.proc is not None \
+                            and prior.proc.poll() is None:
+                        return 409, {"error": f"service {name} already running"}
+                    m = _Managed(name, module, config, env, self.workdir)
+                    self.services[name] = m
                     m.start()
                     return 200, m.status()
             if parts[2] == "stop":
@@ -246,10 +258,18 @@ class AgentClient:
             content = content.encode()
         return self._req("PUT", f"/files/{name}", content)
 
-    def start(self, service: str, module: str, config: str,
-              env: dict | None = None) -> dict:
-        body = json.dumps({"module": module, "config": config,
-                           "env": env or {}}).encode()
+    def start(self, service: str, module: str | None = None,
+              config: str | None = None, env: dict | None = None) -> dict:
+        """Start (or restart) a service. All of module/config/env may be
+        omitted on restart — the agent reuses the service's placed state."""
+        doc = {}
+        if module:
+            doc["module"] = module
+        if config:
+            doc["config"] = config
+        if env:
+            doc["env"] = env
+        body = json.dumps(doc).encode()
         return self._req("POST", f"/services/{service}/start", body)
 
     def stop(self, service: str, sig: str = "SIGTERM") -> dict:

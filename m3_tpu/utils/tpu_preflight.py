@@ -1,0 +1,95 @@
+"""Fast TPU-tunnel reachability probe — no jax import, bounded seconds.
+
+Why this exists: the axon PJRT client initializes by polling
+``GET http://<pool-svc>:8083/init`` every ~10s *forever*. When the tunnel
+behind the relay is down, ``jax.devices()`` therefore hangs every process
+that touches jax with ``JAX_PLATFORMS=axon`` — rounds 1 and 2 lost every
+TPU bench budget (420 s each) and six 13-minute measurement attempts to
+exactly this (see TPU_STATUS.md for the captured evidence).
+
+This module answers "is a terminal reachable?" in under ~3 seconds with
+plain sockets so callers can fall back to CPU immediately instead of
+hanging, and so a background watcher can cheaply poll for the tunnel
+coming alive.
+
+Probed endpoints (in order):
+- ``127.0.0.1:8083`` — the axon terminal's stateless HTTP port; the
+  PJRT client's own init poll target (captured on a local listener:
+  ``GET /init?rank=...&topology=v5e:1x1x1&n_slices=1``).
+- ``127.0.0.1:2024`` — the relay listener present in this image. A live
+  relay proxies HTTP through; a dead one accepts the TCP handshake and
+  immediately closes (observed behavior while the tunnel is down).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from dataclasses import dataclass, field
+
+INIT_PATH = "/init?rank=4294967295&topology=v5e:1x1x1&n_slices=1"
+CANDIDATES = (("127.0.0.1", 8083), ("127.0.0.1", 2024))
+
+
+@dataclass
+class ProbeResult:
+    live: bool
+    detail: list[str] = field(default_factory=list)
+
+    def __bool__(self) -> bool:  # pragma: no cover - convenience
+        return self.live
+
+
+def _probe_http(host: str, port: int, timeout: float) -> tuple[bool, str]:
+    """True if an HTTP server answers the axon /init poll on host:port."""
+    try:
+        s = socket.create_connection((host, port), timeout=timeout)
+    except OSError as e:
+        return False, f"{host}:{port} connect failed: {e}"
+    try:
+        s.settimeout(timeout)
+        req = (
+            f"GET {INIT_PATH} HTTP/1.1\r\n"
+            f"Host: {host}:{port}\r\nAccept: */*\r\n\r\n"
+        )
+        s.sendall(req.encode())
+        data = s.recv(256)
+    except OSError as e:
+        return False, f"{host}:{port} no response: {e}"
+    finally:
+        s.close()
+    if not data:
+        # accept-then-EOF: dead relay endpoint (tunnel down)
+        return False, f"{host}:{port} accepted then closed (dead relay)"
+    if data.startswith(b"HTTP/"):
+        return True, f"{host}:{port} answered: {data[:60]!r}"
+    return False, f"{host}:{port} non-HTTP reply: {data[:60]!r}"
+
+
+def probe(timeout: float = 3.0) -> ProbeResult:
+    """Probe all candidate endpoints; live if any answers HTTP."""
+    details = []
+    for host, port in CANDIDATES:
+        ok, msg = _probe_http(host, port, timeout)
+        details.append(msg)
+        if ok:
+            return ProbeResult(True, details)
+    return ProbeResult(False, details)
+
+
+def wait_live(total_s: float, interval_s: float = 30.0) -> ProbeResult:
+    """Poll until live or total_s elapses; returns the last result."""
+    deadline = time.time() + total_s
+    while True:
+        r = probe()
+        if r.live or time.time() >= deadline:
+            return r
+        time.sleep(min(interval_s, max(0.0, deadline - time.time())))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    r = probe()
+    print(f"live={r.live}")
+    for d in r.detail:
+        print(f"  {d}")
+    raise SystemExit(0 if r.live else 1)

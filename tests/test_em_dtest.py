@@ -183,12 +183,21 @@ class TestEmDtest:
         vals = [float(v) for _, v in res[0]["values"]]
         assert vals[0] == 100.0
 
-        # restart the node via the agent; it rejoins and serves
-        agents["host2"].start("node2", "m3_tpu.services.dbnode", "node.yml")
+        # restart the node via the agent, omitting env on purpose: the agent
+        # must relaunch from the placed state (module/config/env from first
+        # start), the reference m3em restart-from-placed-build semantics
+        agents["host2"].start("node2")
         port2 = node_ports["node2"]
-        ClusterEnv.wait_until(
-            lambda: http_json(f"http://127.0.0.1:{port2}/health").get("ok"),
-            timeout_s=60, desc="node2 back")
+        try:
+            ClusterEnv.wait_until(
+                lambda: http_json(f"http://127.0.0.1:{port2}/health").get("ok"),
+                timeout_s=60, desc="node2 back")
+        except TimeoutError as e:
+            # self-diagnose: the child's log says why it never served
+            raise AssertionError(
+                f"node2 never served /health after restart: {e}\n"
+                f"--- node2 log tail ---\n{agents['host2'].logs('node2')[-4000:]}"
+            ) from e
 
         # logs are collectable through the agent (ops surface)
         assert "dbnode" in agents["host2"].logs("node2")

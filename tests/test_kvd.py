@@ -1,0 +1,296 @@
+"""m3kvd metadata plane: push watches, linearizable CAS, leases,
+kill-the-leader failover (VERDICT r2 "Next round" #5).
+
+Reference semantics being matched: the etcd-backed cluster KV
+(/root/reference/src/cluster/kv/types.go:113 — watchable versioned store,
+src/cluster/etcd/, src/cluster/services/leader elections)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from m3_tpu.cluster.kv import KeyNotFound, VersionMismatch
+from m3_tpu.cluster.kvd import KvdClient, KvdServer, LeaseElection
+
+
+@pytest.fixture
+def server(tmp_path):
+    s = KvdServer("127.0.0.1:0", journal_path=str(tmp_path / "kvd.json"))
+    yield s
+    s.close()
+
+
+@pytest.fixture
+def client(server):
+    c = KvdClient(f"127.0.0.1:{server.port}")
+    yield c
+    c.close()
+
+
+def wait_for(fn, timeout_s=10.0, desc="condition"):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    raise TimeoutError(desc)
+
+
+class TestKvdCore:
+    def test_crud_and_versioning(self, client):
+        assert client.set("a", b"1") == 1
+        assert client.set("a", b"2") == 2
+        vv = client.get("a")
+        assert (vv.version, vv.data) == (2, b"2")
+        with pytest.raises(KeyNotFound):
+            client.get("missing")
+        client.delete("a")
+        with pytest.raises(KeyNotFound):
+            client.get("a")
+        with pytest.raises(KeyNotFound):
+            client.delete("a")
+
+    def test_cas_is_linearizable_across_clients(self, server):
+        """Two clients racing CAS on one key: exactly one winner per
+        version — the single-writer server serializes them."""
+        a = KvdClient(f"127.0.0.1:{server.port}")
+        b = KvdClient(f"127.0.0.1:{server.port}")
+        try:
+            a.set("ctr", b"0")
+            wins = {"a": 0, "b": 0}
+            errs = {"a": 0, "b": 0}
+
+            def bump(client, name, n=30):
+                for _ in range(n):
+                    vv = client.get("ctr")
+                    try:
+                        client.check_and_set(
+                            "ctr", vv.version,
+                            str(int(vv.data) + 1).encode())
+                        wins[name] += 1
+                    except VersionMismatch:
+                        errs[name] += 1
+
+            ta = threading.Thread(target=bump, args=(a, "a"))
+            tb = threading.Thread(target=bump, args=(b, "b"))
+            ta.start(); tb.start(); ta.join(); tb.join()
+            final = int(a.get("ctr").data)
+            # every win incremented exactly once; no lost updates
+            assert final == wins["a"] + wins["b"]
+            assert a.get("ctr").version == final + 1
+        finally:
+            a.close()
+            b.close()
+
+    def test_set_if_not_exists(self, client):
+        assert client.set_if_not_exists("once", b"x") == 1
+        with pytest.raises(VersionMismatch):
+            client.set_if_not_exists("once", b"y")
+
+    def test_keys_prefix(self, client):
+        client.set("p/one", b"1")
+        client.set("p/two", b"2")
+        client.set("q/three", b"3")
+        assert client.keys("p/") == ["p/one", "p/two"]
+
+    def test_journal_survives_restart(self, tmp_path):
+        path = str(tmp_path / "kvd.json")
+        s1 = KvdServer("127.0.0.1:0", journal_path=path)
+        c1 = KvdClient(f"127.0.0.1:{s1.port}")
+        c1.set("durable", b"v")
+        c1.close()
+        s1.close()
+        s2 = KvdServer("127.0.0.1:0", journal_path=path)
+        c2 = KvdClient(f"127.0.0.1:{s2.port}")
+        try:
+            assert c2.get("durable").data == b"v"
+        finally:
+            c2.close()
+            s2.close()
+
+
+class TestKvdWatchPush:
+    def test_cross_client_watch_is_pushed_not_polled(self, server):
+        """Client A learns of client B's write via the server's push
+        stream — A never calls refresh() (which is a no-op anyway)."""
+        a = KvdClient(f"127.0.0.1:{server.port}")
+        b = KvdClient(f"127.0.0.1:{server.port}")
+        got = []
+        try:
+            a.watch("cfg", lambda k, vv: got.append(vv))
+            assert a.refresh() == 0  # push store: nothing to poll
+            b.set("cfg", b"v1")
+            wait_for(lambda: any(vv and vv.data == b"v1" for vv in got),
+                     desc="push of set")
+            b.delete("cfg")
+            wait_for(lambda: got and got[-1] is None, desc="push of delete")
+        finally:
+            a.close()
+            b.close()
+
+    def test_watch_bootstrap_delivers_current_value(self, server):
+        a = KvdClient(f"127.0.0.1:{server.port}")
+        b = KvdClient(f"127.0.0.1:{server.port}")
+        try:
+            b.set("pre", b"existing")
+            got = []
+            a.watch("pre", lambda k, vv: got.append(vv))
+            wait_for(lambda: any(vv and vv.data == b"existing" for vv in got),
+                     desc="bootstrap delivery")
+        finally:
+            a.close()
+            b.close()
+
+
+class TestKvdLeases:
+    def test_ephemeral_key_vanishes_without_keepalive(self, server, client):
+        """A key attached to a lease that never gets keep-alives is
+        reaped and its deletion pushed to watchers."""
+        from m3_tpu.cluster import kvd as kvdmod
+
+        dying = KvdClient(f"127.0.0.1:{server.port}")
+        # grant a short lease but DO NOT start the keepalive thread —
+        # simulates a process that stopped breathing
+        resp = dying._stub("LeaseGrant")(kvdmod._enc_req(ttl_ms=700))
+        _v, _d, _e, lease_id, _k = kvdmod._dec_resp(resp)
+        dying._lease_id = lease_id
+        dying.set("ephemeral", b"alive")
+
+        events = []
+        client.watch("ephemeral", lambda k, vv: events.append(vv))
+        wait_for(lambda: any(vv and vv.data == b"alive" for vv in events),
+                 desc="ephemeral visible")
+        wait_for(lambda: events and events[-1] is None, timeout_s=10,
+                 desc="lease expiry pushed")
+        with pytest.raises(KeyNotFound):
+            client.get("ephemeral")
+        dying._lease_id = 0
+        dying.close()
+
+    def test_stale_lease_cannot_reap_recreated_key(self, server, client):
+        """Ownership handover: A's ephemeral key is deleted and re-created
+        by B under B's lease; when A's lease later dies, B's key must
+        survive (every write re-resolves the key's single lease owner)."""
+        a = KvdClient(f"127.0.0.1:{server.port}")
+        b = KvdClient(f"127.0.0.1:{server.port}")
+        try:
+            a.start_session(ttl_ms=600)
+            a.set("handover", b"A")
+            a.delete("handover")  # A resigns
+            b.start_session(ttl_ms=60_000)
+            b.set("handover", b"B")  # B takes over under its own lease
+            # kill A without revoke: stop its keepalives and wait > TTL
+            a._closed.set()
+            time.sleep(2.0)
+            assert client.get("handover").data == b"B"
+        finally:
+            a.close()
+            b.close()
+
+    def test_rev_dedupe_survives_delete_recreate_replay(self, server):
+        """A key deleted and re-created restarts at version 1; a client
+        replaying the bootstrap after a stream gap must still apply the
+        new value (revision-based dedupe, not version-based)."""
+        c = KvdClient(f"127.0.0.1:{server.port}")
+        try:
+            got = []
+            c.watch("flappy", lambda k, vv: got.append(vv))
+            # simulate a prior life of the key at a high version
+            c._apply_event("flappy", 5, b"old", deleted=False, rev=10)
+            assert c._versions["flappy"] == 5
+            # stream gap: the delete event was lost; the reconnect
+            # bootstrap replays the RE-CREATED key at version 1, rev 12
+            c._apply_event("flappy", 1, b"new", deleted=False, rev=12)
+            assert c._data["flappy"].data == b"new"
+            assert any(vv and vv.data == b"new" for vv in got)
+            # replayed duplicates (rev <= last) stay dropped
+            c._apply_event("flappy", 1, b"stale", deleted=False, rev=12)
+            assert c._data["flappy"].data == b"new"
+            # reconcile: a cached key absent from the bootstrap snapshot
+            # is a deletion that happened during the gap
+            c._reconcile_deletions({"otherkey"})
+            assert "flappy" not in c._data
+            assert got[-1] is None
+        finally:
+            c.close()
+
+    def test_keepalive_preserves_key(self, server, client):
+        holder = KvdClient(f"127.0.0.1:{server.port}")
+        try:
+            holder.start_session(ttl_ms=600)
+            holder.set("held", b"x")
+            time.sleep(1.5)  # several TTLs with keepalives running
+            assert client.get("held").data == b"x"
+        finally:
+            holder.close()
+
+
+KILLABLE_LEADER = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+from m3_tpu.cluster.kvd import KvdClient, LeaseElection
+c = KvdClient("127.0.0.1:{port}")
+e = LeaseElection(c, "flush", "doomed-leader", ttl_ms=800)
+assert e.is_leader()
+print("LEADING", flush=True)
+time.sleep(300)
+"""
+
+
+class TestKvdElection:
+    def test_kill_the_leader_failover(self, server, tmp_path):
+        """The VERDICT's required scenario: SIGKILL the leader process;
+        the follower is promoted by lease expiry + watch push alone."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = KILLABLE_LEADER.format(repo=repo, port=server.port)
+        leader_proc = subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PALLAS_AXON_POOL_IPS": ""},
+        )
+        try:
+            assert leader_proc.stdout.readline().strip() == "LEADING", \
+                leader_proc.stdout.read()
+
+            follower_client = KvdClient(f"127.0.0.1:{server.port}")
+            follower = LeaseElection(
+                follower_client, "flush", "follower", ttl_ms=800)
+            assert not follower.is_leader()
+            assert follower.leader() == "doomed-leader"
+
+            leader_proc.send_signal(signal.SIGKILL)
+            leader_proc.wait(timeout=10)
+
+            # no polling in sight: lease reaper deletes the ephemeral
+            # key, the delete event is pushed, the follower re-campaigns
+            wait_for(follower.is_leader, timeout_s=15,
+                     desc="follower promoted after leader SIGKILL")
+            assert follower.leader() == "follower"
+            follower.close()
+            follower_client.close()
+        finally:
+            if leader_proc.poll() is None:
+                leader_proc.kill()
+
+    def test_resign_hands_over(self, server):
+        ca = KvdClient(f"127.0.0.1:{server.port}")
+        cb = KvdClient(f"127.0.0.1:{server.port}")
+        try:
+            ea = LeaseElection(ca, "tick", "a", ttl_ms=2_000)
+            eb = LeaseElection(cb, "tick", "b", ttl_ms=2_000)
+            assert ea.is_leader() and not eb.is_leader()
+            ea.resign()
+            wait_for(eb.is_leader, desc="b promoted after resign")
+            ea.close()
+            eb.close()
+        finally:
+            ca.close()
+            cb.close()

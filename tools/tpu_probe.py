@@ -1,0 +1,98 @@
+"""Staged TPU probe: one timestamped line per stage so a hang is localized.
+
+Run under a shell timeout; every line flushes immediately. Stages go from
+trivial (constant add) to the real codec kernels at tiny shapes.
+"""
+import os, sys, time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - T0:7.1f}s] {msg}", flush=True)
+
+
+log("start; importing jax")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+log(f"jax {jax.__version__} imported")
+
+cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+os.makedirs(cache_dir, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+log(f"compilation cache at {cache_dir}")
+
+devs = jax.devices()
+log(f"devices: {devs} platform={devs[0].platform}")
+
+# stage 1: trivial eager op
+x = jnp.float32(1.5) + jnp.float32(2.5)
+log("eager add traced")
+x.block_until_ready()
+log(f"eager add done: {x}")
+
+# stage 2: tiny jit
+f = jax.jit(lambda a, b: a * b + 1.0)
+y = f(jnp.ones((8, 8), jnp.float32), jnp.full((8, 8), 2.0, jnp.float32))
+log("tiny jit dispatched")
+y.block_until_ready()
+log(f"tiny jit done sum={float(y.sum())}")
+
+# stage 3: matmul on MXU
+g = jax.jit(lambda a: a @ a)
+z = g(jnp.ones((256, 256), jnp.bfloat16))
+log("matmul dispatched")
+z.block_until_ready()
+log(f"matmul done [0,0]={float(z[0, 0])}")
+
+# stage 4: int64/uint64 ops (codec uses u64 words — X64 rewriter territory)
+h = jax.jit(lambda a: (a << 3) ^ (a >> 2))
+w = h(jnp.arange(64, dtype=jnp.uint32))
+w.block_until_ready()
+log("uint32 shifts done")
+try:
+    h64 = jax.jit(lambda a: (a << 3) ^ (a >> 2))
+    w64 = h64(jnp.arange(64, dtype=jnp.uint64))
+    w64.block_until_ready()
+    log("uint64 shifts done")
+except Exception as e:  # noqa: BLE001
+    log(f"uint64 shifts FAILED: {type(e).__name__}: {e}")
+
+# stage 5: lax.scan (decoder shape)
+def scan_body(c, t):
+    return c + t, c * t
+
+s = jax.jit(lambda xs: jax.lax.scan(scan_body, jnp.float32(0), xs))
+cs, ys = s(jnp.ones((128,), jnp.float32))
+jax.block_until_ready((cs, ys))
+log("lax.scan done")
+
+# stage 6: the real codec at tiny shape
+log("importing m3tsz tpu codec")
+from m3_tpu.encoding.m3tsz import tpu  # noqa: E402
+from m3_tpu.utils.xtime import TimeUnit  # noqa: E402
+from __graft_entry__ import _example_batch  # noqa: E402
+
+for B, T in ((8, 8), (64, 16), (1024, 120)):
+    times, vbits, start, n_points = _example_batch(B=B, T=T)
+    jt, jv, js, jn = map(jnp.asarray, (times, vbits, start, n_points))
+    cap = (64 + 80 * T + 11 + 63) // 64
+    log(f"B={B} T={T}: tracing encode")
+    blocks = tpu.encode_bits(jt, jv, js, jn, TimeUnit.SECOND, cap)
+    log(f"B={B} T={T}: encode dispatched; blocking")
+    jax.block_until_ready(blocks.words)
+    log(f"B={B} T={T}: encode DONE overflow={bool(blocks.overflow)}")
+    dec = tpu.decode(blocks.words, TimeUnit.SECOND, max_points=T)
+    log(f"B={B} T={T}: decode dispatched; blocking")
+    jax.block_until_ready(dec.times)
+    import numpy as np
+    ok = (np.asarray(dec.value_bits)[:, :T] == vbits).all() and (
+        np.asarray(dec.times)[:, :T] == times
+    ).all()
+    log(f"B={B} T={T}: decode DONE correct={bool(ok)}")
+
+log("ALL STAGES PASSED")

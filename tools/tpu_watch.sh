@@ -1,0 +1,26 @@
+#!/bin/bash
+# Background tunnel watcher: probe every ~3 min; when a terminal answers,
+# immediately run the staged measurement (tpu_measure.py) under a bounded
+# timeout. Stops for good once a complete result is recorded.
+cd "$(dirname "$0")/.." || exit 1
+LOG=tpu_watch.log
+echo "=== tpu_watch start $(date -u +%H:%M:%S) ===" >> "$LOG"
+while true; do
+  if python -c "
+import json,sys
+try:
+  d=json.load(open('tpu_measure_out.json'))
+  sys.exit(0 if d.get('result')=='complete' else 1)
+except Exception:
+  sys.exit(1)
+"; then
+    echo "[$(date -u +%H:%M:%S)] complete result recorded; watcher exiting" >> "$LOG"
+    exit 0
+  fi
+  if python m3_tpu/utils/tpu_preflight.py >> "$LOG" 2>&1; then
+    echo "[$(date -u +%H:%M:%S)] TUNNEL LIVE — running staged measurement" >> "$LOG"
+    timeout 900 python tpu_measure.py >> "$LOG" 2>&1
+    echo "[$(date -u +%H:%M:%S)] measurement attempt rc=$? " >> "$LOG"
+  fi
+  sleep 170
+done
