@@ -11,6 +11,13 @@ Transport is pluggable: a node connection is anything exposing the node
 API (in-process Database for the integration harness, an HTTP/RPC proxy
 for real deployments) — the reference's TChannel host queues become this
 connection layer.
+
+Resilience: every per-host request goes through that host's
+breaker+retry policy (client/breaker.py — the reference's
+client/circuitbreaker/circuit.go role): transient errors get bounded
+backed-off retries, repeated failures open the host's circuit so a
+flapping node is shed locally instead of hammered, and a breaker
+rejection feeds the same consistency accounting as a network failure.
 """
 
 from __future__ import annotations
@@ -55,12 +62,40 @@ class Session:
         write_consistency: ConsistencyLevel = ConsistencyLevel.MAJORITY,
         read_consistency: ConsistencyLevel = ConsistencyLevel.ONE,
         shard_seed: int = 42,
+        breaker_config=None,
+        breaker_clock=None,
     ):
+        from m3_tpu.client.breaker import BreakerConfig
+
         self.topology = topology
         self.connections = connections
         self.write_consistency = write_consistency
         self.read_consistency = read_consistency
         self.shard_seed = shard_seed
+        self._breaker_config = breaker_config or BreakerConfig()
+        self._breaker_clock = breaker_clock
+        self._policies: dict[str, object] = {}
+
+    def host_policy(self, host: str):
+        """The host's breaker+retry policy (created on first use); every
+        request this session sends the host goes through policy.call so a
+        flapping node is shed instead of hammered (reference
+        client/circuitbreaker/circuit.go + session retrier wiring)."""
+        import time as _time
+
+        from m3_tpu.client.breaker import HostPolicy
+
+        pol = self._policies.get(host)
+        if pol is None:
+            pol = HostPolicy(
+                host, self._breaker_config,
+                clock=self._breaker_clock or _time.monotonic,
+            )
+            self._policies[host] = pol
+        return pol
+
+    def _host_call(self, host: str, fn, *args, **kwargs):
+        return self.host_policy(host).call(fn, *args, **kwargs)
 
     def _shard(self, series_id: bytes) -> int:
         return murmur3_32(series_id, self.shard_seed) % self.topology.n_shards
@@ -81,7 +116,8 @@ class Session:
                 result.errors.append((host, ConnectionError(f"no connection to {host}")))
                 continue
             try:
-                conn.write_tagged(namespace, metric_name, list(tags), t_ns, value)
+                self._host_call(host, conn.write_tagged, namespace,
+                                metric_name, list(tags), t_ns, value)
                 result.acks += 1
             except Exception as e:  # per-host failure feeds the accumulator
                 result.errors.append((host, e))
@@ -126,12 +162,13 @@ class Session:
             writer = getattr(conn, "write_batch", None)
             try:
                 if writer is not None:
-                    results = writer(namespace, batch)
+                    results = self._host_call(host, writer, namespace, batch)
                 else:  # test doubles expose write_tagged only
                     results = []
                     for m, tags, t, v in batch:
                         try:
-                            conn.write_tagged(namespace, m, list(tags), t, v)
+                            self._host_call(host, conn.write_tagged,
+                                            namespace, m, list(tags), t, v)
                             results.append(None)
                         except Exception as e:  # noqa: BLE001
                             results.append(str(e))
@@ -175,7 +212,8 @@ class Session:
                 errors.append((host, ConnectionError(f"no connection to {host}")))
                 continue
             try:
-                dps = conn.read(namespace, series_id, start_ns, end_ns)
+                dps = self._host_call(host, conn.read, namespace, series_id,
+                                      start_ns, end_ns)
             except Exception as e:
                 errors.append((host, e))
                 continue
@@ -218,9 +256,11 @@ class Session:
             try:
                 batch = getattr(conn, "read_batch", None)
                 if batch is not None:
-                    rows = batch(namespace, want, start_ns, end_ns)
+                    rows = self._host_call(host, batch, namespace, want,
+                                           start_ns, end_ns)
                 else:  # in-process/test doubles expose read() only
-                    rows = [conn.read(namespace, sid, start_ns, end_ns)
+                    rows = [self._host_call(host, conn.read, namespace, sid,
+                                            start_ns, end_ns)
                             for sid in want]
             except Exception as e:  # noqa: BLE001 - per-host failure
                 errors.append((host, e))
@@ -283,7 +323,8 @@ class Session:
             if shards and shards <= covered:
                 continue  # replicas of covered shards hold the same index
             try:
-                rows = conn.query_ids(namespace, doc, start_ns, end_ns, limit)
+                rows = self._host_call(host, conn.query_ids, namespace, doc,
+                                       start_ns, end_ns, limit)
             except Exception as e:  # noqa: BLE001 - per-host failure
                 errors.append((host, e))
                 continue
@@ -315,7 +356,7 @@ class Session:
             if shards <= covered:
                 continue
             try:
-                out.update(getattr(conn, fn_name)(*args))
+                out.update(self._host_call(host, getattr(conn, fn_name), *args))
                 covered |= shards
             except Exception as e:  # noqa: BLE001
                 errors.append((host, e))

@@ -440,19 +440,26 @@ class Database:
         manager.register_listener(on_opts)
 
     def tick(self, now_ns: int | None = None) -> dict:
-        """One mediator cycle: warm flush of cold windows + snapshot of
-        in-flight windows + retention expiry + commitlog rotation (a log
-        retires once its windows are flushed OR snapshotted after it was
-        rotated — the reference flush model, storage/README.md)."""
+        """One mediator cycle: warm flush of aged-out windows, cold flush
+        of backfilled (already-flushed) windows, snapshot of in-flight
+        windows, retention expiry, commitlog rotation (a log retires once
+        its windows are flushed OR snapshotted after it was rotated — the
+        reference flush model, storage/README.md + coldflush.go)."""
         now_ns = now_ns if now_ns is not None else time.time_ns()
-        flushed = expired = 0
+        flushed = cold_flushed = expired = 0
         ropts = self._runtime_opts
         snap_on = ropts is None or ropts.snapshot_enabled
         flush_on = ropts is None or ropts.flush_enabled
         snapped = self.snapshot(now_ns) if snap_on else {}
         for name, ns in self.namespaces.items():
             n = ns.flush(now_ns) if flush_on else 0
+            # cold pass AFTER the warm pass (reference mediator ordering):
+            # backfilled blocks merge into version-bumped volumes without
+            # delaying first-volume warm flushes
+            n_cold = ns.cold_flush() if flush_on else 0
             flushed += n
+            cold_flushed += n_cold
+            n += n_cold  # both make windows durable for commitlog retirement
             expired += ns.expire(now_ns)
             self._cleanup_snapshots(name, ns, now_ns)
             ns_snapped = snapped.get(name, 0)
@@ -480,8 +487,8 @@ class Database:
                 self._open_commitlog(name)
             if name in self._commitlogs:
                 self._cleanup_retired_logs(name, ns, now_ns)
-        return {"flushed": flushed, "expired": expired,
-                "snapshotted": sum(snapped.values())}
+        return {"flushed": flushed, "cold_flushed": cold_flushed,
+                "expired": expired, "snapshotted": sum(snapped.values())}
 
     def aggregate_tiles(self, source_ns: str, target_ns: str,
                         start_ns: int, end_ns: int, tile_ns: int,

@@ -117,12 +117,27 @@ class Namespace:
         return [self.read(sid, start_ns, end_ns) for sid in series_ids]
 
     def flush(self, now_ns: int) -> int:
+        """WARM flush: first volume for aged-out buffered windows."""
         if not self.opts.flush_enabled:
             return 0
         n = 0
         for shard in self.shards.values():
             for bs in shard.flushable_block_starts(now_ns):
                 if shard.flush(bs):
+                    n += 1
+        return n
+
+    def cold_flush(self) -> int:
+        """COLD flush: version-bumped volumes for blocks that took writes
+        after their warm flush (backfill/out-of-retention-order ingest).
+        Separate pass so its decode+merge cost never sits in the warm
+        path (reference storage/coldflush.go)."""
+        if not self.opts.flush_enabled:
+            return 0
+        n = 0
+        for shard in self.shards.values():
+            for bs in shard.cold_dirty_block_starts():
+                if shard.cold_flush(bs):
                     n += 1
         return n
 

@@ -57,6 +57,15 @@ class Shard:
         self._write_seq: dict[int, int] = {}
         self._snap_seq: dict[int, int] = {}
         self._seq_lock = threading.Lock()
+        # warm/cold write split (reference series/buffer.go:77-147
+        # WriteType + storage/coldflush.go): a write landing in a block
+        # that already has a flushed volume is COLD — it must not drag
+        # that block back into the warm flush path (which would decode+
+        # merge+rewrite the volume inside the latency-sensitive warm
+        # pass). Cold-dirty blocks flush separately as version-bumped
+        # volumes.
+        self.warm_writes = 0
+        self.cold_writes = 0
 
     # -- write --
 
@@ -64,6 +73,10 @@ class Shard:
               encoded_tags: bytes = b"") -> int:
         bs = self.opts.retention.block_start(t_ns)
         idx = self.buffer.write(series_id, t_ns, value_bits, encoded_tags)
+        if bs in self._filesets:
+            self.cold_writes += 1
+        else:
+            self.warm_writes += 1
         # seq bumps AFTER the point is in the buffer: a snapshot racing in
         # between re-snapshots next pass instead of marking the window
         # clean without the point
@@ -186,12 +199,35 @@ class Shard:
     # -- flush --
 
     def flushable_block_starts(self, now_ns: int) -> list[int]:
+        """WARM flush candidates: buffered windows past buffer_past that
+        have no volume yet. Windows with an existing volume are cold-dirty
+        (see cold_dirty_block_starts) — keeping them out of here is what
+        keeps warm flush latency flat under backfill."""
         r = self.opts.retention
         out = []
         for bs in self.buffer.block_starts():
-            if bs + r.block_size_ns + r.buffer_past_ns <= now_ns:
+            if bs + r.block_size_ns + r.buffer_past_ns <= now_ns \
+                    and bs not in self._filesets:
                 out.append(bs)
         return out
+
+    def cold_dirty_block_starts(self) -> list[int]:
+        """Blocks holding buffered COLD writes: a flushed volume exists and
+        the buffer has new points for the window (reference
+        coldFlushReuseableResources.dirtySeriesToWrite role)."""
+        return sorted(bs for bs in self.buffer.block_starts()
+                      if bs in self._filesets)
+
+    def cold_flush(self, block_start: int) -> bool:
+        """Merge the window's buffered cold writes with its current volume
+        into a version-bumped volume (reference storage/coldflush.go +
+        persist/fs/merger.go). Runs on the cold cadence so backfill never
+        blocks the warm pass."""
+        from m3_tpu.utils import trace
+
+        with trace.span(trace.SHARD_FLUSH, shard=self.shard_id,
+                        block_start=block_start, cold=True):
+            return self._flush_traced(block_start)
 
     def flush(self, block_start: int) -> bool:
         """Seal the window, batch-encode on device, write a fileset volume.
