@@ -2,23 +2,31 @@
 
 Workload mirrors BASELINE.md config #1 (100k-series M3TSZ round-trip) scaled
 to a single dispatch: B series x T datapoints encoded to storage blocks and
-decoded back, on whatever device JAX selects (real TPU under the driver).
+decoded back.
+
+What is measured is the FRAMEWORK'S BEST SERVING PATH on the platform that
+exists (the methodology the round-3 verdict prescribed):
+  - TPU live: the batched XLA codec (m3_tpu/encoding/m3tsz/tpu.py) — the
+    device path the storage engine flushes through.
+  - CPU only: the native v2 batch codec (native/m3tsz.cpp word-level bit
+    I/O, threaded across cores) — the codec the storage engine's CPU
+    dispatch uses for flush/read when no accelerator is live.
+The metric name states which path produced the number.
 
 Baseline: the reference publishes no absolute throughput numbers
 (BASELINE.md) and no Go toolchain exists in this image, so the CPU baseline
-is MEASURED here: the repo's optimized single-core C++ codec
-(native/m3tsz.cpp, -O3, same stream format) running the same workload —
-the closest stand-in for the reference's hand-optimized Go hot loop. If the
-native build is unavailable, falls back to a 10M dp/s constant (the
-estimated Go single-core rate).
+is MEASURED here: the repo's FROZEN v1 single-core scalar C++ codec
+(native/m3tsz.cpp, byte-at-a-time bit I/O structurally matching the
+reference Go ostream/istream) running the same workload — the closest
+stand-in for the reference's hand-optimized Go hot loop. If the native
+build is unavailable, falls back to a 10M dp/s constant (the estimated Go
+single-core rate).
 
 Self-defense (the axon TPU tunnel can hang interpreter startup or fail
 backend init — round-1 BENCH was 0.0 for exactly this reason): the parent
-process never imports jax. It runs the real bench in a watchdogged child
-with the inherited env (TPU if the tunnel is up); on hang, crash, or a
-zero-value result it retries in a child with a scrubbed CPU-only env
-(PALLAS_AXON_POOL_IPS= skips the relay dial; JAX_PLATFORMS=cpu). The metric
-name says which platform produced the number.
+process never imports jax. It runs the TPU bench in a watchdogged child
+with the inherited env; on hang, crash, or a zero-value result it falls
+back to the native CPU bench in-process (which never touches jax at all).
 
 Prints exactly one JSON line on stdout.
 """
@@ -126,6 +134,54 @@ def _bench_inline() -> dict:
     }
 
 
+def _bench_native_cpu() -> dict | None:
+    """The framework's CPU serving path: native v2 batch codec (threaded).
+
+    Runs in the parent process — no jax import anywhere on this path, so a
+    dead TPU tunnel cannot wedge it. Returns None if the native library is
+    unavailable (no compiler)."""
+    import numpy as np
+
+    from m3_tpu.encoding.m3tsz import native
+    from m3_tpu.utils.xtime import TimeUnit
+    from __graft_entry__ import _example_batch
+
+    if not native.available():
+        return None
+    B = int(os.environ.get("M3_BENCH_B", "8192"))
+    T = int(os.environ.get("M3_BENCH_T", "120"))
+    times, vbits, start, _ = _example_batch(B=B, T=T)
+    values = vbits.view(np.float64)
+    s0 = int(start[0])
+
+    # untimed full-batch correctness check (every series, bit-level)
+    streams = native.encode_batch(times, values, start, TimeUnit.SECOND)
+    dt_, dv_, ns_ = native.decode_batch(streams, TimeUnit.SECOND, max_points=T)
+    ok = bool((ns_ == T).all() and (dt_[:, :T] == times).all()
+              and (dv_[:, :T] == vbits).all())
+
+    # timed: warm once, then average the threaded native round trip
+    native.bench_roundtrip_batch(times, values, s0, TimeUnit.SECOND)
+    iters = 5
+    rates = []
+    for _ in range(iters):
+        r, _lt, _lv = native.bench_roundtrip_batch(times, values, s0, TimeUnit.SECOND)
+        rates.append(r)
+    dp_per_sec = sum(rates) / len(rates)
+
+    baseline = _measure_cpu_baseline(times, values, start, T)
+    baseline = baseline if baseline else FALLBACK_BASELINE_DP_PER_SEC
+    nthreads = native.default_threads()
+    return {
+        "metric": "m3tsz encode+decode roundtrip throughput "
+        f"[cpu, native batch codec, {nthreads} threads]"
+        + ("" if ok else " (CORRECTNESS FAILED)"),
+        "value": round(dp_per_sec / 1e6, 3),
+        "unit": "M datapoints/sec",
+        "vs_baseline": round(dp_per_sec / baseline, 3),
+    }
+
+
 def _fallback(detail: str) -> dict:
     """The driver must always get one parseable JSON line."""
     return {
@@ -201,8 +257,17 @@ def main() -> None:
         out = None
     bad = not out or not out.get("value") or "CORRECTNESS FAILED" in out.get("metric", "")
     if bad:
-        print("retrying bench with scrubbed CPU env", file=sys.stderr)
-        safe = _run_child(True, _SAFE_TIMEOUT_S)
+        # CPU fallback: the framework's native batch codec, no jax anywhere
+        print("falling back to native CPU batch codec bench", file=sys.stderr)
+        try:
+            safe = _bench_native_cpu()
+        except Exception as e:  # noqa: BLE001
+            print(f"native CPU bench failed: {e}", file=sys.stderr)
+            safe = None
+        if not safe:
+            # last resort (no compiler): scrubbed-env XLA:CPU child
+            print("retrying bench with scrubbed CPU env", file=sys.stderr)
+            safe = _run_child(True, _SAFE_TIMEOUT_S)
         if safe and safe.get("value") and "CORRECTNESS FAILED" not in safe.get("metric", ""):
             out = safe
     if not out:

@@ -28,7 +28,7 @@ _tried = False
 def _build() -> bool:
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", _SO, _SRC],
             check=True, capture_output=True, timeout=120,
         )
         return True
@@ -67,6 +67,25 @@ def load():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.m3tsz_encode_batch.restype = ctypes.c_int64
+        lib.m3tsz_encode_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32,
+        ]
+        lib.m3tsz_decode_batch.restype = ctypes.c_int64
+        lib.m3tsz_decode_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_int32,
+        ]
+        lib.m3tsz_roundtrip_batch.restype = ctypes.c_int64
+        lib.m3tsz_roundtrip_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
         ]
         _lib = lib
         return _lib
@@ -123,7 +142,11 @@ def decode_series(stream: bytes, unit: TimeUnit = TimeUnit.SECOND,
 def bench_roundtrip(times: np.ndarray, values: np.ndarray, start: int,
                     unit: TimeUnit = TimeUnit.SECOND) -> float:
     """Datapoints/sec for a [B, T] encode+decode round trip executed
-    entirely in native code (one FFI call: the honest CPU baseline)."""
+    entirely in native code (one FFI call: the honest CPU baseline).
+
+    Measures the FROZEN v1 scalar codec — the stand-in for the reference's
+    single-core Go hot loop. The serving path uses the v2 batch codec
+    (encode_batch/decode_batch/bench_roundtrip_batch below)."""
     import time as _time
 
     lib = load()
@@ -146,3 +169,125 @@ def bench_roundtrip(times: np.ndarray, values: np.ndarray, start: int,
     if n < 0:
         raise ValueError("native bench roundtrip failed")
     return n / dt
+
+
+def default_threads() -> int:
+    """Thread count for the batch codec: the cores this process may use,
+    overridable via M3_NATIVE_THREADS."""
+    v = os.environ.get("M3_NATIVE_THREADS")
+    if v:
+        return max(1, int(v))
+    try:
+        return max(1, len(os.sched_getaffinity(0)))
+    except (AttributeError, OSError):
+        return max(1, os.cpu_count() or 1)
+
+
+def encode_batch(times: np.ndarray, values_or_bits: np.ndarray,
+                 starts: np.ndarray, unit: TimeUnit = TimeUnit.SECOND,
+                 n_points: np.ndarray | None = None,
+                 threads: int | None = None) -> list[bytes]:
+    """Encode [B, T] series to per-series streams with the v2 word-level
+    codec, threaded across series. values_or_bits may be f64 values or u64
+    bit patterns; series b encodes its first n_points[b] points (default
+    all T). Bit-identical to the scalar/XLA encoders."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    B, T = times.shape
+    times = np.ascontiguousarray(times, dtype=np.int64)
+    if values_or_bits.dtype == np.uint64:
+        vbits = np.ascontiguousarray(values_or_bits)
+    else:
+        vbits = np.ascontiguousarray(values_or_bits, dtype=np.float64).view(np.uint64)
+    starts = np.ascontiguousarray(np.broadcast_to(starts, (B,)), dtype=np.int64)
+    np_ptr = 0
+    if n_points is not None:
+        n_points = np.ascontiguousarray(n_points, dtype=np.int32)
+        np_ptr = n_points.ctypes.data
+    stride = 8 + (T * 146 + 11) // 8 + 32
+    out = np.zeros((B, stride), dtype=np.uint8)
+    lens = np.empty(B, dtype=np.int64)
+    rc = lib.m3tsz_encode_batch(
+        times.ctypes.data, vbits.ctypes.data, B, T, starts.ctypes.data,
+        np_ptr, unit_value_ns(unit), _default_bits(unit),
+        out.ctypes.data, stride, lens.ctypes.data,
+        threads or default_threads(),
+    )
+    if rc != 0:
+        # OverflowError for both codes, matching the device path's single
+        # blocks.overflow flag (misaligned start folds into overflow there
+        # too) so Shard.snapshot/_flush_locked degrade identically on CPU.
+        bad = int(np.argmax(lens < 0))
+        code = int(lens[bad])
+        if code == -2:
+            raise OverflowError("delta-of-delta overflows 32 bits for this unit")
+        raise OverflowError(
+            f"native batch encode failed for series {bad} (overflow or "
+            "misaligned start)")
+    return [out[b, :lens[b]].tobytes() for b in range(B)]
+
+
+def decode_batch(streams: list[bytes], unit: TimeUnit = TimeUnit.SECOND,
+                 max_points: int | None = None, threads: int | None = None):
+    """Decode per-series streams into padded [B, T] arrays + counts with the
+    v2 codec, threaded across series. Returns (times, vbits, n_points)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    B = len(streams)
+    if B == 0:
+        z = np.zeros((0, 0))
+        return z.astype(np.int64), z.astype(np.uint64), np.zeros(0, np.int32)
+    maxlen = max(len(s) for s in streams)
+    if max_points is None:
+        # a datapoint costs >= 2 bits, so the stream bounds the output
+        max_points = maxlen * 4 + 16
+    stride = maxlen + 16  # >= 9 bytes of slack for unaligned tail loads
+    buf = np.zeros((B, stride), dtype=np.uint8)
+    lens = np.empty(B, dtype=np.int64)
+    for b, s in enumerate(streams):
+        buf[b, :len(s)] = np.frombuffer(s, dtype=np.uint8)
+        lens[b] = len(s)
+    times = np.zeros((B, max_points), dtype=np.int64)
+    vbits = np.zeros((B, max_points), dtype=np.uint64)
+    out_ns = np.empty(B, dtype=np.int32)
+    rc = lib.m3tsz_decode_batch(
+        buf.ctypes.data, lens.ctypes.data, stride, B,
+        unit_value_ns(unit), _default_bits(unit),
+        times.ctypes.data, vbits.ctypes.data, max_points, out_ns.ctypes.data,
+        threads or default_threads(),
+    )
+    if rc != 0:
+        bad = int(np.argmax(out_ns < 0))
+        raise ValueError(f"native batch decode failed for stream {bad}")
+    return times, vbits, out_ns
+
+
+def bench_roundtrip_batch(times: np.ndarray, values: np.ndarray, start: int,
+                          unit: TimeUnit = TimeUnit.SECOND,
+                          threads: int | None = None) -> tuple[float, np.ndarray, np.ndarray]:
+    """Datapoints/sec for a [B, T] round trip on the v2 serving-path codec
+    (word-level bit I/O, threaded). Returns (dp_per_sec, last_times,
+    last_vbits) so callers can verify correctness of the final series."""
+    import time as _time
+
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    B, T = times.shape
+    times = np.ascontiguousarray(times, dtype=np.int64)
+    vbits = np.ascontiguousarray(values, dtype=np.float64).view(np.uint64)
+    out_t = np.empty(T, dtype=np.int64)
+    out_v = np.empty(T, dtype=np.uint64)
+    nth = threads or default_threads()
+    t0 = _time.perf_counter()
+    n = lib.m3tsz_roundtrip_batch(
+        times.ctypes.data, vbits.ctypes.data, B, T,
+        start, unit_value_ns(unit), _default_bits(unit),
+        out_t.ctypes.data, out_v.ctypes.data, nth,
+    )
+    dt = _time.perf_counter() - t0
+    if n < 0:
+        raise ValueError("native batch roundtrip failed")
+    return n / dt, out_t, out_v

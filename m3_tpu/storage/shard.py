@@ -97,7 +97,7 @@ class Shard:
 
     def read(self, series_id: bytes, start_ns: int, end_ns: int):
         """Merged (times, value_bits) from flushed volumes + buffer."""
-        from m3_tpu.encoding.m3tsz import decode as scalar_decode
+        from m3_tpu.encoding.m3tsz import hostpath
 
         parts_t, parts_v = [], []
         # snapshot: the tick thread swaps fileset volumes concurrently
@@ -120,15 +120,10 @@ class Shard:
             ct = np.empty(0, np.int64)
             cv = np.empty(0, np.uint64)
             if stream:
-                dps = scalar_decode(
-                    stream, int_optimized=self.opts.int_optimized,
-                    default_time_unit=self.opts.write_time_unit,
+                ct, cv = hostpath.decode_stream(
+                    stream, self.opts.write_time_unit,
+                    self.opts.int_optimized,
                 )
-                if dps:
-                    ct = np.array([d.timestamp_ns for d in dps], np.int64)
-                    cv = np.array(
-                        [np.float64(d.value) for d in dps], np.float64
-                    ).view(np.uint64)
             if self.cache is not None:  # negative results cached too
                 self.cache.put(key, (ct, cv))
             if len(ct):
@@ -161,31 +156,21 @@ class Shard:
         restarts recover in-flight blocks without replaying the whole WAL
         (the flush-model snapshot role, reference storage/README.md,
         persist/fs/snapshot_metadata_{read,write}.go)."""
-        import jax.numpy as jnp
-
-        from m3_tpu.encoding.m3tsz import tpu as m3tsz_tpu
+        from m3_tpu.encoding.m3tsz import hostpath
 
         sealed = self.buffer.seal(block_start, drop=False)
         if sealed is None:
             return False
         ids = [self.buffer.series_ids[i] for i in sealed.series_indices]
         tags = [self.buffer.series_tags[i] for i in sealed.series_indices]
-        if self.opts.int_optimized:
-            from m3_tpu.encoding.m3tsz import tpu_int
-
-            encode_fn = tpu_int.encode_bits_int
-        else:
-            encode_fn = m3tsz_tpu.encode_bits
-        blocks = encode_fn(
-            jnp.asarray(sealed.times),
-            jnp.asarray(sealed.value_bits),
-            jnp.asarray(sealed.starts),
-            jnp.asarray(sealed.n_points),
-            self.opts.write_time_unit,
-        )
-        if bool(blocks.overflow):
+        try:
+            streams = hostpath.encode_blocks(
+                sealed.times, sealed.value_bits, sealed.starts,
+                sealed.n_points, self.opts.write_time_unit,
+                self.opts.int_optimized,
+            )
+        except OverflowError:
             return False
-        streams = m3tsz_tpu.blocks_to_bytes(blocks)
         writer = FilesetWriter(
             snapshot_root, self.namespace, self.shard_id, block_start,
             self.opts.retention.block_size_ns, snapshot_id,
@@ -276,10 +261,7 @@ class Shard:
             return self._flush_locked(block_start)
 
     def _flush_locked(self, block_start: int) -> bool:
-        import jax.numpy as jnp
-
-        from m3_tpu.encoding.m3tsz import decode as scalar_decode
-        from m3_tpu.encoding.m3tsz import tpu as m3tsz_tpu
+        from m3_tpu.encoding.m3tsz import hostpath
 
         self._drain_retired()
 
@@ -309,12 +291,10 @@ class Shard:
                     extra.append((sid, stags, stream))
                     continue
                 k = new_ids[sid]
-                dps = scalar_decode(
-                    stream, int_optimized=self.opts.int_optimized,
-                    default_time_unit=self.opts.write_time_unit,
+                old_t, old_v = hostpath.decode_stream(
+                    stream, self.opts.write_time_unit,
+                    self.opts.int_optimized,
                 )
-                old_t = np.array([d.timestamp_ns for d in dps], np.int64)
-                old_v = np.array([d.value for d in dps], np.float64).view(np.uint64)
                 nt, nv = merge_dedup(
                     np.concatenate([old_t, times[k, : n_points[k]]]),
                     np.concatenate([old_v, vbits[k, : n_points[k]]]),
@@ -334,24 +314,15 @@ class Shard:
                     times[k, len(nt):] = nt[-1]
                     n_points[k] = len(nt)
 
-        if self.opts.int_optimized:
-            from m3_tpu.encoding.m3tsz import tpu_int
-
-            encode_fn = tpu_int.encode_bits_int
-        else:
-            encode_fn = m3tsz_tpu.encode_bits
-        blocks = encode_fn(
-            jnp.asarray(times),
-            jnp.asarray(vbits),
-            jnp.asarray(sealed.starts),
-            jnp.asarray(n_points),
-            self.opts.write_time_unit,
-        )
-        if bool(blocks.overflow):
+        try:
+            streams = hostpath.encode_blocks(
+                times, vbits, sealed.starts, n_points,
+                self.opts.write_time_unit, self.opts.int_optimized,
+            )
+        except OverflowError:
             raise RuntimeError(
                 f"flush encode overflow: shard={self.shard_id} bs={block_start}"
             )
-        streams = m3tsz_tpu.blocks_to_bytes(blocks)
 
         writer = FilesetWriter(
             self.fs_root, self.namespace, self.shard_id, block_start,

@@ -7,10 +7,24 @@
 // Bit-identical to m3_tpu/encoding/m3tsz with int_optimized=False and a
 // fixed time unit (same contract as the batched device kernels).
 //
-// Build: g++ -O3 -shared -fPIC -o libm3tsz.so m3tsz.cpp
+// Two codec generations live here:
+//  - v1 (m3tsz_encode/m3tsz_decode/m3tsz_bench_roundtrip): byte-at-a-time
+//    bit I/O, structurally the same as the reference Go ostream/istream
+//    (/root/reference/src/dbnode/x/xio, encoding/ostream.go). This is the
+//    FROZEN baseline bench.py measures as the stand-in for the reference's
+//    single-core Go hot loop. Do not optimize it.
+//  - v2 (m3tsz_encode_batch/m3tsz_decode_batch/m3tsz_roundtrip_batch):
+//    the framework's CPU serving path — word-level (u64) bit buffers with
+//    8-byte bswap flushes/loads and std::thread batching across series.
+//    Produces byte-identical streams to v1.
+//
+// Build: g++ -O3 -shared -fPIC -pthread -o libm3tsz.so m3tsz.cpp
 
 #include <cstdint>
 #include <cstring>
+
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -257,6 +271,343 @@ int64_t m3tsz_bench_roundtrip(const int64_t* times, const uint64_t* vbits,
         total += n;
     }
     return total;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// v2: word-level bit I/O + threaded batch drivers (the serving path).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// MSB-first bit writer holding a 64-bit accumulator; whole words are flushed
+// with one bswap+memcpy instead of v1's per-byte loop.
+struct FastWriter {
+    uint8_t* buf;
+    int64_t cap;
+    int64_t pos = 0;      // bytes flushed
+    uint64_t acc = 0;     // pending bits, right-aligned
+    int accbits = 0;      // 0..63 between put() calls
+    bool ovf = false;
+
+    inline void flush_word(uint64_t w) {
+        if (pos + 8 <= cap) {
+            w = __builtin_bswap64(w);
+            memcpy(buf + pos, &w, 8);
+            pos += 8;
+        } else {
+            ovf = true;
+        }
+    }
+
+    inline void put(uint64_t v, int n) {  // n in 1..64
+        if (n < 64) v &= (1ull << n) - 1;
+        int space = 64 - accbits;
+        if (n < space) {
+            acc = (acc << n) | v;
+            accbits += n;
+            return;
+        }
+        int rem = n - space;  // 0..63
+        flush_word(space == 64 ? v : (acc << space) | (v >> rem));
+        acc = rem ? (v & ((1ull << rem) - 1)) : 0;
+        accbits = rem;
+    }
+
+    int64_t finish() {  // pad to byte boundary; returns total bytes
+        int nb = (accbits + 7) / 8;
+        if (pos + nb > cap) { ovf = true; return -1; }
+        uint64_t a = accbits ? (acc << (64 - accbits)) : 0;
+        for (int i = 0; i < nb; ++i) {
+            buf[pos++] = (uint8_t)(a >> 56);
+            a <<= 8;
+        }
+        accbits = 0;
+        return pos;
+    }
+};
+
+// MSB-first bit reader doing unaligned 8-byte loads. The caller must
+// guarantee 9 readable bytes past the last stream byte — only the batch
+// drivers provide that slack (they own their buffers and pad the stride);
+// there is NO padded single-stream entry point, so route single streams
+// through m3tsz_decode_batch with B=1.
+struct FastReader {
+    const uint8_t* buf;
+    int64_t nbits;
+    int64_t bitpos = 0;
+    bool err = false;
+
+    inline bool can(int n) const { return bitpos + n <= nbits; }
+
+    inline uint64_t read(int n) {  // n in 1..64
+        if (bitpos + n > nbits) { err = true; bitpos = nbits; return 0; }
+        int64_t byte = bitpos >> 3;
+        int off = (int)(bitpos & 7);
+        bitpos += n;
+        uint64_t w;
+        memcpy(&w, buf + byte, 8);
+        w = __builtin_bswap64(w);
+        if (off + n <= 64) return (w << off) >> (64 - n);
+        int extra = off + n - 64;  // 1..7: spill into one more byte
+        return ((w << off) >> (64 - n)) | ((uint64_t)buf[byte + 8] >> (8 - extra));
+    }
+
+    inline uint64_t peek(int n) {
+        int64_t p = bitpos;
+        bool e = err;
+        uint64_t v = read(n);
+        bitpos = p;
+        err = e;
+        return v;
+    }
+};
+
+inline void write_dod_fast(FastWriter& w, int64_t dod, int default_bits) {
+    if (dod == 0) { w.put(0, 1); return; }
+    if (dod >= -64 && dod <= 63) {
+        w.put((0b10ull << 7) | ((uint64_t)dod & 0x7F), 9);
+    } else if (dod >= -256 && dod <= 255) {
+        w.put((0b110ull << 9) | ((uint64_t)dod & 0x1FF), 12);
+    } else if (dod >= -2048 && dod <= 2047) {
+        w.put((0b1110ull << 12) | ((uint64_t)dod & 0xFFF), 16);
+    } else if (default_bits == 32) {
+        w.put((0b1111ull << 32) | ((uint64_t)dod & 0xFFFFFFFFull), 36);
+    } else {
+        w.put(0b1111, 4);
+        w.put((uint64_t)dod, 64);
+    }
+}
+
+int64_t encode_fast(const int64_t* times, const uint64_t* vbits, int32_t n,
+                    int64_t start, int64_t unit_ns, int32_t default_bits,
+                    uint8_t* out, int64_t out_cap) {
+    // n == 0 is legal (start prefix + EOS only), matching the XLA encoder.
+    if (n < 0 || unit_ns <= 0 || start % unit_ns != 0) return -1;
+    FastWriter w{out, out_cap};
+    w.put((uint64_t)start, 64);
+    int64_t prev_t = start, prev_dt = 0;
+    uint64_t prev_bits = 0, prev_xor = 0;
+    for (int32_t i = 0; i < n; ++i) {
+        int64_t dt = times[i] - prev_t;
+        int64_t dod_ns = dt - prev_dt;
+        int64_t dod = dod_ns / unit_ns;
+        if (default_bits == 32 && (dod < INT32_MIN || dod > INT32_MAX)) return -2;
+        write_dod_fast(w, dod, default_bits);
+        prev_dt = dt;
+        prev_t = times[i];
+
+        uint64_t vb = vbits[i];
+        if (i == 0) {
+            w.put(vb, 64);
+            prev_bits = vb;
+            prev_xor = vb;
+        } else {
+            uint64_t x = vb ^ prev_bits;
+            if (x == 0) {
+                w.put(0, 1);
+            } else {
+                int pl = clz64(prev_xor), pt = ctz64(prev_xor);
+                int cl = clz64(x), ct = ctz64(x);
+                if (prev_xor != 0 && cl >= pl && ct >= pt) {
+                    int m = 64 - pl - pt;
+                    w.put(0b10, 2);
+                    w.put(x >> pt, m);
+                } else {
+                    int m = 64 - cl - ct;
+                    w.put((0b11ull << 12) | ((uint64_t)cl << 6) | (uint64_t)(m - 1), 14);
+                    w.put(x >> ct, m);
+                }
+            }
+            prev_xor = x;
+            prev_bits = vb;
+        }
+        if (w.ovf) return -1;
+    }
+    w.put((0x100ull << 2), 11);  // EOS marker: 9-bit opcode + 2-bit value 0
+    int64_t total = w.finish();
+    if (w.ovf) return -1;
+    return total;
+}
+
+int32_t decode_fast(const uint8_t* data, int64_t len, int64_t unit_ns,
+                    int32_t default_bits, int64_t* times, uint64_t* vbits,
+                    int32_t max_points) {
+    FastReader r{data, len * 8};
+    if (!r.can(64)) return 0;
+    int64_t prev_t = sign_extend(r.read(64), 64);
+    int64_t prev_dt = 0;
+    uint64_t prev_bits = 0, prev_xor = 0;
+    int32_t count = 0;
+    while (count < max_points) {
+        if (r.can(11) && (r.peek(11) >> 2) == 0x100) {
+            uint64_t marker = r.peek(11) & 3;
+            if (marker == 0) break;   // EOS
+            return -1;                 // host-path marker: not ours to decode
+        }
+        if (!r.can(1)) break;
+        int64_t dod;
+        if (r.read(1) == 0) {
+            dod = 0;
+        } else if (!r.can(1)) { break; }
+        else if (r.read(1) == 0) {
+            dod = sign_extend(r.read(7), 7);
+        } else if (r.read(1) == 0) {
+            dod = sign_extend(r.read(9), 9);
+        } else if (r.read(1) == 0) {
+            dod = sign_extend(r.read(12), 12);
+        } else {
+            dod = (default_bits == 32) ? sign_extend(r.read(32), 32)
+                                       : sign_extend(r.read(64), 64);
+        }
+        prev_dt += dod * unit_ns;
+        prev_t += prev_dt;
+
+        if (count == 0) {
+            if (!r.can(64)) return -1;
+            prev_bits = r.read(64);
+            prev_xor = prev_bits;
+        } else {
+            if (!r.can(1)) return -1;
+            if (r.read(1) == 0) {
+                prev_xor = 0;  // repeat value
+            } else {
+                if (!r.can(1)) return -1;
+                if (r.read(1) == 0) {  // contained
+                    int pl = clz64(prev_xor), pt = ctz64(prev_xor);
+                    int m = 64 - pl - pt;
+                    // m == 0 (prev_xor == 0) only on corrupt streams: a
+                    // well-formed encoder emits the repeat opcode then.
+                    // read(0) would shift by 64 (UB); reject instead.
+                    if (m <= 0) return -1;
+                    prev_xor = r.read(m) << pt;
+                } else {  // uncontained
+                    int lead = (int)r.read(6);
+                    int m = (int)r.read(6) + 1;
+                    int trail = 64 - lead - m;
+                    if (trail < 0) return -1;
+                    prev_xor = r.read(m) << trail;
+                }
+                prev_bits ^= prev_xor;
+            }
+        }
+        if (r.err) break;
+        times[count] = prev_t;
+        vbits[count] = prev_bits;
+        ++count;
+    }
+    return count;
+}
+
+// Run fn(b) over b in [0, B) on nthreads threads in contiguous chunks.
+template <typename F>
+void parallel_over(int32_t B, int32_t nthreads, F fn) {
+    if (nthreads <= 1 || B <= 1) {
+        for (int32_t b = 0; b < B; ++b) fn(b);
+        return;
+    }
+    if (nthreads > B) nthreads = B;
+    std::vector<std::thread> ts;
+    ts.reserve(nthreads);
+    for (int32_t t = 0; t < nthreads; ++t) {
+        int64_t lo = (int64_t)B * t / nthreads;
+        int64_t hi = (int64_t)B * (t + 1) / nthreads;
+        ts.emplace_back([lo, hi, &fn] {
+            for (int64_t b = lo; b < hi; ++b) fn((int32_t)b);
+        });
+    }
+    for (auto& th : ts) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Encode B series into out (stride bytes per series, which must include
+// >= 9 bytes of slack past the worst-case stream for the decoder's
+// unaligned loads). Series b encodes n_points[b] points from row b of the
+// [B, T] input (n_points == nullptr means all T). out_lens[b] = stream
+// bytes, or <0 on error. Returns 0, or -1 if any series failed.
+int64_t m3tsz_encode_batch(const int64_t* times, const uint64_t* vbits,
+                           int32_t B, int32_t T, const int64_t* starts,
+                           const int32_t* n_points,
+                           int64_t unit_ns, int32_t default_bits,
+                           uint8_t* out, int64_t stride, int64_t* out_lens,
+                           int32_t nthreads) {
+    parallel_over(B, nthreads, [&](int32_t b) {
+        int32_t n = n_points ? n_points[b] : T;
+        if (n > T) n = T;
+        out_lens[b] = encode_fast(times + (int64_t)b * T, vbits + (int64_t)b * T,
+                                  n, starts[b], unit_ns, default_bits,
+                                  out + (int64_t)b * stride, stride);
+    });
+    for (int32_t b = 0; b < B; ++b)
+        if (out_lens[b] < 0) return -1;
+    return 0;
+}
+
+// Decode B streams (stride bytes apart, lens[b] bytes each; the buffer must
+// have >= 9 readable bytes past each stream end) into [B, T] outputs.
+// out_ns[b] = decoded point count, or <0 on error. Returns 0 or -1.
+int64_t m3tsz_decode_batch(const uint8_t* streams, const int64_t* lens,
+                           int64_t stride, int32_t B, int64_t unit_ns,
+                           int32_t default_bits, int64_t* times,
+                           uint64_t* vbits, int32_t T, int32_t* out_ns,
+                           int32_t nthreads) {
+    parallel_over(B, nthreads, [&](int32_t b) {
+        out_ns[b] = decode_fast(streams + (int64_t)b * stride, lens[b],
+                                unit_ns, default_bits,
+                                times + (int64_t)b * T, vbits + (int64_t)b * T,
+                                T);
+    });
+    for (int32_t b = 0; b < B; ++b)
+        if (out_ns[b] < 0) return -1;
+    return 0;
+}
+
+// Threaded encode+decode round trip over [B, T] input: the v2 serving-path
+// throughput measurement. Each thread owns scratch (stream + decode output)
+// so the work is embarrassingly parallel. Writes the LAST series' decoded
+// points into out_times/out_vbits (correctness probe). Returns total
+// datapoints processed, or -1 on any error.
+int64_t m3tsz_roundtrip_batch(const int64_t* times, const uint64_t* vbits,
+                              int32_t B, int32_t T, int64_t start,
+                              int64_t unit_ns, int32_t default_bits,
+                              int64_t* out_times, uint64_t* out_vbits,
+                              int32_t nthreads) {
+    int64_t cap = 8 + ((int64_t)T * 146 + 11) / 8 + 32;
+    std::vector<int64_t> errs(nthreads > 0 ? nthreads : 1, 0);
+    if (nthreads <= 1) nthreads = 1;
+    if (nthreads > B) nthreads = B > 0 ? B : 1;
+    std::vector<std::thread> ts;
+    ts.reserve(nthreads);
+    for (int32_t t = 0; t < nthreads; ++t) {
+        int64_t lo = (int64_t)B * t / nthreads;
+        int64_t hi = (int64_t)B * (t + 1) / nthreads;
+        ts.emplace_back([&, t, lo, hi] {
+            std::vector<uint8_t> scratch((size_t)cap);
+            std::vector<int64_t> dt((size_t)T);
+            std::vector<uint64_t> dv((size_t)T);
+            for (int64_t b = lo; b < hi; ++b) {
+                int64_t nbytes = encode_fast(
+                    times + b * T, vbits + b * T, T, start, unit_ns,
+                    default_bits, scratch.data(), cap);
+                if (nbytes < 0) { errs[t] = 1; return; }
+                int32_t n = decode_fast(scratch.data(), nbytes, unit_ns,
+                                        default_bits, dt.data(), dv.data(), T);
+                if (n != T) { errs[t] = 1; return; }
+                if (b == B - 1) {
+                    memcpy(out_times, dt.data(), (size_t)T * 8);
+                    memcpy(out_vbits, dv.data(), (size_t)T * 8);
+                }
+            }
+        });
+    }
+    for (auto& th : ts) th.join();
+    for (int64_t e : errs)
+        if (e) return -1;
+    return (int64_t)B * T;
 }
 
 }  // extern "C"

@@ -79,3 +79,89 @@ class TestNativeCodec:
         dt, dv = native.decode_series(stream, TimeUnit.SECOND)
         for a, b in zip(dv, values):
             assert a == b or (np.isnan(a) and np.isnan(b))
+
+
+class TestNativeBatchCodec:
+    """The v2 serving-path codec (word-level bit I/O, threaded batch)."""
+
+    def test_batch_bit_identical_to_v1(self, rng):
+        B, T = 64, 150
+        times = np.stack([series(rng, n=T)[0] for _ in range(B)])
+        values = np.stack([series(rng, n=T)[1] for _ in range(B)])
+        streams = native.encode_batch(times, values, np.full(B, START),
+                                      TimeUnit.SECOND)
+        for b in range(0, B, 7):
+            v1 = native.encode_series(times[b], values[b], START,
+                                      TimeUnit.SECOND)
+            assert streams[b] == v1
+
+    def test_batch_roundtrip_threaded(self, rng):
+        B, T = 32, 100
+        times = np.stack([series(rng, n=T)[0] for _ in range(B)])
+        values = np.stack([series(rng, n=T)[1] for _ in range(B)])
+        streams = native.encode_batch(times, values, np.full(B, START),
+                                      TimeUnit.SECOND, threads=4)
+        dt, dv, ns = native.decode_batch(streams, TimeUnit.SECOND,
+                                         max_points=T, threads=4)
+        assert (ns == T).all()
+        np.testing.assert_array_equal(dt[:, :T], times)
+        np.testing.assert_array_equal(dv[:, :T].view(np.float64), values)
+
+    def test_batch_n_points(self, rng):
+        B, T = 8, 50
+        times = np.stack([series(rng, n=T)[0] for _ in range(B)])
+        values = np.stack([series(rng, n=T)[1] for _ in range(B)])
+        n_points = np.array([T, 0, 10, T, 1, 25, T, 3], np.int32)
+        streams = native.encode_batch(times, values, np.full(B, START),
+                                      TimeUnit.SECOND, n_points=n_points)
+        dt, dv, ns = native.decode_batch(streams, TimeUnit.SECOND,
+                                         max_points=T)
+        np.testing.assert_array_equal(ns, n_points)
+        for b in range(B):
+            n = n_points[b]
+            np.testing.assert_array_equal(dt[b, :n], times[b, :n])
+
+    def test_batch_special_values_and_repeats(self):
+        T = 16
+        times = START + (np.arange(T) + 1) * 10**9
+        vals = np.array([1.5, 1.5, 1.5, 0.0, -0.0, np.inf, -np.inf, np.nan,
+                         np.nan, 1e300, 1e-300, 7.0, 7.0, 7.0, -1.25, 2.5])
+        streams = native.encode_batch(times[None, :], vals[None, :],
+                                      np.array([START]), TimeUnit.SECOND)
+        v1 = native.encode_series(times, vals, START, TimeUnit.SECOND)
+        assert streams[0] == v1
+        dt, dv, ns = native.decode_batch(streams, TimeUnit.SECOND,
+                                         max_points=T)
+        assert ns[0] == T
+        got = dv[0, :T].view(np.float64)
+        for a, b in zip(got, vals):
+            assert a == b or (np.isnan(a) and np.isnan(b))
+
+    def test_roundtrip_batch_bench(self, rng):
+        B, T = 128, 60
+        times = np.stack([series(rng, n=T)[0] for _ in range(B)])
+        values = np.stack([series(rng, n=T)[1] for _ in range(B)])
+        rate, lt, lv = native.bench_roundtrip_batch(
+            times, values, START, TimeUnit.SECOND, threads=2)
+        assert rate > 0
+        np.testing.assert_array_equal(lt, times[-1])
+        np.testing.assert_array_equal(lv.view(np.float64), values[-1])
+
+
+class TestHostpathDispatch:
+    def test_encode_blocks_native_on_cpu(self, rng, monkeypatch):
+        from m3_tpu.encoding.m3tsz import hostpath
+        from m3_tpu.utils import dispatch
+
+        monkeypatch.delenv("M3_TPU_DEVICE_OPS", raising=False)
+        B, T = 4, 30
+        times = np.stack([series(rng, n=T)[0] for _ in range(B)])
+        values = np.stack([series(rng, n=T)[1] for _ in range(B)])
+        before = dispatch.counters["m3tsz_encode_native"]
+        streams = hostpath.encode_blocks(
+            times, values.view(np.uint64), np.full(B, START),
+            np.full(B, T, np.int32), TimeUnit.SECOND, False)
+        assert dispatch.counters["m3tsz_encode_native"] == before + 1
+        for b in range(B):
+            t, v = hostpath.decode_stream(streams[b], TimeUnit.SECOND, False)
+            np.testing.assert_array_equal(t, times[b])
