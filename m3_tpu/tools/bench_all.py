@@ -56,34 +56,58 @@ def _block(out):
         pass
 
 
-def config1_codec_roundtrip():
-    """100k-series M3TSZ encode/decode round-trip vs the C++ baseline."""
-    import jax.numpy as jnp
+def _accelerator() -> bool:
+    import jax
 
+    return jax.default_backend() not in ("cpu",)
+
+
+def config1_codec_roundtrip():
+    """100k-series M3TSZ round-trip on the serving path vs the frozen v1
+    scalar C++ baseline (the Go-hot-loop stand-in) — same methodology as
+    bench.py: XLA codec on an accelerator, native v2 batch codec on CPU."""
     from __graft_entry__ import _example_batch
-    from m3_tpu.encoding.m3tsz import native, tpu
+    from m3_tpu.encoding.m3tsz import native
     from m3_tpu.utils.xtime import TimeUnit
 
     B = max(int(100_000 * _scale()), 1024)
     T = 120
     times, vbits, start, n_points = _example_batch(B=B, T=T)
-    jt, jv = jnp.asarray(times), jnp.asarray(vbits)
-    js, jn = jnp.asarray(start), jnp.asarray(n_points)
-    cap = (64 + 80 * T + 11 + 63) // 64
+    values = vbits.view(np.float64)
 
-    def run():
-        blocks = tpu.encode_bits(jt, jv, js, jn, TimeUnit.SECOND, cap)
-        dec = tpu.decode(blocks.words, TimeUnit.SECOND, max_points=T)
-        return blocks.words, dec.times
+    if _accelerator():
+        import jax.numpy as jnp
 
-    dt = _time(run)
-    rate = B * T / dt
+        from m3_tpu.encoding.m3tsz import tpu
+
+        jt, jv = jnp.asarray(times), jnp.asarray(vbits)
+        js, jn = jnp.asarray(start), jnp.asarray(n_points)
+        cap = (64 + 80 * T + 11 + 63) // 64
+
+        def run():
+            blocks = tpu.encode_bits(jt, jv, js, jn, TimeUnit.SECOND, cap)
+            dec = tpu.decode(blocks.words, TimeUnit.SECOND, max_points=T)
+            return blocks.words, dec.times
+
+        dt = _time(run)
+        rate = B * T / dt
+        path = "xla device"
+    elif native.available():
+        native.bench_roundtrip_batch(times, values, int(start[0]),
+                                     TimeUnit.SECOND)  # warm
+        rates = [native.bench_roundtrip_batch(times, values, int(start[0]),
+                                              TimeUnit.SECOND)[0]
+                 for _ in range(3)]
+        rate = sum(rates) / len(rates)
+        path = f"native batch, {native.default_threads()}t"
+    else:
+        _emit(f"#1 m3tsz roundtrip {B}x{T} (no serving codec)", 0.0, 10e6)
+        return
     base = None
     if native.available():
         base = native.bench_roundtrip(
-            times[:4000], vbits.view(np.float64)[:4000], int(start[0]),
-            TimeUnit.SECOND)
-    _emit(f"#1 m3tsz roundtrip {B}x{T}", rate, base or 10e6)
+            times[:4000], values[:4000], int(start[0]), TimeUnit.SECOND)
+    _emit(f"#1 m3tsz roundtrip {B}x{T} [{path}]", rate, base or 10e6)
 
 
 def config2_rollup():
@@ -170,12 +194,23 @@ def config4_regex_postings():
 
 
 def config5_sharded_quantile():
-    """4-shard timer quantile rollup with cross-shard psum on a mesh."""
+    """4-shard timer quantile rollup with explicit cross-shard psum.
+
+    The device program is the flagship ICI pattern: shard_map over the
+    mesh, per-shard selection-based quantile (top_k, NOT a full sort — a
+    p99 over a T-point window needs only the top T-ceil(0.99 T) elements)
+    + local segment sums, then one psum pair across the shard axis. The
+    host baseline is the same computation in numpy (np.partition + add.at,
+    also selection-based — no strawman)."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh, PartitionSpec as P
 
     import m3_tpu.ops  # noqa: F401  (x64)
+
+    shard_map = jax.shard_map if hasattr(jax, "shard_map") else None
+    if shard_map is None:  # older jax
+        from jax.experimental.shard_map import shard_map
 
     n_dev = min(4, len(jax.devices()))
     devices = np.array(jax.devices()[:n_dev])
@@ -183,35 +218,73 @@ def config5_sharded_quantile():
     S = max(int(10_000_000 * _scale()) // 64, 4096)
     S -= S % n_dev
     T = 64
+    G = 128
     rng = np.random.default_rng(2)
     vals = rng.gamma(2.0, 10.0, (S, T))
-    gids = (np.arange(S) % 128).astype(np.int32)
+    gids = (np.arange(S) % G).astype(np.int32)
+    q_idx = int(T * 0.99)
+    k = T - q_idx  # selection depth: sorted[q_idx] == k-th largest
 
-    @jax.jit
-    def quantile_rollup(v, g):
-        # per-series p99-ish via sort, then cross-shard group sums (psum
-        # rides the mesh partitioning through jnp operations under jit)
-        q = jnp.sort(v, axis=1)[:, int(T * 0.99)]
-        seg = jax.ops.segment_sum(q, g, num_segments=128)
-        cnt = jax.ops.segment_sum(jnp.ones_like(q), g, num_segments=128)
+    def kth_largest(v, kk):
+        # iterative masked-max selection over the TIME axis of the
+        # time-major [T, S] elem grid: kk-1 passes peel the larger
+        # elements, pass kk's max is the answer. O(kk*T) elementwise — no
+        # sort, no top_k (XLA:CPU lowers top_k to a full variadic sort;
+        # TPU tiles elementwise reductions onto the VPU directly). The
+        # time-major layout makes each reduction a vertical SIMD op across
+        # series lanes instead of a horizontal within-row reduce (~6x on
+        # XLA:CPU; same orientation the TPU VPU prefers with series on the
+        # 128-lane axis).
+        for _ in range(kk - 1):
+            m = jnp.max(v, axis=0, keepdims=True)
+            # mask exactly one occurrence of the max per series
+            first = jnp.cumsum(v == m, axis=0) == 1
+            v = jnp.where(first & (v == m), -jnp.inf, v)
+        return jnp.max(v, axis=0)
+
+    # group counts depend only on the shard->group placement, not on the
+    # flushed values: precompute once (the host baseline likewise only
+    # does the per-flush work — partition + scatter-add — in its timed
+    # section)
+    cnt_host = np.bincount(gids, minlength=G).astype(np.float64)
+
+    def per_shard(v, g, cnt):
+        q = kth_largest(v, k)
+        seg = jax.ops.segment_sum(q, g, num_segments=G)
+        seg = jax.lax.psum(seg, "shard")
         return seg / cnt
 
-    sharded = NamedSharding(mesh, P("shard", None))
-    jv = jax.device_put(jnp.asarray(vals), sharded)
-    jg = jax.device_put(jnp.asarray(gids), NamedSharding(mesh, P("shard")))
-    with mesh:
-        dt = _time(lambda: quantile_rollup(jv, jg))
+    quantile_rollup = jax.jit(shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(None, "shard"), P("shard"), P()), out_specs=P(),
+    ))
+
+    # the device elem grid is stored time-major [T, S] (layout is ours to
+    # choose for device-resident state); the host baseline keeps its own
+    # best layout (row-major [S, T] for np.partition)
+    jv = jax.device_put(jnp.asarray(vals.T.copy()),
+                        jax.NamedSharding(mesh, P(None, "shard")))
+    jg = jax.device_put(jnp.asarray(gids), jax.NamedSharding(mesh, P("shard")))
+    jc = jax.device_put(jnp.asarray(np.maximum(cnt_host, 1.0)),
+                        jax.NamedSharding(mesh, P()))
+    dt = _time(lambda: quantile_rollup(jv, jg, jc))
+
     # host numpy baseline of the same computation
     def host():
-        q = np.sort(vals, axis=1)[:, int(T * 0.99)]
-        out = np.zeros(128)
+        q = np.partition(vals, q_idx, axis=1)[:, q_idx]
+        out = np.zeros(G)
         np.add.at(out, gids, q)
         return out
 
     t0 = time.perf_counter()
-    host()
-    dt_host = time.perf_counter() - t0
-    _emit(f"#5 {n_dev}-shard timer quantile rollup {S}x{T}",
+    for _ in range(3):
+        host()
+    dt_host = (time.perf_counter() - t0) / 3
+    # correctness: device result == host result
+    dev = np.asarray(quantile_rollup(jv, jg, jc))
+    ok = np.allclose(dev, host() / np.maximum(cnt_host, 1), rtol=1e-9)
+    _emit(f"#5 {n_dev}-shard timer quantile rollup {S}x{T}"
+          + ("" if ok else " (CORRECTNESS FAILED)"),
           S * T / dt, S * T / dt_host)
 
 
