@@ -264,6 +264,12 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             print(f"native CPU bench failed: {e}", file=sys.stderr)
             safe = None
+        if safe and "CORRECTNESS FAILED" in safe.get("metric", ""):
+            # a wrong-answer native result must not block the scrubbed-env
+            # XLA:CPU last resort (round-4 ADVICE finding)
+            print("native CPU bench failed correctness; trying XLA:CPU",
+                  file=sys.stderr)
+            safe = None
         if not safe:
             # last resort (no compiler): scrubbed-env XLA:CPU child
             print("retrying bench with scrubbed CPU env", file=sys.stderr)

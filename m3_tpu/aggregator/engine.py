@@ -135,6 +135,7 @@ class Aggregator:
         self._watermark_ns = 0
         self._elem_res: list[int] = []
         self._elem_second: list[bool] = []
+        self._n_quantile_elems = 0
         # completion time of the previous flush: second-stage windows may
         # only close once EVERY source window feeding them was forwarded,
         # i.e. when their end precedes the previous flush's watermark
@@ -155,6 +156,8 @@ class Aggregator:
             self._elem_list.append(e)
             self._elem_res.append(key.policy.resolution_ns)
             self._elem_second.append(second_stage)
+            if any(a.quantile is not None for a in key.aggregations):
+                self._n_quantile_elems += 1
         return e
 
     def add(
@@ -254,7 +257,8 @@ class Aggregator:
                 continue
             w_c = t_c // res[closed]  # window id in units of resolution
             ge, gw, stats, vq, offsets = windowed_agg.aggregate_groups(
-                e_c, w_c, v_c, order_seq=np.arange(len(e_c)), times=t_c
+                e_c, w_c, v_c, order_seq=np.arange(len(e_c)), times=t_c,
+                need_sorted=self._n_quantile_elems > 0,
             )
             out.extend(self._emit(ge, gw, stats, vq, offsets))
         out.sort(key=lambda m: (m.timestamp_ns, m.series_id))

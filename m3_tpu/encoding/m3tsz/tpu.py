@@ -152,7 +152,9 @@ def encode(
     """Encode from float64 values.
 
     Host/CPU convenience wrapper: the TPU X64 rewriter implements the
-    u64->f64 bitcast but NOT the f64->u64 direction (probed on v5e), so the
+    u64->f64 bitcast but NOT the f64->u64 direction (per the rewriter's
+    lowering rules; NOT verified on TPU hardware from this environment —
+    the tunnel has been down every round), so the
     jitted kernel (encode_bits) takes pre-bitcast uint64 value bits — a free
     numpy view on the host ingest path, and the device-resident
     representation the storage engine keeps anyway. decode's u64->f64
@@ -323,8 +325,10 @@ def _pack_stream_tree(ts_hi, ts_lo, ts_len, v_hi, v_lo, v_len, valid,
     """Assemble per-dp (timestamp, value) u64 bit fields into the output
     word tensor by log-tree bit concatenation — no scatter.
 
-    Scatter on TPU costs ~12ns per scattered element (measured v5e), which
-    made the original 4-piece scatter-add packer the encode bottleneck.
+    Scatter on TPU costs on the order of ~10ns per scattered element
+    (ESTIMATE from scatter's serialized lowering; no TPU run has validated
+    it from this environment), which would make the original 4-piece
+    scatter-add packer the encode bottleneck.
     Instead: each datapoint becomes a top-aligned u32 limb register; the
     [start prefix] + T dp registers + [EOS] slot sequence is then combined
     pairwise — result = A | (B >> lenA), with the variable shift decomposed
@@ -597,7 +601,8 @@ def _decode_shift(
     register and consumes each datapoint from its top — static slices for
     the parse, then a log-decomposed left shift by the datapoint's length.
     This replaces the per-step `read_window` gathers of the original design
-    (~10 gathers x 16ns/element/step on v5e dominated decode) with pure
+    (an estimated ~10 gathers x O(10ns)/element/step would dominate decode;
+    estimate, not measured on TPU from this environment) with pure
     elementwise work that XLA tiles; throughput comes from the batch axis
     and HBM bandwidth.
     """

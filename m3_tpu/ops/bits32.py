@@ -2,8 +2,10 @@
 
 TPUs have no native 64-bit integers: every u64 op in a kernel is emulated by
 the XLA X64 rewriter (~2-10x cost), and scatter/gather lower to
-per-element loops (~12-16 ns/element measured on v5e — hundreds of ms for a
-1M-datapoint block). These helpers exist so the codec hot loops can run as
+per-element loops (estimated ~10ns/element from their serialized lowering —
+NOT validated on TPU hardware from this environment — i.e. hundreds of ms
+for a 1M-datapoint block). These helpers exist so the codec hot loops can
+run as
 pure 32-bit elementwise ops on whole `[..., W]` limb tensors:
 
 - **limb registers**: a bit stream is a row of u32 limbs, MSB-first

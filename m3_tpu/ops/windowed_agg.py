@@ -18,6 +18,7 @@ kernel. Inputs are padded to a power of two so XLA compiles O(log) shapes.
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -26,6 +27,14 @@ from m3_tpu.utils import dispatch
 
 # device sort+segment-reduce pays off later than pure elementwise ops
 DEVICE_THRESHOLD = 32_768
+# below this the numpy path wins over the FFI round trip
+NATIVE_THRESHOLD = 4_096
+
+
+def _order_is_append(order_seq: np.ndarray) -> bool:
+    return (len(order_seq) == 0
+            or (order_seq[0] == 0 and order_seq[-1] == len(order_seq) - 1
+                and bool((np.diff(order_seq) == 1).all())))
 
 
 def aggregate_groups(
@@ -34,11 +43,14 @@ def aggregate_groups(
     values: np.ndarray,  # [N] float64
     order_seq: np.ndarray | None = None,  # [N] append order (LAST tiebreak)
     times: np.ndarray | None = None,  # [N] timestamps; LAST = max time
+    need_sorted: bool = True,  # grouped-sorted vq (quantile input) wanted?
 ):
     """Group by (elem, window) and compute every base statistic.
 
     Returns (group_elem, group_window, stats dict of [G] arrays, and a
     grouped-sorted values array + group offsets for quantile extraction).
+    With ``need_sorted=False`` the returned vq is empty (callers with no
+    quantile aggregations skip the grouped sort entirely).
     """
     n = len(values)
     if order_seq is None:
@@ -50,6 +62,20 @@ def aggregate_groups(
     if device:
         return _aggregate_groups_device(elem_ids, window_ids, values,
                                         order_seq, times)
+    # CPU serving path: the native columnar kernel when available and the
+    # flush is big enough to amortize the FFI call. The native "last" uses
+    # (time, append-index) — identical to the numpy (time, order_seq)
+    # tiebreak only when order_seq IS append order, which every engine
+    # caller passes; custom order_seq falls through to numpy. NaN values
+    # fall through too (native min/max comparisons would skip NaNs).
+    if (n >= NATIVE_THRESHOLD and os.environ.get("M3_TPU_NATIVE_OPS") != "0"
+            and _order_is_append(order_seq)):
+        from m3_tpu.ops import native_hostops
+
+        if native_hostops.available() and not np.isnan(values).any():
+            dispatch.counters["windowed_agg.aggregate_groups[native]"] += 1
+            return native_hostops.agg_groups(elem_ids, window_ids, values,
+                                             times, want_sorted=need_sorted)
     # group identity via lexsort on (elem, window); within a group rows
     # order by (time, append-seq) so LAST = latest timestamp, ties -> the
     # later append (reference gauge lastAt semantics)
@@ -80,7 +106,8 @@ def aggregate_groups(
     last = v[offsets[1:] - 1]  # order_seq tiebreak: last append wins
 
     # grouped sort for quantiles: sort values WITHIN groups
-    vq = values[np.lexsort((values, window_ids, elem_ids))]
+    vq = (values[np.lexsort((values, window_ids, elem_ids))]
+          if need_sorted else np.empty(0))
 
     stats = {
         "count": counts,

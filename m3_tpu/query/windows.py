@@ -18,6 +18,8 @@ segment-op equivalent).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from m3_tpu.utils import dispatch
@@ -210,20 +212,16 @@ def extrapolated_rate(
     the first/last samples are further than 1.1x the average sample spacing
     from them, and (counters) cap start extrapolation at the zero point.
     """
-    lo, hi = raws.window_bounds(eval_ts, range_ns)
-    count = (hi - lo).astype(np.float64)
-    ok = count >= 2
     n = len(raws.values)
-    safe_lo = np.clip(lo, 0, max(n - 1, 0))
-    safe_hi = np.clip(hi - 1, 0, max(n - 1, 0))
     if n == 0:
-        return np.full(lo.shape, np.nan)
+        return np.full((raws.n_series, len(eval_ts)), np.nan)
 
     device = _use_device(raws, eval_ts)
     dispatch.record("temporal.extrapolated_rate", device)
     if device:
         from m3_tpu.ops import temporal
 
+        lo, hi = raws.window_bounds(eval_ts, range_ns)
         adj = (temporal.reset_adjusted(raws.values, raws.offsets)
                if is_counter else raws.values)
         return temporal.extrapolated_rate(
@@ -231,6 +229,26 @@ def extrapolated_rate(
             is_counter, is_rate,
         )
 
+    # CPU serving path: the native columnar kernel (same math, pointer-walk
+    # windows — skips the per-series searchsorted loop entirely) when
+    # available and the fetch is big enough to amortize FFI; requires the
+    # ascending step grid the engine always evaluates on.
+    work = n + raws.n_series * len(eval_ts)
+    if (work >= 16_384 and os.environ.get("M3_TPU_NATIVE_OPS") != "0"
+            and len(eval_ts) > 0 and bool((np.diff(eval_ts) >= 0).all())):
+        from m3_tpu.ops import native_hostops
+
+        if native_hostops.available():
+            dispatch.counters["temporal.extrapolated_rate[native]"] += 1
+            return native_hostops.rate_csr(raws.times, raws.values,
+                                           raws.offsets, eval_ts, range_ns,
+                                           is_counter, is_rate)
+
+    lo, hi = raws.window_bounds(eval_ts, range_ns)
+    count = (hi - lo).astype(np.float64)
+    ok = count >= 2
+    safe_lo = np.clip(lo, 0, max(n - 1, 0))
+    safe_hi = np.clip(hi - 1, 0, max(n - 1, 0))
     v = _reset_adjusted(raws) if is_counter else raws.values
     first_v = v[safe_lo]
     last_v = v[safe_hi]

@@ -1,0 +1,367 @@
+// Native CPU host ops for the aggregation / temporal-math serving paths.
+//
+// Role: the C++ stand-in for the reference's hand-optimized Go hot loops on
+// hosts without an accelerator — the same architecture slot the native
+// m3tsz batch codec fills for encode/decode (SURVEY.md §2.9: native host
+// layer where Python latency would dominate). Two kinds of entry point:
+//
+//  * Serving-path kernels, dispatched by m3_tpu/ops/windowed_agg.py and
+//    m3_tpu/query/windows.py when no accelerator is live:
+//      - m3_agg_groups: columnar grouped aggregation over (elem, window)
+//        keys (radix-sorted, one linear stats pass) — the flush reduction
+//        behind aggregator.Aggregator.flush. Mirrors the semantics of the
+//        reference's streaming accumulators
+//        (/root/reference/src/aggregator/aggregation/counter.go:31-139)
+//        computed batch-at-once instead of per-sample.
+//      - m3_rate_csr: columnar extrapolated rate/increase/delta over CSR
+//        series (pointer-walk windows, row-local reset adjustment) —
+//        upstream Prometheus extrapolatedRate semantics, identical math to
+//        the numpy path in m3_tpu/query/windows.py
+//        (/root/reference/src/query/functions/temporal/rate.go role).
+//
+//  * Measured scalar baselines for bench_all (reference cost-model
+//    stand-ins, the config-#1 methodology):
+//      - m3_agg_baseline_scalar: per-sample string-keyed entry lookup +
+//        per-entry mutex + accumulator update — the reference aggregator's
+//        AddUntimed hot loop shape (aggregator/aggregator/map.go entry
+//        lookup, entry.go lock, aggregation/counter.go update).
+//      - m3_rate_baseline_scalar: per-(series, step) window re-scan with
+//        in-window reset detection — the prometheus/reference engine shape
+//        (each output step re-iterates its window's samples).
+//
+// Both baselines compute the same outputs as the serving kernels so the
+// bench can assert correctness instead of racing a strawman.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr double kNS = 1e9;
+
+// ---------------------------------------------------------------------------
+// LSD radix sort of indices by a u64 key (stable). Digit width 8.
+// ---------------------------------------------------------------------------
+
+void radix_sort_indices(const std::vector<uint64_t>& keys,
+                        std::vector<uint32_t>& idx,
+                        std::vector<uint32_t>& scratch,
+                        uint64_t key_max) {
+    const size_t n = idx.size();
+    int passes = 0;
+    while (key_max) { passes++; key_max >>= 8; }
+    if (passes == 0) return;
+    uint32_t* src = idx.data();
+    uint32_t* dst = scratch.data();
+    for (int p = 0; p < passes; p++) {
+        const int shift = p * 8;
+        size_t count[257] = {0};
+        for (size_t i = 0; i < n; i++)
+            count[((keys[src[i]] >> shift) & 0xff) + 1]++;
+        for (int d = 0; d < 256; d++) count[d + 1] += count[d];
+        for (size_t i = 0; i < n; i++)
+            dst[count[(keys[src[i]] >> shift) & 0xff]++] = src[i];
+        std::swap(src, dst);
+    }
+    if (src != idx.data())
+        memcpy(idx.data(), src, n * sizeof(uint32_t));
+}
+
+int bits_for(uint64_t range) {
+    int b = 0;
+    while (range) { b++; range >>= 1; }
+    return b;
+}
+
+template <typename F>
+void parallel_rows(int64_t n, int nthreads, F fn) {
+    if (nthreads <= 1 || n < 2) {
+        for (int64_t i = 0; i < n; i++) fn(i);
+        return;
+    }
+    std::vector<std::thread> ts;
+    int64_t chunk = (n + nthreads - 1) / nthreads;
+    for (int t = 0; t < nthreads; t++) {
+        int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+        if (lo >= hi) break;
+        ts.emplace_back([=]() { for (int64_t i = lo; i < hi; i++) fn(i); });
+    }
+    for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Columnar grouped aggregation: group rows by (elem, window), compute every
+// base statistic per group. Rows within a group keep append order (stable
+// sort), so "last" = the row with max (time, append index) — the reference
+// gauge lastAt tiebreak. Returns G (#groups) or -1 on error.
+// All out_* arrays must hold n elements (G <= n); out_offsets n+1.
+// want_sorted != 0 additionally fills out_vq with values sorted ascending
+// WITHIN each group (quantile extraction input).
+int64_t m3_agg_groups(
+    const int64_t* e, const int64_t* w, const double* v, const int64_t* t,
+    int64_t n, int32_t want_sorted,
+    int64_t* out_e, int64_t* out_w,
+    double* out_count, double* out_sum, double* out_sumsq,
+    double* out_min, double* out_max, double* out_mean,
+    double* out_last, double* out_stdev,
+    double* out_vq, int64_t* out_offsets) {
+    if (n <= 0) { out_offsets[0] = 0; return 0; }
+    if (n > INT32_MAX) return -1;
+
+    int64_t e_min = e[0], e_max = e[0], w_min = w[0], w_max = w[0];
+    for (int64_t i = 1; i < n; i++) {
+        e_min = std::min(e_min, e[i]); e_max = std::max(e_max, e[i]);
+        w_min = std::min(w_min, w[i]); w_max = std::max(w_max, w[i]);
+    }
+    const uint64_t e_range = (uint64_t)(e_max - e_min);
+    const uint64_t w_range = (uint64_t)(w_max - w_min);
+    const int wbits = bits_for(w_range);
+
+    std::vector<uint32_t> idx(n), scratch(n);
+    for (int64_t i = 0; i < n; i++) idx[i] = (uint32_t)i;
+
+    if (bits_for(e_range) + wbits <= 64) {
+        std::vector<uint64_t> keys(n);
+        for (int64_t i = 0; i < n; i++)
+            keys[i] = ((uint64_t)(e[i] - e_min) << wbits) |
+                      (uint64_t)(w[i] - w_min);
+        radix_sort_indices(keys, idx, scratch,
+                           (e_range << wbits) | ((1ull << wbits) - 1));
+    } else {
+        std::stable_sort(idx.begin(), idx.end(),
+                         [&](uint32_t a, uint32_t b) {
+                             if (e[a] != e[b]) return e[a] < e[b];
+                             return w[a] < w[b];
+                         });
+    }
+
+    // one linear pass over the sorted order
+    int64_t G = -1;
+    int64_t cur_e = 0, cur_w = 0;
+    double cnt = 0, s1 = 0, s2 = 0, mn = 0, mx = 0, last_v = 0;
+    int64_t last_t = 0; uint32_t last_i = 0;
+    auto close_group = [&]() {
+        if (G < 0) return;
+        out_count[G] = cnt; out_sum[G] = s1; out_sumsq[G] = s2;
+        out_min[G] = mn; out_max[G] = mx;
+        double mean = s1 / cnt;
+        out_mean[G] = mean;
+        out_last[G] = last_v;
+        double var = s2 / cnt - mean * mean;
+        out_stdev[G] = std::sqrt(var > 0 ? var : 0.0);
+    };
+    for (int64_t k = 0; k < n; k++) {
+        const uint32_t i = idx[k];
+        if (G < 0 || e[i] != cur_e || w[i] != cur_w) {
+            close_group();
+            G++;
+            cur_e = e[i]; cur_w = w[i];
+            out_e[G] = cur_e; out_w[G] = cur_w;
+            out_offsets[G] = k;
+            cnt = 0; s1 = 0; s2 = 0;
+            mn = v[i]; mx = v[i];
+            last_v = v[i]; last_t = t[i]; last_i = i;
+        }
+        const double x = v[i];
+        cnt += 1.0; s1 += x; s2 += x * x;
+        if (x < mn) mn = x;
+        if (x > mx) mx = x;
+        // last by (time, append index): stable sort preserves append order,
+        // but out-of-order timestamps within a group need the explicit max
+        if (t[i] > last_t || (t[i] == last_t && i >= last_i)) {
+            last_t = t[i]; last_i = i; last_v = x;
+        }
+    }
+    close_group();
+    G++;
+    out_offsets[G] = n;
+
+    if (want_sorted) {
+        for (int64_t k = 0; k < n; k++) out_vq[k] = v[idx[k]];
+        for (int64_t g = 0; g < G; g++)
+            std::sort(out_vq + out_offsets[g], out_vq + out_offsets[g + 1]);
+    }
+    return G;
+}
+
+// Reference-cost-model scalar baseline: per-sample string-keyed entry
+// lookup + per-entry lock + streaming accumulator update, then a flush
+// walk emitting each (entry, window) sum. ids = concatenated id bytes with
+// id_off[n+1] boundaries (the UNRESOLVED metric IDs the reference hashes on
+// every add — aggregator/aggregator/map.go). Returns the total of all
+// window sums (correctness checksum) or NaN on error.
+double m3_agg_baseline_scalar(
+    const char* ids, const int64_t* id_off, const int64_t* w,
+    const double* v, int64_t n) {
+    struct WinStats {
+        int64_t w;
+        double cnt = 0, sum = 0, sumsq = 0, mn = 0, mx = 0, last = 0;
+    };
+    struct Entry {
+        std::mutex mu;
+        std::vector<WinStats> wins;  // reference keeps per-resolution
+                                     // windows in a small list
+    };
+    std::unordered_map<std::string, Entry*> map;
+    map.reserve((size_t)(n / 4 + 16));
+    std::vector<Entry*> owned;
+    owned.reserve((size_t)(n / 4 + 16));
+
+    for (int64_t i = 0; i < n; i++) {
+        std::string id(ids + id_off[i], (size_t)(id_off[i + 1] - id_off[i]));
+        auto it = map.find(id);
+        Entry* ent;
+        if (it == map.end()) {
+            ent = new Entry();
+            owned.push_back(ent);
+            map.emplace(std::move(id), ent);
+        } else {
+            ent = it->second;
+        }
+        std::lock_guard<std::mutex> lk(ent->mu);
+        WinStats* ws = nullptr;
+        for (auto rit = ent->wins.rbegin(); rit != ent->wins.rend(); ++rit)
+            if (rit->w == w[i]) { ws = &*rit; break; }
+        if (!ws) {
+            ent->wins.push_back(WinStats{w[i]});
+            ws = &ent->wins.back();
+            ws->mn = v[i]; ws->mx = v[i];
+        }
+        const double x = v[i];
+        ws->cnt += 1.0; ws->sum += x; ws->sumsq += x * x;
+        if (x < ws->mn) ws->mn = x;
+        if (x > ws->mx) ws->mx = x;
+        ws->last = x;
+    }
+    double total = 0;
+    for (Entry* ent : owned) {
+        for (const auto& ws : ent->wins) total += ws.sum;
+        delete ent;
+    }
+    return total;
+}
+
+// Columnar extrapolated rate/increase/delta over CSR series. Identical
+// math (same operation order) to the numpy host path in
+// m3_tpu/query/windows.py::extrapolated_rate, which mirrors upstream
+// Prometheus extrapolatedRate. eval_ts must be ascending (the engine's
+// step grid always is). out is [S, K] row-major.
+void m3_rate_csr(
+    const int64_t* times, const double* values, const int64_t* offsets,
+    int64_t S, const int64_t* eval_ts, int64_t K, int64_t range_ns,
+    int32_t is_counter, int32_t is_rate, int32_t nthreads, double* out) {
+    const double nan = std::numeric_limits<double>::quiet_NaN();
+    const double range_s = (double)range_ns / kNS;
+    parallel_rows(S, nthreads, [&](int64_t s) {
+        const int64_t a = offsets[s], b = offsets[s + 1];
+        double* row_out = out + s * K;
+        // row-local reset adjustment: adj[i] = v[i] + cumulative drops
+        std::vector<double> adj;
+        if (is_counter) {
+            adj.resize((size_t)(b - a));
+            double cum = 0;
+            for (int64_t i = a; i < b; i++) {
+                if (i > a && values[i] < values[i - 1]) cum += values[i - 1];
+                adj[(size_t)(i - a)] = values[i] + cum;
+            }
+        }
+        int64_t lo = a, hi = a;
+        for (int64_t k = 0; k < K; k++) {
+            const int64_t ts = eval_ts[k];
+            const int64_t ws = ts - range_ns;
+            while (hi < b && times[hi] <= ts) hi++;
+            while (lo < b && times[lo] <= ws) lo++;
+            const int64_t count = hi - lo;
+            if (count < 2) { row_out[k] = nan; continue; }
+            const double first_v = is_counter ? adj[(size_t)(lo - a)]
+                                              : values[lo];
+            const double last_v = is_counter ? adj[(size_t)(hi - 1 - a)]
+                                             : values[hi - 1];
+            const double raw_first = values[lo];
+            const double first_t = (double)times[lo];
+            const double last_t = (double)times[hi - 1];
+            double result = last_v - first_v;
+            const double sampled = (last_t - first_t) / kNS;
+            if (!(sampled > 0)) { row_out[k] = nan; continue; }
+            double dur_start = (first_t - (double)ws) / kNS;
+            double dur_end = ((double)ts - last_t) / kNS;
+            const double avg = sampled / (double)(count - 1);
+            const double thr = avg * 1.1;
+            if (is_counter && result > 0 && raw_first >= 0) {
+                const double dur_zero = sampled * (raw_first / result);
+                if (dur_zero < dur_start) dur_start = dur_zero;
+            }
+            if (dur_start >= thr) dur_start = avg / 2;
+            if (dur_end >= thr) dur_end = avg / 2;
+            const double extrap = sampled + dur_start + dur_end;
+            const double factor = extrap / sampled;
+            double o = result * factor;
+            if (is_rate) o = o / range_s;
+            row_out[k] = o;
+        }
+    });
+}
+
+// Reference-cost-model scalar baseline: each (series, step) re-scans its
+// window's samples (binary-searched bounds, in-window reset detection) —
+// the per-step iteration shape of the prometheus engine / reference
+// temporal ops. Computes the same outputs as m3_rate_csr.
+void m3_rate_baseline_scalar(
+    const int64_t* times, const double* values, const int64_t* offsets,
+    int64_t S, const int64_t* eval_ts, int64_t K, int64_t range_ns,
+    int32_t is_counter, int32_t is_rate, double* out) {
+    const double nan = std::numeric_limits<double>::quiet_NaN();
+    const double range_s = (double)range_ns / kNS;
+    for (int64_t s = 0; s < S; s++) {
+        const int64_t a = offsets[s], b = offsets[s + 1];
+        double* row_out = out + s * K;
+        for (int64_t k = 0; k < K; k++) {
+            const int64_t ts = eval_ts[k];
+            const int64_t ws = ts - range_ns;
+            const int64_t* lo_p = std::upper_bound(times + a, times + b, ws);
+            const int64_t* hi_p = std::upper_bound(lo_p, times + b, ts);
+            const int64_t lo = lo_p - times, hi = hi_p - times;
+            const int64_t count = hi - lo;
+            if (count < 2) { row_out[k] = nan; continue; }
+            // in-window scan: reset-adjusted delta from first to last
+            double cum = 0;
+            if (is_counter)
+                for (int64_t i = lo + 1; i < hi; i++)
+                    if (values[i] < values[i - 1]) cum += values[i - 1];
+            const double raw_first = values[lo];
+            double result = (values[hi - 1] + cum) - raw_first;
+            const double first_t = (double)times[lo];
+            const double last_t = (double)times[hi - 1];
+            const double sampled = (last_t - first_t) / kNS;
+            if (!(sampled > 0)) { row_out[k] = nan; continue; }
+            double dur_start = (first_t - (double)ws) / kNS;
+            double dur_end = ((double)ts - last_t) / kNS;
+            const double avg = sampled / (double)(count - 1);
+            const double thr = avg * 1.1;
+            if (is_counter && result > 0 && raw_first >= 0) {
+                const double dur_zero = sampled * (raw_first / result);
+                if (dur_zero < dur_start) dur_start = dur_zero;
+            }
+            if (dur_start >= thr) dur_start = avg / 2;
+            if (dur_end >= thr) dur_end = avg / 2;
+            const double extrap = sampled + dur_start + dur_end;
+            const double factor = extrap / sampled;
+            double o = result * factor;
+            if (is_rate) o = o / range_s;
+            row_out[k] = o;
+        }
+    }
+}
+
+}  // extern "C"

@@ -26,6 +26,12 @@
 #include <thread>
 #include <vector>
 
+// The v2 FastWriter/FastReader word path assumes a little-endian host
+// (bswap64 + memcpy); a big-endian build would silently break the
+// byte-identical stream contract v1 keeps, so refuse to compile there.
+static_assert(__BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__,
+              "v2 batch codec requires a little-endian host");
+
 namespace {
 
 struct BitWriter {
