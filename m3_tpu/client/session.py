@@ -22,6 +22,7 @@ rejection feeds the same consistency accounting as a network failure.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Protocol
 
@@ -75,6 +76,9 @@ class Session:
         self._breaker_config = breaker_config or BreakerConfig()
         self._breaker_clock = breaker_clock
         self._policies: dict[str, object] = {}
+        # concurrent writers race host_policy's check-then-insert; a lock
+        # keeps one HostPolicy (and so one breaker state) per host
+        self._policies_lock = threading.Lock()
 
     def host_policy(self, host: str):
         """The host's breaker+retry policy (created on first use); every
@@ -85,14 +89,15 @@ class Session:
 
         from m3_tpu.client.breaker import HostPolicy
 
-        pol = self._policies.get(host)
-        if pol is None:
-            pol = HostPolicy(
-                host, self._breaker_config,
-                clock=self._breaker_clock or _time.monotonic,
-            )
-            self._policies[host] = pol
-        return pol
+        with self._policies_lock:
+            pol = self._policies.get(host)
+            if pol is None:
+                pol = HostPolicy(
+                    host, self._breaker_config,
+                    clock=self._breaker_clock or _time.monotonic,
+                )
+                self._policies[host] = pol
+            return pol
 
     def _host_call(self, host: str, fn, *args, **kwargs):
         return self.host_policy(host).call(fn, *args, **kwargs)

@@ -11,14 +11,32 @@ process re-polled once per tick (cluster/kv.py FileKVStore.refresh) —
 functional, but pull-based and host-local.
 
 This module is the push-based replacement, redesigned rather than ported:
-one kvd process (optionally file-journaled for durability) serializes all
-mutations — a single writer IS linearizable, the same trick the reference
-leans on etcd's raft leader for — and streams change events to every
-subscribed client over server-streaming gRPC, so placement changes,
-rule updates, and election flips propagate in milliseconds without any
-polling. Leases give liveness: a key written under a lease vanishes when
-its owner stops sending keep-alives (process death included), which is
-what makes kill-the-leader failover work.
+one kvd process (file-journaled by default) serializes all mutations — a
+single writer IS linearizable, the same trick the reference leans on
+etcd's raft leader for — and streams change events to every subscribed
+client over server-streaming gRPC, so placement changes, rule updates,
+and election flips propagate in milliseconds without any polling. Leases
+give liveness: a key written with ``ephemeral=True`` attaches to its
+writer's session lease and vanishes when the owner stops sending
+keep-alives (process death included) — which is what makes
+kill-the-leader failover work. Plain writes are persistent (etcd
+put-without-lease semantics).
+
+Survivability (round-4 hardening):
+- revisions are epoch-based, monotonic across restarts, so surviving
+  clients never drop post-restart events as replays;
+- the ephemeral-key set is journaled (reserved key ``_kvd/eph``); a
+  restarted or promoted server grace-leases restored ephemeral keys —
+  dead owners' election keys are reaped after the grace TTL while live
+  owners re-grant their session (keepalive "notfound" → re-grant +
+  re-assert) and keep their keys;
+- a standby (``--standby-of``) replicates the primary over its Watch
+  stream and promotes itself when the primary stays unreachable; clients
+  accept a comma-separated target list and fail over on transport errors
+  or standby rejections. Single-standby promotion is NOT a quorum
+  protocol (a partitioned primary plus a promoted standby can dual-write;
+  the reference avoids this with raft-replicated etcd — documented
+  deployment caveat).
 
 Wire schema (hand-rolled protowire over raw-bytes gRPC, house style of
 query/remote.py — no protobuf codegen):
@@ -50,6 +68,7 @@ from concurrent import futures
 from m3_tpu.cluster.kv import (
     FileKVStore,
     KeyNotFound,
+    KVError,
     KVStore,
     VersionedValue,
     VersionMismatch,
@@ -192,8 +211,17 @@ class KvdServer:
     replication of kvd itself is a deployment concern, as running etcd is
     for the reference)."""
 
+    # reserved store key tracking which keys are lease-attached; rides the
+    # journal AND standby replication, so a restarted/promoted server knows
+    # which restored keys are ephemeral and must be grace-reaped unless
+    # their owner re-attaches (etcd persists leases in raft state; this is
+    # the single-writer equivalent)
+    EPH_KEY = "_kvd/eph"
+
     def __init__(self, listen: str, journal_path: str | None = None,
-                 max_workers: int = 16):
+                 max_workers: int = 16, standby_of: str | None = None,
+                 promote_after_s: float = 5.0,
+                 orphan_grace_ms: int = 10_000):
         import grpc
 
         self.store: KVStore = FileKVStore(journal_path) if journal_path else KVStore()
@@ -201,14 +229,25 @@ class KvdServer:
         self._key_lease: dict[str, int] = {}  # current lease owner per key
         self._lease_seq = int(time.time() * 1e3) % 1_000_000 * 1_000
         self._lock = threading.Lock()
+        self._eph_persist_lock = threading.Lock()
         self._subs: list[tuple[str, queue.SimpleQueue]] = []
         self._closed = threading.Event()
+        self._orphan_grace_ms = orphan_grace_ms
         # server-global revision, stamped on every change event: versions
         # restart at 1 when a key is deleted and re-created, so clients
         # dedupe replayed events by revision, not version (etcd's
-        # store-revision idea)
-        self._rev = 0
+        # store-revision idea). EPOCH-BASED so it stays monotonic across a
+        # restart — a fresh counter would start below clients' cached revs
+        # and every post-restart event would be silently dropped as a
+        # replay (round-4 advisor finding).
+        self._rev = (time.time_ns() // 1_000_000) << 16
         self._key_rev: dict[str, int] = {}
+        # standby mode: follow a primary until it dies, then promote
+        self._standby = threading.Event()
+        self._promote_after_s = promote_after_s
+        self._primary = standby_of
+        if standby_of:
+            self._standby.set()
 
         # every store mutation fans out to subscriber queues (the store
         # has per-key watches only, so intercept its notify fanout)
@@ -244,6 +283,37 @@ class KvdServer:
         self._server.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
+        if standby_of:
+            self._follower = threading.Thread(target=self._follow_loop,
+                                              daemon=True)
+            self._follower.start()
+        else:
+            # journal restore: grace-lease restored ephemeral keys so a
+            # dead owner's election/advert keys are reaped (after the
+            # grace TTL) instead of wedging failover forever, while a
+            # LIVE owner re-attaches on its next session re-grant
+            self._grace_lease_ephemerals()
+
+    def _grace_lease_ephemerals(self) -> None:
+        try:
+            eph = json.loads(self.store.get(self.EPH_KEY).data.decode())
+        except (KeyNotFound, ValueError):
+            return
+        present = [k for k in eph if k in self.store.keys()]
+        if not present:
+            return
+        with self._lock:
+            self._lease_seq += 1
+            grace = _Lease(self._lease_seq, self._orphan_grace_ms)
+            self._leases[grace.lease_id] = grace
+        for k in present:
+            with self._lock:
+                if self._key_lease.get(k):
+                    # a live owner already re-attached (its keepalive beat
+                    # this restore) — don't steal its key for the grace
+                    # lease, that would reap a healthy leader
+                    continue
+            self._attach_lease(k, grace.lease_id, persist=False)
 
     # -- store-change fanout --
 
@@ -281,12 +351,16 @@ class KvdServer:
         return _enc_resp(version=vv.version, data=vv.data)
 
     def _set(self, req: bytes, ctx) -> bytes:
+        if self._standby.is_set():
+            return _enc_resp(err="standby")
         key, data, _exp, lease, _p, _t = _dec_req(req)
         version = self.store.set(key, data)
         self._attach_lease(key, lease)  # lease 0 detaches a prior owner
         return _enc_resp(version=version)
 
     def _cas(self, req: bytes, ctx) -> bytes:
+        if self._standby.is_set():
+            return _enc_resp(err="standby")
         key, data, expect, lease, _p, _t = _dec_req(req)
         try:
             version = self.store.check_and_set(key, expect or 0, data)
@@ -296,6 +370,8 @@ class KvdServer:
         return _enc_resp(version=version)
 
     def _delete(self, req: bytes, ctx) -> bytes:
+        if self._standby.is_set():
+            return _enc_resp(err="standby")
         key, *_ = _dec_req(req)
         try:
             self.store.delete(key)
@@ -310,19 +386,44 @@ class KvdServer:
 
     # -- leases --
 
-    def _attach_lease(self, key: str, lease_id: int) -> None:
+    def _attach_lease(self, key: str, lease_id: int,
+                      persist: bool = True) -> None:
         """Make lease_id (0 = none) the key's ONLY lease owner. Every
         write/delete re-resolves ownership, so a key re-created by a new
         client is never reaped by a previous owner's lease expiry."""
         with self._lock:
+            had = key in self._key_lease
             old = self._key_lease.pop(key, None)
             if old is not None and old in self._leases:
                 self._leases[old].keys.discard(key)
-            if lease_id and lease_id in self._leases:
+            attached = bool(lease_id and lease_id in self._leases)
+            if attached:
                 self._leases[lease_id].keys.add(key)
                 self._key_lease[key] = lease_id
+        if persist and attached != had:
+            self._persist_eph()
+
+    def _persist_eph(self) -> None:
+        """Journal the ephemeral-key set under EPH_KEY (skipping the
+        broadcast-triggering set when nothing changed). Serialized by its
+        own lock so concurrent attach/expire can't journal a stale
+        snapshot last (the snapshot is taken while holding it; _lock alone
+        can't be held across store.set — the broadcast re-takes it)."""
+        with self._eph_persist_lock:
+            with self._lock:
+                eph = sorted(self._key_lease)
+            data = json.dumps(eph).encode()
+            try:
+                if self.store.get(self.EPH_KEY).data == data:
+                    return
+            except KeyNotFound:
+                if not eph:
+                    return
+            self.store.set(self.EPH_KEY, data)
 
     def _lease_grant(self, req: bytes, ctx) -> bytes:
+        if self._standby.is_set():
+            return _enc_resp(err="standby")
         _k, _d, _e, _l, _p, ttl_ms = _dec_req(req)
         ttl_ms = ttl_ms or 10_000
         with self._lock:
@@ -355,6 +456,7 @@ class KvdServer:
                 self._expire(dead)
 
     def _expire(self, lease_ids: list[int]) -> None:
+        any_owned = False
         for lid in lease_ids:
             with self._lock:
                 lease = self._leases.pop(lid, None)
@@ -366,11 +468,95 @@ class KvdServer:
                          if self._key_lease.get(k) == lid]
                 for k in owned:
                     self._key_lease.pop(k, None)
+            any_owned = any_owned or bool(owned)
             for key in owned:
                 try:
                     self.store.delete(key)  # pushes a deleted event
                 except KeyNotFound:
                     pass
+        if any_owned:
+            self._persist_eph()
+
+    # -- standby: follow the primary, promote when it dies --
+
+    @property
+    def is_standby(self) -> bool:
+        return self._standby.is_set()
+
+    def _apply_replica(self, key: str, version: int, data: bytes,
+                       deleted: bool) -> None:
+        """Apply a replicated primary event preserving its exact version
+        (the store's own mutators would renumber)."""
+        st = self.store
+        with st._lock:
+            if deleted:
+                if st._data.pop(key, None) is None:
+                    return
+                st._persist()
+                st._notify(key, None)
+            else:
+                cur = st._data.get(key)
+                if cur is not None and cur.version == version and \
+                        cur.data == data:
+                    return
+                vv = VersionedValue(version, data)
+                st._data[key] = vv
+                st._persist()
+                st._notify(key, vv)
+
+    def _follow_loop(self) -> None:
+        """Replicate the primary's full keyspace over its Watch stream;
+        promote to writable when the primary stays unreachable longer than
+        promote_after_s. Single-standby failover — NOT a quorum protocol;
+        a partitioned-but-alive primary and a promoted standby can both
+        accept writes (the reference avoids this by running raft-replicated
+        etcd; documented deployment caveat)."""
+        import grpc
+
+        last_ok = time.monotonic()
+        connected = False
+        while not self._closed.is_set() and self._standby.is_set():
+            try:
+                channel = grpc.insecure_channel(self._primary)
+                stub = channel.unary_stream(_method("Watch"))
+                stream = stub(_enc_req(prefix=""))
+                seen: set[str] = set()
+                in_bootstrap = True
+                for raw in stream:
+                    connected = True
+                    last_ok = time.monotonic()
+                    key, version, data, deleted, done, _rev = _dec_event(raw)
+                    if done:
+                        # reconnect reconcile: replicated keys missing from
+                        # the fresh snapshot were deleted while we were away
+                        for k in [k for k in self.store.keys()
+                                  if k not in seen]:
+                            self._apply_replica(k, 0, b"", deleted=True)
+                        in_bootstrap = False
+                        continue
+                    if in_bootstrap:
+                        seen.add(key)
+                    self._apply_replica(key, version, data, deleted)
+                    if self._closed.is_set() or not self._standby.is_set():
+                        return
+            except Exception:  # noqa: BLE001 - stream down: maybe promote
+                if connected:
+                    # death observed just now — an idle-but-alive stream
+                    # doesn't advance last_ok, so restart the clock here
+                    last_ok = time.monotonic()
+                    connected = False
+            if self._closed.wait(0.3):
+                return
+            if time.monotonic() - last_ok > self._promote_after_s:
+                self._promote()
+                return
+
+    def _promote(self) -> None:
+        """Become the writable metadata server: grace-lease the replicated
+        ephemeral keys (their owners' leases lived on the dead primary) and
+        start accepting writes."""
+        self._grace_lease_ephemerals()
+        self._standby.clear()
 
     # -- watch streaming --
 
@@ -429,9 +615,13 @@ class KvdClient(KVStore):
         super().__init__()
         import grpc
 
-        self.target = target
+        # comma-separated failover list: primary first, standbys after.
+        # RPCs rotate to the next target on transport errors or "standby"
+        # responses, so a promoted standby is picked up automatically.
+        self._targets = [t.strip() for t in target.split(",") if t.strip()]
+        self._cur = 0
         self.timeout_s = timeout_s
-        self._channel = grpc.insecure_channel(target)
+        self._channel = grpc.insecure_channel(self._targets[0])
         self._stubs: dict[str, object] = {}
         self._stub_lock = threading.Lock()
         self._versions: dict[str, int] = {}  # last pushed version per key
@@ -440,7 +630,15 @@ class KvdClient(KVStore):
         self._watch_ready = threading.Event()
         self._closed = threading.Event()
         self._lease_id = 0
+        self._lease_ttl_ms = 0
         self._lease_thread: threading.Thread | None = None
+        # ephemeral keys this session owns (key -> last-asserted data),
+        # re-asserted under a fresh lease after a server restart/failover
+        self._ephemeral: dict[str, bytes] = {}
+
+    @property
+    def target(self) -> str:
+        return self._targets[self._cur % len(self._targets)]
 
     def _stub(self, name: str, streaming: bool = False):
         import grpc  # noqa: F401
@@ -455,45 +653,94 @@ class KvdClient(KVStore):
                 self._stubs[name] = st
         return st
 
+    def _rotate(self) -> None:
+        """Advance to the next configured target (failover)."""
+        import grpc
+
+        with self._stub_lock:
+            if len(self._targets) > 1:
+                self._cur = (self._cur + 1) % len(self._targets)
+            try:
+                self._channel.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._channel = grpc.insecure_channel(
+                self._targets[self._cur % len(self._targets)])
+            self._stubs = {}
+
+    def _call(self, name: str, req: bytes):
+        """Unary call with failover: rotate targets on transport errors and
+        on standby rejections; single-target clients retry once (server
+        restart)."""
+        attempts = max(5, 2 * len(self._targets))
+        last_exc: Exception | None = None
+        for i in range(attempts):
+            try:
+                resp = _dec_resp(self._stub(name)(req, timeout=self.timeout_s))
+            except Exception as e:  # noqa: BLE001 - grpc transport error
+                last_exc = e
+                self._rotate()
+                if self._closed.wait(min(0.2 * (i + 1), 1.0)):
+                    break
+                continue
+            if resp[2] == "standby":
+                self._rotate()
+                if self._closed.wait(min(0.2 * (i + 1), 1.0)):
+                    break
+                continue
+            return resp
+        raise KVError(f"kvd unreachable at {self._targets}: {last_exc}")
+
     # -- KVStore surface --
 
     def get(self, key: str) -> VersionedValue:
-        version, data, err, _l, _k = _dec_resp(
-            self._stub("Get")(_enc_req(key=key), timeout=self.timeout_s))
+        version, data, err, _l, _k = self._call("Get", _enc_req(key=key))
         if err == "notfound":
             raise KeyNotFound(key)
         return VersionedValue(version, data)
 
-    def set(self, key: str, data: bytes) -> int:
-        version, _d, _e, _l, _k = _dec_resp(
-            self._stub("Set")(_enc_req(key=key, data=data,
-                                       lease_id=self._lease_id),
-                              timeout=self.timeout_s))
+    def set(self, key: str, data: bytes, ephemeral: bool = False) -> int:
+        """ephemeral=True attaches the key to this client's session lease
+        (vanishes if the process dies). Plain sets are PERSISTENT — and
+        clear a prior lease attachment, matching etcd put-without-lease
+        (round-4 advisor finding: the lease must not ride every write)."""
+        lease = self._session_lease() if ephemeral else 0
+        version, _d, _e, _l, _k = self._call(
+            "Set", _enc_req(key=key, data=data, lease_id=lease))
+        self._track_ephemeral(key, data if ephemeral else None)
         return version
 
-    def set_if_not_exists(self, key: str, data: bytes) -> int:
-        return self.check_and_set(key, 0, data)
+    def set_if_not_exists(self, key: str, data: bytes,
+                          ephemeral: bool = False) -> int:
+        return self.check_and_set(key, 0, data, ephemeral=ephemeral)
 
-    def check_and_set(self, key: str, expect_version: int, data: bytes) -> int:
-        version, _d, err, _l, _k = _dec_resp(
-            self._stub("Cas")(_enc_req(key=key, data=data,
-                                       expect_version=expect_version,
-                                       lease_id=self._lease_id),
-                              timeout=self.timeout_s))
+    def check_and_set(self, key: str, expect_version: int, data: bytes,
+                      ephemeral: bool = False) -> int:
+        lease = self._session_lease() if ephemeral else 0
+        version, _d, err, _l, _k = self._call(
+            "Cas", _enc_req(key=key, data=data,
+                            expect_version=expect_version, lease_id=lease))
         if err.startswith("conflict"):
             raise VersionMismatch(err.partition(":")[2] or key)
+        self._track_ephemeral(key, data if ephemeral else None)
         return version
 
     def delete(self, key: str) -> None:
-        _v, _d, err, _l, _k = _dec_resp(
-            self._stub("Delete")(_enc_req(key=key), timeout=self.timeout_s))
+        _v, _d, err, _l, _k = self._call("Delete", _enc_req(key=key))
+        self._track_ephemeral(key, None)
         if err == "notfound":
             raise KeyNotFound(key)
 
     def keys(self, prefix: str = "") -> list[str]:
-        _v, _d, _e, _l, keys = _dec_resp(
-            self._stub("Keys")(_enc_req(prefix=prefix), timeout=self.timeout_s))
+        _v, _d, _e, _l, keys = self._call("Keys", _enc_req(prefix=prefix))
         return keys
+
+    def _track_ephemeral(self, key: str, data: bytes | None) -> None:
+        with self._lock:
+            if data is None:
+                self._ephemeral.pop(key, None)
+            else:
+                self._ephemeral[key] = data
 
     def refresh(self) -> int:
         """Push-based store: nothing to poll."""
@@ -549,6 +796,9 @@ class KvdClient(KVStore):
                     if self._closed.is_set():
                         return
             except Exception:  # noqa: BLE001 - reconnect on any stream error
+                # rotate so watch-only clients also fail over to a
+                # promoted standby (unary RPCs rotate in _call)
+                self._rotate()
                 if self._closed.wait(0.5):
                     return
 
@@ -582,28 +832,72 @@ class KvdClient(KVStore):
 
     # -- liveness: session lease --
 
+    def _session_lease(self) -> int:
+        """The session lease id, granting one on first ephemeral write."""
+        if not self._lease_id:
+            self.start_session()
+        return self._lease_id
+
     def start_session(self, ttl_ms: int = 5_000) -> int:
-        """Grant a lease and keep it alive from a background thread; any
-        subsequent set/check_and_set attaches its key to the session, so
+        """Grant a lease and keep it alive from a background thread;
+        ephemeral set/check_and_set attach their keys to the session, so
         this process's keys vanish if it dies (etcd session semantics —
-        what elections and service advertisements ride)."""
-        _v, _d, _e, lease_id, _k = _dec_resp(
-            self._stub("LeaseGrant")(_enc_req(ttl_ms=ttl_ms),
-                                     timeout=self.timeout_s))
+        what elections and service advertisements ride).
+
+        Survives server restart/failover: a keepalive answered with
+        "notfound" (the lease died with the old server) re-grants a fresh
+        lease and RE-ASSERTS every ephemeral key this client owns before
+        the server's orphan grace expires — a live leader keeps its
+        leadership across a kvd restart."""
+        _v, _d, _e, lease_id, _k = self._call(
+            "LeaseGrant", _enc_req(ttl_ms=ttl_ms))
         self._lease_id = lease_id
+        self._lease_ttl_ms = ttl_ms
         interval = max(0.2, ttl_ms / 3e3)
+        if self._lease_thread is not None:
+            return lease_id  # re-grant from the existing keepalive thread
 
         def keepalive():
             while not self._closed.wait(interval):
+                if not self._lease_id:
+                    continue  # session explicitly ended; don't resurrect
                 try:
-                    self._stub("LeaseKeepAlive")(
-                        _enc_req(lease_id=lease_id), timeout=self.timeout_s)
+                    _v2, _d2, err, _l2, _k2 = self._call(
+                        "LeaseKeepAlive", _enc_req(lease_id=self._lease_id))
                 except Exception:  # noqa: BLE001 - retry next tick
-                    pass
+                    continue
+                if err == "notfound" and self._lease_id \
+                        and not self._closed.is_set():
+                    try:
+                        self._regrant()
+                    except Exception:  # noqa: BLE001 - retry next tick
+                        pass
 
         self._lease_thread = threading.Thread(target=keepalive, daemon=True)
         self._lease_thread.start()
         return lease_id
+
+    def _regrant(self) -> None:
+        """Fresh lease + re-assert owned ephemeral keys (server lost ours)."""
+        self.start_session(self._lease_ttl_ms or 5_000)
+        with self._lock:
+            owned = list(self._ephemeral.items())
+        for key, data in owned:
+            try:
+                vv = self.get(key)
+            except KeyNotFound:
+                vv = None
+            try:
+                if vv is None:
+                    self.set_if_not_exists(key, data, ephemeral=True)
+                elif vv.data == data:
+                    # still ours: re-attach under the new lease
+                    self.set(key, data, ephemeral=True)
+                else:
+                    # someone else took it while our lease was dead
+                    self._track_ephemeral(key, None)
+            except (VersionMismatch, KVError):
+                self._track_ephemeral(key, None)
 
     def end_session(self) -> None:
         if self._lease_id:
@@ -613,6 +907,8 @@ class KvdClient(KVStore):
             except Exception:  # noqa: BLE001 - server may already be gone
                 pass
             self._lease_id = 0
+            with self._lock:
+                self._ephemeral.clear()
 
     def close(self) -> None:
         self._closed.set()
@@ -656,7 +952,8 @@ class LeaseElection:
     def campaign(self) -> bool:
         self._campaigning = True
         try:
-            self.client.set_if_not_exists(self.key, self.instance_id.encode())
+            self.client.set_if_not_exists(self.key, self.instance_id.encode(),
+                                          ephemeral=True)
             self._is_leader.set()
             return True
         except VersionMismatch:
@@ -701,10 +998,15 @@ class LeaseElection:
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="m3kvd metadata server")
     ap.add_argument("--listen", default="127.0.0.1:0")
-    ap.add_argument("--journal", default="", help="optional journal path")
+    ap.add_argument("--journal", default="kvd.journal",
+                    help="journal path (ON by default; --no-journal for "
+                         "a volatile store)")
+    ap.add_argument("--no-journal", action="store_true")
+    ap.add_argument("--standby-of", default="",
+                    help="follow this primary kvd and promote if it dies")
     ap.add_argument("-f", "--config", default="", help="yaml/json config file")
     args = ap.parse_args(argv)
-    listen, journal = args.listen, args.journal
+    listen, journal, standby = args.listen, args.journal, args.standby_of
     if args.config:
         from m3_tpu.utils.config import load_config
 
@@ -712,7 +1014,11 @@ def main(argv=None) -> None:
         kvd_cfg = cfg.get("kvd", {}) if isinstance(cfg, dict) else {}
         listen = kvd_cfg.get("listen", listen)
         journal = kvd_cfg.get("journal", journal)
-    server = KvdServer(listen, journal_path=journal or None)
+        standby = kvd_cfg.get("standby_of", standby)
+    if args.no_journal:
+        journal = ""
+    server = KvdServer(listen, journal_path=journal or None,
+                       standby_of=standby or None)
     print(f"m3kvd listening on port {server.port}", flush=True)
     try:  # port discovery file for orchestrators spawning with port 0
         with open("kvd.port", "w") as f:

@@ -191,7 +191,18 @@ class EmAgent:
                     env = doc.get("env") or (prior.env if prior else {})
                     if prior is not None and prior.proc is not None \
                             and prior.proc.poll() is None:
-                        return 409, {"error": f"service {name} already running"}
+                        # idempotent start: re-asserting the SAME placement
+                        # is a no-op success (orchestrators retry starts);
+                        # only a conflicting module/config on a live
+                        # service is an error
+                        req_env = doc.get("env")
+                        if module == prior.module \
+                                and config == prior.config_path \
+                                and (not req_env or req_env == prior.env):
+                            return 200, prior.status()
+                        return 409, {"error": f"service {name} already "
+                                     "running with different "
+                                     "module/config/env"}
                     m = _Managed(name, module, config, env, self.workdir)
                     self.services[name] = m
                     m.start()

@@ -161,7 +161,7 @@ class TestKvdLeases:
         resp = dying._stub("LeaseGrant")(kvdmod._enc_req(ttl_ms=700))
         _v, _d, _e, lease_id, _k = kvdmod._dec_resp(resp)
         dying._lease_id = lease_id
-        dying.set("ephemeral", b"alive")
+        dying.set("ephemeral", b"alive", ephemeral=True)
 
         events = []
         client.watch("ephemeral", lambda k, vv: events.append(vv))
@@ -182,10 +182,10 @@ class TestKvdLeases:
         b = KvdClient(f"127.0.0.1:{server.port}")
         try:
             a.start_session(ttl_ms=600)
-            a.set("handover", b"A")
+            a.set("handover", b"A", ephemeral=True)
             a.delete("handover")  # A resigns
             b.start_session(ttl_ms=60_000)
-            b.set("handover", b"B")  # B takes over under its own lease
+            b.set("handover", b"B", ephemeral=True)  # B takes over under its own lease
             # kill A without revoke: stop its keepalives and wait > TTL
             a._closed.set()
             time.sleep(2.0)
@@ -225,7 +225,7 @@ class TestKvdLeases:
         holder = KvdClient(f"127.0.0.1:{server.port}")
         try:
             holder.start_session(ttl_ms=600)
-            holder.set("held", b"x")
+            holder.set("held", b"x", ephemeral=True)
             time.sleep(1.5)  # several TTLs with keepalives running
             assert client.get("held").data == b"x"
         finally:
@@ -294,3 +294,171 @@ class TestKvdElection:
         finally:
             ca.close()
             cb.close()
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class TestKvdRestartSurvivability:
+    """The metadata plane must survive a kvd restart (round-4 VERDICT #3):
+    monotonic revisions, orphan-grace reaping of journaled ephemeral keys,
+    session re-grant + re-assert, and standby failover."""
+
+    def test_client_sees_updates_after_server_restart(self, tmp_path):
+        """The epoch-based revision counter stays monotonic across a
+        restart; a surviving client's watch must deliver post-restart
+        updates instead of dropping them as replays."""
+        port = _free_port()
+        journal = str(tmp_path / "kvd.json")
+        s1 = KvdServer(f"127.0.0.1:{port}", journal_path=journal)
+        c = KvdClient(f"127.0.0.1:{port}")
+        w = KvdClient(f"127.0.0.1:{port}")
+        try:
+            got = []
+            w.watch("k", lambda k, vv: got.append(vv))
+            c.set("k", b"v1")
+            wait_for(lambda: any(vv and vv.data == b"v1" for vv in got),
+                     desc="pre-restart watch")
+            s1.close()
+            s2 = KvdServer(f"127.0.0.1:{port}", journal_path=journal)
+            try:
+                # _call retries through the reconnect
+                c.set("k", b"v2")
+                wait_for(lambda: any(vv and vv.data == b"v2" for vv in got),
+                         timeout_s=15, desc="post-restart watch delivery")
+            finally:
+                s2.close()
+        finally:
+            c.close()
+            w.close()
+
+    def test_dead_leaders_journaled_key_is_grace_reaped(self, tmp_path):
+        """An election key restored from the journal whose owner is dead
+        must be reaped after the orphan grace, unwedging failover."""
+        port = _free_port()
+        journal = str(tmp_path / "kvd.json")
+        s1 = KvdServer(f"127.0.0.1:{port}", journal_path=journal)
+        dead = KvdClient(f"127.0.0.1:{port}")
+        dead.start_session(ttl_ms=60_000)
+        dead.set("_election/agg", b"dead-leader", ephemeral=True)
+        dead._closed.set()  # the process dies with the server outage
+        s1.close()
+
+        s2 = KvdServer(f"127.0.0.1:{port}", journal_path=journal,
+                       orphan_grace_ms=1_000)
+        cb = KvdClient(f"127.0.0.1:{port}")
+        try:
+            assert cb.get("_election/agg").data == b"dead-leader"
+            el = LeaseElection(cb, "agg", "successor", ttl_ms=800)
+            assert not el.is_leader()
+            wait_for(el.is_leader, timeout_s=15,
+                     desc="successor elected after orphan grace")
+            el.close()
+        finally:
+            cb.close()
+            s2.close()
+
+    def test_live_leader_keeps_leadership_across_restart(self, tmp_path):
+        """A LIVE leader re-grants its session on the restarted server and
+        re-asserts its election key before the orphan grace expires."""
+        port = _free_port()
+        journal = str(tmp_path / "kvd.json")
+        s1 = KvdServer(f"127.0.0.1:{port}", journal_path=journal)
+        ca = KvdClient(f"127.0.0.1:{port}")
+        try:
+            el = LeaseElection(ca, "agg", "survivor", ttl_ms=600)
+            assert el.is_leader()
+            s1.close()
+            s2 = KvdServer(f"127.0.0.1:{port}", journal_path=journal,
+                           orphan_grace_ms=4_000)
+            try:
+                # give the keepalive time to re-grant + re-assert, then
+                # outlive the grace window
+                time.sleep(5.0)
+                assert s2.store.get("_election/agg").data == b"survivor"
+                assert el.is_leader()
+                # and the key is lease-attached again (ephemeral)
+                assert "_election/agg" in s2._key_lease
+            finally:
+                s2.close()
+        finally:
+            ca.close()
+
+    def test_persistent_keys_survive_campaigner_death(self, server):
+        """Plain sets from a process that also campaigned must NOT ride
+        its lease: placements/rules stay after the process dies."""
+        a = KvdClient(f"127.0.0.1:{server.port}")
+        check = KvdClient(f"127.0.0.1:{server.port}")
+        try:
+            a.start_session(ttl_ms=600)
+            a.set("_election/x", b"a", ephemeral=True)
+            a.set("placement/prod", b"shards...")  # persistent
+            a._closed.set()  # dies without revoking
+            wait_for(lambda: not _has(check, "_election/x"), timeout_s=10,
+                     desc="ephemeral reaped")
+            assert check.get("placement/prod").data == b"shards..."
+        finally:
+            a.close()
+            check.close()
+
+    def test_standby_replicates_and_promotes(self, tmp_path):
+        """Primary + standby: writes replicate; killing the primary
+        promotes the standby; a multi-target client fails over and an
+        election re-establishes on the promoted standby."""
+        p1, p2 = _free_port(), _free_port()
+        prim = KvdServer(f"127.0.0.1:{p1}",
+                         journal_path=str(tmp_path / "prim.json"))
+        stby = KvdServer(f"127.0.0.1:{p2}",
+                         journal_path=str(tmp_path / "stby.json"),
+                         standby_of=f"127.0.0.1:{p1}",
+                         promote_after_s=1.0, orphan_grace_ms=2_000)
+        c = KvdClient(f"127.0.0.1:{p1},127.0.0.1:{p2}")
+        try:
+            el = LeaseElection(c, "agg", "leader-1", ttl_ms=600)
+            assert el.is_leader()
+            c.set("placement/prod", b"v1")
+            wait_for(lambda: _store_has(stby, "placement/prod", b"v1"),
+                     desc="replicated to standby")
+            wait_for(lambda: _store_has(stby, "_election/agg", b"leader-1"),
+                     desc="election replicated")
+            assert stby.is_standby
+
+            prim.close()
+            wait_for(lambda: not stby.is_standby, timeout_s=15,
+                     desc="standby promoted")
+            # client fails over; persistent data intact on the standby
+            assert c.get("placement/prod").data == b"v1"
+            c.set("placement/prod", b"v2")
+            assert c.get("placement/prod").data == b"v2"
+            # the leader re-grants on the standby and keeps (or regains)
+            # leadership before/after the grace reap
+            wait_for(el.is_leader, timeout_s=15,
+                     desc="leadership re-established on standby")
+            assert stby.store.get("_election/agg").data == b"leader-1"
+        finally:
+            c.close()
+            stby.close()
+            if prim._server:  # already closed above; double-close is safe
+                pass
+
+
+def _has(client, key) -> bool:
+    try:
+        client.get(key)
+        return True
+    except KeyNotFound:
+        return False
+
+
+def _store_has(server, key, data) -> bool:
+    try:
+        return server.store.get(key).data == data
+    except KeyNotFound:
+        return False
