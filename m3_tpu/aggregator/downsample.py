@@ -41,7 +41,8 @@ class Downsampler:
                         retention_ns=policy.retention_ns,
                         block_size_ns=max(policy.resolution_ns * 720,
                                           2 * 3600 * 10**9),
-                    )
+                    ),
+                    aggregated_resolution_ns=policy.resolution_ns,
                 ),
             )
         return name

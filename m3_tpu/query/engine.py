@@ -98,10 +98,18 @@ class Engine:
     def __init__(self, db, namespace: str = "default",
                  lookback_ns: int = DEFAULT_LOOKBACK_NS,
                  limits: "QueryLimits | None" = None,
-                 subquery_step_ns: int = 60 * NS):
+                 subquery_step_ns: int = 60 * NS,
+                 resolve_tiers: bool = True,
+                 now_fn=None):
+        import time as _time
+
         self.db = db
         self.namespace = namespace
         self.lookback_ns = lookback_ns
+        # retention-tier read resolution (aggregated namespaces); now_fn is
+        # injectable so tests can expire raw retention deterministically
+        self.resolve_tiers = resolve_tiers
+        self.now_fn = now_fn or _time.time_ns
         # Budgets are enforced in the storage read path; an explicit limits
         # arg (re)binds the DATABASE-WIDE budget, mirroring the reference
         # where limits live in storage options, one set per node — so the
@@ -122,6 +130,13 @@ class Engine:
         return getattr(self.db, "limits", None) or self.limits
 
     def query_range(self, q: str, start_ns: int, end_ns: int, step_ns: int):
+        return self.query_range_expr(promql.parse(q), start_ns, end_ns,
+                                     step_ns)
+
+    def query_range_expr(self, expr: Expr, start_ns: int, end_ns: int,
+                         step_ns: int):
+        """Evaluate a pre-parsed AST (PromQL or any front-end compiling to
+        it — M3QL, Graphite-on-tags) over the step grid."""
         if step_ns <= 0:
             raise EvalError("step must be positive")
         eval_ts = np.arange(start_ns, end_ns + 1, step_ns, dtype=np.int64)
@@ -132,7 +147,6 @@ class Engine:
 
         try:
             with trace.span(trace.ENGINE_QUERY, steps=len(eval_ts)):
-                expr = promql.parse(q)
                 _resolve_at_sentinels(expr, int(eval_ts[0]), int(eval_ts[-1]))
                 return self._eval(expr, eval_ts), eval_ts
         finally:
@@ -162,21 +176,26 @@ class Engine:
         return eval_ts - sel.offset_ns
 
     def _fetch(self, sel: VectorSelector, eval_ts: np.ndarray, range_ns: int):
-        """(labels, RaggedSeries) for samples covering the windows."""
+        """(labels, RaggedSeries) for samples covering the windows.
+
+        Namespaces are chosen by retention-tier resolution (query/resolver):
+        a range past raw retention reads the downsampled namespaces and
+        stitches — the reference's aggregated-namespace fanout
+        (cluster_resolver.go)."""
         shifted = self._resolve_ts(sel, eval_ts)
         t_min = int(shifted[0]) - max(range_ns, self.lookback_ns)
         t_max = int(shifted[-1]) + 1
-        ns = self.db.namespaces[self.namespace]
         from m3_tpu.index.query import matchers_to_query
+        from m3_tpu.query import resolver
 
-        docs = ns.query_ids(matchers_to_query(sel.matchers), t_min, t_max)
+        ns_list = (resolver.resolve_namespaces(self.db, self.namespace,
+                                               t_min, t_max, self.now_fn())
+                   if self.resolve_tiers else [self.namespace])
+        docs, series = resolver.fetch_tagged(
+            self.db, ns_list, matchers_to_query(sel.matchers), t_min, t_max)
         labels = []
         per_series = []
-        # one batched read (one request per storage node in cluster mode)
-        results = ns.read_many([d.series_id for d in docs], t_min, t_max)
-        for doc, (times, vbits) in zip(docs, results):
-            if len(times) == 0:
-                continue
+        for doc, (times, vbits) in zip(docs, series):
             labels.append(dict(doc.fields))
             per_series.append((times, vbits.view(np.float64)))
         return labels, RaggedSeries.from_lists(per_series)
@@ -316,6 +335,25 @@ class Engine:
             vals = windows.over_time(self._OVER_TIME[fn], raws, shifted, range_ns)
             out = Vector(labels, vals)
             return _compact(out if fn in _KEEPS_NAME else out.drop_name())
+        if fn == "holt_winters":
+            labels, raws, shifted, range_ns = self._eval_range_arg(
+                self._range_arg(e), eval_ts)
+            sf = self._scalar_param(e.args[1], eval_ts)
+            tf = self._scalar_param(e.args[2], eval_ts)
+            if not (0 < sf < 1) or not (0 < tf <= 1):
+                raise EvalError("holt_winters smoothing factors must be in "
+                                "(0, 1)")
+            vals = windows.holt_winters(raws, shifted, range_ns, sf, tf)
+            return _compact(Vector(labels, vals).drop_name())
+        if fn == "absent_over_time":
+            arg = self._range_arg(e)
+            labels, raws, shifted, range_ns = self._eval_range_arg(arg, eval_ts)
+            present_m = windows.over_time("present", raws, shifted, range_ns)
+            present = ((~np.isnan(present_m)).any(axis=0) if len(labels)
+                       else np.zeros(len(eval_ts), bool))
+            lbls = (_absent_labels(arg.selector)
+                    if isinstance(arg, MatrixSelector) else {})
+            return Vector([lbls], np.where(present, np.nan, 1.0)[None, :])
         if fn == "quantile_over_time":
             phi = self._scalar_param(e.args[0], eval_ts)
             labels, raws, shifted, range_ns = self._eval_range_arg(

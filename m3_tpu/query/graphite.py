@@ -259,20 +259,33 @@ def parse_target(expr: str, pos: int = 0):
 class GraphiteEngine:
     """Evaluates render targets against the database."""
 
-    def __init__(self, db, namespace: str = "default"):
+    def __init__(self, db, namespace: str = "default", resolve_tiers=True,
+                 now_fn=None):
+        import time as _time
+
         self.db = db
         self.namespace = namespace
+        self.resolve_tiers = resolve_tiers
+        self.now_fn = now_fn or _time.time_ns
 
     # -- fetch --
 
     def fetch(self, pattern: str, start_ns: int, end_ns: int, step_ns: int
               ) -> list[Series]:
-        ns = self.db.namespaces[self.namespace]
-        docs = ns.query_ids(path_query(pattern), start_ns, end_ns)
+        from m3_tpu.query import resolver
+
+        ns_list = (resolver.resolve_namespaces(self.db, self.namespace,
+                                               start_ns, end_ns,
+                                               self.now_fn())
+                   if self.resolve_tiers else [self.namespace])
+        docs, series = resolver.fetch_tagged(
+            self.db, ns_list, path_query(pattern), start_ns, end_ns,
+            keep_empty=True)
         grid = np.arange(start_ns, end_ns, step_ns, dtype=np.int64)
         out = []
-        for doc in sorted(docs, key=lambda d: d.series_id):
-            times, vbits = ns.read(doc.series_id, start_ns, end_ns)
+        order = sorted(range(len(docs)), key=lambda i: docs[i].series_id)
+        for i in order:
+            doc, (times, vbits) = docs[i], series[i]
             vals = np.full(len(grid), np.nan)
             if len(times):
                 idx = np.searchsorted(grid, times, side="right") - 1

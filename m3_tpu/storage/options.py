@@ -37,6 +37,11 @@ class NamespaceOptions:
     retention: RetentionOptions = field(default_factory=RetentionOptions)
     index: IndexOptions = field(default_factory=IndexOptions)
     write_time_unit: TimeUnit = TimeUnit.SECOND
+    # 0 = unaggregated (raw) namespace; >0 = this namespace holds
+    # downsampled data at this resolution (the reference's namespace
+    # "aggregated" attributes, namespace/types.go AggregationOptions —
+    # what retention-tier read resolution keys on)
+    aggregated_resolution_ns: int = 0
     # encode value streams with the M3TSZ int optimization (the reference's
     # production default; float-XOR only when False)
     int_optimized: bool = False

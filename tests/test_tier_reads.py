@@ -1,0 +1,120 @@
+"""Retention-tier read resolution: queries past raw retention are served
+from downsampled (aggregated) namespaces and stitched with raw data.
+
+The round-4 VERDICT "done" criterion: write @10s, downsample to 1m, expire
+raw retention, and still get a correct rate() over the old range.
+Reference: /root/reference/src/query/storage/m3/cluster_resolver.go:34-120
+(namespace selection by retention coverage) and storage.go fanout merge.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from m3_tpu.aggregator.downsample import Downsampler, DownsamplerAndWriter
+from m3_tpu.metrics.aggregation import AggregationType, MetricType
+from m3_tpu.metrics.filters import TagFilter
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.rules import MappingRule, RuleSet
+from m3_tpu.query import resolver
+from m3_tpu.query.engine import Engine
+from m3_tpu.query.graphite import GraphiteEngine
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import (
+    DatabaseOptions,
+    NamespaceOptions,
+    RetentionOptions,
+)
+
+NS = 10**9
+HOUR = 3600 * NS
+
+
+@pytest.fixture
+def tiered_db(tmp_path):
+    """Raw namespace with 2h retention + 1m rollup with 24h retention,
+    fed by the embedded downsampler."""
+    db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+    db.create_namespace(
+        "default",
+        NamespaceOptions(retention=RetentionOptions(retention_ns=2 * HOUR)),
+    )
+    policy = StoragePolicy(60 * NS, 24 * HOUR)
+    rules = RuleSet([
+        MappingRule("all", TagFilter.parse("__name__:reqs"), (policy,),
+                    (AggregationType.LAST,)),
+    ])
+    ds = Downsampler(db, rules)
+    w = DownsamplerAndWriter(db, ds)
+    # counter sampled @10s for 4h: value increments 1/s (rate = 1.0);
+    # carbon-positional tags ride along so the Graphite engine finds the
+    # same series (carbon ingest writes both forms)
+    for t in range(0, 4 * 3600, 10):
+        w.write(MetricType.GAUGE, b"reqs",
+                [(b"job", b"api"), (b"__g0__", b"reqs"), (b"__g1__", b"api")],
+                t * NS, float(t))
+    ds.flush(now_ns=5 * HOUR)
+    return db, policy
+
+
+def test_resolver_prefers_raw_when_covering(tiered_db):
+    db, policy = tiered_db
+    now = 4 * HOUR
+    # range entirely within raw retention (2h) -> raw only
+    assert resolver.resolve_namespaces(
+        db, "default", now - HOUR, now, now) == ["default"]
+
+
+def test_resolver_fans_out_past_raw_retention(tiered_db):
+    db, policy = tiered_db
+    now = 4 * HOUR
+    got = resolver.resolve_namespaces(db, "default", 0, now, now)
+    assert got[0] == "default"  # finer data still wanted where it exists
+    assert policy.namespace_name in got
+
+
+def test_rate_over_expired_raw_range(tiered_db):
+    """The headline scenario: raw retention has expired over the queried
+    range; the 1m rollup must serve it and rate() must be correct."""
+    db, policy = tiered_db
+    now = 6 * HOUR  # raw covers only (4h, 6h]; data ended at 4h
+    db.tick(now_ns=now)  # expire raw blocks past retention
+    eng = Engine(db, "default", now_fn=lambda: now)
+
+    # query the first 2 hours - entirely outside raw retention now
+    vec, ts = eng.query_range("rate(reqs[10m])", int(0.5 * HOUR),
+                              int(1.5 * HOUR), 5 * 60 * NS)
+    assert vec.values.shape[0] == 1
+    vals = vec.values[0]
+    assert np.isfinite(vals).all(), vals
+    np.testing.assert_allclose(vals, 1.0, rtol=1e-6)
+
+    # tier OFF: the same query over the expired range finds nothing
+    eng_off = Engine(db, "default", now_fn=lambda: now, resolve_tiers=False)
+    vec_off, _ = eng_off.query_range("rate(reqs[10m])", int(0.5 * HOUR),
+                                     int(1.5 * HOUR), 5 * 60 * NS)
+    assert vec_off.values.shape[0] == 0
+
+
+def test_stitched_rate_across_tier_boundary(tiered_db):
+    """A range spanning expired-raw and live-raw spans both tiers; the
+    stitch hands one continuous stream to rate()."""
+    db, policy = tiered_db
+    now = int(3.5 * HOUR)  # raw covers (1.5h, 3.5h]; rollup covers all
+    db.tick(now_ns=now)
+    eng = Engine(db, "default", now_fn=lambda: now)
+    vec, ts = eng.query_range("rate(reqs[10m])", HOUR, 3 * HOUR, 10 * 60 * NS)
+    assert vec.values.shape[0] == 1
+    np.testing.assert_allclose(vec.values[0], 1.0, rtol=1e-6)
+
+
+def test_graphite_reads_aggregated_tier(tiered_db):
+    db, policy = tiered_db
+    now = 6 * HOUR
+    db.tick(now_ns=now)
+    g = GraphiteEngine(db, "default", now_fn=lambda: now)
+    out = g.render("reqs.api", int(0.5 * HOUR), int(1.5 * HOUR),
+                   step_ns=5 * 60 * NS)
+    assert len(out) == 1
+    assert np.isfinite(out[0].values).any()
