@@ -1,0 +1,69 @@
+"""Size-tiered compaction planning for index segments.
+
+Role parity with the reference compaction planner
+(/root/reference/src/dbnode/storage/index/compaction/plan.go): segments are
+grouped into size levels; within a level, consecutive segments accumulate
+into one merge task until the cumulative size crosses the level's max; the
+(sealed view of the) mutable segment is always compacted first. Segments
+larger than every level are left alone — they are the tier outputs.
+
+Size here is DOCUMENT COUNT: the packed columnar segments (index/packed.py)
+scale linearly in docs, and doc count is available without re-serializing,
+so it plays the role byte-size plays for the reference's FST segments.
+
+The payoff is the same as the reference's: per-block segment count stays
+O(levels + 1) under continuous churn, and each doc is rewritten
+O(#levels) times total instead of once per compaction pass.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Level:
+    min_size_inclusive: int
+    max_size_exclusive: int
+
+
+# geometric doc-count tiers; segments >= the last max are terminal outputs
+DEFAULT_LEVELS = (
+    Level(0, 1 << 14),
+    Level(1 << 14, 1 << 17),
+    Level(1 << 17, 1 << 20),
+)
+
+
+@dataclass
+class Task:
+    segments: list = field(default_factory=list)
+
+    @property
+    def size(self) -> int:
+        return sum(s.n_docs for s in self.segments)
+
+
+def plan(sealed_segments: list, levels=DEFAULT_LEVELS) -> list[Task]:
+    """Merge tasks over sealed segments (each task's segments merge into
+    one). Only tasks with >= 2 segments are returned — a lone segment in
+    its level is already compact."""
+    by_level: dict[Level, list] = {}
+    for seg in sealed_segments:
+        for lv in levels:
+            if lv.min_size_inclusive <= seg.n_docs < lv.max_size_exclusive:
+                by_level.setdefault(lv, []).append(seg)
+                break
+        # segments above every level are terminal: left unplanned
+    tasks: list[Task] = []
+    for lv in sorted(by_level, key=lambda l: l.min_size_inclusive):
+        segs = sorted(by_level[lv], key=lambda s: s.n_docs)
+        cur = Task()
+        for seg in segs:
+            cur.segments.append(seg)
+            if cur.size >= lv.max_size_exclusive:
+                tasks.append(cur)
+                cur = Task()
+        if len(cur.segments):
+            tasks.append(cur)
+    return [t for t in tasks if len(t.segments) >= 2]

@@ -41,17 +41,38 @@ class IndexBlock:
             segs.append(self._cache)
         return segs
 
-    def compact(self) -> None:
-        """Fold the mutable segment (and fragmented sealed ones) into one
-        PACKED immutable segment (the mutable->FST compaction,
-        reference storage/index/mutable_segments.go)."""
-        segs = self.segments()
-        if not segs:
+    def compact(self, full: bool = False) -> None:
+        """Compact this block's segments (the mutable->FST compaction,
+        reference storage/index/mutable_segments.go).
+
+        Default: SIZE-TIERED — seal the mutable segment into a packed one
+        (mutable-first priority, reference plan.go OrderBy), then run the
+        planner over the sealed set and merge only within-level groups.
+        Per-block segment count stays bounded under churn without
+        rewriting every doc each pass. ``full=True`` folds everything into
+        ONE packed segment (the persist path wants a single artifact)."""
+        if full:
+            segs = self.segments()
+            if not segs:
+                return
+            if len(segs) > 1 or not isinstance(segs[0], packed.PackedSegment):
+                self.sealed = [packed.merge(segs)]
+            self.mutable = MutableSegment()
+            self._cache = None
             return
-        if len(segs) > 1 or not isinstance(segs[0], packed.PackedSegment):
-            self.sealed = [packed.merge(segs)]
-        self.mutable = MutableSegment()
-        self._cache = None
+        from m3_tpu.index import compaction
+
+        if self.mutable.n_docs:
+            sealed_view = self.segments()[-1]  # cached sealed view
+            self.sealed.append(packed.merge([sealed_view])
+                               if not isinstance(sealed_view, packed.PackedSegment)
+                               else sealed_view)
+            self.mutable = MutableSegment()
+            self._cache = None
+        for task in compaction.plan(self.sealed):
+            merged = packed.merge(task.segments)
+            keep = [s for s in self.sealed if s not in task.segments]
+            self.sealed = keep + [merged]
 
 
 class NamespaceIndex:
@@ -107,9 +128,9 @@ class NamespaceIndex:
                         values.add(v)
         return sorted(values)
 
-    def compact(self) -> None:
+    def compact(self, full: bool = False) -> None:
         for blk in self._blocks.values():
-            blk.compact()
+            blk.compact(full=full)
 
     def expire_before(self, cutoff_ns: int) -> int:
         dropped = 0

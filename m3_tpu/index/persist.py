@@ -35,16 +35,26 @@ def _path(root: str, namespace: str, block_start: int) -> str:
     return os.path.join(_index_dir(root, namespace), f"segment-{block_start}.db")
 
 
-def persist_index(index: NamespaceIndex, root: str, namespace: str) -> int:
+def persist_index(index: NamespaceIndex, root: str, namespace: str,
+                  seal_before_ns: int | None = None) -> int:
     """Compact + write every index block that has new docs since the last
-    persist. Returns blocks written."""
+    persist. Returns blocks written.
+
+    ``seal_before_ns`` limits persistence to blocks whose window has fully
+    passed (the reference persists index segments per block volume at data
+    flush time, not continuously); ACTIVE blocks are left to the
+    background size-tiered compaction instead of being fully rewritten
+    every tick."""
     os.makedirs(_index_dir(root, namespace), exist_ok=True)
     written = 0
     for bs, blk in list(index._blocks.items()):
+        if seal_before_ns is not None and \
+                bs + index.block_size_ns > seal_before_ns:
+            continue  # still accepting writes: tiered compaction only
         n_docs = sum(s.n_docs for s in blk.segments())
         if blk.persisted_docs == n_docs:
             continue
-        blk.compact()
+        blk.compact(full=True)  # the fileset wants one segment artifact
         if not blk.sealed:
             continue
         payload = blk.sealed[0].to_bytes()

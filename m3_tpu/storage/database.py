@@ -470,7 +470,14 @@ class Database:
                     now_ns - ns.opts.retention.retention_ns
                 )
                 ns.index.expire_before(cutoff)
-                index_persist.persist_index(ns.index, self.fs_root, name)
+                # sealed-by-time blocks persist as one artifact; ACTIVE
+                # blocks instead get a background size-tiered compaction
+                # pass (index/compaction.py planner) so per-block segment
+                # count stays bounded without rewriting every doc per tick
+                index_persist.persist_index(
+                    ns.index, self.fs_root, name,
+                    seal_before_ns=now_ns - ns.opts.retention.buffer_past_ns)
+                ns.index.compact()
                 index_persist.expire_index_files(
                     self.fs_root, name, cutoff, ns.opts.index.block_size_ns
                 )

@@ -1,0 +1,108 @@
+"""Size-tiered index compaction planner (round-4 VERDICT missing #3).
+
+Reference parity: /root/reference/src/dbnode/storage/index/compaction/
+plan.go — level grouping, within-level accumulation, mutable-first — and
+mutable_segments.go's background compaction keeping per-block segment
+count bounded under churn.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from m3_tpu.index import compaction, packed
+from m3_tpu.index.index import IndexBlock, NamespaceIndex
+from m3_tpu.index.query import TermQuery
+from m3_tpu.index.segment import Document
+
+
+def _seg(n_docs: int, tag=b"x") -> packed.PackedSegment:
+    return packed.build([
+        Document(i, b"%s-%06d" % (tag, i), [(b"t", tag)]) for i in range(n_docs)
+    ])
+
+
+class TestPlanner:
+    def test_single_segment_per_level_is_left_alone(self):
+        assert compaction.plan([_seg(100)]) == []
+
+    def test_same_level_segments_merge(self):
+        tasks = compaction.plan([_seg(100), _seg(200), _seg(300)])
+        assert len(tasks) == 1
+        assert len(tasks[0].segments) == 3
+
+    def test_levels_do_not_mix(self):
+        small = [_seg(100), _seg(100)]
+        big = [_seg(1 << 15), _seg(1 << 15)]
+        tasks = compaction.plan(small + big)
+        sizes = sorted(t.size for t in tasks)
+        assert len(tasks) == 2
+        assert sizes[0] == 200 and sizes[1] == 2 << 15
+
+    def test_oversize_segments_are_terminal(self):
+        giant = _seg(1 << 20)
+        assert compaction.plan([giant, giant]) == []
+
+    def test_accumulation_splits_at_level_max(self):
+        # many small segments cumulatively larger than the level max split
+        # into multiple tasks instead of one unbounded merge
+        segs = [_seg(6000) for _ in range(10)]  # 60k docs, level max 16k
+        tasks = compaction.plan(segs)
+        assert len(tasks) >= 3
+        assert all(len(t.segments) >= 2 for t in tasks)
+
+
+class TestChurn:
+    def test_segment_count_bounded_under_churn(self):
+        """Continuous insert + background compact keeps the per-block
+        sealed segment count bounded (the planner's whole point) while
+        queries stay correct."""
+        blk = IndexBlock()
+        total = 0
+        max_segs = 0
+        for round_i in range(60):
+            for j in range(500):
+                sid = b"churn-%02d-%04d" % (round_i, j)
+                blk.insert(sid, [(b"app", b"web"), (b"round", b"%02d" % round_i)])
+                total += 1
+            blk.compact()  # background tiered pass
+            max_segs = max(max_segs, len(blk.sealed))
+        assert total == 30_000
+        # 30k docs / levels(16k cap on tier 0) -> a handful of segments,
+        # never one-per-round (60)
+        assert max_segs <= 8, max_segs
+        from m3_tpu.index.executor import search
+
+        docs = search(blk.segments(), TermQuery(b"app", b"web"), None)
+        assert len(docs) == total
+
+    def test_full_compact_still_yields_single_segment(self):
+        blk = IndexBlock()
+        for j in range(100):
+            blk.insert(b"s-%d" % j, [(b"a", b"b")])
+        blk.compact()
+        for j in range(100, 200):
+            blk.insert(b"s-%d" % j, [(b"a", b"b")])
+        blk.compact(full=True)
+        assert len(blk.sealed) == 1
+        assert blk.sealed[0].n_docs == 200
+
+    def test_tick_runs_background_compaction(self, tmp_path):
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions
+
+        NS = 10**9
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=2))
+        db.create_namespace("default", NamespaceOptions())
+        ns = db.namespaces["default"]
+        now = 10**9 * 3600
+        for j in range(50):
+            db.write_tagged("default", b"m%d" % j, [(b"k", b"v")], now, 1.0)
+        db.tick(now_ns=now + 10**9)
+        blocks = list(ns.index._blocks.values())
+        assert blocks, "no index blocks"
+        # active block was compacted by the tiered pass (mutable drained)
+        assert all(b.mutable.n_docs == 0 for b in blocks)
+        q = TermQuery(b"k", b"v")
+        assert len(ns.query_ids(q, now - 1, now + 1)) == 50
