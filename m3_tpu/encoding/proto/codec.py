@@ -9,10 +9,21 @@ Wire layout per stream:
   End-of-stream: the m3tsz marker (timestamp opcode 0x100 + EOS).
 
 Field payloads (reference scheme roles, encoder.go/custom_marshal.go):
-  DOUBLE  m3tsz XOR float vs the field's previous value
-  INT64   zigzag varint of (value - previous)
-  BOOL    1 bit
-  BYTES   1 bit dict-hit + (index in ceil(log2(cap)) bits | varint len+raw)
+  DOUBLE   m3tsz XOR float vs the field's previous value
+  INT64    zigzag varint of (value - previous)
+  BOOL     1 bit
+  BYTES    1 bit dict-hit + (index in ceil(log2(cap)) bits | varint len+raw)
+  MESSAGE  recursive: a nested changed-bitmask over the sub-schema, then
+           each changed sub-field by these same rules with per-PATH state
+           (deeper than the reference, which marshals nested messages as
+           opaque non-custom bytes — recursing keeps XOR/delta compression
+           working inside nested messages)
+  repeated varint count then each element encoded FULL (no cross-element
+           state): doubles as raw 64 bits, ints as zigzag varints, bools
+           as bits, bytes through the field's LRU dict, nested messages
+           as canonical custom-marshal bytes through the dict (the
+           reference's non-custom marshal + byte-dict scheme —
+           custom_marshal.py provides the deterministic bytes)
 """
 
 from __future__ import annotations
@@ -27,6 +38,7 @@ from m3_tpu.encoding.m3tsz.encoder import (
     finalize_stream,
     write_varint,
 )
+from m3_tpu.encoding.proto import custom_marshal
 from m3_tpu.encoding.proto.schema import FieldType, Schema
 from m3_tpu.utils.bitstream import IStream, OStream
 from m3_tpu.utils.xtime import TimeUnit
@@ -67,69 +79,96 @@ class ProtoEncoder:
         self._os = OStream()
         self._ts = TimestampEncoder(start_ns, default_time_unit)
         self.schema = schema
-        self._prev: dict[int, object] = {}
-        self._floats: dict[int, FloatXOREncoder] = {
-            f.number: FloatXOREncoder() for f in schema.fields
-            if f.type == FieldType.DOUBLE
-        }
-        self._dicts: dict[int, _BytesDict] = {
-            f.number: _BytesDict() for f in schema.fields
-            if f.type == FieldType.BYTES
-        }
+        # all compression state is keyed by FIELD PATH (tuples of field
+        # numbers) so nested messages compress recursively
+        self._prev: dict[tuple, object] = {}
+        self._floats: dict[tuple, FloatXOREncoder] = {}
+        self._dicts: dict[tuple, _BytesDict] = {}
         self.num_encoded = 0
 
     def encode(self, t_ns: int, message: dict,
                unit: TimeUnit = TimeUnit.SECOND) -> None:
         self._ts.write_time(self._os, t_ns, b"", unit)
-        first = self.num_encoded == 0
-        changed = []
-        for f in self.schema.fields:
-            v = _normalize(f, message.get(f.name))
-            prev = self._prev.get(f.number)
-            if first:
-                diff = True
-            elif f.type == FieldType.DOUBLE:
-                # bit-pattern compare: 0.0 == -0.0 and NaN != NaN under
-                # float equality, both wrong for change detection
-                diff = c.float_to_bits(v) != c.float_to_bits(prev)
-            else:
-                diff = v != prev
-            changed.append(diff)
-        for flag in changed:
-            self._os.write_bit(1 if flag else 0)
-        for f, flag in zip(self.schema.fields, changed):
-            if not flag:
-                continue
-            v = _normalize(f, message.get(f.name))
-            self._write_field(f, v, first)
-            self._prev[f.number] = v
+        self._write_message(self.schema, message or {}, ())
         self.num_encoded += 1
 
-    def _write_field(self, f, v, first: bool) -> None:
+    # -- recursive message writing --
+
+    def _write_message(self, schema: Schema, message: dict, path: tuple) -> None:
+        changed = []
+        values = []
+        for f in schema.fields:
+            v = _normalize(f, message.get(f.name))
+            prev = self._prev.get(path + (f.number,))
+            if path + (f.number,) not in self._prev:
+                diff = True
+            else:
+                diff = not _equal(f, v, prev)
+            changed.append(diff)
+            values.append(v)
+        for flag in changed:
+            self._os.write_bit(1 if flag else 0)
+        for f, flag, v in zip(schema.fields, changed, values):
+            if not flag:
+                continue
+            self._write_field(f, v, path + (f.number,))
+            self._prev[path + (f.number,)] = v
+
+    def _write_field(self, f, v, path: tuple) -> None:
         os = self._os
-        if f.type == FieldType.DOUBLE:
-            enc = self._floats[f.number]
-            if first:
+        if f.repeated:
+            write_varint(os, len(v))
+            for e in v:
+                self._write_element(f, e, path)
+            return
+        if f.type == FieldType.MESSAGE:
+            self._write_message(f.message, v, path)
+        elif f.type == FieldType.DOUBLE:
+            enc = self._floats.get(path)
+            if enc is None:
+                enc = self._floats[path] = FloatXOREncoder()
                 enc.write_full_float(os, c.float_to_bits(v))
             else:
                 enc.write_next_float(os, c.float_to_bits(v))
         elif f.type == FieldType.INT64:
-            prev = self._prev.get(f.number, 0)
-            write_varint(os, v - (prev if not first else 0))
+            prev = self._prev.get(path, 0)
+            write_varint(os, v - (prev if isinstance(prev, int) else 0))
         elif f.type == FieldType.BOOL:
             os.write_bit(1 if v else 0)
         elif f.type == FieldType.BYTES:
-            d = self._dicts[f.number]
-            idx = d.find(v)
-            if idx >= 0:
-                os.write_bit(1)
-                os.write_bits(idx, _DICT_BITS)
-            else:
-                os.write_bit(0)
-                write_varint(os, len(v))
-                for b in v:
-                    os.write_bits(b, 8)
-            d.push(v)
+            self._write_dict_bytes(path, v)
+        else:
+            raise ValueError(f.type)
+
+    def _write_element(self, f, e, path: tuple) -> None:
+        """One repeated element, encoded with no cross-element state."""
+        os = self._os
+        if f.type == FieldType.DOUBLE:
+            os.write_bits(c.float_to_bits(e), 64)
+        elif f.type == FieldType.INT64:
+            write_varint(os, e)
+        elif f.type == FieldType.BOOL:
+            os.write_bit(1 if e else 0)
+        elif f.type == FieldType.BYTES:
+            self._write_dict_bytes(path, e)
+        elif f.type == FieldType.MESSAGE:
+            self._write_dict_bytes(path, custom_marshal.marshal(f.message, e))
+        else:
+            raise ValueError(f.type)
+
+    def _write_dict_bytes(self, path: tuple, v: bytes) -> None:
+        os = self._os
+        d = self._dicts.setdefault(path, _BytesDict())
+        idx = d.find(v)
+        if idx >= 0:
+            os.write_bit(1)
+            os.write_bits(idx, _DICT_BITS)
+        else:
+            os.write_bit(0)
+            write_varint(os, len(v))
+            for b in v:
+                os.write_bits(b, 8)
+        d.push(v)
 
     def stream(self) -> bytes:
         return finalize_stream(self._os)
@@ -143,10 +182,10 @@ class ProtoDecoder:
         self._stream = IStream(data)
         self._ts = _TimestampIterator(default_time_unit)
         self.schema = schema
-        self._prev: dict[int, object] = {}
-        self._prev_bits: dict[int, int] = {}
-        self._prev_xor: dict[int, int] = {}
-        self._dicts: dict[int, _BytesDict] = {}
+        self._prev: dict[tuple, object] = {}
+        self._prev_bits: dict[tuple, int] = {}
+        self._prev_xor: dict[tuple, int] = {}
+        self._dicts: dict[tuple, _BytesDict] = {}
 
     def __iter__(self):
         while True:
@@ -156,54 +195,76 @@ class ProtoDecoder:
                 return
             if self._ts.done:  # EOS marker
                 return
-            msg = {}
-            changed = [self._stream.read_bits(1) == 1
-                       for _ in self.schema.fields]
-            for f, flag in zip(self.schema.fields, changed):
-                if flag:
-                    v = self._read_field(f)
-                    self._prev[f.number] = v
-                msg[f.name] = self._prev.get(f.number, _zero(f))
+            msg = self._read_message(self.schema, ())
             yield ProtoDatapoint(self._ts.prev_time, msg)
 
-    def _read_field(self, f):
+    def _read_message(self, schema: Schema, path: tuple) -> dict:
+        changed = [self._stream.read_bits(1) == 1 for _ in schema.fields]
+        msg = {}
+        for f, flag in zip(schema.fields, changed):
+            fpath = path + (f.number,)
+            if flag:
+                v = self._read_field(f, fpath)
+                self._prev[fpath] = v
+            msg[f.name] = self._prev.get(fpath, _zero(f))
+        return msg
+
+    def _read_field(self, f, path: tuple):
         s = self._stream
+        if f.repeated:
+            n = read_varint(s)
+            return [self._read_element(f, path) for _ in range(n)]
+        if f.type == FieldType.MESSAGE:
+            return self._read_message(f.message, path)
         if f.type == FieldType.DOUBLE:
-            if f.number not in self._prev_bits:
+            if path not in self._prev_bits:
                 bits = s.read_bits(64)
-                self._prev_bits[f.number] = bits
-                self._prev_xor[f.number] = bits
+                self._prev_bits[path] = bits
+                self._prev_xor[path] = bits
                 return c.bits_to_float(bits)
-            bits = self._read_next_float(f.number)
+            bits = self._read_next_float(path)
             return c.bits_to_float(bits)
         if f.type == FieldType.INT64:
             delta = read_varint(s)
-            base = self._prev.get(f.number, 0)
-            return base + delta
+            base = self._prev.get(path, 0)
+            return (base if isinstance(base, int) else 0) + delta
         if f.type == FieldType.BOOL:
             return s.read_bits(1) == 1
         if f.type == FieldType.BYTES:
-            d = self._dict(f.number)
-            if s.read_bits(1) == 1:
-                v = d.entries[s.read_bits(_DICT_BITS)]
-            else:
-                n = read_varint(s)
-                v = bytes(s.read_bits(8) for _ in range(n))
-            d.push(v)
-            return v
+            return self._read_dict_bytes(path)
         raise ValueError(f.type)
 
-    def _dict(self, number: int) -> _BytesDict:
-        d = self._dicts.get(number)
-        if d is None:
-            d = self._dicts[number] = _BytesDict()
-        return d
+    def _read_element(self, f, path: tuple):
+        s = self._stream
+        if f.type == FieldType.DOUBLE:
+            return c.bits_to_float(s.read_bits(64))
+        if f.type == FieldType.INT64:
+            return read_varint(s)
+        if f.type == FieldType.BOOL:
+            return s.read_bits(1) == 1
+        if f.type == FieldType.BYTES:
+            return self._read_dict_bytes(path)
+        if f.type == FieldType.MESSAGE:
+            return custom_marshal.unmarshal(f.message,
+                                            self._read_dict_bytes(path))
+        raise ValueError(f.type)
 
-    def _read_next_float(self, number: int) -> int:
+    def _read_dict_bytes(self, path: tuple) -> bytes:
+        s = self._stream
+        d = self._dicts.setdefault(path, _BytesDict())
+        if s.read_bits(1) == 1:
+            v = d.entries[s.read_bits(_DICT_BITS)]
+        else:
+            n = read_varint(s)
+            v = bytes(s.read_bits(8) for _ in range(n))
+        d.push(v)
+        return v
+
+    def _read_next_float(self, path: tuple) -> int:
         """m3tsz XOR read against this field's own state."""
         s = self._stream
-        prev_bits = self._prev_bits[number]
-        prev_xor = self._prev_xor[number]
+        prev_bits = self._prev_bits[path]
+        prev_xor = self._prev_xor[path]
         if s.read_bits(1) == c.OPCODE_ZERO_VALUE_XOR:
             xor = 0
         elif s.read_bits(1) == 0:  # contained '10'
@@ -218,15 +279,40 @@ class ProtoDecoder:
             mant = s.read_bits(m)
             xor = mant << (64 - lead - m)
         bits = prev_bits ^ xor
-        self._prev_bits[number] = bits
+        self._prev_bits[path] = bits
         # the encoder records the xor unconditionally (including 0)
-        self._prev_xor[number] = xor
+        self._prev_xor[path] = xor
         return bits
 
 
+def _equal(f, a, b) -> bool:
+    """Structural equality with doubles compared by BIT PATTERN
+    (0.0 == -0.0 and NaN != NaN under float equality, both wrong for
+    change detection), recursively through repeated/nested values."""
+    if f.repeated:
+        return (len(a) == len(b)
+                and all(_equal_scalar(f, x, y) for x, y in zip(a, b)))
+    return _equal_scalar(f, a, b)
+
+
+def _equal_scalar(f, a, b) -> bool:
+    if f.type == FieldType.DOUBLE:
+        return c.float_to_bits(a) == c.float_to_bits(b)
+    if f.type == FieldType.MESSAGE:
+        return all(_equal(sub, a[sub.name], b[sub.name])
+                   for sub in f.message.fields)
+    return a == b
+
+
 def _normalize(f, v):
+    if f.repeated:
+        return [_normalize_scalar(f, e) for e in (v or ())]
+    return _normalize_scalar(f, v)
+
+
+def _normalize_scalar(f, v):
     if v is None:
-        return _zero(f)
+        return _zero_scalar(f)
     if f.type == FieldType.DOUBLE:
         return float(v)
     if f.type == FieldType.INT64:
@@ -235,10 +321,21 @@ def _normalize(f, v):
         return bool(v)
     if f.type == FieldType.BYTES:
         return bytes(v)
+    if f.type == FieldType.MESSAGE:
+        return {sub.name: _normalize(sub, (v or {}).get(sub.name))
+                for sub in f.message.fields}
     raise ValueError(f.type)
 
 
 def _zero(f):
+    if f.repeated:
+        return []
+    return _zero_scalar(f)
+
+
+def _zero_scalar(f):
+    if f.type == FieldType.MESSAGE:
+        return {sub.name: _zero(sub) for sub in f.message.fields}
     return {
         FieldType.DOUBLE: 0.0,
         FieldType.INT64: 0,

@@ -1,0 +1,204 @@
+"""Canonical protobuf wire marshal/unmarshal for schema'd messages.
+
+Role parity with the reference's custom marshaller
+(/root/reference/src/dbnode/encoding/proto/custom_marshal.go): a
+DETERMINISTIC proto3 wire encoding — fields in ascending field-number
+order, zero values omitted, packed repeated scalars — so equal messages
+always marshal to equal bytes (the property change-detection and byte-dict
+compression rely on; stock proto marshallers don't guarantee ordering).
+
+The output is valid protobuf wire format for the schema, so externally
+produced proto bytes for the same schema unmarshal here and vice versa.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from m3_tpu.encoding.proto.schema import Field, FieldType, Schema
+
+_WT_VARINT = 0
+_WT_FIXED64 = 1
+_WT_LEN = 2
+
+
+def _uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_uvarint(data: bytes, pos: int) -> tuple[int, int]:
+    shift = 0
+    val = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        b = data[pos]
+        pos += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+
+
+def _tag(number: int, wt: int) -> bytes:
+    return _uvarint((number << 3) | wt)
+
+
+def _int64_wire(v: int) -> bytes:
+    # proto3 int64: two's-complement varint (negatives cost 10 bytes)
+    return _uvarint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _scalar_bytes(f: Field, v) -> bytes:
+    if f.type == FieldType.DOUBLE:
+        return struct.pack("<d", float(v))
+    if f.type == FieldType.INT64:
+        return _int64_wire(int(v))
+    if f.type == FieldType.BOOL:
+        return b"\x01" if v else b"\x00"
+    raise ValueError(f.type)
+
+
+def _is_zero(f: Field, v) -> bool:
+    if f.repeated:
+        return not v
+    if f.type == FieldType.DOUBLE:
+        # byte compare: -0.0 and NaN are NOT the zero value even though
+        # `not v` / v == 0.0 would say otherwise
+        return struct.pack("<d", float(v)) == struct.pack("<d", 0.0)
+    if f.type == FieldType.INT64:
+        return not v
+    if f.type == FieldType.BOOL:
+        return not v
+    if f.type == FieldType.BYTES:
+        return not v
+    if f.type == FieldType.MESSAGE:
+        return not v or not marshal(f.message, v)
+    raise ValueError(f.type)
+
+
+def marshal(schema: Schema, message: dict) -> bytes:
+    """Canonical wire bytes; ascending field number, zeros omitted."""
+    out = bytearray()
+    for f in sorted(schema.fields, key=lambda x: x.number):
+        v = message.get(f.name)
+        if v is None or _is_zero(f, v):
+            continue
+        if f.repeated:
+            if f.type in (FieldType.DOUBLE, FieldType.INT64, FieldType.BOOL):
+                # packed scalars (proto3 default)
+                payload = b"".join(_scalar_bytes(f, e) for e in v)
+                out += _tag(f.number, _WT_LEN) + _uvarint(len(payload)) + payload
+            else:
+                for e in v:
+                    payload = (marshal(f.message, e)
+                               if f.type == FieldType.MESSAGE else bytes(e))
+                    out += _tag(f.number, _WT_LEN) + _uvarint(len(payload)) + payload
+        elif f.type == FieldType.DOUBLE:
+            out += _tag(f.number, _WT_FIXED64) + struct.pack("<d", float(v))
+        elif f.type == FieldType.INT64:
+            out += _tag(f.number, _WT_VARINT) + _int64_wire(int(v))
+        elif f.type == FieldType.BOOL:
+            out += _tag(f.number, _WT_VARINT) + b"\x01"
+        elif f.type == FieldType.BYTES:
+            out += _tag(f.number, _WT_LEN) + _uvarint(len(v)) + bytes(v)
+        elif f.type == FieldType.MESSAGE:
+            payload = marshal(f.message, v)
+            out += _tag(f.number, _WT_LEN) + _uvarint(len(payload)) + payload
+    return bytes(out)
+
+
+def _decode_scalar(f: Field, data: bytes):
+    if f.type == FieldType.DOUBLE:
+        vals = [struct.unpack("<d", data[i:i + 8])[0]
+                for i in range(0, len(data), 8)]
+        return vals
+    if f.type in (FieldType.INT64, FieldType.BOOL):
+        out = []
+        pos = 0
+        while pos < len(data):
+            u, pos = _read_uvarint(data, pos)
+            if f.type == FieldType.BOOL:
+                out.append(bool(u))
+            else:
+                out.append(u - (1 << 64) if u >= (1 << 63) else u)
+        return out
+    raise ValueError(f.type)
+
+
+def unmarshal(schema: Schema, data: bytes) -> dict:
+    """Wire bytes -> message dict (zero values materialized); accepts any
+    field order and both packed/unpacked repeated scalars."""
+    by_num = {f.number: f for f in schema.fields}
+    msg: dict = {}
+    pos = 0
+    while pos < len(data):
+        key, pos = _read_uvarint(data, pos)
+        number, wt = key >> 3, key & 7
+        if wt == _WT_VARINT:
+            raw, pos = _read_uvarint(data, pos)
+            payload = None
+        elif wt == _WT_FIXED64:
+            if pos + 8 > len(data):
+                raise ValueError("truncated fixed64")
+            payload = data[pos:pos + 8]
+            pos += 8
+            raw = None
+        elif wt == _WT_LEN:
+            ln, pos = _read_uvarint(data, pos)
+            if pos + ln > len(data):
+                raise ValueError("truncated length-delimited field")
+            payload = data[pos:pos + ln]
+            pos += ln
+            raw = None
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        f = by_num.get(number)
+        if f is None:
+            continue  # unknown field: skip (proto semantics)
+        if f.repeated:
+            lst = msg.setdefault(f.name, [])
+            if f.type == FieldType.MESSAGE:
+                lst.append(unmarshal(f.message, payload))
+            elif f.type == FieldType.BYTES:
+                lst.append(payload)
+            elif wt == _WT_LEN:
+                lst.extend(_decode_scalar(f, payload))
+            elif f.type == FieldType.DOUBLE:
+                lst.append(struct.unpack("<d", payload)[0])
+            elif f.type == FieldType.BOOL:
+                lst.append(bool(raw))
+            else:
+                lst.append(raw - (1 << 64) if raw >= (1 << 63) else raw)
+        elif f.type == FieldType.DOUBLE:
+            msg[f.name] = struct.unpack("<d", payload)[0]
+        elif f.type == FieldType.INT64:
+            msg[f.name] = raw - (1 << 64) if raw >= (1 << 63) else raw
+        elif f.type == FieldType.BOOL:
+            msg[f.name] = bool(raw)
+        elif f.type == FieldType.BYTES:
+            msg[f.name] = payload
+        elif f.type == FieldType.MESSAGE:
+            msg[f.name] = unmarshal(f.message, payload)
+    # materialize zero values for absent fields
+    for f in schema.fields:
+        if f.name not in msg:
+            if f.repeated:
+                msg[f.name] = []
+            elif f.type == FieldType.MESSAGE:
+                msg[f.name] = unmarshal(f.message, b"")
+            else:
+                msg[f.name] = {FieldType.DOUBLE: 0.0, FieldType.INT64: 0,
+                               FieldType.BOOL: False,
+                               FieldType.BYTES: b""}[f.type]
+    return msg

@@ -1,5 +1,12 @@
 """Message schemas + per-namespace registry (dbnode/namespace schema
-registry role, reference namespace/types.go:254 SchemaRegistry)."""
+registry role, reference namespace/types.go:254 SchemaRegistry).
+
+Schemas describe the proto message shape the codec compresses:
+scalar fields (double/int64/bool/bytes), NESTED message fields (a
+sub-schema, compressed recursively with per-path state), and REPEATED
+fields of any type — the same surface the reference's schema-aware proto
+encoder handles (/root/reference/src/dbnode/encoding/proto/encoder.go
+custom fields vs non-custom marshaled fields)."""
 
 from __future__ import annotations
 
@@ -13,6 +20,7 @@ class FieldType(enum.Enum):
     INT64 = "int64"
     BOOL = "bool"
     BYTES = "bytes"
+    MESSAGE = "message"
 
 
 @dataclass(frozen=True)
@@ -20,6 +28,14 @@ class Field:
     number: int  # stable field id (proto field-number role)
     name: str
     type: FieldType
+    repeated: bool = False
+    # sub-schema for MESSAGE fields (required when type == MESSAGE)
+    message: "Schema | None" = None
+
+    def __post_init__(self):
+        if (self.type == FieldType.MESSAGE) != (self.message is not None):
+            raise ValueError(
+                f"field {self.name}: message schema iff type MESSAGE")
 
 
 @dataclass(frozen=True)
@@ -32,25 +48,36 @@ class Schema:
         if len(set(nums)) != len(nums):
             raise ValueError("duplicate field numbers")
 
+    def _field_doc(self, f: Field) -> dict:
+        doc = {"number": f.number, "name": f.name, "type": f.type.value}
+        if f.repeated:
+            doc["repeated"] = True
+        if f.message is not None:
+            doc["message"] = json.loads(f.message.to_json())
+        return doc
+
     def to_json(self) -> bytes:
         return json.dumps({
             "name": self.name,
-            "fields": [
-                {"number": f.number, "name": f.name, "type": f.type.value}
-                for f in self.fields
-            ],
+            "fields": [self._field_doc(f) for f in self.fields],
         }).encode()
 
     @classmethod
     def from_json(cls, raw: bytes) -> "Schema":
         doc = json.loads(raw)
-        return cls(
-            name=doc["name"],
-            fields=tuple(
-                Field(f["number"], f["name"], FieldType(f["type"]))
-                for f in doc["fields"]
-            ),
-        )
+
+        def parse(d: dict) -> "Schema":
+            return cls(
+                name=d["name"],
+                fields=tuple(
+                    Field(f["number"], f["name"], FieldType(f["type"]),
+                          repeated=f.get("repeated", False),
+                          message=parse(f["message"]) if "message" in f else None)
+                    for f in d["fields"]
+                ),
+            )
+
+        return parse(doc)
 
 
 class SchemaRegistry:

@@ -1,0 +1,221 @@
+"""Proto encoding depth: nested messages, repeated fields, custom-marshal
+(round-4 VERDICT missing #4), with hypothesis round-trip property tests
+over fixture schemas (SURVEY §4 tier 2 — the reference's gopter
+round_trip_prop_test.go for encoding/proto).
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from m3_tpu.encoding.proto import custom_marshal
+from m3_tpu.encoding.proto.codec import decode, encode_messages
+from m3_tpu.encoding.proto.schema import Field, FieldType, Schema
+from m3_tpu.utils.xtime import TimeUnit
+
+NS = 10**9
+
+# -- fixture schemas ---------------------------------------------------------
+
+POINT = Schema("Point", (
+    Field(1, "lat", FieldType.DOUBLE),
+    Field(2, "lon", FieldType.DOUBLE),
+    Field(3, "label", FieldType.BYTES),
+))
+
+VEHICLE = Schema("Vehicle", (
+    Field(1, "speed", FieldType.DOUBLE),
+    Field(2, "odometer", FieldType.INT64),
+    Field(3, "engaged", FieldType.BOOL),
+    Field(4, "vin", FieldType.BYTES),
+    Field(5, "position", FieldType.MESSAGE, message=POINT),
+    Field(6, "route", FieldType.MESSAGE, repeated=True, message=POINT),
+    Field(7, "temps", FieldType.DOUBLE, repeated=True),
+    Field(8, "codes", FieldType.INT64, repeated=True),
+))
+
+
+def _roundtrip(schema, points):
+    stream = encode_messages(0, schema, points, TimeUnit.SECOND)
+    out = decode(stream, schema, TimeUnit.SECOND)
+    assert len(out) == len(points)
+    return stream, out
+
+
+def _assert_msg_equal(schema, got, want_normalized):
+    for f in schema.fields:
+        g, w = got[f.name], want_normalized[f.name]
+        if f.repeated:
+            assert len(g) == len(w), f.name
+            for ge, we in zip(g, w):
+                _assert_value_equal(f, ge, we)
+        else:
+            _assert_value_equal(f, g, w)
+
+
+def _assert_value_equal(f, g, w):
+    if f.type == FieldType.DOUBLE:
+        assert struct.pack("<d", g) == struct.pack("<d", w)
+    elif f.type == FieldType.MESSAGE:
+        _assert_msg_equal(f.message, g, w)
+    else:
+        assert g == w, f.name
+
+
+class TestNestedAndRepeated:
+    def test_nested_message_roundtrip_and_delta_compression(self):
+        pts = []
+        for i in range(50):
+            pts.append((i * NS, {
+                "speed": 30.0 + i * 0.1,
+                "odometer": 100000 + i,
+                "engaged": True,
+                "vin": b"5YJ3E1EA7KF000316",
+                "position": {"lat": 37.77 + i * 1e-5, "lon": -122.41,
+                             "label": b"sf"},
+            }))
+        stream, out = _roundtrip(VEHICLE, pts)
+        assert out[-1].message["position"]["lat"] == pytest.approx(
+            37.77 + 49e-5)
+        assert out[-1].message["position"]["label"] == b"sf"
+        # nested lon never changes after the first dp: the recursive
+        # bitmask must make repeats nearly free (well under full re-encode)
+        assert len(stream) < 50 * 40
+
+    def test_repeated_scalars_roundtrip(self):
+        pts = [
+            (0, {"temps": [1.5, -2.5, float("nan")], "codes": [1, -5, 1 << 40]}),
+            (NS, {"temps": [1.5, -2.5, float("nan")], "codes": [1, -5, 1 << 40]}),
+            (2 * NS, {"temps": [], "codes": [7]}),
+        ]
+        _, out = _roundtrip(VEHICLE, pts)
+        assert math.isnan(out[0].message["temps"][2])
+        assert out[1].message["codes"] == [1, -5, 1 << 40]
+        assert out[2].message["temps"] == []
+        assert out[2].message["codes"] == [7]
+
+    def test_repeated_messages_dict_compress_repeats(self):
+        route = [{"lat": 1.0, "lon": 2.0, "label": b"wp"}] * 3
+        pts = [(i * NS, {"route": route}) for i in range(20)]
+        stream, out = _roundtrip(VEHICLE, pts)
+        got = out[-1].message["route"]
+        assert len(got) == 3
+        assert got[0]["lat"] == 1.0 and got[0]["label"] == b"wp"
+        # identical element bytes dict-hit after the first occurrence
+        assert len(stream) < 200
+
+    def test_field_absent_vs_zero(self):
+        pts = [(0, {"speed": 5.0}), (NS, {})]
+        _, out = _roundtrip(VEHICLE, pts)
+        assert out[1].message["speed"] == 0.0
+        assert out[1].message["position"]["lat"] == 0.0
+        assert out[1].message["route"] == []
+
+
+class TestCustomMarshal:
+    def test_deterministic_and_order_independent(self):
+        m1 = {"lat": 1.25, "lon": -7.0, "label": b"x"}
+        m2 = {"label": b"x", "lon": -7.0, "lat": 1.25}
+        assert custom_marshal.marshal(POINT, m1) == custom_marshal.marshal(POINT, m2)
+
+    def test_zero_values_omitted(self):
+        assert custom_marshal.marshal(POINT, {"lat": 0.0, "label": b""}) == b""
+        # -0.0 is NOT the zero value (distinct bit pattern)
+        assert custom_marshal.marshal(POINT, {"lat": -0.0}) != b""
+
+    def test_wire_bytes_are_valid_protobuf(self):
+        # hand-checked canonical bytes: field 2 (lon) fixed64 then field 3
+        raw = custom_marshal.marshal(POINT, {"lon": 2.0, "label": b"ab"})
+        assert raw == (b"\x11" + struct.pack("<d", 2.0)  # tag(2,1)
+                       + b"\x1a\x02ab")  # tag(3,2) len 2
+        back = custom_marshal.unmarshal(POINT, raw)
+        assert back["lon"] == 2.0 and back["label"] == b"ab"
+        assert back["lat"] == 0.0
+
+    def test_unknown_fields_skipped(self):
+        raw = custom_marshal.marshal(POINT, {"lat": 3.5})
+        # append an unknown varint field number 15
+        raw2 = raw + b"\x78\x05"
+        assert custom_marshal.unmarshal(POINT, raw2)["lat"] == 3.5
+
+    def test_nested_and_packed_repeated(self):
+        raw = custom_marshal.marshal(VEHICLE, {
+            "odometer": -3,
+            "codes": [1, 2, 300],
+            "position": {"lat": 1.0},
+            "route": [{"lon": 2.0}, {}],
+        })
+        back = custom_marshal.unmarshal(VEHICLE, raw)
+        assert back["odometer"] == -3
+        assert back["codes"] == [1, 2, 300]
+        assert back["position"]["lat"] == 1.0
+        assert back["route"][0]["lon"] == 2.0
+        # empty message elements marshal to zero-length payloads and come
+        # back as all-zero messages
+        assert back["route"][1]["lat"] == 0.0
+
+
+# -- hypothesis property tier ------------------------------------------------
+
+_doubles = st.floats(allow_nan=True, allow_infinity=True, width=64)
+_ints = st.integers(min_value=-(1 << 62), max_value=1 << 62)
+_bytestr = st.binary(max_size=12)
+
+_point_msgs = st.fixed_dictionaries({}, optional={
+    "lat": _doubles, "lon": _doubles, "label": _bytestr,
+})
+
+_vehicle_msgs = st.fixed_dictionaries({}, optional={
+    "speed": _doubles,
+    "odometer": _ints,
+    "engaged": st.booleans(),
+    "vin": _bytestr,
+    "position": _point_msgs,
+    "route": st.lists(_point_msgs, max_size=4),
+    "temps": st.lists(_doubles, max_size=4),
+    "codes": st.lists(_ints, max_size=4),
+})
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(_vehicle_msgs, min_size=1, max_size=12), st.data())
+def test_prop_roundtrip_vehicle(msgs, data):
+    from m3_tpu.encoding.proto.codec import _normalize
+    import m3_tpu.encoding.proto.codec as codec_mod
+
+    ts = sorted(data.draw(st.lists(
+        st.integers(min_value=0, max_value=10**6), min_size=len(msgs),
+        max_size=len(msgs), unique=True)))
+    pts = list(zip([t * NS for t in ts], msgs))
+    _, out = _roundtrip(VEHICLE, pts)
+    for (t, msg), got in zip(pts, out):
+        assert got.timestamp_ns == t
+        want = {f.name: _normalize(f, msg.get(f.name))
+                for f in VEHICLE.fields}
+        _assert_msg_equal(VEHICLE, got.message, want)
+
+
+@settings(max_examples=60, deadline=None)
+@given(_vehicle_msgs)
+def test_prop_custom_marshal_roundtrip(msg):
+    from m3_tpu.encoding.proto.codec import _normalize
+
+    raw = custom_marshal.marshal(VEHICLE, msg)
+    back = custom_marshal.unmarshal(VEHICLE, raw)
+    want = {f.name: _normalize(f, msg.get(f.name)) for f in VEHICLE.fields}
+    # marshal canonicalization: re-marshal of the unmarshaled form is
+    # byte-identical (the determinism the byte-dict compression needs)
+    assert custom_marshal.marshal(VEHICLE, back) == raw
+    _assert_msg_equal(VEHICLE, back, want)
+
+
+def test_schema_json_roundtrip_nested():
+    raw = VEHICLE.to_json()
+    back = Schema.from_json(raw)
+    assert back == VEHICLE
+    assert back.fields[5].message == POINT and back.fields[5].repeated
