@@ -27,10 +27,14 @@ from m3_tpu.metrics.aggregation import (
     MetricType,
 )
 from m3_tpu.metrics.policy import StoragePolicy
-from m3_tpu.metrics.rules import Matcher, RuleSet
+from m3_tpu.metrics.rules import Matcher, PipelineStage, RuleSet
 from m3_tpu.metrics.transformation import TransformationType, apply as apply_transform
 from m3_tpu.ops import windowed_agg
 from m3_tpu.utils.hash import murmur3_32
+
+# flush-history depth bound: stage-k windows close against the k-th
+# previous flush, so chains deeper than this could never close
+MAX_PIPELINE_STAGES = 16
 
 
 @dataclass(frozen=True)
@@ -39,12 +43,12 @@ class ElemKey:
     policy: StoragePolicy
     aggregations: tuple[AggregationType, ...]
     transform: TransformationType | None = None
-    # (aggregations, resolution_ns) of a SECOND aggregation stage the
-    # first stage's window outputs forward into (multi-stage pipelines,
-    # reference forwarded_writer.go)
-    forward: tuple[tuple[AggregationType, ...], int] | None = None
-    # second-stage elems carry their SOURCE stage's resolution so two
-    # first-stage policies forwarding into equal target policies stay
+    # REMAINING pipeline stages this elem's window outputs forward into
+    # (arbitrary depth — the reference's numForwardedTimes chains,
+    # forwarded_writer.go + metrics/pipeline). Empty = emit directly.
+    forward: "tuple[PipelineStage, ...]" = ()
+    # forwarded-stage elems carry their SOURCE stage's resolution so two
+    # upstream policies forwarding into equal target policies stay
     # distinct instead of conflating their streams
     source_resolution_ns: int = 0
 
@@ -58,9 +62,12 @@ class Elem:
     # previous emitted window aggregate per aggregation (for binary
     # transforms like PerSecond), keyed by aggregation type
     prev: dict[AggregationType, tuple[int, float]] = field(default_factory=dict)
-    # marks an elem as a second pipeline stage (its windows close against
-    # the PREVIOUS flush watermark, not now — see flush())
-    second_stage: bool = False
+    # pipeline depth: 0 = fed by raw adds; k>0 = fed by stage k-1's
+    # forwarded outputs (windows close against the k-th previous flush
+    # watermark — see flush())
+    stage: int = 0
+    # per-stage extra lateness allowance (PipelineStage.buffer_past_ns)
+    stage_buffer_past_ns: int = 0
 
 
 @dataclass
@@ -134,12 +141,14 @@ class Aggregator:
         # samples landing in them are rejected (reference buffer-past rule)
         self._watermark_ns = 0
         self._elem_res: list[int] = []
-        self._elem_second: list[bool] = []
+        self._elem_stage: list[int] = []
+        self._elem_stage_bp: list[int] = []
         self._n_quantile_elems = 0
-        # completion time of the previous flush: second-stage windows may
-        # only close once EVERY source window feeding them was forwarded,
-        # i.e. when their end precedes the previous flush's watermark
-        self._last_flush_ns = 0
+        # completion times of recent flushes, most recent first: a stage-k
+        # elem's windows may only close once EVERY upstream window feeding
+        # them was forwarded, i.e. after k full flush passes — its
+        # threshold is the k-th previous flush's watermark
+        self._flush_history: list[int] = []
 
     # -- add path --
 
@@ -147,15 +156,16 @@ class Aggregator:
         return murmur3_32(series_id) % self.n_shards
 
     def _elem(self, key: ElemKey, tags, metric_type: MetricType,
-              second_stage: bool = False) -> Elem:
+              stage: int = 0, stage_buffer_past_ns: int = 0) -> Elem:
         e = self._elems.get(key)
         if e is None:
             e = Elem(len(self._elem_list), key, tuple(tags), metric_type,
-                     second_stage=second_stage)
+                     stage=stage, stage_buffer_past_ns=stage_buffer_past_ns)
             self._elems[key] = e
             self._elem_list.append(e)
             self._elem_res.append(key.policy.resolution_ns)
-            self._elem_second.append(second_stage)
+            self._elem_stage.append(stage)
+            self._elem_stage_bp.append(stage_buffer_past_ns)
             if any(a.quantile is not None for a in key.aggregations):
                 self._n_quantile_elems += 1
         return e
@@ -185,10 +195,13 @@ class Aggregator:
                 )
                 self._append(series_id, elem, t_ns, value)
         for _rule, target, rolled_id, rolled_tags in result.rollups:
-            forward = None
-            if target.forward_aggregations and target.forward_resolution_ns:
-                forward = (tuple(target.forward_aggregations),
-                           target.forward_resolution_ns)
+            forward = target.stages()
+            if len(forward) >= MAX_PIPELINE_STAGES:
+                # deeper chains would outrun the flush-history window and
+                # silently never close — reject loudly instead
+                raise ValueError(
+                    f"pipeline depth {len(forward) + 1} exceeds the "
+                    f"supported {MAX_PIPELINE_STAGES} stages")
             for policy in target.policies:
                 elem = self._elem(
                     ElemKey(rolled_id, policy, tuple(target.aggregations),
@@ -223,10 +236,24 @@ class Aggregator:
             self._watermark_ns = max(self._watermark_ns, now_ns)
             res_by_elem = (np.array(self._elem_res, np.int64)
                            if self._elem_res else np.zeros(0, np.int64))
-            second_by_elem = (np.array(self._elem_second, bool)
-                              if self._elem_second else np.zeros(0, bool))
+            stage_by_elem = (np.array(self._elem_stage, np.int64)
+                             if self._elem_stage else np.zeros(0, np.int64))
+            stage_bp_by_elem = (np.array(self._elem_stage_bp, np.int64)
+                                if self._elem_stage_bp
+                                else np.zeros(0, np.int64))
             taken = {sid: buf.take() for sid, buf in self._shards.items()}
             carries = {sid: self._carry.pop(sid, None) for sid in self._shards}
+            # stage-k threshold: the k-th previous flush's completion —
+            # after k full passes every upstream window feeding a stage-k
+            # window has been forwarded (exact completeness regardless of
+            # tick cadence). Unreached depths never close.
+            max_stage = int(stage_by_elem.max()) if len(stage_by_elem) else 0
+            thresholds = np.full(max_stage + 1, np.iinfo(np.int64).min,
+                                 np.int64)
+            thresholds[0] = now_ns
+            for k in range(1, max_stage + 1):
+                if len(self._flush_history) >= k:
+                    thresholds[k] = self._flush_history[k - 1]
         for shard_id in taken:
             e_idx, times, values = taken[shard_id]
             carry = carries[shard_id]
@@ -238,16 +265,9 @@ class Aggregator:
                 continue
             res = res_by_elem[e_idx]
             window_end = (times // res + 1) * res
-            # second-stage elems close against the PREVIOUS flush time:
-            # every source window ending before that was forwarded during
-            # that flush and is visible now — exact completeness
-            # regardless of tick cadence
-            second = second_by_elem[e_idx]
-            closed = np.where(
-                second,
-                window_end + self.buffer_past_ns <= self._last_flush_ns,
-                window_end + self.buffer_past_ns <= now_ns,
-            )
+            thr = thresholds[stage_by_elem[e_idx]]
+            closed = (window_end + self.buffer_past_ns
+                      + stage_bp_by_elem[e_idx] <= thr)
             if not closed.all():
                 keep = ~closed
                 with self._lock:
@@ -262,7 +282,8 @@ class Aggregator:
             )
             out.extend(self._emit(ge, gw, stats, vq, offsets))
         out.sort(key=lambda m: (m.timestamp_ns, m.series_id))
-        self._last_flush_ns = max(self._last_flush_ns, now_ns)
+        self._flush_history.insert(0, now_ns)
+        del self._flush_history[MAX_PIPELINE_STAGES:]
         return out
 
     def _emit(self, ge, gw, stats, vq, offsets) -> list[AggregatedMetric]:
@@ -302,10 +323,10 @@ class Aggregator:
                     tags = tuple(
                         (k, v + suffix if k == b"__name__" else v) for k, v in tags
                     )
-                if elem.key.forward is not None:
-                    # multi-stage pipeline: the first-stage window aggregate
-                    # is FORWARDED into the coarser second stage instead of
-                    # emitted (forwarded_writer.go role, in-process here;
+                if elem.key.forward:
+                    # multi-stage pipeline: this stage's window aggregate
+                    # is FORWARDED into the next stage instead of emitted
+                    # (forwarded_writer.go role, in-process here;
                     # cross-instance forwarding rides the msg topic)
                     self._forward(elem, suffix, tags, w_end, res, value)
                     continue
@@ -322,18 +343,22 @@ class Aggregator:
 
     def _forward(self, elem: Elem, suffix: bytes, tags, w_end: int,
                  res: int, value: float) -> None:
-        """AddForwarded: route a first-stage window aggregate into its
-        second-stage elem. Timestamped at the source window START so it
-        lands in the second-stage window covering that span; second-stage
-        windows close against the previous flush watermark (see flush())
-        so late first-stage outputs always land first."""
-        fwd_aggs, fwd_res = elem.key.forward
-        policy = StoragePolicy(fwd_res, elem.key.policy.retention_ns)
-        fkey = ElemKey(elem.key.series_id + suffix, policy, fwd_aggs,
+        """AddForwarded: route a window aggregate into the NEXT pipeline
+        stage's elem. Timestamped at the source window START so it lands
+        in the next stage's window covering that span; stage-k windows
+        close against the k-th previous flush watermark (see flush()) so
+        late upstream outputs always land first."""
+        stage = elem.key.forward[0]
+        rest = elem.key.forward[1:]
+        policy = StoragePolicy(stage.resolution_ns,
+                               elem.key.policy.retention_ns)
+        fkey = ElemKey(elem.key.series_id + suffix, policy,
+                       tuple(stage.aggregations), forward=rest,
                        source_resolution_ns=res)
         with self._lock:
             felem = self._elem(fkey, tags, elem.metric_type,
-                               second_stage=True)
+                               stage=elem.stage + 1,
+                               stage_buffer_past_ns=stage.buffer_past_ns)
             shard = self._shards[self._shard_for(fkey.series_id)]
             if shard.n >= self.max_buffered_per_shard:
                 self.num_dropped += 1

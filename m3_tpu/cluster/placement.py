@@ -39,6 +39,9 @@ class Instance:
     endpoint: str = ""
     shards: dict[int, Shard] = field(default_factory=dict)
     shard_set_id: int = 0  # mirrored placements: paired instances share ids
+    # subclustered placements: all RF replicas of a shard live within one
+    # subcluster (cluster/placement/algo/subclustered.go role); 0 = none
+    sub_cluster_id: int = 0
 
     def shard_ids(self, *states: ShardState) -> list[int]:
         if not states:
@@ -88,6 +91,7 @@ class Placement:
                         "weight": inst.weight,
                         "endpoint": inst.endpoint,
                         "shard_set_id": inst.shard_set_id,
+                        "sub_cluster_id": inst.sub_cluster_id,
                         "shards": [
                             {"id": s.id, "state": s.state.value, "source": s.source_id}
                             for s in inst.shards.values()
@@ -114,6 +118,7 @@ class Placement:
                 weight=d.get("weight", 1),
                 endpoint=d.get("endpoint", ""),
                 shard_set_id=d.get("shard_set_id", 0),
+                sub_cluster_id=d.get("sub_cluster_id", 0),
             )
             for s in d["shards"]:
                 inst.shards[s["id"]] = Shard(
@@ -176,17 +181,12 @@ def _least_loaded(p: Placement, exclude: set[str], avoid_groups: set[str]) -> In
     return min(pool, key=lambda i: (load(i), i.id))
 
 
-def add_instance(p: Placement, new: Instance) -> Placement:
-    """Move a fair share of shards onto the new instance; moved shards are
-    INITIALIZING on the target (sourced from the donor) and stay AVAILABLE
-    on the donor until the target finishes bootstrapping."""
-    out = Placement.from_json(p.to_json())
-    new_inst = _bare_copy(new)
-    out.instances[new_inst.id] = new_inst
-    total = p.n_shards * p.replica_factor
-    target_load = total // len(out.instances)
-    donors = sorted(out.instances.values(), key=_active_shards, reverse=True)
-    for donor in donors:
+def _move_fair_share(donors: list[Instance], new_inst: Instance,
+                     target_load: int) -> None:
+    """Stream shards from the most-loaded donors onto a joining instance
+    until it carries target_load: INITIALIZING on the target (sourced from
+    the donor), LEAVING on the donor until bootstrap completes."""
+    for donor in sorted(donors, key=_active_shards, reverse=True):
         if donor.id == new_inst.id:
             continue
         while (len(new_inst.shards) < target_load
@@ -200,28 +200,78 @@ def add_instance(p: Placement, new: Instance) -> Placement:
             sh = movable[0]
             new_inst.shards[sh.id] = Shard(sh.id, ShardState.INITIALIZING, donor.id)
             donor.shards[sh.id] = Shard(sh.id, ShardState.LEAVING)
+
+
+def add_instance(p: Placement, new: Instance) -> Placement:
+    """Move a fair share of shards onto the new instance; moved shards are
+    INITIALIZING on the target (sourced from the donor) and stay AVAILABLE
+    on the donor until the target finishes bootstrapping."""
+    out = Placement.from_json(p.to_json())
+    new_inst = _bare_copy(new)
+    out.instances[new_inst.id] = new_inst
+    total = p.n_shards * p.replica_factor
+    target_load = total // len(out.instances)
+    _move_fair_share(list(out.instances.values()), new_inst, target_load)
     out.version += 1
     return out
 
 
-def remove_instance(p: Placement, instance_id: str) -> Placement:
-    """Reassign the leaving instance's shards to the least-loaded peers."""
+def remove_instance(p: Placement, instance_id: str,
+                    within_subcluster: bool = False) -> Placement:
+    """Reassign the leaving instance's shards, minimizing churn
+    (reference algo/sharded.go selection): an instance ALREADY holding the
+    shard in LEAVING state reclaims it in place (zero data movement —
+    reverses an unfinished move); otherwise the least-loaded peer outside
+    the current owners' isolation groups streams it."""
     out = Placement.from_json(p.to_json())
     leaving = out.instances.get(instance_id)
     if leaving is None:
         raise KeyError(instance_id)
     for sid in list(leaving.shards):
         leaving.shards[sid] = Shard(sid, ShardState.LEAVING)
-        current_owners = {
-            i.id for i in out.instances.values()
+        owners = [
+            i for i in out.instances.values()
             if sid in i.shards and i.shards[sid].state != ShardState.LEAVING
-        }
-        target = _least_loaded(
-            out,
-            exclude=current_owners | {instance_id},
-            avoid_groups=set(),
-        )
+        ]
+        # churn-minimizing reclaim: a peer mid-handoff of this same shard
+        # keeps it instead of a third instance streaming a fresh copy
+        reclaim = [
+            i for i in out.instances.values()
+            if i.id != instance_id and sid in i.shards
+            and i.shards[sid].state == ShardState.LEAVING
+            and (not within_subcluster
+                 or i.sub_cluster_id == leaving.sub_cluster_id)
+        ]
+        if reclaim:
+            reclaim[0].shards[sid] = Shard(sid, ShardState.AVAILABLE)
+            # the cancelled handoff leaves nothing to stream: the leaver's
+            # copy can drop right away (mark_available would never reap it
+            # — no INITIALIZING shard links back via source_id)
+            del leaving.shards[sid]
+            continue
+        exclude = {i.id for i in owners} | {instance_id}
+        if within_subcluster:
+            exclude |= {i.id for i in out.instances.values()
+                        if i.sub_cluster_id != leaving.sub_cluster_id}
+        try:
+            target = _least_loaded(
+                out,
+                exclude=exclude,
+                avoid_groups={i.isolation_group for i in owners},
+            )
+        except ValueError:
+            if within_subcluster:
+                # a subcluster sized exactly at RF has no spare member to
+                # take the shard; removal would break the invariant —
+                # the operator must replace_instance instead
+                raise ValueError(
+                    f"subcluster {leaving.sub_cluster_id} has no spare "
+                    f"instance for shard {sid}; use replace_instance (or "
+                    "add an instance to the subcluster first)") from None
+            raise
         target.shards[sid] = Shard(sid, ShardState.INITIALIZING, instance_id)
+    if not leaving.shards:
+        del out.instances[instance_id]  # nothing left to hand off
     out.version += 1
     return out
 
@@ -332,3 +382,101 @@ def mirrored_placement(pairs: list[tuple[Instance, Instance]], n_shards: int) ->
                 inst.shards[sid] = Shard(sid, ShardState.AVAILABLE)
     p.version = 1
     return p
+
+
+# ---------------------------------------------------------------------------
+# subclustered placement algorithm
+# ---------------------------------------------------------------------------
+#
+# Role parity with /root/reference/src/cluster/placement/algo/subclustered.go:
+# instances partition into fixed-size subclusters and every replica of a
+# shard lives WITHIN one subcluster, so a shard's replica group never spans
+# subcluster boundaries (bounds blast radius and keeps replica streams on
+# subcluster-local links — on TPU topology, a subcluster maps to one ICI
+# domain so replica traffic never crosses DCN).
+
+
+def subclustered_placement(
+    instances: list[Instance], n_shards: int, replica_factor: int,
+    instances_per_subcluster: int,
+) -> Placement:
+    """Initial subclustered placement. Each subcluster must be able to
+    hold RF replicas (instances_per_subcluster >= RF); shards spread over
+    subclusters round-robin, replicas within their subcluster preferring
+    distinct isolation groups."""
+    if instances_per_subcluster < replica_factor:
+        raise ValueError("subcluster smaller than replica factor")
+    if len(instances) < instances_per_subcluster:
+        raise ValueError("need at least one full subcluster")
+    p = Placement(n_shards=n_shards, replica_factor=replica_factor)
+    for i, inst in enumerate(instances):
+        cp = _bare_copy(inst)
+        cp.sub_cluster_id = i // instances_per_subcluster + 1
+        p.instances[cp.id] = cp
+    # only FULL subclusters take shards (a partial trailing group waits
+    # for members, reference semantics)
+    full = [
+        sc for sc in sorted({i.sub_cluster_id for i in p.instances.values()})
+        if sum(1 for i in p.instances.values() if i.sub_cluster_id == sc)
+        >= instances_per_subcluster
+    ]
+    if not full:
+        raise ValueError("no full subcluster")
+    for sid in range(n_shards):
+        sc = full[sid % len(full)]
+        members = {i.id for i in p.instances.values()
+                   if i.sub_cluster_id != sc}
+        owners: list[Instance] = []
+        for _r in range(replica_factor):
+            cand = _least_loaded(
+                p,
+                exclude=members | {o.id for o in owners},
+                avoid_groups={o.isolation_group for o in owners},
+            )
+            cand.shards[sid] = Shard(sid, ShardState.AVAILABLE)
+            owners.append(cand)
+    p.version = 1
+    return p
+
+
+def validate_subclusters(p: Placement) -> None:
+    """Every shard's non-LEAVING replicas share one subcluster."""
+    shard_sc: dict[int, set[int]] = {}
+    for inst in p.instances.values():
+        for sid, sh in inst.shards.items():
+            if sh.state != ShardState.LEAVING:
+                shard_sc.setdefault(sid, set()).add(inst.sub_cluster_id)
+    bad = {sid: scs for sid, scs in shard_sc.items() if len(scs) > 1}
+    if bad:
+        raise ValueError(f"shards spanning subclusters: {bad}")
+
+
+def add_instance_subclustered(
+    p: Placement, new: Instance, instances_per_subcluster: int,
+) -> Placement:
+    """Join the first under-full subcluster (or open a new one) and take a
+    fair share of THAT subcluster's shards only — the subcluster invariant
+    means a joining instance can only relieve its own group."""
+    out = Placement.from_json(p.to_json())
+    counts: dict[int, int] = {}
+    for inst in out.instances.values():
+        counts[inst.sub_cluster_id] = counts.get(inst.sub_cluster_id, 0) + 1
+    under = [sc for sc, n in sorted(counts.items())
+             if n < instances_per_subcluster]
+    sc = under[0] if under else max(counts) + 1
+    new_inst = _bare_copy(new)
+    new_inst.sub_cluster_id = sc
+    out.instances[new_inst.id] = new_inst
+    members = [i for i in out.instances.values()
+               if i.sub_cluster_id == sc and i.id != new_inst.id]
+    if members:
+        sc_load = sum(_active_shards(i) for i in members)
+        target_load = sc_load // (len(members) + 1)
+        _move_fair_share(members, new_inst, target_load)
+    out.version += 1
+    return out
+
+
+def remove_instance_subclustered(p: Placement, instance_id: str) -> Placement:
+    """Remove an instance; its shards stay within its subcluster."""
+    return remove_instance(p, instance_id, within_subcluster=True)

