@@ -247,6 +247,8 @@ class CoordinatorAPI:
             return self._influx_write(q, body)
         if path == "/api/v1/query_range":
             return self._query_range(q)
+        if path == "/api/v1/m3ql/query_range":
+            return self._m3ql_query_range(q)
         if path == "/api/v1/query":
             return self._query_instant(q)
         if path == "/api/v1/labels":
@@ -470,6 +472,19 @@ class CoordinatorAPI:
         end = _parse_time(q["end"][0])
         step = _parse_step(q["step"][0])
         result, eval_ts = self.engine.query_range(expr, start, end, step)
+        return 200, "application/json", self._render(result, eval_ts, matrix=True)
+
+    def _m3ql_query_range(self, q):
+        """M3QL pipe-syntax range query (the reference's experimental
+        /api/v1/m3ql endpoint role): parse with query.m3ql into the SAME
+        AST and evaluate on the shared engine."""
+        from m3_tpu.query import m3ql
+
+        expr = m3ql.parse(q["query"][0])
+        start = _parse_time(q["start"][0])
+        end = _parse_time(q["end"][0])
+        step = _parse_step(q["step"][0])
+        result, eval_ts = self.engine.query_range_expr(expr, start, end, step)
         return 200, "application/json", self._render(result, eval_ts, matrix=True)
 
     def _query_instant(self, q):

@@ -1,0 +1,340 @@
+"""M3QL front-end: the pipe-based query language, compiled to the SAME
+AST the PromQL engine evaluates.
+
+Role parity with the reference M3QL parser
+(/root/reference/src/query/parser/m3ql/grammar.peg — macros, pipelines of
+function calls with boolean/numeric/pattern/string/keyword arguments, and
+parenthesized nesting). Where the reference lowers to its common DAG ops,
+this compiles to m3_tpu.query.promql Expr nodes, so one evaluation engine
+(and one set of device kernels) serves both languages.
+
+Surface (the practically used M3QL core):
+
+    fetch name:cpu.util host:web* dc:ny        # tag matchers; * ? globs
+      | sum host dc                            # aggregate BY tags
+      | avg | min | max | count | stddev       # no tags = collapse all
+      | sumSeries / avgSeries ...              # explicit collapse aliases
+      | perSecond [5m]                         # rate() over the window
+      | increase [5m], irate, delta
+      | movingAverage 5m                       # avg_over_time window
+      | abs | ceil | floor | sqrt | log | exp  # elementwise math
+      | scale 2.5 | offset -3                  # arithmetic with a constant
+      | clamp-ish: removeAbove 10, removeBelow 1
+      | > 5, >= 5, < 5, <= 5, == 5, != 5       # comparison filters
+      | keepLastValue                          # last_over_time lookback
+      | head 5 / topk-style limiting (top k) / bottom k
+      | timeshift 1h                           # offset modifier
+    macros:  m = fetch name:reqs | sum dc; m | perSecond
+
+Keyword arguments (`sf:0.3`) are accepted wherever positional numbers are.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from m3_tpu.index.query import Matcher, MatchType
+from m3_tpu.query.promql import (
+    AggregateExpr,
+    BinaryExpr,
+    Call,
+    Expr,
+    MatrixSelector,
+    NumberLiteral,
+    VectorSelector,
+)
+
+NS = 1_000_000_000
+
+
+class M3QLError(ValueError):
+    pass
+
+
+# -- tokenizer ---------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>[ \t\r\n]+)
+  | (?P<comment>\#[^\n]*)
+  | (?P<pipe>\|)
+  | (?P<semi>;)
+  | (?P<eq>=)
+  | (?P<lparen>\()
+  | (?P<rparen>\))
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<op><=|>=|==|!=|<|>)
+  | (?P<word>[^ \t\r\n|;()="]+)
+""", re.X)
+
+
+@dataclass
+class _Tok:
+    kind: str
+    text: str
+
+
+def _tokenize(src: str) -> list[_Tok]:
+    out = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            raise M3QLError(f"bad character at {pos}: {src[pos]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind in ("ws", "comment"):
+            continue
+        out.append(_Tok(kind, m.group()))
+    out.append(_Tok("eof", ""))
+    return out
+
+
+# -- parser ------------------------------------------------------------------
+
+_NUMBER_RE = re.compile(r"^-?\d+(\.\d+)?$")
+_DURATION_RE = re.compile(r"^(\d+)(ms|s|m|h|d|w)$")
+_DUR_NS = {"ms": 10**6, "s": NS, "m": 60 * NS, "h": 3600 * NS,
+           "d": 86400 * NS, "w": 7 * 86400 * NS}
+
+
+def _duration_ns(text: str) -> int | None:
+    m = _DURATION_RE.match(text)
+    if not m:
+        return None
+    return int(m.group(1)) * _DUR_NS[m.group(2)]
+
+
+@dataclass
+class _CallSpec:
+    name: str
+    args: list  # str | float | Expr (nested pipeline)
+    keywords: dict
+
+
+class _Parser:
+    def __init__(self, toks: list[_Tok]):
+        self.toks = toks
+        self.i = 0
+        self.macros: dict[str, Expr] = {}
+
+    def peek(self) -> _Tok:
+        return self.toks[self.i]
+
+    def next(self) -> _Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def parse(self) -> Expr:
+        # (macro ;)* pipeline
+        while (self.peek().kind == "word"
+               and self.toks[self.i + 1].kind == "eq"):
+            name = self.next().text
+            self.next()  # =
+            self.macros[name] = self.pipeline()
+            if self.next().kind != "semi":
+                raise M3QLError(f"macro {name!r} must end with ';'")
+        expr = self.pipeline()
+        if self.peek().kind != "eof":
+            raise M3QLError(f"trailing input at {self.peek().text!r}")
+        return expr
+
+    def pipeline(self) -> Expr:
+        expr: Expr | None = None
+        while True:
+            spec = self.call_spec()
+            expr = _compile(spec, expr, self.macros)
+            if self.peek().kind == "pipe":
+                self.next()
+                continue
+            return expr
+
+    def call_spec(self) -> _CallSpec:
+        t = self.peek()
+        if t.kind == "lparen":
+            self.next()
+            inner = self.pipeline()
+            if self.next().kind != "rparen":
+                raise M3QLError("unbalanced parenthesis")
+            return _CallSpec("__nested__", [inner], {})
+        if t.kind not in ("word", "op"):
+            raise M3QLError(f"expected function, got {t.text!r}")
+        self.next()
+        spec = _CallSpec(t.text, [], {})
+        while True:
+            a = self.peek()
+            if a.kind == "lparen":
+                self.next()
+                inner = self.pipeline()
+                if self.next().kind != "rparen":
+                    raise M3QLError("unbalanced parenthesis")
+                spec.args.append(inner)
+                continue
+            if a.kind == "string":
+                self.next()
+                spec.args.append(a.text[1:-1])
+                continue
+            if a.kind == "word":
+                # keyword argument?  word ':' value is inside one token
+                self.next()
+                spec.args.append(a.text)
+                continue
+            return spec
+
+
+def _glob_to_matcher(name: str, pattern: str) -> Matcher:
+    if re.search(r"[*?{}\[\]]", pattern):
+        rx = _glob_to_regex(pattern)
+        return Matcher(MatchType.REGEXP, name.encode(), rx.encode())
+    return Matcher(MatchType.EQUAL, name.encode(), pattern.encode())
+
+
+def _glob_to_regex(glob: str) -> str:
+    out = []
+    i = 0
+    while i < len(glob):
+        ch = glob[i]
+        if ch == "*":
+            out.append(".*")
+        elif ch == "?":
+            out.append(".")
+        elif ch == "{":
+            j = glob.find("}", i)
+            if j < 0:
+                raise M3QLError(f"unclosed brace in {glob!r}")
+            out.append("(" + "|".join(re.escape(p)
+                                      for p in glob[i + 1:j].split(",")) + ")")
+            i = j
+        elif ch == "[":
+            j = glob.find("]", i)
+            if j < 0:
+                raise M3QLError(f"unclosed bracket in {glob!r}")
+            out.append(glob[i:j + 1])
+            i = j
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return "".join(out)
+
+
+_AGG_OPS = {
+    "sum": "sum", "avg": "avg", "min": "min", "max": "max",
+    "count": "count", "stddev": "stddev", "stdev": "stddev",
+    "median": "quantile",
+}
+_COLLAPSE = {"sumseries": "sum", "avgseries": "avg", "minseries": "min",
+             "maxseries": "max", "countseries": "count"}
+_RANGE_FNS = {"persecond": "rate", "increase": "increase", "irate": "irate",
+              "delta": "delta", "rate": "rate"}
+_MATH_FNS = {"abs", "ceil", "floor", "sqrt", "log", "exp", "ln", "log2",
+             "log10"}
+_DEFAULT_RANGE_NS = 5 * 60 * NS
+
+
+def _num(spec: _CallSpec, idx: int, default=None) -> float:
+    if idx < len(spec.args) and isinstance(spec.args[idx], str) \
+            and _NUMBER_RE.match(spec.args[idx]):
+        return float(spec.args[idx])
+    if default is None:
+        raise M3QLError(f"{spec.name} expects a numeric argument")
+    return default
+
+
+def _range_of(spec: _CallSpec, idx: int = 0) -> int:
+    for a in spec.args[idx:]:
+        if isinstance(a, str):
+            d = _duration_ns(a)
+            if d is not None:
+                return d
+    return _DEFAULT_RANGE_NS
+
+
+def _compile(spec: _CallSpec, upstream: Expr | None, macros: dict) -> Expr:
+    fn = spec.name.lower()
+    if spec.name == "__nested__":
+        return spec.args[0]
+    if spec.name in macros:
+        if upstream is not None:
+            raise M3QLError(f"macro {spec.name!r} cannot take pipe input")
+        return macros[spec.name]
+
+    if fn == "fetch":
+        if upstream is not None:
+            raise M3QLError("fetch must start a pipeline")
+        matchers = []
+        for a in spec.args:
+            if not isinstance(a, str) or ":" not in a:
+                raise M3QLError(f"fetch expects tag:pattern, got {a!r}")
+            tag, _, pattern = a.partition(":")
+            tag = {"name": "__name__"}.get(tag, tag)
+            matchers.append(_glob_to_matcher(tag, pattern))
+        if not matchers:
+            raise M3QLError("fetch needs at least one tag:pattern")
+        return VectorSelector(None, matchers)
+
+    if upstream is None:
+        raise M3QLError(f"{spec.name!r} needs pipe input (start with fetch)")
+
+    if fn in _AGG_OPS and fn != "median":
+        tags = tuple(a for a in spec.args if isinstance(a, str))
+        return AggregateExpr(_AGG_OPS[fn], upstream, grouping=tags,
+                             without=False)
+    if fn == "median":
+        tags = tuple(a for a in spec.args if isinstance(a, str))
+        return AggregateExpr("quantile", upstream,
+                             param=NumberLiteral(0.5), grouping=tags)
+    if fn in _COLLAPSE:
+        return AggregateExpr(_COLLAPSE[fn], upstream)
+    if fn in _RANGE_FNS:
+        rng = _range_of(spec)
+        return Call(_RANGE_FNS[fn],
+                    [MatrixSelector(_require_selector(upstream, spec), rng)])
+    if fn == "movingaverage":
+        rng = _range_of(spec)
+        return Call("avg_over_time",
+                    [MatrixSelector(_require_selector(upstream, spec), rng)])
+    if fn == "keeplastvalue":
+        rng = _range_of(spec)
+        return Call("last_over_time",
+                    [MatrixSelector(_require_selector(upstream, spec), rng)])
+    if fn in _MATH_FNS:
+        name = {"log": "ln"}.get(fn, fn)
+        return Call(name, [upstream])
+    if fn == "scale":
+        return BinaryExpr("*", upstream, NumberLiteral(_num(spec, 0)))
+    if fn == "offset":
+        return BinaryExpr("+", upstream, NumberLiteral(_num(spec, 0)))
+    if fn == "removeabove":
+        return Call("clamp_max", [upstream, NumberLiteral(_num(spec, 0))])
+    if fn == "removebelow":
+        return Call("clamp_min", [upstream, NumberLiteral(_num(spec, 0))])
+    if fn == "timeshift":
+        sel = _require_selector(upstream, spec)
+        d = _duration_ns(spec.args[0]) if spec.args else None
+        if d is None:
+            raise M3QLError("timeshift expects a duration")
+        sel.offset_ns = d
+        return sel
+    if fn in ("top", "head", "highestmax", "highestcurrent"):
+        k = _num(spec, 0, 5.0)
+        return AggregateExpr("topk", upstream, param=NumberLiteral(k))
+    if fn in ("bottom", "lowestcurrent"):
+        k = _num(spec, 0, 5.0)
+        return AggregateExpr("bottomk", upstream, param=NumberLiteral(k))
+    if spec.name in ("<", "<=", ">", ">=", "==", "!="):
+        return BinaryExpr(spec.name, upstream, NumberLiteral(_num(spec, 0)))
+    raise M3QLError(f"unknown m3ql function {spec.name!r}")
+
+
+def _require_selector(e: Expr, spec: _CallSpec) -> VectorSelector:
+    if not isinstance(e, VectorSelector):
+        raise M3QLError(
+            f"{spec.name} needs raw fetched series (apply it before "
+            "aggregations)")
+    return e
+
+
+def parse(src: str) -> Expr:
+    """M3QL source -> promql Expr AST."""
+    return _Parser(_tokenize(src)).parse()

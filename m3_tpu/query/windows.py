@@ -287,6 +287,53 @@ def extrapolated_rate(
     return np.where(ok & (sampled > 0), out, np.nan)
 
 
+def holt_winters(raws: RaggedSeries, eval_ts: np.ndarray, range_ns: int,
+                 sf: float, tf: float):
+    """Double exponential smoothing per window (upstream Prometheus
+    holt_winters / the reference temporal/holt_winters.go:90-140):
+    smoothed value s and trend b fold over the window's non-NaN samples;
+    needs >= 2 samples, NaN otherwise.
+
+    Columnar formulation: one pass over window OFFSETS with [S, n_steps]
+    state matrices — the per-sample recurrence is inherently sequential,
+    so the vectorization axis is (series x step), not time.
+    """
+    lo, hi = raws.window_bounds(eval_ts, range_ns)
+    n = len(raws.values)
+    if n == 0:
+        return np.full(lo.shape, np.nan)
+    max_len = int((hi - lo).max()) if lo.size else 0
+    shape = lo.shape
+    found_first = np.zeros(shape, bool)
+    found_second = np.zeros(shape, bool)
+    prev = np.zeros(shape)
+    curr = np.zeros(shape)
+    trend = np.zeros(shape)
+    idx = np.zeros(shape, np.int64)  # non-NaN samples consumed so far
+    for j in range(max_len):
+        pos = lo + j
+        valid = pos < hi
+        val = raws.values[np.clip(pos, 0, n - 1)]
+        valid &= ~np.isnan(val)
+        take_first = valid & ~found_first
+        curr = np.where(take_first, val, curr)
+        idx = idx + take_first
+        found_first |= take_first
+        sub = valid & found_first & ~take_first
+        take_second = sub & ~found_second
+        trend = np.where(take_second, val - curr, trend)
+        found_second |= take_second
+        # calcTrendValue(i-1): the second sample (i-1 == 0) uses b as-is
+        tv = np.where(idx == 1, trend,
+                      tf * (curr - prev) + (1 - tf) * trend)
+        new_curr = sf * val + (1 - sf) * (curr + tv)
+        prev = np.where(sub, curr, prev)
+        trend = np.where(sub, tv, trend)
+        curr = np.where(sub, new_curr, curr)
+        idx = idx + sub
+    return np.where(found_second, curr, np.nan)
+
+
 def instant_delta(raws: RaggedSeries, eval_ts: np.ndarray, range_ns: int,
                   is_counter: bool, is_rate: bool):
     """irate/idelta: from the last two samples in the window."""

@@ -95,6 +95,9 @@ QUERIES = [
     ("sort", "sort(rate(ctr[2m]))"),
     ("vector_and", "ctr and ctr{job=\"a\"}"),
     ("absent_present", "present_over_time(gauge_const[5m])"),
+    ("holt_winters_lin", "holt_winters(gauge_lin[5m], 0.5, 0.5)"),
+    ("absent_ot_present", "absent_over_time(gauge_const[5m])"),
+    ("absent_ot_missing", "absent_over_time(no_such_metric[5m])"),
 ]
 
 # analytic expectations: name -> fn(t_s) -> {series_key: value} where
@@ -137,6 +140,14 @@ def _analytic_expectations():
         # falls in (2,4] bucket (0.5,1]: 0.5 + (10/3-2)/2 * 0.5 = 0.8333..
         "histogram_q50": {"": const(0.5 + (20 / 3 * 0.5 - 2.0) / 2.0 * 0.5)},
         "absent_present": {"k=v": const(1.0)},
+        # linear data: Holt's double smoothing tracks exactly, so the
+        # smoothed value equals the window's LAST sample (at t, samples
+        # land on 15s marks -> last = t rounded down to 15)
+        "holt_winters_lin": {"k=v": lambda t: float(((t - START) // 15) * 15)},
+        # gauge_const always has samples -> absent_over_time returns no
+        # rows; a never-written metric -> constant 1 with empty labels
+        "absent_ot_present": {},
+        "absent_ot_missing": {"": const(1.0)},
     }, (q_start, q_end, q_step)
 
 

