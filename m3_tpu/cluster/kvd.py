@@ -299,7 +299,8 @@ class KvdServer:
             eph = json.loads(self.store.get(self.EPH_KEY).data.decode())
         except (KeyNotFound, ValueError):
             return
-        present = [k for k in eph if k in self.store.keys()]
+        existing = set(self.store.keys())
+        present = [k for k in eph if k in existing]
         if not present:
             return
         with self._lock:
@@ -515,7 +516,13 @@ class KvdServer:
 
         last_ok = time.monotonic()
         connected = False
+        # promotion requires a replica of the keyspace: either a bootstrap
+        # snapshot completed THIS session, or the journal restored one
+        # (else a standby restarted during a permanent primary outage
+        # could never promote — review finding)
+        ever_synced = bool(self.store.keys())
         while not self._closed.is_set() and self._standby.is_set():
+            channel = None
             try:
                 channel = grpc.insecure_channel(self._primary)
                 stub = channel.unary_stream(_method("Watch"))
@@ -525,7 +532,15 @@ class KvdServer:
                 for raw in stream:
                     connected = True
                     last_ok = time.monotonic()
-                    key, version, data, deleted, done, _rev = _dec_event(raw)
+                    key, version, data, deleted, done, rev = _dec_event(raw)
+                    # adopt the primary's revision clock: local re-stamps
+                    # must stay ABOVE every rev the primary ever issued, or
+                    # clients that cached primary revs drop all standby
+                    # events as replays after failover
+                    if rev:
+                        with self._lock:
+                            if rev > self._rev:
+                                self._rev = rev
                     if done:
                         # reconnect reconcile: replicated keys missing from
                         # the fresh snapshot were deleted while we were away
@@ -533,6 +548,7 @@ class KvdServer:
                                   if k not in seen]:
                             self._apply_replica(k, 0, b"", deleted=True)
                         in_bootstrap = False
+                        ever_synced = True
                         continue
                     if in_bootstrap:
                         seen.add(key)
@@ -545,9 +561,19 @@ class KvdServer:
                     # doesn't advance last_ok, so restart the clock here
                     last_ok = time.monotonic()
                     connected = False
+            finally:
+                if channel is not None:
+                    try:
+                        channel.close()
+                    except Exception:  # noqa: BLE001
+                        pass
             if self._closed.wait(0.3):
                 return
-            if time.monotonic() - last_ok > self._promote_after_s:
+            if ever_synced and \
+                    time.monotonic() - last_ok > self._promote_after_s:
+                # never promote a standby that has no replica of the
+                # keyspace — an empty promoted server would dual-write
+                # against a primary that was merely slow to boot
                 self._promote()
                 return
 
@@ -1017,6 +1043,10 @@ def main(argv=None) -> None:
         standby = kvd_cfg.get("standby_of", standby)
     if args.no_journal:
         journal = ""
+    if standby and journal == "kvd.journal":
+        # a primary and standby launched from one directory must not
+        # clobber each other's journal
+        journal = "kvd.standby.journal"
     server = KvdServer(listen, journal_path=journal or None,
                        standby_of=standby or None)
     print(f"m3kvd listening on port {server.port}", flush=True)

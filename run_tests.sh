@@ -3,6 +3,16 @@
 # sitecustomize doesn't dial the TPU relay at interpreter startup (hangs
 # every python process when the tunnel is down), and forces the CPU
 # platform with an 8-device virtual mesh for sharding tests.
+#
+# Lanes:
+#   run_tests.sh fast   — deselects the `slow`-marked files (multi-process
+#                         clusters, XLA parity sweeps); target < 2 min
+#   run_tests.sh [...]  — full suite (extra args pass through to pytest)
+ARGS=("$@")
+if [ "${1:-}" = "fast" ]; then
+  shift
+  ARGS=(-m "not slow" "$@")
+fi
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-  python -m pytest tests/ -q "$@"
+  python -m pytest tests/ -q "${ARGS[@]}"

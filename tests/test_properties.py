@@ -1,0 +1,271 @@
+"""Property-test tier (round-4 VERDICT missing #8; SURVEY §4 tier 2 — the
+reference's gopter suites: encoding round trips, commitlog read/write
+props, m3ninx search proptests comparing segment impls).
+
+hypothesis generates the adversarial inputs the example tests miss:
+out-of-order timestamps x time units x unit-change markers x int-opt mode
+for the codec; random tag corpora for the index; torn tails for the WAL.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from m3_tpu.encoding.m3tsz import native
+from m3_tpu.encoding.m3tsz.constants import float_to_bits
+from m3_tpu.encoding.m3tsz.decoder import decode
+from m3_tpu.encoding.m3tsz.encoder import Encoder
+from m3_tpu.utils.xtime import TimeUnit, unit_value_ns
+
+NS = 10**9
+
+# -- codec strategies --------------------------------------------------------
+
+_units = st.sampled_from([TimeUnit.SECOND, TimeUnit.MILLISECOND,
+                          TimeUnit.NANOSECOND])
+
+# values that exercise int-opt mode switches, XOR paths, and specials
+_values = st.one_of(
+    st.integers(min_value=-10**6, max_value=10**6).map(float),
+    st.floats(min_value=-1e9, max_value=1e9, allow_nan=False),
+    st.sampled_from([0.0, -0.0, 1.5, float("inf"), float("-inf")]),
+    st.floats(allow_nan=True, allow_infinity=False, width=64),
+)
+
+# deltas in UNITS; negatives exercise out-of-order writes
+_deltas = st.lists(st.integers(min_value=-500, max_value=5000),
+                   min_size=1, max_size=60)
+
+
+@settings(max_examples=80, deadline=None)
+@given(_deltas, st.data(), _units, st.booleans())
+def test_prop_codec_roundtrip_ooo_units_intopt(deltas, data, unit, int_opt):
+    """Scalar codec round trip: arbitrary (incl. backwards) unit-aligned
+    timestamps, mixed int/float values, both int-opt modes."""
+    u = unit_value_ns(unit)
+    start = 1_600_000_000 * NS
+    times = []
+    t = start
+    for d in deltas:
+        t = t + d * u
+        times.append(t)
+    values = [data.draw(_values) for _ in times]
+    if int_opt:
+        # int-opt diffs are computed in float64 on BOTH sides (reference
+        # encoder.go:160-214: valDiff := enc.intVal - val), so integral
+        # magnitudes >= 2^53 lose ULPs by design; keep the property inside
+        # the exact-int range and let the float-mode case cover the rest
+        values = [v if not (np.isfinite(v) and float(v).is_integer())
+                  else float(int(v) % (1 << 53)) for v in values]
+    def roundtrip(vals):
+        enc = Encoder(start, int_optimized=int_opt, default_time_unit=unit)
+        for ts, v in zip(times, vals):
+            enc.encode(ts, v, unit)
+        out = decode(enc.stream(), int_optimized=int_opt,
+                     default_time_unit=unit)
+        assert [d.timestamp_ns for d in out] == times
+        return [d.value for d in out]
+
+    first = roundtrip(values)
+    if not int_opt:
+        # float-XOR mode is bit-exact (NaN payloads included)
+        assert [float_to_bits(v) for v in first] == \
+            [float_to_bits(v) for v in values]
+        return
+    # int-opt mode carries the reference's documented canonicalizations
+    # (convertToIntFloat snaps values within 1 ULP of an integer —
+    # m3tsz.go:78-119 — and diffs ride float64). The property: any
+    # lossiness is IDEMPOTENT (one round trip canonicalizes; the second is
+    # bit-exact) and never moves a value by more than the snap tolerance.
+    for g, w in zip(first, values):
+        if np.isnan(w):
+            assert np.isnan(g)
+        else:
+            assert g == w or abs(g - w) <= abs(w) * 1e-15 + 5e-324
+    second = roundtrip(first)
+    assert [float_to_bits(v) for v in second] == \
+        [float_to_bits(v) for v in first]
+
+
+@settings(max_examples=40, deadline=None)
+@given(_deltas, st.data())
+def test_prop_codec_unit_change_markers(deltas, data):
+    """Mid-stream time-unit changes (marker opcodes) round-trip."""
+    start = 1_600_000_000 * NS
+    seq = []
+    t = start
+    for i, d in enumerate(deltas):
+        unit = data.draw(_units)
+        u = unit_value_ns(unit)
+        t = ((t + d * u) // u) * u  # aligned to THIS point's unit
+        seq.append((t, float(i), unit))
+    enc = Encoder(start, int_optimized=True)
+    for ts, v, unit in seq:
+        enc.encode(ts, v, unit)
+    out = decode(enc.stream(), int_optimized=True)
+    assert [(d.timestamp_ns, d.value) for d in out] == \
+        [(ts, v) for ts, v, _ in seq]
+
+
+@pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+@settings(max_examples=30, deadline=None)
+@given(st.lists(st.integers(min_value=1, max_value=3000), min_size=2,
+                max_size=40), st.data())
+def test_prop_native_scalar_python_byte_identity(deltas, data):
+    """The native v1 scalar codec and the Python scalar codec (float-XOR
+    mode, the native codec's documented contract) produce BYTE-IDENTICAL
+    streams (the frozen-baseline contract)."""
+    start = 1_600_000_000 * NS
+    times = np.cumsum(np.array(deltas, np.int64)) * NS + start
+    values = np.array([data.draw(_values) for _ in times])
+    enc = Encoder(start, int_optimized=False,
+                  default_time_unit=TimeUnit.SECOND)
+    for ts, v in zip(times.tolist(), values.tolist()):
+        enc.encode(ts, v, TimeUnit.SECOND)
+    py_stream = enc.stream()
+    nat_stream = native.encode_series(times, values, start, TimeUnit.SECOND)
+    assert nat_stream == py_stream
+
+
+class TestNativeBatchThreadIdentity:
+    """nthreads > 1 must be bit-identical to nthreads == 1 (round-4
+    VERDICT weak #5: the 'scales across cores' claim needs a determinism
+    pin, native/m3tsz.cpp parallel_over chunking)."""
+
+    @pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+    def test_encode_decode_identical_across_thread_counts(self):
+        rng = np.random.default_rng(7)
+        B, T = 257, 100  # odd B: uneven thread chunks
+        start = 1_600_000_000 * NS
+        times = start + np.cumsum(
+            rng.integers(1, 100, (B, T)), axis=1).astype(np.int64) * NS
+        values = np.where(rng.random((B, T)) < 0.5,
+                          rng.integers(0, 1000, (B, T)).astype(np.float64),
+                          rng.normal(0, 1e6, (B, T)))
+        streams_1 = native.encode_batch(times, values, times[:, 0] - NS,
+                                        TimeUnit.SECOND, threads=1)
+        streams_4 = native.encode_batch(times, values, times[:, 0] - NS,
+                                        TimeUnit.SECOND, threads=4)
+        assert streams_1 == streams_4
+        t1, v1, n1 = native.decode_batch(streams_1, TimeUnit.SECOND,
+                                         max_points=T, threads=1)
+        t4, v4, n4 = native.decode_batch(streams_1, TimeUnit.SECOND,
+                                         max_points=T, threads=4)
+        np.testing.assert_array_equal(n1, n4)
+        np.testing.assert_array_equal(t1, t4)
+        np.testing.assert_array_equal(v1, v4)
+
+
+# -- index properties --------------------------------------------------------
+
+_tagvals = st.sampled_from([b"a", b"b", b"ab", b"ba", b"x1", b"x2", b"y"])
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.lists(st.tuples(_tagvals, _tagvals), min_size=1, max_size=60),
+       _tagvals)
+def test_prop_packed_segment_matches_bruteforce(rows, needle):
+    """Packed-segment term/regex postings == brute-force scan (the m3ninx
+    search proptest shape: FST impl vs exhaustive)."""
+    import re
+
+    from m3_tpu.index import packed
+    from m3_tpu.index.segment import Document
+
+    docs = [Document(i, b"s%04d" % i, [(b"t", tv), (b"u", uv)])
+            for i, (tv, uv) in enumerate(rows)]
+    seg = packed.build(docs)
+    got = set(seg.postings_term(b"t", needle).tolist())
+    want = {i for i, (tv, _) in enumerate(rows) if tv == needle}
+    assert got == want
+    rx = re.compile(re.escape(needle[:1]) + b".*")
+    got_rx = set(seg.postings_regexp(b"t", rx).tolist())
+    want_rx = {i for i, (tv, _) in enumerate(rows) if rx.fullmatch(tv)}
+    assert got_rx == want_rx
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.lists(st.tuples(_tagvals, _tagvals), min_size=1, max_size=40),
+       st.integers(min_value=1, max_value=5))
+def test_prop_merge_equals_union(rows, n_parts):
+    """merge(partition(docs)) is doc-equivalent to build(docs)."""
+    from m3_tpu.index import packed
+    from m3_tpu.index.segment import Document
+
+    docs = [Document(i, b"s%04d" % i, [(b"t", tv), (b"u", uv)])
+            for i, (tv, uv) in enumerate(rows)]
+    whole = packed.build(docs)
+    parts = [packed.build(docs[k::n_parts]) for k in range(n_parts)]
+    merged = packed.merge([p for p in parts if p.n_docs])
+    assert merged.n_docs == whole.n_docs
+    assert sorted(d.series_id for d in merged.docs) == \
+        sorted(d.series_id for d in whole.docs)
+    for needle in {tv for tv, _ in rows}:
+        got = {merged.docs[i].series_id
+               for i in merged.postings_term(b"t", needle).tolist()}
+        want = {whole.docs[i].series_id
+                for i in whole.postings_term(b"t", needle).tolist()}
+        assert got == want
+
+
+# -- commitlog properties ----------------------------------------------------
+
+_entries = st.lists(
+    st.tuples(
+        st.sampled_from([b"s1", b"s2", b"series-long-name-3"]),
+        st.integers(min_value=0, max_value=10**12),
+        st.integers(min_value=0, max_value=(1 << 64) - 1),
+        st.integers(min_value=0, max_value=6),
+    ),
+    min_size=1, max_size=50,
+)
+
+
+@settings(max_examples=40, deadline=None)
+@given(_entries)
+def test_prop_commitlog_roundtrip(tmp_path_factory_entries):
+    entries = tmp_path_factory_entries
+    import tempfile
+
+    from m3_tpu.storage import commitlog
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "wal", "log.db")
+        w = commitlog.CommitLogWriter(path)
+        for sid, t, bits, unit in entries:
+            w.write(sid, b"tags:" + sid, t, bits, unit)
+        w.close()
+        got = commitlog.replay(path)
+        assert [(e.series_id, e.time_ns, e.value_bits, e.unit)
+                for e in got] == entries
+        assert all(e.encoded_tags == b"tags:" + e.series_id for e in got)
+
+
+@settings(max_examples=30, deadline=None)
+@given(_entries, st.integers(min_value=1, max_value=64))
+def test_prop_commitlog_torn_tail_yields_prefix(entries, cut):
+    """A torn final write (crash mid-append) must replay a clean PREFIX —
+    never an error, never corrupt entries (checkpoint/resume contract)."""
+    import tempfile
+
+    from m3_tpu.storage import commitlog
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "wal", "log.db")
+        w = commitlog.CommitLogWriter(path)
+        for sid, t, bits, unit in entries:
+            w.write(sid, b"", t, bits, unit)
+        w.close()
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(0, size - cut))
+        got = commitlog.replay(path)
+        want = [(e[0], e[1], e[2], e[3]) for e in entries]
+        got_t = [(e.series_id, e.time_ns, e.value_bits, e.unit) for e in got]
+        assert got_t == want[:len(got_t)]
