@@ -18,7 +18,13 @@ from m3_tpu.utils.xtime import TimeUnit, unit_value_ns
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "m3tsz.cpp")
-_SO = os.path.join(_REPO_ROOT, "native", "libm3tsz.so")
+# M3TSZ_SO points the loader at an instrumented build (tools/race_check.py
+# swaps in the ThreadSanitizer variant); overrides are loaded AS-IS (no
+# stale-mtime rebuild, which would overwrite the instrumented artifact
+# with a plain -O3 build)
+_SO_OVERRIDE = "M3TSZ_SO" in os.environ
+_SO = os.environ.get("M3TSZ_SO",
+                     os.path.join(_REPO_ROOT, "native", "libm3tsz.so"))
 
 _lock = threading.Lock()
 _lib = None
@@ -44,7 +50,8 @@ def load():
             return _lib
         _tried = True
         src_mtime = os.path.getmtime(_SRC) if os.path.exists(_SRC) else 0
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime:
+        if not _SO_OVERRIDE and (
+                not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime):
             if not _build():
                 return None
         try:

@@ -18,7 +18,12 @@ import numpy as np
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
 _SRC = os.path.join(_REPO_ROOT, "native", "hostops.cpp")
-_SO = os.path.join(_REPO_ROOT, "native", "libm3hostops.so")
+# M3HOSTOPS_SO points the loader at an instrumented build
+# (tools/race_check.py swaps in the ThreadSanitizer variant); overrides
+# load AS-IS — no stale-mtime rebuild over the instrumented artifact
+_SO_OVERRIDE = "M3HOSTOPS_SO" in os.environ
+_SO = os.environ.get("M3HOSTOPS_SO",
+                     os.path.join(_REPO_ROOT, "native", "libm3hostops.so"))
 
 _lock = threading.Lock()
 _lib = None
@@ -48,7 +53,8 @@ def load():
             return _lib
         _tried = True
         src_mtime = os.path.getmtime(_SRC) if os.path.exists(_SRC) else 0
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime:
+        if not _SO_OVERRIDE and (
+                not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime):
             if not _build():
                 return None
         try:

@@ -272,6 +272,25 @@ class Engine:
         "sinh": np.sinh,
         "cosh": np.cosh,
         "tanh": np.tanh,
+        "asinh": np.arcsinh,
+        "acosh": np.arccosh,
+        "atanh": np.arctanh,
+    }
+    # datetime component extractors over UTC second timestamps (upstream
+    # promql functions.go dateWrapper family); 1970-01-01 was a Thursday
+    _DATETIME = {
+        "minute": lambda s, D, M, Y: (s // 60) % 60,
+        "hour": lambda s, D, M, Y: (s // 3600) % 24,
+        "day_of_week": lambda s, D, M, Y: (D.astype(np.int64) + 4) % 7,
+        "day_of_month": lambda s, D, M, Y: (
+            D - M.astype("datetime64[D]")).astype(np.int64) + 1,
+        "day_of_year": lambda s, D, M, Y: (
+            D - Y.astype("datetime64[D]")).astype(np.int64) + 1,
+        "days_in_month": lambda s, D, M, Y: (
+            (M + 1).astype("datetime64[D]")
+            - M.astype("datetime64[D]")).astype(np.int64),
+        "month": lambda s, D, M, Y: (M - Y).astype(np.int64) + 1,
+        "year": lambda s, D, M, Y: Y.astype(np.int64) + 1970,
     }
 
     def _range_arg(self, e: Call, idx: int = 0):
@@ -406,6 +425,27 @@ class Engine:
             return Vector([{}], s.values[None, :])
         if fn == "time":
             return Scalar(eval_ts.astype(np.float64) / NS)
+        if fn == "pi":
+            return Scalar(np.full(len(eval_ts), math.pi))
+        if fn in self._DATETIME:
+            if e.args:
+                v = self._eval(e.args[0], eval_ts)
+                if not isinstance(v, Vector):
+                    raise EvalError(f"{fn}() expects an instant vector")
+                labels = v.drop_name().labels
+                vals = v.values
+            else:
+                # no argument: the evaluation timestamps themselves
+                labels = [{}]
+                vals = (eval_ts.astype(np.float64) / NS)[None, :]
+            secs = np.floor(vals)
+            safe = np.where(np.isnan(secs), 0, secs).astype(np.int64)
+            dt = safe.astype("datetime64[s]")
+            D = dt.astype("datetime64[D]")
+            M = dt.astype("datetime64[M]")
+            Y = dt.astype("datetime64[Y]")
+            out = self._DATETIME[fn](safe, D, M, Y).astype(np.float64)
+            return Vector(labels, np.where(np.isnan(vals), np.nan, out))
         if fn == "timestamp":
             v = self._eval(e.args[0], eval_ts)
             ts = np.broadcast_to(eval_ts.astype(np.float64) / NS, v.values.shape)

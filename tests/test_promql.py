@@ -548,3 +548,52 @@ class TestSubqueriesAndAt:
         res, _ = eng.query_range("q @ start()", START + 60 * 10**9,
                                  START + 600 * 10**9, MIN)
         assert np.allclose(res.values[0], 1.0)
+
+
+# 2021-03-14 15:09:26 UTC (a Sunday, day 73 of the year)
+DT_T0_NS = 1615734566 * 10**9
+
+
+class TestDatetimeFunctions:
+    """Upstream date/time extractors (functions.go dateWrapper family)."""
+
+    @pytest.fixture(scope="class")
+    def eng(self, tmp_path_factory):
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.options import DatabaseOptions
+
+        db = Database(str(tmp_path_factory.mktemp("dt")),
+                      DatabaseOptions(n_shards=1))
+        db.create_namespace("default")
+        db.write_tagged("default", b"ts", [(b"k", b"v")],
+                        DT_T0_NS, float(DT_T0_NS // 10**9))
+        from m3_tpu.query.engine import Engine
+
+        return Engine(db, "default")
+
+    @pytest.mark.parametrize("q,want", [
+        ("year(ts)", 2021), ("month(ts)", 3), ("day_of_month(ts)", 14),
+        ("day_of_week(ts)", 0), ("day_of_year(ts)", 73),
+        ("days_in_month(ts)", 31), ("hour(ts)", 15), ("minute(ts)", 9),
+    ])
+    def test_components(self, eng, q, want):
+        v, _ = eng.query_range(q, DT_T0_NS, DT_T0_NS, 60 * 10**9)
+        assert float(v.values[0][0]) == want
+
+    def test_no_arg_uses_eval_time(self, eng):
+        v, _ = eng.query_range("hour()", DT_T0_NS, DT_T0_NS, 60 * 10**9)
+        assert v.labels == [{}] and float(v.values[0][0]) == 15.0
+
+    def test_pi_and_inverse_hyperbolics(self, eng):
+        import math
+
+        v, _ = eng.query_range("pi() * sgn(ts)", DT_T0_NS, DT_T0_NS, 60 * 10**9)
+        assert float(v.values[0][0]) == pytest.approx(math.pi)
+        v, _ = eng.query_range("atanh(sgn(ts) * 0.5)", DT_T0_NS, DT_T0_NS, 60 * 10**9)
+        assert float(v.values[0][0]) == pytest.approx(math.atanh(0.5))
+
+    def test_scalar_argument_rejected(self, eng):
+        from m3_tpu.query.engine import EvalError
+
+        with pytest.raises(EvalError):
+            eng.query_range("year(2)", DT_T0_NS, DT_T0_NS, 60 * 10**9)
