@@ -131,3 +131,46 @@ def ring_shift_boundary(values, mesh):
                   out_specs=P(None, "shard"))
     return f(values)
 
+
+def time_sharded_reset_adjust(values, mesh):
+    """Sequence-parallel counter monotonization: reset-adjust [S, T]
+    counter samples whose TIME axis is sharded across 'shard'.
+
+    The single-host form (query/windows._reset_adjusted, upstream
+    Prometheus counter semantics) is a prefix computation over time —
+    exactly the dependency ring/blockwise attention breaks for long
+    sequences. Device-local work is one pass; the cross-device carry needs
+    two tiny collectives (SURVEY.md §5 long-context analog):
+
+      1. each device receives its LEFT neighbor's last column (ppermute
+         ring) so a reset straddling the shard boundary is detected;
+      2. per-device total drops all_gather into an EXCLUSIVE prefix over
+         the mesh axis — the carry every device adds to its local
+         cumulative drops.
+
+    Returns the globally monotonized [S, T] matrix, sharded like the
+    input. rate()/increase() over any window then reduces to
+    last-minus-first regardless of which devices hold the window.
+    """
+    n = mesh.shape["shard"]
+
+    def local(vals):
+        # 1) boundary exchange: left neighbor's last column
+        prev_col = lax.ppermute(
+            vals[:, -1:], "shard", [(i, (i + 1) % n) for i in range(n)]
+        )
+        idx = lax.axis_index("shard")
+        # device 0 has no predecessor: its first column can't be a reset
+        prev = jnp.where(idx == 0, vals[:, :1], prev_col)
+        shifted = jnp.concatenate([prev, vals[:, :-1]], axis=1)
+        drop = jnp.where(vals < shifted, shifted, 0.0)
+        local_cum = jnp.cumsum(drop, axis=1)
+        # 2) exclusive prefix of per-device drop totals over the mesh axis
+        totals = lax.all_gather(local_cum[:, -1], "shard")  # [n, S]
+        mask = (jnp.arange(n) < idx)[:, None]
+        carry = jnp.sum(totals * mask, axis=0)  # [S]
+        return vals + local_cum + carry[:, None]
+
+    f = shard_map(local, mesh=mesh, in_specs=(P(None, "shard"),),
+                  out_specs=P(None, "shard"))
+    return f(values)
