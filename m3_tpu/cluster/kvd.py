@@ -351,10 +351,20 @@ class KvdServer:
             return _enc_resp(err="notfound")
         return _enc_resp(version=vv.version, data=vv.data)
 
+    def _lease_live(self, lease: int) -> bool:
+        with self._lock:
+            return lease in self._leases
+
     def _set(self, req: bytes, ctx) -> bytes:
         if self._standby.is_set():
             return _enc_resp(err="standby")
         key, data, _exp, lease, _p, _t = _dec_req(req)
+        if lease and not self._lease_live(lease):
+            # a write meant to be EPHEMERAL must never silently become
+            # persistent because its lease expired in flight — an
+            # unreapable election key wedges failover forever. Reject so
+            # the client re-grants and retries (etcd: lease not found).
+            return _enc_resp(err="nolease")
         version = self.store.set(key, data)
         self._attach_lease(key, lease)  # lease 0 detaches a prior owner
         return _enc_resp(version=version)
@@ -363,6 +373,8 @@ class KvdServer:
         if self._standby.is_set():
             return _enc_resp(err="standby")
         key, data, expect, lease, _p, _t = _dec_req(req)
+        if lease and not self._lease_live(lease):
+            return _enc_resp(err="nolease")
         try:
             version = self.store.check_and_set(key, expect or 0, data)
         except VersionMismatch as e:
@@ -730,11 +742,19 @@ class KvdClient(KVStore):
         (vanishes if the process dies). Plain sets are PERSISTENT — and
         clear a prior lease attachment, matching etcd put-without-lease
         (round-4 advisor finding: the lease must not ride every write)."""
-        lease = self._session_lease() if ephemeral else 0
-        version, _d, _e, _l, _k = self._call(
-            "Set", _enc_req(key=key, data=data, lease_id=lease))
-        self._track_ephemeral(key, data if ephemeral else None)
-        return version
+        for _attempt in range(2):
+            lease = self._session_lease() if ephemeral else 0
+            version, _d, err, _l, _k = self._call(
+                "Set", _enc_req(key=key, data=data, lease_id=lease))
+            if err == "nolease":
+                # the session lease expired in flight (server restart or a
+                # stalled keepalive): grant a fresh one and retry so the
+                # write stays ephemeral
+                self._lease_id = 0
+                continue
+            self._track_ephemeral(key, data if ephemeral else None)
+            return version
+        raise KVError(f"session lease unrecoverable writing {key!r}")
 
     def set_if_not_exists(self, key: str, data: bytes,
                           ephemeral: bool = False) -> int:
@@ -742,14 +762,20 @@ class KvdClient(KVStore):
 
     def check_and_set(self, key: str, expect_version: int, data: bytes,
                       ephemeral: bool = False) -> int:
-        lease = self._session_lease() if ephemeral else 0
-        version, _d, err, _l, _k = self._call(
-            "Cas", _enc_req(key=key, data=data,
-                            expect_version=expect_version, lease_id=lease))
-        if err.startswith("conflict"):
-            raise VersionMismatch(err.partition(":")[2] or key)
-        self._track_ephemeral(key, data if ephemeral else None)
-        return version
+        for _attempt in range(2):
+            lease = self._session_lease() if ephemeral else 0
+            version, _d, err, _l, _k = self._call(
+                "Cas", _enc_req(key=key, data=data,
+                                expect_version=expect_version,
+                                lease_id=lease))
+            if err == "nolease":
+                self._lease_id = 0  # expired in flight: re-grant + retry
+                continue
+            if err.startswith("conflict"):
+                raise VersionMismatch(err.partition(":")[2] or key)
+            self._track_ephemeral(key, data if ephemeral else None)
+            return version
+        raise KVError(f"session lease unrecoverable writing {key!r}")
 
     def delete(self, key: str) -> None:
         _v, _d, err, _l, _k = self._call("Delete", _enc_req(key=key))
