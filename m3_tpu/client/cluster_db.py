@@ -27,6 +27,12 @@ class ClusterNamespace:
     def limits(self):
         return self._cdb.limits
 
+    @property
+    def opts(self):
+        """NamespaceOptions from the cluster registry (None when unknown —
+        retention-tier resolution then leaves this namespace alone)."""
+        return self._cdb._ns_opts.get(self.name)
+
     def query_ids(self, query, start_ns: int, end_ns: int, limit=None):
         docs = self._cdb.session.query_ids(
             self.name, query, start_ns, end_ns, limit)
@@ -88,11 +94,28 @@ class ClusterDatabase:
         self.namespaces = _Namespaces(self)
         self.limits = None
         self._open = True
+        # namespace -> NamespaceOptions mirrored from the KV registry (the
+        # coordinator syncs it); gives retention-tier read resolution its
+        # retention/resolution metadata in cluster mode
+        self._ns_opts: dict[str, object] = {}
 
     def create_namespace(self, name: str, opts=None) -> ClusterNamespace:
-        """Namespaces are owned by the storage nodes; the facade just
-        materializes a view (the downsampler calls this per policy)."""
+        """Namespaces are owned by the storage nodes; the facade
+        materializes a view and records the options for tier resolution
+        (the downsampler calls this per policy)."""
+        if opts is not None:
+            self._ns_opts[name] = opts
         return self.namespaces[name]
+
+    def set_namespace_options(self, name: str, opts) -> None:
+        self._ns_opts[name] = opts
+        self.namespaces[name]  # materialize so tier resolution sees it
+
+    def drop_namespace(self, name: str) -> None:
+        """Forget a namespace removed from the registry (tier resolution
+        must stop fanning out to it)."""
+        self._ns_opts.pop(name, None)
+        self.namespaces.pop(name, None)
 
     # -- write path (quorum fan-out) --
 

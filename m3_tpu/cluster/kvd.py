@@ -366,8 +366,18 @@ class KvdServer:
             # the client re-grants and retries (etcd: lease not found).
             return _enc_resp(err="nolease")
         version = self.store.set(key, data)
-        self._attach_lease(key, lease)  # lease 0 detaches a prior owner
+        if not self._attach_lease(key, lease):  # 0 detaches a prior owner
+            # lease expired BETWEEN the check and the attach (reaper runs
+            # every 250ms): roll the write back — ephemeral-or-nothing
+            self._rollback_noleased(key)
+            return _enc_resp(err="nolease")
         return _enc_resp(version=version)
+
+    def _rollback_noleased(self, key: str) -> None:
+        try:
+            self.store.delete(key)
+        except KeyNotFound:
+            pass
 
     def _cas(self, req: bytes, ctx) -> bytes:
         if self._standby.is_set():
@@ -379,7 +389,9 @@ class KvdServer:
             version = self.store.check_and_set(key, expect or 0, data)
         except VersionMismatch as e:
             return _enc_resp(err=f"conflict:{e}")
-        self._attach_lease(key, lease)
+        if not self._attach_lease(key, lease):
+            self._rollback_noleased(key)
+            return _enc_resp(err="nolease")
         return _enc_resp(version=version)
 
     def _delete(self, req: bytes, ctx) -> bytes:
@@ -400,10 +412,13 @@ class KvdServer:
     # -- leases --
 
     def _attach_lease(self, key: str, lease_id: int,
-                      persist: bool = True) -> None:
+                      persist: bool = True) -> bool:
         """Make lease_id (0 = none) the key's ONLY lease owner. Every
         write/delete re-resolves ownership, so a key re-created by a new
-        client is never reaped by a previous owner's lease expiry."""
+        client is never reaped by a previous owner's lease expiry.
+        Returns False when a REQUESTED lease no longer exists (expired
+        between the caller's liveness check and here) — the caller must
+        not let the write stand as silently persistent."""
         with self._lock:
             had = key in self._key_lease
             old = self._key_lease.pop(key, None)
@@ -415,6 +430,7 @@ class KvdServer:
                 self._key_lease[key] = lease_id
         if persist and attached != had:
             self._persist_eph()
+        return attached or not lease_id
 
     def _persist_eph(self) -> None:
         """Journal the ephemeral-key set under EPH_KEY (skipping the
@@ -670,6 +686,10 @@ class KvdClient(KVStore):
         self._lease_id = 0
         self._lease_ttl_ms = 0
         self._lease_thread: threading.Thread | None = None
+        # serializes lease grants: a nolease write retry racing the
+        # keepalive's re-grant must not mint two live leases (the key
+        # would ride the one that never gets renewed and silently vanish)
+        self._lease_lock = threading.Lock()
         # ephemeral keys this session owns (key -> last-asserted data),
         # re-asserted under a fresh lease after a server restart/failover
         self._ephemeral: dict[str, bytes] = {}
@@ -748,9 +768,10 @@ class KvdClient(KVStore):
                 "Set", _enc_req(key=key, data=data, lease_id=lease))
             if err == "nolease":
                 # the session lease expired in flight (server restart or a
-                # stalled keepalive): grant a fresh one and retry so the
+                # stalled keepalive): replace it exactly once (racing the
+                # keepalive's own re-grant is serialized) and retry so the
                 # write stays ephemeral
-                self._lease_id = 0
+                self._ensure_fresh_lease(lease)
                 continue
             self._track_ephemeral(key, data if ephemeral else None)
             return version
@@ -769,7 +790,7 @@ class KvdClient(KVStore):
                                 expect_version=expect_version,
                                 lease_id=lease))
             if err == "nolease":
-                self._lease_id = 0  # expired in flight: re-grant + retry
+                self._ensure_fresh_lease(lease)  # expired in flight: retry
                 continue
             if err.startswith("conflict"):
                 raise VersionMismatch(err.partition(":")[2] or key)
@@ -890,6 +911,23 @@ class KvdClient(KVStore):
             self.start_session()
         return self._lease_id
 
+    def _ensure_fresh_lease(self, stale_id: int) -> int:
+        """Replace stale_id with a fresh lease exactly once: concurrent
+        callers observing the same dead lease serialize here, and whoever
+        loses the race adopts the winner's lease instead of granting a
+        second one."""
+        with self._lease_lock:
+            if self._lease_id == stale_id or not self._lease_id:
+                self._grant_locked(self._lease_ttl_ms or 5_000)
+            return self._lease_id
+
+    def _grant_locked(self, ttl_ms: int) -> int:
+        _v, _d, _e, lease_id, _k = self._call(
+            "LeaseGrant", _enc_req(ttl_ms=ttl_ms))
+        self._lease_id = lease_id
+        self._lease_ttl_ms = ttl_ms
+        return lease_id
+
     def start_session(self, ttl_ms: int = 5_000) -> int:
         """Grant a lease and keep it alive from a background thread;
         ephemeral set/check_and_set attach their keys to the session, so
@@ -901,27 +939,26 @@ class KvdClient(KVStore):
         lease and RE-ASSERTS every ephemeral key this client owns before
         the server's orphan grace expires — a live leader keeps its
         leadership across a kvd restart."""
-        _v, _d, _e, lease_id, _k = self._call(
-            "LeaseGrant", _enc_req(ttl_ms=ttl_ms))
-        self._lease_id = lease_id
-        self._lease_ttl_ms = ttl_ms
+        with self._lease_lock:
+            lease_id = self._grant_locked(ttl_ms)
         interval = max(0.2, ttl_ms / 3e3)
         if self._lease_thread is not None:
             return lease_id  # re-grant from the existing keepalive thread
 
         def keepalive():
             while not self._closed.wait(interval):
-                if not self._lease_id:
+                cur = self._lease_id
+                if not cur:
                     continue  # session explicitly ended; don't resurrect
                 try:
                     _v2, _d2, err, _l2, _k2 = self._call(
-                        "LeaseKeepAlive", _enc_req(lease_id=self._lease_id))
+                        "LeaseKeepAlive", _enc_req(lease_id=cur))
                 except Exception:  # noqa: BLE001 - retry next tick
                     continue
                 if err == "notfound" and self._lease_id \
                         and not self._closed.is_set():
                     try:
-                        self._regrant()
+                        self._regrant(cur)
                     except Exception:  # noqa: BLE001 - retry next tick
                         pass
 
@@ -929,9 +966,9 @@ class KvdClient(KVStore):
         self._lease_thread.start()
         return lease_id
 
-    def _regrant(self) -> None:
+    def _regrant(self, stale_id: int) -> None:
         """Fresh lease + re-assert owned ephemeral keys (server lost ours)."""
-        self.start_session(self._lease_ttl_ms or 5_000)
+        self._ensure_fresh_lease(stale_id)
         with self._lock:
             owned = list(self._ephemeral.items())
         for key, data in owned:

@@ -257,13 +257,18 @@ class AdminAPI:
     # -- database / namespaces --
 
     def _ns_options_doc(self, doc: dict) -> dict:
-        return {
+        out = {
             "retention": {
                 "period": doc.get("retentionTime", doc.get("retention", "48h")),
                 "block_size": doc.get("blockSize", "2h"),
             },
             "int_optimized": bool(doc.get("intOptimized", False)),
         }
+        if doc.get("resolution"):
+            # downsampled tier: its resolution drives retention-tier read
+            # resolution (aggregated namespace attributes)
+            out["resolution"] = doc["resolution"]
+        return out
 
     def _create_local_namespace(self, name: str, opts_doc: dict) -> None:
         create = getattr(self.db, "create_namespace", None)

@@ -38,6 +38,7 @@ def namespace_options(doc: dict | None) -> NamespaceOptions:
     from m3_tpu.metrics.policy import parse_go_duration as dur
 
     r = doc.get("retention", {}) or {}
+    res = doc.get("resolution")  # set on downsampled (aggregated) tiers
     return NamespaceOptions(
         retention=RetentionOptions(
             retention_ns=dur(r.get("period", "48h")),
@@ -46,6 +47,7 @@ def namespace_options(doc: dict | None) -> NamespaceOptions:
             buffer_future_ns=dur(r.get("buffer_future", "2m")),
         ),
         int_optimized=bool(doc.get("int_optimized", False)),
+        aggregated_resolution_ns=dur(res) if res else 0,
     )
 
 
@@ -57,6 +59,7 @@ class CoordinatorService:
         cl_cfg = config.get("cluster", {}) or {}
         self.kv = kv
         self._placement_version = -1
+        self._registry_ns: set[str] = set()  # names synced from the registry
         if self.kv is None:
             from m3_tpu.cluster.kv import kv_from_config
 
@@ -71,6 +74,7 @@ class CoordinatorService:
             if self.kv is None:
                 raise RuntimeError("cluster.enabled needs a KV (kv_path or kv_addr)")
             self.db = self._build_cluster_db(cl_cfg)
+            self._sync_namespace_options()  # tier metadata before first tick
         else:
             self.db = Database(
                 db_cfg.get("path", "./m3data"),
@@ -204,6 +208,35 @@ class CoordinatorService:
         )
         return ClusterDatabase(session)
 
+    def _sync_namespace_options(self) -> None:
+        """Mirror the KV namespace registry's options into the cluster
+        facade so retention-tier read resolution has each tier's
+        retention/resolution in cluster mode (nodes sync data namespaces
+        from the same registry). Namespaces REMOVED from the registry are
+        pruned so the resolver stops fanning out to deleted tiers."""
+        from m3_tpu.query.admin import load_namespace_registry
+
+        set_opts = getattr(self.db, "set_namespace_options", None)
+        if set_opts is None:
+            return
+        registry = load_namespace_registry(self.kv)
+        for name, doc in registry.items():
+            try:
+                set_opts(name, namespace_options(doc))
+            except Exception as e:  # noqa: BLE001 - one bad doc must not
+                # block the rest, but it must be VISIBLE (validated at
+                # registration; an out-of-band writer can bypass that)
+                self.log.info("bad namespace registry doc; skipping",
+                              namespace=name, error=str(e))
+        # prune only names THIS sync previously sourced from the registry
+        # (the embedded downsampler registers its tier namespaces directly
+        # on the facade; those must survive)
+        drop = getattr(self.db, "drop_namespace", None)
+        if drop is not None:
+            for name in self._registry_ns - set(registry):
+                drop(name)
+        self._registry_ns = set(registry)
+
     def _refresh_topology(self) -> None:
         """Pick up placement changes (node add/remove/endpoint) between
         ticks."""
@@ -274,6 +307,7 @@ class CoordinatorService:
                             self.kv.refresh()
                         if self.kv is not None and self._cluster_mode:
                             self._refresh_topology()
+                            self._sync_namespace_options()
                         if self.downsampler is not None:
                             flushed = self.downsampler.flush()
                             scope.counter("downsample_flushed", flushed)

@@ -118,3 +118,26 @@ def test_graphite_reads_aggregated_tier(tiered_db):
                    step_ns=5 * 60 * NS)
     assert len(out) == 1
     assert np.isfinite(out[0].values).any()
+
+
+def test_cluster_facade_exposes_tier_metadata():
+    """In cluster mode the coordinator mirrors the KV namespace registry
+    into the ClusterDatabase facade; the resolver fans out the same way it
+    does over local storage (and leaves unknown namespaces alone)."""
+    from m3_tpu.client.cluster_db import ClusterDatabase
+    from m3_tpu.services.coordinator import namespace_options
+
+    cdb = ClusterDatabase(session=None)
+    now = 4 * HOUR
+    # no metadata at all: old single-namespace behavior
+    assert resolver.resolve_namespaces(cdb, "default", 0, now, now) == [
+        "default"]
+    cdb.set_namespace_options("default", namespace_options(
+        {"retention": {"period": "2h"}}))
+    cdb.set_namespace_options("aggregated_1m_1d", namespace_options(
+        {"retention": {"period": "24h"}, "resolution": "1m"}))
+    got = resolver.resolve_namespaces(cdb, "default", 0, now, now)
+    assert got == ["default", "aggregated_1m_1d"]
+    # recent range: raw only
+    assert resolver.resolve_namespaces(
+        cdb, "default", now - HOUR, now, now) == ["default"]
