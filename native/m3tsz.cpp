@@ -418,12 +418,22 @@ int64_t encode_fast(const int64_t* times, const uint64_t* vbits, int32_t n,
                 int cl = clz64(x), ct = ctz64(x);
                 if (prev_xor != 0 && cl >= pl && ct >= pt) {
                     int m = 64 - pl - pt;
-                    w.put(0b10, 2);
-                    w.put(x >> pt, m);
+                    if (m <= 62)  // opcode + payload in one word
+                        w.put((0b10ull << m) | (x >> pt), 2 + m);
+                    else {
+                        w.put(0b10, 2);
+                        w.put(x >> pt, m);
+                    }
                 } else {
                     int m = 64 - cl - ct;
-                    w.put((0b11ull << 12) | ((uint64_t)cl << 6) | (uint64_t)(m - 1), 14);
-                    w.put(x >> ct, m);
+                    uint64_t hdr = (0b11ull << 12) | ((uint64_t)cl << 6)
+                                   | (uint64_t)(m - 1);
+                    if (m <= 50)  // 14-bit header + payload in one word
+                        w.put((hdr << m) | (x >> ct), 14 + m);
+                    else {
+                        w.put(hdr, 14);
+                        w.put(x >> ct, m);
+                    }
                 }
             }
             prev_xor = x;
@@ -447,25 +457,54 @@ int32_t decode_fast(const uint8_t* data, int64_t len, int64_t unit_ns,
     uint64_t prev_bits = 0, prev_xor = 0;
     int32_t count = 0;
     while (count < max_points) {
-        if (r.can(11) && (r.peek(11) >> 2) == 0x100) {
-            uint64_t marker = r.peek(11) & 3;
-            if (marker == 0) break;   // EOS
-            return -1;                 // host-path marker: not ours to decode
-        }
-        if (!r.can(1)) break;
         int64_t dod;
-        if (r.read(1) == 0) {
-            dod = 0;
-        } else if (!r.can(1)) { break; }
-        else if (r.read(1) == 0) {
-            dod = sign_extend(r.read(7), 7);
-        } else if (r.read(1) == 0) {
-            dod = sign_extend(r.read(9), 9);
-        } else if (r.read(1) == 0) {
-            dod = sign_extend(r.read(12), 12);
+        // Fast path: classify the timestamp field from ONE 16-bit peek
+        // (the '0'/'10'/'110'/'1110' short forms fit entirely; markers
+        // lead with the reserved 9-bit '100000000' prefix). The
+        // bit-by-bit fallback below handles the stream tail.
+        if (r.can(16)) {
+            uint64_t h = r.peek(16);
+            if ((h >> 7) == 0x100) {      // marker opcode
+                if (((h >> 5) & 3) == 0) break;  // EOS
+                return -1;  // host-path marker: not ours to decode
+            }
+            if (!(h >> 15)) {
+                r.bitpos += 1;
+                dod = 0;
+            } else if (!((h >> 14) & 1)) {
+                dod = sign_extend((h >> 7) & 0x7F, 7);
+                r.bitpos += 9;
+            } else if (!((h >> 13) & 1)) {
+                dod = sign_extend((h >> 4) & 0x1FF, 9);
+                r.bitpos += 12;
+            } else if (!((h >> 12) & 1)) {
+                dod = sign_extend(h & 0xFFF, 12);
+                r.bitpos += 16;
+            } else {
+                r.bitpos += 4;
+                dod = (default_bits == 32) ? sign_extend(r.read(32), 32)
+                                           : sign_extend(r.read(64), 64);
+            }
         } else {
-            dod = (default_bits == 32) ? sign_extend(r.read(32), 32)
-                                       : sign_extend(r.read(64), 64);
+            if (r.can(11) && (r.peek(11) >> 2) == 0x100) {
+                uint64_t marker = r.peek(11) & 3;
+                if (marker == 0) break;   // EOS
+                return -1;                 // host-path marker
+            }
+            if (!r.can(1)) break;
+            if (r.read(1) == 0) {
+                dod = 0;
+            } else if (!r.can(1)) { break; }
+            else if (r.read(1) == 0) {
+                dod = sign_extend(r.read(7), 7);
+            } else if (r.read(1) == 0) {
+                dod = sign_extend(r.read(9), 9);
+            } else if (r.read(1) == 0) {
+                dod = sign_extend(r.read(12), 12);
+            } else {
+                dod = (default_bits == 32) ? sign_extend(r.read(32), 32)
+                                           : sign_extend(r.read(64), 64);
+            }
         }
         prev_dt += dod * unit_ns;
         prev_t += prev_dt;
@@ -474,6 +513,40 @@ int32_t decode_fast(const uint8_t* data, int64_t len, int64_t unit_ns,
             if (!r.can(64)) return -1;
             prev_bits = r.read(64);
             prev_xor = prev_bits;
+        } else if (r.can(64)) {
+            // fast path: header AND payload from one 64-bit peek
+            // ('0' | '10'+m | '11'+6 lead+6 (m-1)+m); only payloads too
+            // long to share the word (m > 62 / m > 50) pay a second read
+            uint64_t vw = r.peek(64);
+            if (!(vw >> 63)) {
+                r.bitpos += 1;
+                prev_xor = 0;  // repeat value
+            } else if (!((vw >> 62) & 1)) {  // contained
+                int pl = clz64(prev_xor), pt = ctz64(prev_xor);
+                int m = 64 - pl - pt;
+                if (m <= 0) return -1;  // corrupt: see fallback comment
+                if (m <= 62) {  // 2 + m <= 64: inside the peeked word
+                    prev_xor = ((vw << 2) >> (64 - m)) << pt;
+                    r.bitpos += 2 + m;
+                } else {
+                    r.bitpos += 2;
+                    prev_xor = r.read(m) << pt;
+                }
+                prev_bits ^= prev_xor;
+            } else {  // uncontained
+                int lead = (int)((vw >> 56) & 0x3F);
+                int m = (int)((vw >> 50) & 0x3F) + 1;
+                int trail = 64 - lead - m;
+                if (trail < 0) return -1;
+                if (m <= 50) {  // 14 + m <= 64: inside the peeked word
+                    prev_xor = ((vw << 14) >> (64 - m)) << trail;
+                    r.bitpos += 14 + m;
+                } else {
+                    r.bitpos += 14;
+                    prev_xor = r.read(m) << trail;
+                }
+                prev_bits ^= prev_xor;
+            }
         } else {
             if (!r.can(1)) return -1;
             if (r.read(1) == 0) {
