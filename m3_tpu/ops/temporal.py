@@ -110,6 +110,44 @@ def _kernels():
             out = out / (range_ns / NS)
         return jnp.where(ok & (sampled > 0), out, jnp.nan)
 
+    @functools.partial(jax.jit, static_argnames=("max_len",))
+    def holt_winters(v, lo, hi, sf, tf, max_len):
+        """Double exponential smoothing per window (windows.holt_winters
+        host math, upstream Prometheus semantics): fori_loop over window
+        OFFSETS with [S, steps] state matrices — the per-sample recurrence
+        is sequential, so time is the loop axis and (series x step) the
+        vector axis (the layout the TPU VPU wants)."""
+        n = v.shape[0]
+        shape = lo.shape
+
+        def body(j, st):
+            found_first, found_second, prev, curr, trend, idx = st
+            pos = lo + j
+            val = v[jnp.clip(pos, 0, n - 1)]
+            valid = (pos < hi) & ~jnp.isnan(val)
+            take_first = valid & ~found_first
+            curr = jnp.where(take_first, val, curr)
+            idx = idx + take_first
+            found_first = found_first | take_first
+            sub = valid & found_first & ~take_first
+            take_second = sub & ~found_second
+            trend = jnp.where(take_second, val - curr, trend)
+            found_second = found_second | take_second
+            tv = jnp.where(idx == 1, trend,
+                           tf * (curr - prev) + (1 - tf) * trend)
+            new_curr = sf * val + (1 - sf) * (curr + tv)
+            prev = jnp.where(sub, curr, prev)
+            trend = jnp.where(sub, tv, trend)
+            curr = jnp.where(sub, new_curr, curr)
+            idx = idx + sub
+            return (found_first, found_second, prev, curr, trend, idx)
+
+        init = (jnp.zeros(shape, bool), jnp.zeros(shape, bool),
+                jnp.zeros(shape), jnp.zeros(shape), jnp.zeros(shape),
+                jnp.zeros(shape, jnp.int64))
+        _ff, fs, _p, curr, _tr, _i = jax.lax.fori_loop(0, max_len, body, init)
+        return jnp.where(fs, curr, jnp.nan)
+
     @jax.jit
     def reset_adjusted(v, is_first, row_start_index):
         """Counter monotonization: v + cumulative in-row reset drops.
@@ -125,6 +163,7 @@ def _kernels():
         "sum_avg_std": sum_avg_std,
         "instant_values": instant_values,
         "extrapolated_rate": extrapolated_rate,
+        "holt_winters": holt_winters,
         "reset_adjusted": reset_adjusted,
     }
 
@@ -186,6 +225,19 @@ def extrapolated_rate(
         v, adj, t, lo_p, hi_p, _pad_eval_ts(eval_ts), np.int64(range_ns),
         bool(is_counter), bool(is_rate),
     )
+    return np.asarray(out)[:S, :T]
+
+
+def holt_winters(values: np.ndarray, lo: np.ndarray, hi: np.ndarray,
+                 sf: float, tf: float):
+    v, _ = _pad_samples(values)
+    lo_p, hi_p, S, T = _pad_bounds(lo, hi)
+    max_len = int((hi - lo).max()) if lo.size else 0
+    # pad the static loop bound to a power of two: extra offsets fall
+    # outside every window (pos >= hi) and no-op, buying shape reuse
+    max_len = dispatch.next_pow2(max(max_len, 1))
+    out = _kernels()["holt_winters"](v, lo_p, hi_p, float(sf), float(tf),
+                                     max_len)
     return np.asarray(out)[:S, :T]
 
 

@@ -302,6 +302,12 @@ def holt_winters(raws: RaggedSeries, eval_ts: np.ndarray, range_ns: int,
     n = len(raws.values)
     if n == 0:
         return np.full(lo.shape, np.nan)
+    device = _use_device(raws, eval_ts)
+    dispatch.record("temporal.holt_winters", device)
+    if device:
+        from m3_tpu.ops import temporal
+
+        return temporal.holt_winters(raws.values, lo, hi, sf, tf)
     max_len = int((hi - lo).max()) if lo.size else 0
     shape = lo.shape
     found_first = np.zeros(shape, bool)

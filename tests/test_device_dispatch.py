@@ -143,6 +143,25 @@ class TestTemporalDevice:
             np.testing.assert_allclose(host, dev, rtol=1e-9, atol=1e-12,
                                        equal_nan=True)
 
+    def test_holt_winters_parity(self, monkeypatch):
+        from m3_tpu.query import windows
+
+        raws = self._ragged(seed=7)
+        # NaN samples (staleness markers) exercise the kernel's riskiest
+        # logic: the found_first/idx/take_second bookkeeping must SKIP NaN
+        # lanes identically on both paths
+        rng = np.random.default_rng(11)
+        nan_at = rng.integers(0, len(raws.values), len(raws.values) // 6)
+        raws.values[nan_at] = np.nan
+        eval_ts = np.arange(300, 3600, 45, dtype=np.int64) * 10**9
+
+        def run():
+            return windows.holt_winters(raws, eval_ts, 300 * 10**9, 0.4, 0.3)
+
+        host, dev = _both(monkeypatch, run)
+        np.testing.assert_allclose(host, dev, rtol=1e-9, atol=1e-12,
+                                   equal_nan=True)
+
     def test_instant_values_parity(self, monkeypatch):
         from m3_tpu.query import windows
 
