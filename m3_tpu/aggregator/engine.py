@@ -282,7 +282,12 @@ class Aggregator:
             )
             out.extend(self._emit(ge, gw, stats, vq, offsets))
         out.sort(key=lambda m: (m.timestamp_ns, m.series_id))
-        self._flush_history.insert(0, now_ns)
+        # clamp to the current head: a non-monotonic flush() must not
+        # regress stage watermarks already used to close forwarded-stage
+        # windows (stage-k thresholds read history entries as high-water
+        # marks)
+        head = self._flush_history[0] if self._flush_history else now_ns
+        self._flush_history.insert(0, max(now_ns, head))
         del self._flush_history[MAX_PIPELINE_STAGES:]
         return out
 

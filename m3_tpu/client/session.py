@@ -259,11 +259,15 @@ class Session:
             if not want:
                 continue
             try:
+                # one batched request per host: HTTP conns AND in-process
+                # Databases expose read_batch (the storage side fuses the
+                # whole batch into one decode per (shard, block, volume)
+                # group); only minimal test doubles still expose read() only
                 batch = getattr(conn, "read_batch", None)
                 if batch is not None:
                     rows = self._host_call(host, batch, namespace, want,
                                            start_ns, end_ns)
-                else:  # in-process/test doubles expose read() only
+                else:
                     rows = [self._host_call(host, conn.read, namespace, sid,
                                             start_ns, end_ns)
                             for sid in want]

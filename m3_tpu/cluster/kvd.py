@@ -308,13 +308,12 @@ class KvdServer:
             grace = _Lease(self._lease_seq, self._orphan_grace_ms)
             self._leases[grace.lease_id] = grace
         for k in present:
-            with self._lock:
-                if self._key_lease.get(k):
-                    # a live owner already re-attached (its keepalive beat
-                    # this restore) — don't steal its key for the grace
-                    # lease, that would reap a healthy leader
-                    continue
-            self._attach_lease(k, grace.lease_id, persist=False)
+            # check-and-attach is atomic (one _lock acquisition inside
+            # _attach_lease): a live owner re-attaching between a separate
+            # check and attach would be silently stolen by the grace lease
+            # and reaped despite being healthy
+            self._attach_lease(k, grace.lease_id, persist=False,
+                               only_if_unowned=True)
 
     # -- store-change fanout --
 
@@ -365,19 +364,71 @@ class KvdServer:
             # unreapable election key wedges failover forever. Reject so
             # the client re-grants and retries (etcd: lease not found).
             return _enc_resp(err="nolease")
+        prior, prior_lease = self._prior_state(key) if lease else (None, 0)
         version = self.store.set(key, data)
         if not self._attach_lease(key, lease):  # 0 detaches a prior owner
             # lease expired BETWEEN the check and the attach (reaper runs
             # every 250ms): roll the write back — ephemeral-or-nothing
-            self._rollback_noleased(key)
+            self._rollback_noleased(key, prior, prior_lease)
             return _enc_resp(err="nolease")
         return _enc_resp(version=version)
 
-    def _rollback_noleased(self, key: str) -> None:
+    def _prior_state(self, key: str) -> tuple[VersionedValue | None, int]:
+        """The key's pre-write (VersionedValue, lease owner) for rollback.
+        Only captured for LEASED writes — _attach_lease(key, 0) cannot
+        fail, so lease-less writes never roll back and must not pay the
+        extra store.get per write."""
         try:
-            self.store.delete(key)
+            prior = self.store.get(key)
         except KeyNotFound:
-            pass
+            prior = None
+        with self._lock:
+            prior_lease = self._key_lease.get(key, 0)
+        return prior, prior_lease
+
+    def _restore_exact(self, key: str, prior: VersionedValue) -> None:
+        """Put back a key's exact prior VersionedValue (the store's own
+        mutators would renumber). Follows the store's cross-process
+        mutation discipline when it has one (FileKVStore: OS file lock +
+        reload before rewriting the journal, so concurrent writers'
+        committed keys are never clobbered by a stale in-memory view)."""
+        st = self.store
+        file_lock = getattr(st, "_file_lock", None)
+        if file_lock is not None:
+            with st._lock, file_lock():
+                st._reload()
+                st._data[key] = prior
+                st._persist()
+                st._notify(key, prior)
+        else:
+            with st._lock:
+                st._data[key] = prior
+                st._persist()
+                st._notify(key, prior)
+
+    def _rollback_noleased(self, key: str, prior: VersionedValue | None,
+                           prior_lease: int) -> None:
+        """Undo a write whose requested lease expired in flight. A key
+        that existed before gets its prior VersionedValue restored at its
+        EXACT version (no spurious delete event, no destroyed version
+        history) plus its prior lease attachment; only a key that did not
+        previously exist is deleted outright."""
+        if prior is None:
+            try:
+                self.store.delete(key)
+            except KeyNotFound:
+                pass
+            self._attach_lease(key, 0)
+            return
+        self._restore_exact(key, prior)
+        if not self._attach_lease(key, prior_lease):
+            # the prior owner's lease ALSO died while we were rolling
+            # back: its ephemeral key has a dead owner — reap it as the
+            # lease expiry would have
+            try:
+                self.store.delete(key)
+            except KeyNotFound:
+                pass
 
     def _cas(self, req: bytes, ctx) -> bytes:
         if self._standby.is_set():
@@ -385,12 +436,13 @@ class KvdServer:
         key, data, expect, lease, _p, _t = _dec_req(req)
         if lease and not self._lease_live(lease):
             return _enc_resp(err="nolease")
+        prior, prior_lease = self._prior_state(key) if lease else (None, 0)
         try:
             version = self.store.check_and_set(key, expect or 0, data)
         except VersionMismatch as e:
             return _enc_resp(err=f"conflict:{e}")
         if not self._attach_lease(key, lease):
-            self._rollback_noleased(key)
+            self._rollback_noleased(key, prior, prior_lease)
             return _enc_resp(err="nolease")
         return _enc_resp(version=version)
 
@@ -412,14 +464,20 @@ class KvdServer:
     # -- leases --
 
     def _attach_lease(self, key: str, lease_id: int,
-                      persist: bool = True) -> bool:
+                      persist: bool = True,
+                      only_if_unowned: bool = False) -> bool:
         """Make lease_id (0 = none) the key's ONLY lease owner. Every
         write/delete re-resolves ownership, so a key re-created by a new
         client is never reaped by a previous owner's lease expiry.
         Returns False when a REQUESTED lease no longer exists (expired
         between the caller's liveness check and here) — the caller must
-        not let the write stand as silently persistent."""
+        not let the write stand as silently persistent.
+        only_if_unowned makes the ownership check and the attach one
+        atomic step (grace-lease restore must never displace a live owner
+        that re-attached concurrently)."""
         with self._lock:
+            if only_if_unowned and self._key_lease.get(key):
+                return False
             had = key in self._key_lease
             old = self._key_lease.pop(key, None)
             if old is not None and old in self._leases:

@@ -38,6 +38,10 @@ def _device_encode() -> bool:
     return bool(dispatch._accelerator_present())
 
 
+# decode rides the same platform switch as encode
+_device_decode = _device_encode
+
+
 def encode_blocks(times, vbits, starts, n_points,
                   unit: TimeUnit, int_optimized: bool) -> list[bytes]:
     """Encode a sealed [B, T] window to per-series streams on the best
@@ -101,3 +105,116 @@ def decode_stream(stream: bytes, unit: TimeUnit,
     t = np.array([d.timestamp_ns for d in dps], np.int64)
     v = np.array([np.float64(d.value) for d in dps], np.float64).view(np.uint64)
     return t, v
+
+
+def _forced_batch_path() -> str:
+    """Test/diagnostic override for the decode_streams_batch ladder:
+    M3_TPU_DECODE_BATCH_PATH in {native, device, scalar} pins one rung
+    (parity tests force each rung against the per-series path)."""
+    import os
+
+    return os.environ.get("M3_TPU_DECODE_BATCH_PATH", "")
+
+
+def _decode_streams_device(streams: list[bytes], unit: TimeUnit,
+                           int_optimized: bool):
+    """One vmapped XLA decode over the whole group. Streams whose rows come
+    back flagged (annotation/time-unit markers the kernels don't decode)
+    fall back to the scalar decoder individually. Shapes are padded to
+    powers of two so repeated groups share compiled kernels."""
+    import numpy as _np
+
+    from m3_tpu.encoding.m3tsz import tpu as m3tsz_tpu
+
+    maxlen = max(len(s) for s in streams)
+    words = m3tsz_tpu.bytes_to_words(
+        streams, dispatch.next_pow2((maxlen + 7) // 8))
+    # a datapoint costs >= 2 bits, so the longest stream bounds the points
+    max_points = dispatch.next_pow2(maxlen * 4 + 16)
+    if int_optimized:
+        from m3_tpu.encoding.m3tsz import tpu_int
+
+        dec = tpu_int.decode_int(words, unit, max_points=max_points)
+        vals = _np.asarray(dec.values, _np.float64)
+        vbits = vals.view(_np.uint64)
+    else:
+        dec = m3tsz_tpu.decode(words, unit, max_points=max_points)
+        vbits = _np.asarray(dec.value_bits, _np.uint64)
+    times = _np.asarray(dec.times, _np.int64)
+    err = _np.asarray(dec.error)
+    counts = _np.asarray(dec.n_points)
+    dispatch.counters["m3tsz_decode_device_batch"] += 1
+    out = []
+    for b, stream in enumerate(streams):
+        if err[b]:
+            out.append(decode_stream(stream, unit, int_optimized))
+            continue
+        n = int(counts[b])
+        out.append((times[b, :n].copy(), vbits[b, :n].copy()))
+    return out
+
+
+def decode_streams_batch(streams: list[bytes | None], unit: TimeUnit,
+                         int_optimized: bool
+                         ) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Decode MANY streams of one (shard, block, volume) group in a single
+    batched dispatch — the read-path dual of encode_blocks. Returns
+    [(times int64, value_bits uint64)] aligned to the input; empty/None
+    streams decode to empty arrays.
+
+    Ladder (same platform dispatch as the flush encode): the vmapped XLA
+    kernels when an accelerator is live/forced (float AND int-optimized —
+    the batch surface removes the int-opt scalar cliff), else the native
+    v2 batch decoder (float-mode only), else a scalar loop. Streams the
+    fast rungs reject (annotation/time-unit markers) degrade per stream,
+    never the whole group.
+    """
+    empty = (np.empty(0, np.int64), np.empty(0, np.uint64))
+    out: list = [empty] * len(streams)
+    todo = [i for i, s in enumerate(streams) if s]
+    if not todo:
+        return out
+    subset = [streams[i] for i in todo]
+    # one counter bump per GROUP: tests assert read_many issues at most one
+    # batched dispatch per (shard, block, volume) group
+    dispatch.counters["m3tsz_decode_batch_groups"] += 1
+    forced = _forced_batch_path()
+    decoded = None
+    use_device = forced == "device" or (not forced and _device_decode())
+    use_native = forced == "native" or (not forced and not use_device)
+    if use_device:
+        decoded = _decode_streams_device(subset, unit, int_optimized)
+    if decoded is None and use_native and not int_optimized:
+        from m3_tpu.encoding.m3tsz import native
+
+        if native.available():
+            try:
+                t, v, ns = native.decode_batch(subset, unit)
+            except ValueError:
+                # a marker-bearing stream poisons the whole native batch:
+                # degrade per stream (decode_stream isolates the bad ones)
+                decoded = [decode_stream(s, unit, int_optimized)
+                           for s in subset]
+            else:
+                dispatch.counters["m3tsz_decode_native_batch"] += 1
+                decoded = [(t[b, : int(ns[b])].copy(),
+                            v[b, : int(ns[b])].copy())
+                           for b in range(len(subset))]
+    if decoded is None:
+        from m3_tpu.encoding.m3tsz import decode as scalar_decode
+
+        dispatch.counters["m3tsz_decode_scalar_batch"] += 1
+        decoded = []
+        for s in subset:
+            dps = scalar_decode(s, int_optimized=int_optimized,
+                                default_time_unit=unit)
+            if not dps:
+                decoded.append(empty)
+                continue
+            t = np.array([d.timestamp_ns for d in dps], np.int64)
+            v = np.array([np.float64(d.value) for d in dps],
+                         np.float64).view(np.uint64)
+            decoded.append((t, v))
+    for i, r in zip(todo, decoded):
+        out[i] = r
+    return out

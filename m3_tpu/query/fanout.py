@@ -91,12 +91,16 @@ class FanoutNamespace:
     # -- reads (replica-style sample merge across zones) --
 
     def read_many(self, series_ids: list[bytes], start_ns: int, end_ns: int):
+        """One BATCHED read per zone: the local leg is the namespace's
+        fused fetch+decode batch (one dispatch per (shard, block, volume)
+        group) and each remote leg is one read_many RPC, so a fan-out over
+        N series costs one batched request per node, not N."""
         local = self._local
         if local is not None:
             merged = list(local.read_many(series_ids, start_ns, end_ns))
         else:
             empty_t = np.array([], dtype=np.int64)
-            empty_v = np.array([], dtype=np.float64)
+            empty_v = np.array([], dtype=np.uint64)
             merged = [(empty_t, empty_v) for _ in series_ids]
         for zone in self._fdb.zones:
             remote = self._zone_call(

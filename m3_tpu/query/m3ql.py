@@ -32,7 +32,7 @@ Keyword arguments (`sf:0.3`) are accepted wherever positional numbers are.
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 from m3_tpu.index.query import Matcher, MatchType
 from m3_tpu.query.promql import (
@@ -314,8 +314,10 @@ def _compile(spec: _CallSpec, upstream: Expr | None, macros: dict) -> Expr:
         d = _duration_ns(spec.args[0]) if spec.args else None
         if d is None:
             raise M3QLError("timeshift expects a duration")
-        sel.offset_ns = d
-        return sel
+        # a fresh selector, never an in-place mutation: macro bodies are
+        # expanded BY REFERENCE, so writing offset_ns on the shared
+        # upstream would timeshift every other use of the macro too
+        return replace(sel, offset_ns=d)
     if fn in ("top", "head", "highestmax", "highestcurrent"):
         k = _num(spec, 0, 5.0)
         return AggregateExpr("topk", upstream, param=NumberLiteral(k))

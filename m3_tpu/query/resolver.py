@@ -83,6 +83,11 @@ def fetch_tagged(db, namespaces: list[str], index_query, t_min: int,
     earliest sample already held for that series (no interleaving — the
     overlap region is served by the finer tier alone, the reference's
     completeness preference).
+
+    Each tier's read is ONE batched read_many — storage fuses it into one
+    fetch+decode dispatch per (shard, block, volume) group (or one RPC per
+    node on cluster facades), so a 10k-series PromQL fetch costs a handful
+    of decode dispatches, not 10k.
     """
     by_id: dict[bytes, list] = {}  # id -> [doc, times, vbits]
     empties: dict[bytes, object] = {}  # matched but no samples anywhere

@@ -29,12 +29,16 @@ def merge_dedup(times: np.ndarray, vbits: np.ndarray,
     The single definition of write-conflict resolution: later appends win on
     timestamp ties, everywhere (buffer reads, seals, shard merges).
     """
-    order = np.argsort(times, kind="stable")
-    times, vbits = times[order], vbits[order]
-    keep = np.ones(len(times), bool)
-    if len(times) > 1:
+    # fast path: already strictly increasing (the common case — a single
+    # decoded block, or blocks concatenated in time order with no buffer
+    # overlap) makes sort AND dedup no-ops; O(n) check vs O(n log n) sort
+    # matters when read_many calls this once per series
+    if len(times) > 1 and not np.all(times[1:] > times[:-1]):
+        order = np.argsort(times, kind="stable")
+        times, vbits = times[order], vbits[order]
+        keep = np.ones(len(times), bool)
         keep[:-1] = times[1:] != times[:-1]
-    times, vbits = times[keep], vbits[keep]
+        times, vbits = times[keep], vbits[keep]
     if start_ns is not None or end_ns is not None:
         sel = np.ones(len(times), bool)
         if start_ns is not None:

@@ -42,6 +42,32 @@ class BlockCache:
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
 
+    def get_many(self, keys) -> list:
+        """Batched probe under ONE lock acquisition (the read_many hot
+        path probes a whole (shard, block) group at once)."""
+        out = []
+        with self._lock:
+            for key in keys:
+                hit = self._entries.get(key)
+                if hit is None:
+                    self.misses += 1
+                else:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                out.append(hit)
+        return out
+
+    def put_many(self, items) -> None:
+        """Batched fill under one lock acquisition; items: [(key, value)]."""
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            for key, value in items:
+                self._entries[key] = value
+                self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
     def invalidate_block(self, namespace: str, shard_id: int,
                          block_start: int) -> int:
         """Drop every cached series of one (shard, block) — called when a

@@ -401,9 +401,11 @@ class Database:
 
         ns = self.namespaces[namespace]
         docs = ns.query_ids(matchers_to_query(list(matchers)), start_ns, end_ns, limit)
+        # one batched read for the whole match set: a single fused
+        # fetch+decode dispatch per (shard, block, volume) group
+        results = ns.read_many([d.series_id for d in docs], start_ns, end_ns)
         out = []
-        for doc in docs:
-            times, vbits = ns.read(doc.series_id, start_ns, end_ns)
+        for doc, (times, vbits) in zip(docs, results):
             dps = [
                 Datapoint(int(t), float(v))
                 for t, v in zip(times, vbits.view(np.float64))
@@ -417,6 +419,19 @@ class Database:
         times, vbits = ns.read(series_id, start_ns, end_ns)
         values = vbits.view(np.float64)
         return [Datapoint(int(t), float(v)) for t, v in zip(times, values)]
+
+    def read_batch(self, namespace: str, series_ids: list[bytes],
+                   start_ns: int, end_ns: int) -> list[list[Datapoint]]:
+        """Batched node-API reads (the read_batch RPC shape): one fused
+        fetch+decode per (shard, block, volume) group server-side, so a
+        Session wired to in-process databases batches like the HTTP path."""
+        ns = self.namespaces[namespace]
+        results = ns.read_many(series_ids, start_ns, end_ns)
+        return [
+            [Datapoint(int(t), float(v))
+             for t, v in zip(times, vbits.view(np.float64))]
+            for times, vbits in results
+        ]
 
     # -- maintenance --
 

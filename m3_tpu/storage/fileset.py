@@ -328,6 +328,41 @@ class FilesetReader:
         _sid, _tags, data_off, data_len, _nxt = self._parse_entry(off)
         return bytes(self._data[data_off : data_off + data_len])
 
+    def read_many(self, series_ids: list[bytes]) -> list[bytes | None]:
+        """Streams for MANY series in one pass — the batched-fetch half of
+        the fused read path. Large requests merge-join the sorted request
+        against the sorted index in ONE sequential walk (each entry parsed
+        at most once, no per-series bloom probe or bisect); small requests
+        keep the per-series seek (a full walk would touch every entry for
+        a handful of ids). Returns streams aligned to the input, None for
+        absent series."""
+        out: list[bytes | None] = [None] * len(series_ids)
+        if not series_ids or not self._summary_ids:
+            return out
+        # walk cost ~ n_series parses; per-id cost ~ bloom + up to
+        # _SUMMARY_EVERY/2 parses each — walk only when it wins
+        if len(series_ids) * (_SUMMARY_EVERY // 2) < self.n_series:
+            for i, sid in enumerate(series_ids):
+                out[i] = self.read(sid)
+            return out
+        order = sorted(range(len(series_ids)), key=lambda i: series_ids[i])
+        data = self._data
+        off, end = 0, len(self._index)
+        k, n_req = 0, len(order)
+        while k < n_req and off < end:
+            sid, _tags, data_off, data_len, nxt = self._parse_entry(off)
+            while series_ids[order[k]] < sid:
+                k += 1  # requested id absent from this fileset
+                if k == n_req:
+                    return out
+            if series_ids[order[k]] == sid:
+                stream = bytes(data[data_off : data_off + data_len])
+                while k < n_req and series_ids[order[k]] == sid:
+                    out[order[k]] = stream  # duplicate requests share it
+                    k += 1
+            off = nxt
+        return out
+
     def read_at(self, i: int) -> tuple[bytes, bytes, bytes]:
         """(id, encoded_tags, stream) for index position i."""
         off = int(self._entry_offsets()[i])

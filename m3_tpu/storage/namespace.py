@@ -14,6 +14,11 @@ from m3_tpu.storage.sharding import ShardSet
 
 
 class Namespace:
+    # batch size per decode dispatch when a datapoint limit is active:
+    # large enough to keep the batched path's dispatch economy, small
+    # enough that an over-limit query stops within one chunk
+    READ_MANY_LIMIT_CHUNK = 4096
+
     def __init__(
         self,
         name: str,
@@ -113,8 +118,38 @@ class Namespace:
 
     def read_many(self, series_ids: list[bytes], start_ns: int, end_ns: int):
         """Batch-read surface shared with the cluster facade (which turns
-        it into one request per storage node)."""
-        return [self.read(sid, start_ns, end_ns) for sid in series_ids]
+        it into one request per storage node).
+
+        First-class batched operation: series group by owning shard and
+        each shard fuses fetch+decode into one dispatch per (block,
+        volume) group (Shard.read_many) — cache hits never enter the
+        batch. Limits accounting stays EXACT: one add_datapoints per
+        series, same as the per-series path; with a datapoint limit
+        configured, shard batches are chunked so the limit still bounds
+        decode WORK (an over-limit query aborts after at most one chunk
+        of extra decode, not after materializing the whole match set)."""
+        by_shard: dict[int, list[int]] = {}
+        for i, sid in enumerate(series_ids):
+            shard_id = self.shard_set.lookup(sid)
+            if shard_id not in self.shards:
+                raise KeyError(f"shard {shard_id} not owned by this node")
+            by_shard.setdefault(shard_id, []).append(i)
+        limits = self.limits
+        chunk = len(series_ids) or 1
+        if limits is not None and getattr(limits, "max_datapoints", 0):
+            chunk = min(chunk, self.READ_MANY_LIMIT_CHUNK)
+        out: list = [None] * len(series_ids)
+        for shard_id, idxs in by_shard.items():
+            shard = self.shards[shard_id]
+            for lo in range(0, len(idxs), chunk):
+                part = idxs[lo : lo + chunk]
+                results = shard.read_many(
+                    [series_ids[i] for i in part], start_ns, end_ns)
+                for i, (times, vbits) in zip(part, results):
+                    if limits is not None:
+                        limits.add_datapoints(len(times))
+                    out[i] = (times, vbits)
+        return out
 
     def flush(self, now_ns: int) -> int:
         """WARM flush: first volume for aged-out buffered windows."""

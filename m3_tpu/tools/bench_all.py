@@ -1,4 +1,5 @@
-"""BASELINE.md configs #1-#5 as one harness.
+"""BASELINE.md configs #1-#5 as one harness, plus #6: the batched
+read_many path (config #3's fetch leg measured directly).
 
 Prints one JSON line per config (same shape as bench.py). Sizes are
 env-tunable; defaults are sized to finish on CPU in a few minutes —
@@ -378,10 +379,94 @@ def config5_sharded_quantile():
           S * T / dt, S * T / dt_host)
 
 
+def config6_read_many():
+    """Batched multi-series fetch (config #3's fetch leg, measured
+    directly): Namespace.read_many — grouping by (shard, block, volume)
+    with ONE fused fetch+decode dispatch per group — vs the per-series
+    read loop it replaced (one Python round-trip + cache probe + decode
+    dispatch per series). Both single-threaded, cold cache, so the ratio
+    is pure dispatch economy, not parallelism."""
+    import tempfile
+
+    from m3_tpu.encoding.m3tsz import hostpath
+    from m3_tpu.storage.database import Database
+    from m3_tpu.storage.fileset import FilesetWriter
+    from m3_tpu.storage.options import (
+        DatabaseOptions, IndexOptions, NamespaceOptions, RetentionOptions,
+    )
+    from m3_tpu.utils.xtime import TimeUnit
+
+    NS = 10**9
+    BLOCK = 3600 * NS
+    START = 1_600_000_000 * NS
+    T = 24
+    n_blocks, n_shards = 2, 8
+    prev_threads = os.environ.get("M3_NATIVE_THREADS")
+    os.environ["M3_NATIVE_THREADS"] = "1"
+    try:
+        for B in (10_000, 100_000):
+            with tempfile.TemporaryDirectory() as root:
+                db = Database(root, DatabaseOptions(
+                    n_shards=n_shards, block_cache_entries=0))  # cold cache
+                ns = db.create_namespace("default", NamespaceOptions(
+                    retention=RetentionOptions(retention_ns=1000 * BLOCK,
+                                               block_size_ns=BLOCK),
+                    index=IndexOptions(enabled=False),
+                    writes_to_commitlog=False, snapshot_enabled=False))
+                ids = [b"series-%07d" % i for i in range(B)]
+                by_shard: dict[int, list[bytes]] = {}
+                for sid in ids:
+                    by_shard.setdefault(ns.shard_set.lookup(sid), []).append(sid)
+                rng = np.random.default_rng(0)
+                for shard_id, sids in by_shard.items():
+                    for b in range(n_blocks):
+                        bs = START + b * BLOCK
+                        nb = len(sids)
+                        times = np.broadcast_to(
+                            bs + np.arange(T, dtype=np.int64) * 10 * NS,
+                            (nb, T)).copy()
+                        vbits = rng.normal(100.0, 20.0, (nb, T)) \
+                            .view(np.uint64)
+                        streams = hostpath.encode_blocks(
+                            times, vbits, np.full(nb, bs, np.int64),
+                            np.full(nb, T, np.int32), TimeUnit.SECOND, False)
+                        w = FilesetWriter(db.fs_root, "default", shard_id,
+                                          bs, BLOCK, 0)
+                        for sid, stream in zip(sids, streams):
+                            w.write_series(sid, b"", stream)
+                        w.close()
+                db.open(START + n_blocks * BLOCK)
+                t_lo, t_hi = START, START + n_blocks * BLOCK
+                n_dp = B * T * n_blocks
+
+                batched = ns.read_many(ids, t_lo, t_hi)  # warm code paths
+                t0 = time.perf_counter()
+                batched = ns.read_many(ids, t_lo, t_hi)
+                dt_batch = time.perf_counter() - t0
+
+                t0 = time.perf_counter()
+                scalar = [ns.read(sid, t_lo, t_hi) for sid in ids]
+                dt_loop = time.perf_counter() - t0
+                ok = all(np.array_equal(bt, st) and np.array_equal(bv, sv)
+                         for (bt, bv), (st, sv)
+                         in zip(batched[::max(1, B // 200)],
+                                scalar[::max(1, B // 200)]))
+                db.close()
+            _emit(f"#6 read_many {B} series x {T * n_blocks} pts cold "
+                  "fetch+decode [batched per (shard, block), 1t]"
+                  + ("" if ok else " (CORRECTNESS FAILED)"),
+                  n_dp / dt_batch, n_dp / dt_loop)
+    finally:
+        if prev_threads is None:
+            os.environ.pop("M3_NATIVE_THREADS", None)
+        else:
+            os.environ["M3_NATIVE_THREADS"] = prev_threads
+
+
 def main(argv=None) -> None:
     global _ACCEL
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5")
+    ap.add_argument("--configs", default="1,2,3,4,5,6")
     ap.add_argument("--record", default=None,
                     help="also append the JSON lines to this file")
     args = ap.parse_args(argv)
@@ -406,7 +491,7 @@ def main(argv=None) -> None:
             raise SystemExit(subprocess.run(cmd, env=env, cwd=repo).returncode)
     fns = {"1": config1_codec_roundtrip, "2": config2_rollup,
            "3": config3_promql_rate_sum, "4": config4_regex_postings,
-           "5": config5_sharded_quantile}
+           "5": config5_sharded_quantile, "6": config6_read_many}
     for c in args.configs.split(","):
         c = c.strip()
         try:

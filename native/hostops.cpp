@@ -124,18 +124,23 @@ int64_t m3_agg_groups(
         e_min = std::min(e_min, e[i]); e_max = std::max(e_max, e[i]);
         w_min = std::min(w_min, w[i]); w_max = std::max(w_max, w[i]);
     }
-    const uint64_t e_range = (uint64_t)(e_max - e_min);
-    const uint64_t w_range = (uint64_t)(w_max - w_min);
+    // ranges as UNSIGNED subtraction: adversarial ids spanning most of
+    // int64 would overflow a signed max-min (UB); u64 wraparound is
+    // defined and yields the correct distance
+    const uint64_t e_range = (uint64_t)e_max - (uint64_t)e_min;
+    const uint64_t w_range = (uint64_t)w_max - (uint64_t)w_min;
     const int wbits = bits_for(w_range);
 
     std::vector<uint32_t> idx(n), scratch(n);
     for (int64_t i = 0; i < n; i++) idx[i] = (uint32_t)i;
 
-    if (bits_for(e_range) + wbits <= 64) {
+    // wbits == 64 must take the comparison sort: "<< wbits" and
+    // "1ull << wbits" are UB at 64 even when the packed key would fit
+    if (wbits < 64 && bits_for(e_range) + wbits <= 64) {
         std::vector<uint64_t> keys(n);
         for (int64_t i = 0; i < n; i++)
-            keys[i] = ((uint64_t)(e[i] - e_min) << wbits) |
-                      (uint64_t)(w[i] - w_min);
+            keys[i] = (((uint64_t)e[i] - (uint64_t)e_min) << wbits) |
+                      ((uint64_t)w[i] - (uint64_t)w_min);
         radix_sort_indices(keys, idx, scratch,
                            (e_range << wbits) | ((1ull << wbits) - 1));
     } else {

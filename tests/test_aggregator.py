@@ -274,6 +274,21 @@ class TestLateArrivals:
         assert out == []
         assert agg.num_late_dropped == 1
 
+    def test_non_monotonic_flush_never_regresses_history(self):
+        """A flush(now) with now < the previous flush must not insert a
+        lower head into _flush_history: stage-k thresholds read history
+        entries as high-water marks already used to close forwarded-stage
+        windows, and a regressed head could re-close (re-emit) them."""
+        agg = Aggregator(simple_ruleset())
+        agg.flush(START + 120 * SEC)
+        agg.flush(START + 60 * SEC)  # clock went backwards
+        assert agg._flush_history[0] == START + 120 * SEC  # clamped
+        assert agg._flush_history == sorted(agg._flush_history,
+                                            reverse=True)
+        # and a recovered clock resumes normally
+        agg.flush(START + 180 * SEC)
+        assert agg._flush_history[0] == START + 180 * SEC
+
 
 class TestMultiStagePipelines:
     def test_forwarded_second_stage(self):

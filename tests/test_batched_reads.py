@@ -1,0 +1,269 @@
+"""Batched multi-series read path: fetch+decode fused into ONE columnar
+dispatch per (shard, block, volume) group.
+
+Pins the three claims of the batched surface:
+  - dispatch economy: read_many over >=10k cold-cache series issues at
+    most one batched decode per (shard, block, volume) group (counted via
+    utils/dispatch counters), never one per series;
+  - parity: batched results are identical (times AND value bits) to the
+    per-series read() path on every ladder rung (native batch, vmapped
+    XLA kernel, scalar loop), including int-optimized and NaN-staleness
+    streams and marker-bearing streams the fast rungs reject;
+  - cache semantics: hits are served without entering the batch, and the
+    batch fills the decoded-block LRU so the per-series path hits it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from m3_tpu.encoding.m3tsz import hostpath
+from m3_tpu.encoding.m3tsz.encoder import Encoder
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.fileset import FilesetWriter
+from m3_tpu.storage.options import (
+    DatabaseOptions,
+    IndexOptions,
+    NamespaceOptions,
+    RetentionOptions,
+)
+from m3_tpu.utils import dispatch
+from m3_tpu.utils.xtime import TimeUnit
+
+NS = 10**9
+BLOCK = 3600 * NS
+START = 1_600_000_000 * NS
+
+# per-stream (non-batched) decode counters: the dispatch-economy tests
+# assert these do NOT move during a batched read
+PER_STREAM_COUNTERS = ("m3tsz_decode_native", "m3tsz_decode_scalar")
+
+
+def build_db(tmp_path, n_series, n_blocks=2, n_shards=4, points=6,
+             int_optimized=False, cache_entries=0):
+    """A database whose fileset volumes are written directly (one batched
+    encode per (shard, block)) — fast enough to set up 10k+ series."""
+    db = Database(
+        str(tmp_path / "db"),
+        DatabaseOptions(n_shards=n_shards, block_cache_entries=cache_entries),
+    )
+    opts = NamespaceOptions(
+        retention=RetentionOptions(retention_ns=1000 * BLOCK,
+                                   block_size_ns=BLOCK),
+        index=IndexOptions(enabled=False),
+        int_optimized=int_optimized,
+        writes_to_commitlog=False,
+        snapshot_enabled=False,
+    )
+    ns = db.create_namespace("default", opts)
+    ids = [b"series-%06d" % i for i in range(n_series)]
+    by_shard: dict[int, list[bytes]] = {}
+    for sid in ids:
+        by_shard.setdefault(ns.shard_set.lookup(sid), []).append(sid)
+    rng = np.random.default_rng(7)
+    for shard_id, sids in by_shard.items():
+        for b in range(n_blocks):
+            bs = START + b * BLOCK
+            B, T = len(sids), points
+            times = np.broadcast_to(
+                bs + np.arange(T, dtype=np.int64) * 10 * NS, (B, T)).copy()
+            values = rng.normal(100.0, 20.0, (B, T))
+            if int_optimized:
+                values = np.floor(values)
+            streams = hostpath.encode_blocks(
+                times, values.view(np.uint64), np.full(B, bs, np.int64),
+                np.full(B, T, np.int32), TimeUnit.SECOND, int_optimized)
+            writer = FilesetWriter(db.fs_root, "default", shard_id, bs,
+                                   BLOCK, 0)
+            for sid, stream in zip(sids, streams):
+                writer.write_series(sid, b"", stream)
+            writer.close()
+    db.open(START + n_blocks * BLOCK)
+    return db, ns, ids
+
+
+def _deltas(before, names):
+    return {k: dispatch.counters[k] - before.get(k, 0) for k in names}
+
+
+class TestDispatchEconomy:
+    N_SERIES = 10_000
+    N_BLOCKS = 2
+    N_SHARDS = 4
+
+    def test_one_dispatch_per_shard_block_group(self, tmp_path):
+        """>=10k cold-cache series resolve in n_shards * n_blocks batched
+        dispatches — zero per-series decode dispatches."""
+        db, ns, ids = build_db(tmp_path, self.N_SERIES,
+                               n_blocks=self.N_BLOCKS,
+                               n_shards=self.N_SHARDS, cache_entries=0)
+        try:
+            before = dict(dispatch.counters)
+            results = ns.read_many(ids, START, START + self.N_BLOCKS * BLOCK)
+            groups = dispatch.counters["m3tsz_decode_batch_groups"] \
+                - before.get("m3tsz_decode_batch_groups", 0)
+            assert groups <= self.N_SHARDS * self.N_BLOCKS
+            assert _deltas(before, PER_STREAM_COUNTERS) == {
+                k: 0 for k in PER_STREAM_COUNTERS}
+            assert len(results) == self.N_SERIES
+            # every series got both blocks' points
+            per_series = self.N_BLOCKS * 6
+            assert all(len(t) == per_series for t, _ in results)
+            # spot parity vs the per-series path
+            for i in range(0, self.N_SERIES, 997):
+                st, sv = ns.read(ids[i], START,
+                                 START + self.N_BLOCKS * BLOCK)
+                np.testing.assert_array_equal(results[i][0], st)
+                np.testing.assert_array_equal(results[i][1], sv)
+        finally:
+            db.close()
+
+    def test_cache_hits_never_enter_the_batch(self, tmp_path):
+        db, ns, ids = build_db(tmp_path, 300, cache_entries=10_000)
+        try:
+            first = ns.read_many(ids, START, START + 2 * BLOCK)
+            before = dict(dispatch.counters)
+            second = ns.read_many(ids, START, START + 2 * BLOCK)
+            assert dispatch.counters["m3tsz_decode_batch_groups"] \
+                == before.get("m3tsz_decode_batch_groups", 0)
+            for (t1, v1), (t2, v2) in zip(first, second):
+                np.testing.assert_array_equal(t1, t2)
+                np.testing.assert_array_equal(v1, v2)
+            # and the batch's cache fill serves the per-series path too
+            st, sv = ns.read(ids[0], START, START + 2 * BLOCK)
+            np.testing.assert_array_equal(st, first[0][0])
+        finally:
+            db.close()
+
+    def test_limits_accounting_is_per_series_exact(self, tmp_path):
+        from m3_tpu.storage.limits import QueryLimitError, QueryLimits
+
+        db, ns, ids = build_db(tmp_path, 64, n_blocks=1, cache_entries=0)
+        try:
+            total = 64 * 6
+            db.limits = QueryLimits(max_datapoints=total)
+            db.limits.start_query()
+            ns.read_many(ids, START, START + BLOCK)  # exactly at the limit
+            assert db.limits._tl.datapoints == total
+            db.limits.end_query()
+            db.limits = QueryLimits(max_datapoints=total - 1)
+            db.limits.start_query()
+            with pytest.raises(QueryLimitError):
+                ns.read_many(ids, START, START + BLOCK)
+            db.limits.end_query()
+        finally:
+            db.close()
+
+    def test_datapoint_limit_bounds_decode_work(self, tmp_path, monkeypatch):
+        """With a datapoint limit configured, an over-limit read_many must
+        abort after at most one chunk's decode — the limit bounds WORK,
+        not just the reported total (the per-series path's property)."""
+        from m3_tpu.storage.limits import QueryLimitError, QueryLimits
+        from m3_tpu.storage.namespace import Namespace
+
+        db, ns, ids = build_db(tmp_path, 1024, n_blocks=1, cache_entries=0)
+        monkeypatch.setattr(Namespace, "READ_MANY_LIMIT_CHUNK", 64)
+        try:
+            db.limits = QueryLimits(max_datapoints=30)  # < one chunk
+            db.limits.start_query()
+            before = dispatch.counters["m3tsz_decode_batch_groups"]
+            with pytest.raises(QueryLimitError):
+                ns.read_many(ids, START, START + BLOCK)
+            groups = dispatch.counters["m3tsz_decode_batch_groups"] - before
+            assert groups <= 1  # stopped inside the first chunk
+            db.limits.end_query()
+        finally:
+            db.close()
+
+    def test_unowned_shard_still_raises(self, tmp_path):
+        db, ns, ids = build_db(tmp_path, 32, n_blocks=1)
+        try:
+            victim = ids[0]
+            ns.shards.pop(ns.shard_set.lookup(victim))
+            with pytest.raises(KeyError):
+                ns.read_many(ids, START, START + BLOCK)
+        finally:
+            db.close()
+
+
+class TestForcedPathParity:
+    """Every ladder rung produces bit-identical (times, vbits) to the
+    per-series decode_stream path — float, int-optimized, NaN staleness."""
+
+    def _streams(self, int_opt):
+        rng = np.random.default_rng(3)
+        streams = []
+        for s in range(12):
+            enc = Encoder(START, int_optimized=int_opt,
+                          default_time_unit=TimeUnit.SECOND)
+            t = START
+            for i in range(int(rng.integers(1, 40))):
+                t += int(rng.integers(1, 120)) * NS
+                if rng.random() < 0.15:
+                    v = float("nan")  # staleness marker
+                elif int_opt and rng.random() < 0.5:
+                    v = float(int(rng.integers(-1000, 1000)))
+                else:
+                    v = float(rng.normal(50, 20))
+                enc.encode(t, v, TimeUnit.SECOND)
+            streams.append(enc.stream())
+        streams.insert(3, b"")  # empty stream mid-batch
+        return streams
+
+    @pytest.mark.parametrize("path", ["scalar", "native", "device"])
+    @pytest.mark.parametrize("int_opt", [False, True])
+    def test_rung_matches_per_series(self, monkeypatch, path, int_opt):
+        streams = self._streams(int_opt)
+        ref = [hostpath.decode_stream(s, TimeUnit.SECOND, int_opt) if s
+               else (np.empty(0, np.int64), np.empty(0, np.uint64))
+               for s in streams]
+        monkeypatch.setenv("M3_TPU_DECODE_BATCH_PATH", path)
+        got = hostpath.decode_streams_batch(streams, TimeUnit.SECOND, int_opt)
+        for (gt, gv), (rt, rv) in zip(got, ref):
+            np.testing.assert_array_equal(gt, rt)
+            np.testing.assert_array_equal(gv, rv)
+
+    def test_marker_stream_degrades_per_stream_not_whole_group(self):
+        """A time-unit-change marker stream (native batch rejects it) must
+        not poison the group: the other streams still decode, and the
+        marker stream decodes via the scalar rung."""
+        enc = Encoder(START, int_optimized=False,
+                      default_time_unit=TimeUnit.SECOND)
+        enc.encode(START + NS, 1.0, TimeUnit.SECOND)
+        enc.encode(START + NS + 10**6, 2.0, TimeUnit.MILLISECOND)
+        marker = enc.stream()
+        plain = Encoder(START, int_optimized=False,
+                        default_time_unit=TimeUnit.SECOND)
+        plain.encode(START + NS, 5.0, TimeUnit.SECOND)
+        streams = [plain.stream(), marker]
+        # float-mode group containing a marker stream: the native rung
+        # raises for the whole batch and must fall back per stream
+        got = hostpath.decode_streams_batch(streams, TimeUnit.SECOND, False)
+        np.testing.assert_array_equal(got[0][0], [START + NS])
+        ref = hostpath.decode_stream(marker, TimeUnit.SECOND, False)
+        np.testing.assert_array_equal(got[1][0], ref[0])
+        np.testing.assert_array_equal(got[1][1], ref[1])
+
+
+class TestBatchedVsBufferMerge:
+    def test_buffered_writes_win_over_flushed(self, tmp_path):
+        """Batched reads keep last-write-wins semantics: buffer points
+        override flushed points on timestamp ties, same as read()."""
+        db, ns, ids = build_db(tmp_path, 40, n_blocks=1, cache_entries=0)
+        try:
+            overwrite_t = START + 20 * NS  # collides with a flushed point
+            for sid in ids[:10]:
+                ns.write(sid, overwrite_t,
+                         int(np.float64(-1.0).view(np.uint64)))
+            batched = ns.read_many(ids, START, START + BLOCK)
+            for i, sid in enumerate(ids):
+                st, sv = ns.shards[ns.shard_set.lookup(sid)].read(
+                    sid, START, START + BLOCK)
+                np.testing.assert_array_equal(batched[i][0], st)
+                np.testing.assert_array_equal(batched[i][1], sv)
+            row = batched[0]
+            at = row[1][row[0] == overwrite_t].view(np.float64)
+            assert at == -1.0
+        finally:
+            db.close()

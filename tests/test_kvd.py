@@ -244,6 +244,104 @@ time.sleep(300)
 """
 
 
+class TestLeaseExpiryRollback:
+    """A write whose lease expires between the liveness check and the
+    attach must roll back to the key's PRIOR VersionedValue (value,
+    version, lease attachment) — not delete it (which destroyed version
+    history and pushed a spurious delete event to every watcher)."""
+
+    def _dead_lease(self, server) -> int:
+        with server._lock:
+            server._lease_seq += 1
+            return server._lease_seq  # never registered => not live
+
+    def _force_past_liveness_check(self, server):
+        """Simulate the lease dying BETWEEN _lease_live and _attach_lease
+        (the reaper window) by letting the pre-check pass."""
+        server._lease_live = lambda lid: True
+
+    def test_set_rollback_restores_prior_value_and_version(self, server,
+                                                           client):
+        from m3_tpu.cluster.kvd import _dec_resp, _enc_req
+
+        client.set("k", b"v1")
+        client.set("k", b"v2")
+        events = []
+        orig_notify = server.store._notify
+        server.store._notify = lambda key, vv: (
+            events.append((key, None if vv is None else vv.data)),
+            orig_notify(key, vv))
+        self._force_past_liveness_check(server)
+        resp = server._set(
+            _enc_req(key="k", data=b"v3", lease_id=self._dead_lease(server)),
+            None)
+        assert _dec_resp(resp)[2] == "nolease"
+        vv = server.store.get("k")
+        assert (vv.version, vv.data) == (2, b"v2")  # exact prior restored
+        assert ("k", None) not in events  # no spurious delete event
+        # and the key is NOT silently lease-attached to anything
+        with server._lock:
+            assert "k" not in server._key_lease
+
+    def test_cas_rollback_restores_prior_value(self, server, client):
+        from m3_tpu.cluster.kvd import _dec_resp, _enc_req
+
+        client.set("k", b"v1")
+        self._force_past_liveness_check(server)
+        resp = server._cas(
+            _enc_req(key="k", data=b"v2", expect_version=1,
+                     lease_id=self._dead_lease(server)), None)
+        assert _dec_resp(resp)[2] == "nolease"
+        vv = server.store.get("k")
+        assert (vv.version, vv.data) == (1, b"v1")
+
+    def test_rollback_deletes_only_previously_absent_keys(self, server,
+                                                          client):
+        from m3_tpu.cluster.kvd import _dec_resp, _enc_req
+
+        self._force_past_liveness_check(server)
+        resp = server._set(
+            _enc_req(key="fresh", data=b"x",
+                     lease_id=self._dead_lease(server)), None)
+        assert _dec_resp(resp)[2] == "nolease"
+        with pytest.raises(KeyNotFound):
+            server.store.get("fresh")
+
+    def test_grace_attach_never_steals_a_live_owner(self, server, client):
+        """only_if_unowned attach (the grace-lease restore) is atomic with
+        the ownership check: a key a live owner re-attached is left alone."""
+        owner = client.start_session(ttl_ms=30_000)
+        client.set("eph", b"mine", ephemeral=True)
+        with server._lock:
+            server._lease_seq += 1
+            from m3_tpu.cluster.kvd import _Lease
+
+            grace = _Lease(server._lease_seq, 10_000)
+            server._leases[grace.lease_id] = grace
+        assert not server._attach_lease("eph", grace.lease_id, persist=False,
+                                        only_if_unowned=True)
+        with server._lock:
+            assert server._key_lease.get("eph") == owner
+
+    def test_rollback_preserves_prior_lease_attachment(self, server, client):
+        from m3_tpu.cluster.kvd import _dec_resp, _enc_req
+
+        owner = client.start_session(ttl_ms=30_000)
+        client.set("eph", b"mine", ephemeral=True)
+        with server._lock:
+            assert server._key_lease.get("eph") == owner
+        self._force_past_liveness_check(server)
+        resp = server._set(
+            _enc_req(key="eph", data=b"stolen",
+                     lease_id=self._dead_lease(server)), None)
+        assert _dec_resp(resp)[2] == "nolease"
+        vv = server.store.get("eph")
+        assert vv.data == b"mine"
+        # the ORIGINAL owner still holds the key: its expiry still reaps it
+        with server._lock:
+            assert server._key_lease.get("eph") == owner
+
+
 class TestKvdElection:
     def test_kill_the_leader_failover(self, server, tmp_path):
         """The VERDICT's required scenario: SIGKILL the leader process;

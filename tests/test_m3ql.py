@@ -54,6 +54,28 @@ class TestParse:
         assert isinstance(e, AggregateExpr) and e.op == "max"
         assert isinstance(e.expr, AggregateExpr) and e.expr.op == "sum"
 
+    def test_timeshift_returns_shifted_selector(self):
+        e = m3ql.parse("fetch name:reqs | timeshift 1h")
+        assert isinstance(e, VectorSelector)
+        assert e.offset_ns == 3600 * NS
+
+    def test_macro_reuse_not_poisoned_by_timeshift(self):
+        """Macro bodies are expanded BY REFERENCE: timeshift must return a
+        fresh selector, or shifting one use of the macro shifts them all."""
+        e = m3ql.parse(
+            "a = fetch name:reqs; b = a | timeshift 1h; a | sum host")
+        assert isinstance(e, AggregateExpr)
+        sel = e.expr
+        assert isinstance(sel, VectorSelector)
+        assert sel.offset_ns == 0  # the shared selector was NOT mutated
+        # and the shifted use really is shifted
+        e2 = m3ql.parse("a = fetch name:reqs; a | timeshift 2h")
+        assert isinstance(e2, VectorSelector) and e2.offset_ns == 7200 * NS
+        # parse-order independence: shift first, reuse after
+        e3 = m3ql.parse(
+            "a = fetch name:reqs; b = a | timeshift 1h; a | max")
+        assert e3.expr.offset_ns == 0
+
     def test_errors(self):
         with pytest.raises(M3QLError):
             m3ql.parse("sum dc")  # no fetch

@@ -69,6 +69,51 @@ class TestAggGroups:
         np.testing.assert_array_equal(gw, gw_n)
         np.testing.assert_array_equal(st["last"], st_n["last"])
 
+    def test_adversarial_id_ranges_span_int64(self):
+        """Ids spanning (almost) the full int64 range: the min/max range
+        computation must be u64 subtraction (signed overflow is UB) and a
+        64-bit window range must route to the comparison sort (a 64-bit
+        shift in the radix key packing is UB)."""
+        imin = np.iinfo(np.int64).min
+        imax = np.iinfo(np.int64).max
+        n = 4_096
+        rng = np.random.default_rng(11)
+        e = rng.integers(-2**62, 2**62, n).astype(np.int64)
+        w = rng.integers(-2**62, 2**62, n).astype(np.int64)
+        # pin the extremes so e_range and w_range both wrap int64
+        e[:4] = [imin, imax, imin + 1, imax - 1]
+        w[:4] = [imax, imin, imax - 1, imin + 1]
+        # duplicates so grouping actually groups at the extremes
+        e[4:8] = e[:4]
+        w[4:8] = w[:4]
+        v = rng.normal(0, 1, n)
+        t = rng.integers(0, 100, n).astype(np.int64)
+        ge_n, gw_n, st_n, vq_n, off_n = _numpy_groups(e, w, v, t)
+        ge, gw, st, vq, off = native_hostops.agg_groups(e, w, v, t)
+        np.testing.assert_array_equal(ge, ge_n)
+        np.testing.assert_array_equal(gw, gw_n)
+        np.testing.assert_array_equal(off, off_n)
+        np.testing.assert_array_equal(st["last"], st_n["last"])
+        np.testing.assert_allclose(st["sum"], st_n["sum"], rtol=1e-9)
+
+    def test_wbits_exactly_64_takes_comparison_sort(self):
+        """w range needing all 64 bits with a single elem id: the radix
+        condition (0 + 64 <= 64) used to pass and shift by 64 — UB."""
+        imin = np.iinfo(np.int64).min
+        imax = np.iinfo(np.int64).max
+        e = np.zeros(64, np.int64)
+        w = np.concatenate([np.array([imin, imax, imin, imax], np.int64),
+                            np.arange(-30, 30, dtype=np.int64)])
+        rng = np.random.default_rng(5)
+        v = rng.normal(0, 1, len(w))
+        t = np.arange(len(w), dtype=np.int64)
+        ge_n, gw_n, st_n, _, off_n = _numpy_groups(e, w, v, t)
+        ge, gw, st, _, off = native_hostops.agg_groups(e, w, v, t)
+        np.testing.assert_array_equal(ge, ge_n)
+        np.testing.assert_array_equal(gw, gw_n)
+        np.testing.assert_array_equal(off, off_n)
+        np.testing.assert_array_equal(st["last"], st_n["last"])
+
     def test_dispatch_uses_native_for_large_flushes(self):
         from m3_tpu.utils import dispatch
 

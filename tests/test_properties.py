@@ -14,8 +14,11 @@ import struct
 
 import numpy as np
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+
+pytest.importorskip("hypothesis")  # property tier needs hypothesis; the
+# rest of the suite must not fail collection on images without it
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from m3_tpu.encoding.m3tsz import native
 from m3_tpu.encoding.m3tsz.constants import float_to_bits
@@ -160,6 +163,50 @@ class TestNativeBatchThreadIdentity:
         np.testing.assert_array_equal(n1, n4)
         np.testing.assert_array_equal(t1, t4)
         np.testing.assert_array_equal(v1, v4)
+
+
+# -- batched read-path properties --------------------------------------------
+
+_batch_paths = ["scalar", "device"] + (["native"] if native.available() else [])
+
+
+@settings(max_examples=20, deadline=None)
+@given(st.lists(st.lists(st.integers(min_value=1, max_value=3000),
+                         min_size=1, max_size=30),
+                min_size=1, max_size=8),
+       st.data(), st.booleans(), st.sampled_from(_batch_paths))
+def test_prop_batched_decode_matches_per_series(series_deltas, data, int_opt,
+                                                path):
+    """decode_streams_batch on EVERY forced ladder rung (native batch,
+    vmapped XLA kernel, scalar loop) is bit-identical — times AND value
+    bits — to the per-series decode_stream path, across int-optimized and
+    float-XOR modes, NaN staleness markers included."""
+    from m3_tpu.encoding.m3tsz import hostpath
+
+    start = 1_600_000_000 * NS
+    streams = []
+    for deltas in series_deltas:
+        enc = Encoder(start, int_optimized=int_opt,
+                      default_time_unit=TimeUnit.SECOND)
+        t = start
+        for d in deltas:
+            t += d * NS
+            v = data.draw(_values)
+            if int_opt and np.isfinite(v) and float(v).is_integer():
+                v = float(int(v) % (1 << 53))
+            enc.encode(t, v, TimeUnit.SECOND)
+        streams.append(enc.stream())
+    per_series = [hostpath.decode_stream(s, TimeUnit.SECOND, int_opt)
+                  for s in streams]
+    os.environ["M3_TPU_DECODE_BATCH_PATH"] = path
+    try:
+        batched = hostpath.decode_streams_batch(streams, TimeUnit.SECOND,
+                                                int_opt)
+    finally:
+        os.environ.pop("M3_TPU_DECODE_BATCH_PATH", None)
+    for (bt, bv), (pt, pv) in zip(batched, per_series):
+        np.testing.assert_array_equal(bt, pt)
+        np.testing.assert_array_equal(bv, pv)
 
 
 # -- index properties --------------------------------------------------------
