@@ -19,6 +19,7 @@ in virtual time.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from dataclasses import dataclass
@@ -35,6 +36,9 @@ class BreakerConfig:
     half_open_probes: int = 1       # concurrent trial requests when half-open
     retry_attempts: int = 2         # per-call attempts (1 = no retry)
     retry_backoff_s: float = 0.02   # first backoff; doubles per retry
+    # multiplicative backoff jitter in [0, frac): many callers retrying a
+    # recovered host must not stampede it in lockstep (0 = deterministic)
+    retry_jitter_frac: float = 0.0
 
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
@@ -106,11 +110,20 @@ class HostPolicy:
     session sends that host."""
 
     def __init__(self, host: str, config: BreakerConfig = BreakerConfig(),
-                 clock=time.monotonic, sleep=time.sleep):
+                 clock=time.monotonic, sleep=time.sleep,
+                 rng: random.Random | None = None,
+                 no_count: tuple[type[BaseException], ...] = ()):
         self.host = host
         self.breaker = CircuitBreaker(config, clock)
         self.config = config
         self._sleep = sleep
+        # seeded per-host so jittered schedules replay deterministically
+        self._rng = rng if rng is not None else random.Random(host)
+        # exception types that are the CALLER's fault (deterministic 4xx,
+        # malformed request): re-raised without a retry and without
+        # counting as a host failure — a healthy host must not have its
+        # circuit opened by requests that can never succeed
+        self._no_count = no_count
 
     def call(self, fn, *args, **kwargs):
         """Run fn through the breaker with bounded backed-off retries.
@@ -126,10 +139,22 @@ class HostPolicy:
             try:
                 out = fn(*args, **kwargs)
             except Exception as e:  # noqa: BLE001 - every failure counts
+                if self._no_count and isinstance(e, self._no_count):
+                    # the host ANSWERED (deterministic request error): for
+                    # the breaker that is a healthy response — record
+                    # success so a half-open probe ending in a 4xx closes
+                    # the circuit (and releases its probe slot) instead of
+                    # leaking it and shedding the host forever
+                    self.breaker.on_success()
+                    raise  # ...but the caller still sees their error
                 self.breaker.on_failure()
                 last_err = e
                 if attempt + 1 < self.config.retry_attempts:
-                    self._sleep(self.config.retry_backoff_s * (2 ** attempt))
+                    backoff = self.config.retry_backoff_s * (2 ** attempt)
+                    if self.config.retry_jitter_frac:
+                        backoff *= 1.0 + \
+                            self.config.retry_jitter_frac * self._rng.random()
+                    self._sleep(backoff)
                 continue
             self.breaker.on_success()
             return out

@@ -35,7 +35,9 @@ from m3_tpu.cluster.topology import (
     required_acks,
 )
 from m3_tpu.storage.buffer import merge_dedup
+from m3_tpu.utils import faults
 from m3_tpu.utils.hash import murmur3_32
+from m3_tpu.utils.warnings import ReadWarning
 
 
 class NodeConnection(Protocol):
@@ -79,6 +81,11 @@ class Session:
         # concurrent writers race host_policy's check-then-insert; a lock
         # keeps one HostPolicy (and so one breaker state) per host
         self._policies_lock = threading.Lock()
+        # partial-result contract: when a read meets its consistency level
+        # but some replica failed, the read SUCCEEDS and the degraded legs
+        # are recorded here (reset per fetch/fetch_many call) and in the
+        # caller-provided `warnings` out-param
+        self.last_warnings: list[ReadWarning] = []
 
     def host_policy(self, host: str):
         """The host's breaker+retry policy (created on first use); every
@@ -100,7 +107,16 @@ class Session:
             return pol
 
     def _host_call(self, host: str, fn, *args, **kwargs):
-        return self.host_policy(host).call(fn, *args, **kwargs)
+        pol = self.host_policy(host)
+        if faults.enabled():
+            # inject INSIDE the policy wrapper so the host's breaker and
+            # retry accounting see injected failures exactly like real ones
+            def faulted(*a, **k):
+                faults.check("session.host_call", host=host)
+                return fn(*a, **k)
+
+            return pol.call(faulted, *args, **kwargs)
+        return pol.call(fn, *args, **kwargs)
 
     def _shard(self, series_id: bytes) -> int:
         return murmur3_32(series_id, self.shard_seed) % self.topology.n_shards
@@ -196,8 +212,13 @@ class Session:
 
     # -- read path --
 
-    def fetch(self, namespace: str, series_id: bytes, start_ns: int, end_ns: int):
-        """Replica-merged datapoints [(t_ns, value)]."""
+    def fetch(self, namespace: str, series_id: bytes, start_ns: int, end_ns: int,
+              warnings: list | None = None):
+        """Replica-merged datapoints [(t_ns, value)]. Degrades gracefully:
+        once the read consistency level is met, replica failures become
+        ReadWarnings (self.last_warnings / the warnings out-param), not
+        errors."""
+        self.last_warnings = []  # never serve a prior call's warnings
         shard = self._shard(series_id)
         hosts = self.topology.readable_hosts_for_shard(shard)
         if not hosts:
@@ -233,17 +254,36 @@ class Session:
                 f"read got {successes}/{need} replicas "
                 f"(level={self.read_consistency.value}, errors={errors})"
             )
+        self._record_warnings(errors, warnings)
         if not parts_t:
             return []
         times, vbits = merge_dedup(np.concatenate(parts_t), np.concatenate(parts_v))
         values = vbits.view(np.float64)
         return list(zip(times.tolist(), values.tolist()))
 
+    def _record_warnings(self, errors: list, warnings: list | None) -> None:
+        """A read that met consistency despite per-host failures surfaces
+        them as structured warnings instead of dropping them on the floor.
+        self.last_warnings is a convenience for single-threaded callers
+        (concurrent fetches clobber it — whichever call wrote last wins);
+        the `warnings` out-param is the per-call, thread-safe channel."""
+        self.last_warnings = [
+            ReadWarning("session", str(host), str(err)) for host, err in errors
+        ]
+        if warnings is not None:
+            warnings.extend(self.last_warnings)
+
     def fetch_many(self, namespace: str, series_ids: list[bytes],
-                   start_ns: int, end_ns: int):
+                   start_ns: int, end_ns: int, warnings: list | None = None):
         """Replica-merged reads for MANY series with one batched request
         per host (the host-queue op-batching role, client/host_queue.go).
-        Returns [(times int64[], value_bits uint64[])] aligned to input."""
+        Returns [(times int64[], value_bits uint64[])] aligned to input.
+
+        Partial-result contract: a host failure only raises when it drops
+        some series below the read consistency level; otherwise the batch
+        succeeds and each failed leg is reported as a ReadWarning via
+        self.last_warnings / the warnings out-param."""
+        self.last_warnings = []  # never serve a prior call's warnings
         if is_unstrict(self.read_consistency):
             need = 1
         else:
@@ -282,7 +322,6 @@ class Session:
                         np.array([d.value for d in dps],
                                  np.float64).view(np.uint64),
                     ))
-        out = []
         for sid in series_ids:
             if successes[sid] < need:
                 raise ConsistencyError(
@@ -290,6 +329,12 @@ class Session:
                     f"{sid!r} (level={self.read_consistency.value}, "
                     f"errors={errors})"
                 )
+        # warnings accompany a SUCCEEDING partial read only — record them
+        # after every series cleared its consistency level (as fetch does),
+        # so a raising call never pollutes the caller's warnings list
+        self._record_warnings(errors, warnings)
+        out = []
+        for sid in series_ids:
             if not parts[sid]:
                 out.append((np.empty(0, np.int64), np.empty(0, np.uint64)))
                 continue

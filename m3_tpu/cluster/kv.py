@@ -16,6 +16,8 @@ import threading
 from dataclasses import dataclass
 from typing import Callable
 
+from m3_tpu.utils import faults
+
 
 class KVError(Exception):
     pass
@@ -266,15 +268,20 @@ class FileKVStore(KVStore):
             super().delete(key)
 
     def _persist(self) -> None:
+        # journal writes are already crash-atomic (tmp + fsync + replace);
+        # kvd.persist injects failures BEFORE any byte lands and
+        # kvd.persist.write can tear the tmp file — either way the
+        # committed journal under the final name stays intact
+        faults.check("kvd.persist")
         tmp = self._path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(
-                {
-                    k: {"version": v.version, "data": v.data.hex()}
-                    for k, v in self._data.items()
-                },
-                f,
-            )
+        payload = json.dumps(
+            {
+                k: {"version": v.version, "data": v.data.hex()}
+                for k, v in self._data.items()
+            }
+        ).encode()
+        with open(tmp, "wb") as f:
+            faults.torn_write(f, payload, "kvd.persist.write")
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._path)

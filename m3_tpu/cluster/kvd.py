@@ -73,6 +73,7 @@ from m3_tpu.cluster.kv import (
     VersionedValue,
     VersionMismatch,
 )
+from m3_tpu.utils import faults
 from m3_tpu.utils.protowire import field_bytes, field_varint, iter_fields
 
 _SERVICE = "m3.cluster.Kvd"
@@ -792,6 +793,9 @@ class KvdClient(KVStore):
         last_exc: Exception | None = None
         for i in range(attempts):
             try:
+                # injected transport faults drive the same rotate/retry
+                # failover path a dead kvd does
+                faults.check("kvd.rpc", method=name, target=self.target)
                 resp = _dec_resp(self._stub(name)(req, timeout=self.timeout_s))
             except Exception as e:  # noqa: BLE001 - grpc transport error
                 last_exc = e

@@ -14,6 +14,7 @@ import threading
 from typing import Callable
 
 from m3_tpu.msg.protocol import recv_frame, send_frame
+from m3_tpu.utils import faults
 
 
 class Consumer:
@@ -51,6 +52,9 @@ class Consumer:
         try:
             while not self._closed:
                 try:
+                    # an injected error tears the connection down (outer
+                    # OSError handler) → the producer reconnects + retries
+                    faults.check("msg.consumer.recv")
                     frame = recv_frame(conn)
                 except TimeoutError:
                     if pending_acks:

@@ -17,6 +17,7 @@ import time
 from dataclasses import dataclass
 
 from m3_tpu.msg.protocol import recv_frame, send_frame
+from m3_tpu.utils import faults
 
 
 @dataclass
@@ -44,6 +45,12 @@ class Producer:
         self.on_drop = on_drop
         self._pending: dict[int, _Pending] = {}
         self._queue: list[int] = []
+        # mirror of _queue's membership, maintained under _lock: BOTH
+        # requeue paths (the writer's send-failure handler and the stale
+        # scan) consult it immediately before inserting, so a message can
+        # never be queued twice — double-queued ids double-send on flappy
+        # links (each pop transmits)
+        self._queued: set[int] = set()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._next_id = 1
@@ -69,6 +76,7 @@ class Producer:
                         self._queue.remove(oldest)
                     except ValueError:
                         pass
+                    self._queued.discard(oldest)
                     self.num_dropped += 1
                     if self.on_drop:
                         self.on_drop(dropped)
@@ -76,6 +84,7 @@ class Producer:
             self._next_id += 1
             self._pending[msg_id] = _Pending(msg_id, shard, payload)
             self._queue.append(msg_id)
+            self._queued.add(msg_id)
             self._cv.notify()
             return msg_id
 
@@ -98,6 +107,7 @@ class Producer:
 
     def _connect(self) -> socket.socket | None:
         try:
+            faults.check("msg.producer.connect", endpoint=self.endpoint)
             sock = socket.create_connection(self.endpoint, timeout=5)
             sock.settimeout(None)
             return sock
@@ -129,10 +139,12 @@ class Producer:
                 if self._closed:
                     return
                 msg_id = self._queue.pop(0)
+                self._queued.discard(msg_id)
                 p = self._pending.get(msg_id)
             if p is None:
                 continue  # acked while queued
             try:
+                faults.check("msg.producer.send", msg_id=p.msg_id)
                 send_frame(
                     self._sock,
                     {"type": "msg", "id": p.msg_id, "shard": p.shard},
@@ -142,13 +154,22 @@ class Producer:
                     p.sent_at = time.monotonic()
                     p.attempts += 1
             except OSError:
-                with self._cv:
-                    self._queue.insert(0, msg_id)
+                self._requeue_after_error(msg_id)
                 try:
                     self._sock.close()
                 except OSError:
                     pass
                 self._sock = None
+
+    def _requeue_after_error(self, msg_id: int) -> None:
+        """Front-requeue a message whose send failed — unless it was acked
+        mid-send or is ALREADY queued again (the stale scan may have
+        re-appended it between our pop and the failure; queuing it twice
+        double-sends)."""
+        with self._cv:
+            if msg_id in self._pending and msg_id not in self._queued:
+                self._queue.insert(0, msg_id)
+                self._queued.add(msg_id)
 
     def _requeue_stale_locked(self) -> None:
         now = time.monotonic()
@@ -157,14 +178,14 @@ class Producer:
         if now - getattr(self, "_last_requeue_scan", 0.0) < self.retry_after_s / 2:
             return
         self._last_requeue_scan = now
-        queued = set(self._queue)
         for p in self._pending.values():
             if (
-                p.msg_id not in queued
+                p.msg_id not in self._queued  # live set, not a scan snapshot
                 and p.sent_at
                 and now - p.sent_at > self.retry_after_s
             ):
                 self._queue.append(p.msg_id)
+                self._queued.add(p.msg_id)
 
     def _run_acker(self, sock: socket.socket) -> None:
         while not self._closed:

@@ -26,7 +26,9 @@ import logging
 import numpy as np
 
 from m3_tpu.storage.buffer import merge_dedup
+from m3_tpu.utils import faults
 from m3_tpu.utils.instrument import default_registry
+from m3_tpu.utils.warnings import ReadWarning
 
 log = logging.getLogger(__name__)
 _scope = default_registry().root_scope("fanout")
@@ -42,6 +44,11 @@ class FanoutNamespace:
     def __init__(self, fdb: "FanoutDatabase", name: str):
         self._fdb = fdb
         self.name = name
+        # partial-result contract (non-strict mode): zones skipped by the
+        # last read/query call, as structured ReadWarnings — callers that
+        # must distinguish "complete" from "served degraded" read this
+        # instead of scraping logs/counters
+        self.last_warnings: list[ReadWarning] = []
 
     @property
     def _local(self):
@@ -55,19 +62,23 @@ class FanoutNamespace:
 
     # -- index scatter --
 
-    def _zone_call(self, zone, fn, *args):
+    def _zone_call(self, zone, fn, *args, warnings: list | None = None):
         try:
+            faults.check("fanout.zone", zone=zone.name)
             return fn(*args)
         except Exception as e:  # noqa: BLE001 - per-zone failure policy
             if self._fdb.strict:
                 raise FanoutError(f"remote zone {zone.name}: {e}") from e
             _scope.subscope("zone", zone=zone.name).counter("errors")
             log.warning("fanout: skipping zone %s: %s", zone.name, e)
+            if warnings is not None:
+                warnings.append(ReadWarning("fanout", zone.name, str(e)))
             return None
 
     def query_ids(self, query, start_ns: int, end_ns: int, limit=None):
         from m3_tpu.index.query import query_to_json
 
+        warns: list[ReadWarning] = []
         local = self._local
         docs = list(local.query_ids(query, start_ns, end_ns, limit)) if local else []
         seen = {d.series_id for d in docs}
@@ -76,7 +87,8 @@ class FanoutNamespace:
 
         for zone in self._fdb.zones:
             rows = self._zone_call(
-                zone, zone.query_ids, self.name, qj, start_ns, end_ns, limit)
+                zone, zone.query_ids, self.name, qj, start_ns, end_ns, limit,
+                warnings=warns)
             if not rows:
                 continue
             for sid, fields in rows:
@@ -86,15 +98,23 @@ class FanoutNamespace:
         docs.sort(key=lambda d: d.series_id)
         if limit is not None:
             docs = docs[:limit]
+        self.last_warnings = warns
         return docs
 
     # -- reads (replica-style sample merge across zones) --
 
-    def read_many(self, series_ids: list[bytes], start_ns: int, end_ns: int):
+    def read_many(self, series_ids: list[bytes], start_ns: int, end_ns: int,
+                  warnings: list | None = None):
         """One BATCHED read per zone: the local leg is the namespace's
         fused fetch+decode batch (one dispatch per (shard, block, volume)
         group) and each remote leg is one read_many RPC, so a fan-out over
-        N series costs one batched request per node, not N."""
+        N series costs one batched request per node, not N.
+
+        Partial-result contract (non-strict): a zone failing closed yields
+        the surviving zones' merge plus one ReadWarning per skipped zone
+        (self.last_warnings / the warnings out-param) — never an
+        exception."""
+        warns: list[ReadWarning] = []
         local = self._local
         if local is not None:
             merged = list(local.read_many(series_ids, start_ns, end_ns))
@@ -104,7 +124,8 @@ class FanoutNamespace:
             merged = [(empty_t, empty_v) for _ in series_ids]
         for zone in self._fdb.zones:
             remote = self._zone_call(
-                zone, zone.read_many, self.name, series_ids, start_ns, end_ns)
+                zone, zone.read_many, self.name, series_ids, start_ns, end_ns,
+                warnings=warns)
             if remote is None:
                 continue
             for i, (rt, rv) in enumerate(remote):
@@ -118,6 +139,9 @@ class FanoutNamespace:
                     # remote samples go FIRST and the local zone wins
                     merged[i] = merge_dedup(
                         np.concatenate([rt, lt]), np.concatenate([rv, lv]))
+        self.last_warnings = warns
+        if warnings is not None:
+            warnings.extend(warns)
         return merged
 
     def read(self, series_id: bytes, start_ns: int, end_ns: int):

@@ -21,6 +21,7 @@ from urllib.parse import parse_qs, urlparse
 from m3_tpu.services.coordinator import namespace_options
 from m3_tpu.storage.database import Database
 from m3_tpu.storage.options import DatabaseOptions
+from m3_tpu.utils import faults
 from m3_tpu.utils.config import load_config
 from m3_tpu.utils.instrument import Logger, default_registry
 
@@ -35,7 +36,12 @@ class NodeAPI:
     def handle(self, method, path, q, body):
         try:
             if path in ("/health", "/bootstrapped"):
+                # exempt from injection so orchestrators can still see the
+                # process is alive under a fault plan
                 return 200, json.dumps({"ok": True}).encode()
+            # node-level request faults: clients see a 5xx, driving their
+            # breaker/consistency paths like a real sick node
+            faults.check("dbnode.handle", path=path)
             if path == "/metrics":
                 return 200, default_registry().render_prometheus()
             if path == "/write" and method == "POST":
@@ -169,6 +175,15 @@ class NodeAPI:
                     }
                 ).encode()
             return 404, b'{"error":"unknown path"}'
+        except faults.SimulatedCrash:
+            # a simulated crash must NOT be served as an error response —
+            # no handler survives a SIGKILL. Propagate so the request
+            # thread dies mid-flight (the client sees a torn connection)
+            # and any partially-written durability state stays exactly as
+            # the kill left it.
+            raise
+        except (faults.InjectedError, faults.InjectedTimeout) as e:
+            return 503, json.dumps({"error": str(e)}).encode()
         except Exception as e:
             return 400, json.dumps({"error": str(e)}).encode()
 

@@ -11,6 +11,17 @@ host-side byte buffer flushed on size/explicit fsync; the chunk format is:
           kind 1 (write):    u32 sidx, i64 time_ns, u64 value_bits, u8 unit
 Series are registered once per log file and then referenced by index,
 mirroring the reference's commit-log series registry.
+
+Recovery modes: `replay` is strict (corrupt interior chunks raise — the
+inspector/verifier behavior), `replay_salvage` truncates at the first bad
+chunk and reports what was dropped (the bootstrap behavior: a damaged log
+must never brick a node; the reference's commitlog bootstrapper likewise
+reads until the first unrecoverable error). Torn TRAILING chunks — the
+tail of a crashed process — are skipped by both.
+
+Fault points (utils/faults.py): commitlog.write, commitlog.flush (torn
+writes land a prefix of the chunk, the kill-mid-flush case),
+commitlog.fsync.
 """
 
 from __future__ import annotations
@@ -19,6 +30,8 @@ import os
 import struct
 import zlib
 from dataclasses import dataclass
+
+from m3_tpu.utils import faults
 
 _MAGIC = 0xC0881706
 
@@ -32,6 +45,21 @@ class CommitLogEntry:
     unit: int
 
 
+@dataclass
+class SalvageReport:
+    """What a salvage replay recovered and what it gave up on."""
+    entries: int = 0            # entries successfully recovered
+    chunks: int = 0             # complete chunks replayed
+    truncated_at: int | None = None  # byte offset of the first bad chunk
+    dropped_bytes: int = 0      # bytes abandoned from truncated_at on
+    torn_tail: bool = False     # ended at a torn trailing chunk (benign)
+    reason: str = ""
+
+    @property
+    def clean(self) -> bool:
+        return self.truncated_at is None
+
+
 class CommitLogWriter:
     def __init__(self, path: str, flush_every_bytes: int = 1 << 20):
         os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -40,9 +68,21 @@ class CommitLogWriter:
         self._series: dict[bytes, int] = {}
         self._flush_every = flush_every_bytes
         self.path = path
+        # a failed flush POISONS the writer: the file may hold a torn
+        # interior chunk, and salvage replay truncates everything after
+        # the first bad chunk — so acking any later write on this file
+        # would be a silent-loss lie. Callers that survive the error (a
+        # request handler swallowing it) must rotate to a fresh log.
+        self._failed: Exception | None = None
 
     def write(self, series_id: bytes, encoded_tags: bytes, time_ns: int,
               value_bits: int, unit: int) -> None:
+        if self._failed is not None:
+            raise OSError(
+                f"commitlog writer poisoned by earlier flush failure "
+                f"({self.path})"
+            ) from self._failed
+        faults.check("commitlog.write")
         sidx = self._series.get(series_id)
         if sidx is None:
             sidx = len(self._series)
@@ -55,65 +95,126 @@ class CommitLogWriter:
             self.flush()
 
     def flush(self, fsync: bool = False) -> None:
-        if not self._buf:
-            return
-        payload = bytes(self._buf)
-        self._buf.clear()
-        header = struct.pack(">III", _MAGIC, len(payload), zlib.adler32(payload))
-        self._f.write(header + payload)
-        self._f.flush()
-        if fsync:
-            os.fsync(self._f.fileno())
+        if self._failed is not None:
+            raise OSError(
+                f"commitlog writer poisoned by earlier flush failure "
+                f"({self.path})"
+            ) from self._failed
+        try:
+            if not self._buf:
+                if fsync:
+                    faults.check("commitlog.fsync")
+                    os.fsync(self._f.fileno())
+                return
+            payload = bytes(self._buf)
+            self._buf.clear()
+            header = struct.pack(">III", _MAGIC, len(payload),
+                                 zlib.adler32(payload))
+            # a crash here may land any byte prefix of the chunk — the
+            # torn tail that replay/replay_salvage skip
+            faults.torn_write(self._f, header + payload, "commitlog.flush")
+            self._f.flush()
+            if fsync:
+                faults.check("commitlog.fsync")
+                os.fsync(self._f.fileno())
+        except BaseException as e:
+            self._failed = e
+            raise
 
     def close(self) -> None:
-        self.flush(fsync=True)
+        if self._failed is None:
+            self.flush(fsync=True)
         self._f.close()
 
 
-def replay(path: str) -> list[CommitLogEntry]:
-    """Replay a commit log; torn trailing chunks are skipped (the tail of a
-    crashed process), corrupt interior chunks raise."""
+def _decode_payload(payload: bytes, series: dict[int, tuple[bytes, bytes]],
+                    entries: list[CommitLogEntry]) -> None:
+    """Decode one chunk payload into `entries`, updating the series
+    registry. Raises ValueError/struct.error on a malformed entry."""
+    p = 0
+    while p < len(payload):
+        kind, sidx = struct.unpack_from(">BI", payload, p)
+        p += 5
+        if kind == 0:
+            (idlen,) = struct.unpack_from(">I", payload, p)
+            p += 4
+            sid = payload[p : p + idlen]
+            p += idlen
+            (tlen,) = struct.unpack_from(">I", payload, p)
+            p += 4
+            tags = payload[p : p + tlen]
+            p += tlen
+            series[sidx] = (sid, tags)
+        elif kind == 1:
+            t_ns, vbits, unit = struct.unpack_from(">qQB", payload, p)
+            p += 17
+            sid, tags = series[sidx]
+            entries.append(CommitLogEntry(sid, tags, t_ns, vbits, unit))
+        else:
+            raise ValueError(f"unknown commitlog entry kind {kind}")
+
+
+def _replay(path: str, salvage: bool) -> tuple[list[CommitLogEntry], SalvageReport]:
     entries: list[CommitLogEntry] = []
+    report = SalvageReport()
     if not os.path.exists(path):
-        return entries
+        return entries, report
     with open(path, "rb") as f:
         raw = f.read()
     series: dict[int, tuple[bytes, bytes]] = {}
     off = 0
+
+    def bad(reason: str) -> tuple[list[CommitLogEntry], SalvageReport]:
+        if not salvage:
+            raise ValueError(f"{reason} at {off}")
+        report.truncated_at = off
+        report.dropped_bytes = len(raw) - off
+        report.reason = reason
+        report.entries = len(entries)
+        return entries, report
+
     while off + 12 <= len(raw):
         magic, plen, digest = struct.unpack_from(">III", raw, off)
         if magic != _MAGIC:
-            raise ValueError(f"bad commitlog chunk magic at {off}")
+            return bad("bad commitlog chunk magic")
         if off + 12 + plen > len(raw):
+            report.torn_tail = True
             break  # torn tail chunk from a crash: ignore
         payload = raw[off + 12 : off + 12 + plen]
         if zlib.adler32(payload) != digest:
             if off + 12 + plen == len(raw):
+                report.torn_tail = True
                 break  # torn tail
-            raise ValueError(f"corrupt commitlog chunk at {off}")
+            return bad("corrupt commitlog chunk")
+        mark = len(entries)
+        try:
+            _decode_payload(payload, series, entries)
+        except (ValueError, KeyError, struct.error) as e:
+            # digest-valid but undecodable (format bug / sidx from a
+            # truncated registry): salvage keeps nothing of this chunk
+            del entries[mark:]
+            return bad(f"undecodable commitlog chunk ({e})")
+        report.chunks += 1
         off += 12 + plen
-        p = 0
-        while p < len(payload):
-            kind, sidx = struct.unpack_from(">BI", payload, p)
-            p += 5
-            if kind == 0:
-                (idlen,) = struct.unpack_from(">I", payload, p)
-                p += 4
-                sid = payload[p : p + idlen]
-                p += idlen
-                (tlen,) = struct.unpack_from(">I", payload, p)
-                p += 4
-                tags = payload[p : p + tlen]
-                p += tlen
-                series[sidx] = (sid, tags)
-            elif kind == 1:
-                t_ns, vbits, unit = struct.unpack_from(">qQB", payload, p)
-                p += 17
-                sid, tags = series[sidx]
-                entries.append(CommitLogEntry(sid, tags, t_ns, vbits, unit))
-            else:
-                raise ValueError(f"unknown commitlog entry kind {kind}")
+    if off < len(raw) and not report.torn_tail:
+        # trailing sub-header garbage (< 12 bytes): torn tail by definition
+        report.torn_tail = True
+    report.entries = len(entries)
+    return entries, report
+
+
+def replay(path: str) -> list[CommitLogEntry]:
+    """Strict replay: torn trailing chunks are skipped (the tail of a
+    crashed process), corrupt interior chunks raise."""
+    entries, _report = _replay(path, salvage=False)
     return entries
+
+
+def replay_salvage(path: str) -> tuple[list[CommitLogEntry], SalvageReport]:
+    """Salvage replay: recover every entry up to the first bad chunk and
+    report the truncation instead of raising — bootstrap must come up on
+    a damaged log and say what it lost."""
+    return _replay(path, salvage=True)
 
 
 def log_files(directory: str) -> list[str]:

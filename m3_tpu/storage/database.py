@@ -9,6 +9,7 @@ tick calls driven by the host control plane.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from dataclasses import dataclass
@@ -19,6 +20,8 @@ from m3_tpu.storage import commitlog
 from m3_tpu.storage.namespace import Namespace
 from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions
 from m3_tpu.storage.sharding import ShardSet
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -141,7 +144,12 @@ class Database:
         datapoints also live in a flushed volume are resolved by the normal
         last-write-wins merge (and re-merged into a higher volume on the
         next flush), so replay is safe to repeat; replayed files are retired
-        and deleted once every window they cover has flushed."""
+        and deleted once every window they cover has flushed.
+
+        Replay runs in SALVAGE mode: a corrupt interior chunk truncates
+        that log (dropping everything after it, with a warning naming the
+        offset and byte count) instead of raising — a damaged WAL must
+        degrade bootstrap, never brick it."""
         from m3_tpu.utils.ident import decode_tags
 
         retired = self._retired_logs.setdefault(name, [])
@@ -150,8 +158,16 @@ class Database:
             r = ns.opts.retention
             cutoff = r.block_start(now_ns - r.retention_ns)
         for path in commitlog.log_files(self.commitlog_dir(name)):
+            entries, report = commitlog.replay_salvage(path)
+            if not report.clean:
+                log.warning(
+                    "commitlog salvage: %s truncated at byte %d (%s): "
+                    "replayed %d entries, dropped %d bytes",
+                    path, report.truncated_at, report.reason,
+                    report.entries, report.dropped_bytes,
+                )
             windows: set[int] = set()
-            for e in commitlog.replay(path):
+            for e in entries:
                 if cutoff is not None and e.time_ns < cutoff:
                     continue  # past retention: don't resurrect
                 try:

@@ -29,6 +29,7 @@ import zlib
 from bisect import bisect_left
 from dataclasses import dataclass
 
+from m3_tpu.utils import faults
 from m3_tpu.utils.hash import murmur3_32
 
 SUFFIXES = ("info", "data", "index", "summaries", "bloom", "offsets",
@@ -84,7 +85,16 @@ class IndexEntry:
 
 
 class FilesetWriter:
-    """Writes one complete fileset; checkpoint file lands last."""
+    """Writes one complete fileset; checkpoint file lands last.
+
+    Crash safety: every component file is written ATOMICALLY — temp file,
+    fsync, `os.replace` — so a kill at any byte offset (see
+    utils/faults.py torn writes) leaves either no file or a complete one,
+    never a short/garbage file under the final name; the checkpoint (also
+    atomic, written after everything else is fsynced) is what marks the
+    volume complete, and FilesetReader verifies the digest chain on open.
+    Fault points: fileset.persist (per file), fileset.write (torn bytes),
+    fileset.checkpoint."""
 
     def __init__(self, root: str, namespace: str, shard: int, block_start: int,
                  block_size_ns: int, volume: int = 0):
@@ -107,6 +117,19 @@ class FilesetWriter:
         return fileset_path(
             self.root, self.namespace, self.shard, self.block_start, self.volume, suffix
         )
+
+    def _write_atomic(self, suffix: str, payload: bytes) -> None:
+        """temp + fsync + rename: the final name only ever points at a
+        complete, durable file (a crash leaves at most a .tmp, which
+        list_filesets/bootstrap never look at)."""
+        faults.check("fileset.persist", suffix=suffix)
+        path = self._path(suffix)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            faults.torn_write(f, payload, "fileset.write")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     def close(self) -> dict:
         os.makedirs(os.path.dirname(self._path("info")), exist_ok=True)
@@ -146,22 +169,15 @@ class FilesetWriter:
         }
         digests = {}
         for suffix, payload in files.items():
-            with open(self._path(suffix), "wb") as f:
-                f.write(payload)
-                f.flush()
-                os.fsync(f.fileno())
+            self._write_atomic(suffix, payload)
             digests[suffix] = zlib.adler32(payload)
         digest_payload = json.dumps(digests).encode()
-        with open(self._path("digest"), "wb") as f:
-            f.write(digest_payload)
-            f.flush()
-            os.fsync(f.fileno())
+        self._write_atomic("digest", digest_payload)
         # checkpoint last (after everything else is fsynced): its presence
         # marks the fileset complete even across power loss
-        with open(self._path("checkpoint"), "wb") as f:
-            f.write(struct.pack(">I", zlib.adler32(digest_payload)))
-            f.flush()
-            os.fsync(f.fileno())
+        faults.check("fileset.checkpoint")
+        self._write_atomic("checkpoint",
+                           struct.pack(">I", zlib.adler32(digest_payload)))
         # fsync the directory so the new names themselves are durable
         dfd = os.open(os.path.dirname(self._path("info")), os.O_RDONLY)
         try:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import base64
 import json
+import threading
 import urllib.request
 import zlib
 from dataclasses import dataclass
@@ -21,8 +22,10 @@ from typing import Protocol
 
 import numpy as np
 
+from m3_tpu.client.breaker import BreakerConfig, HostPolicy
 from m3_tpu.storage.buffer import merge_dedup
 from m3_tpu.storage.fileset import FilesetWriter
+from m3_tpu.utils import faults
 
 
 class PeerSource(Protocol):
@@ -70,16 +73,77 @@ class InProcessPeer:
         return reader.read(series_id) or b"", reader.tags_of(series_id) or b""
 
 
-class HTTPPeer:
-    """Peer over the dbnode NodeAPI (services/dbnode.py)."""
+class PeerClientError(Exception):
+    """A peer answered with a deterministic 4xx (e.g. a namespace it
+    doesn't have): the REQUEST is wrong, the host is healthy. Never
+    retried and never counted against the host's circuit — one bad probe
+    must not open a shared breaker and stall bootstrap of everything else
+    that peer serves."""
 
-    def __init__(self, base_url: str, timeout_s: float = 10.0):
+
+# per-host breaker+retry policies shared by every HTTPPeer talking to the
+# same base URL: bootstrap and repair often build several peer objects per
+# replica, and they must share one circuit so a dead peer is shed
+# process-wide instead of serializing a fresh timeout per object
+PEER_POLICY_CONFIG = BreakerConfig(
+    failure_threshold=3,
+    open_timeout_s=2.0,
+    retry_attempts=3,
+    retry_backoff_s=0.05,
+    retry_jitter_frac=0.25,  # de-synchronize replicas re-probing a peer
+)
+_host_policies: dict[str, HostPolicy] = {}
+_host_policies_lock = threading.Lock()
+
+
+def peer_policy(base_url: str, config: BreakerConfig | None = None) -> HostPolicy:
+    with _host_policies_lock:
+        pol = _host_policies.get(base_url)
+        if pol is None:
+            pol = HostPolicy(base_url, config or PEER_POLICY_CONFIG,
+                             no_count=(PeerClientError,))
+            _host_policies[base_url] = pol
+        return pol
+
+
+def reset_peer_policies() -> None:
+    """Drop all shared peer breaker state (tests)."""
+    with _host_policies_lock:
+        _host_policies.clear()
+
+
+class HTTPPeer:
+    """Peer over the dbnode NodeAPI (services/dbnode.py).
+
+    Every request runs through the host's shared CircuitBreaker + bounded
+    jittered retry (client/breaker.py): transient errors get a couple of
+    backed-off retries, and a dead peer opens the circuit so
+    bootstrap/repair shed it locally (BreakerOpen, caught by the callers'
+    per-peer error handling) instead of serializing 10s urlopen timeouts
+    per block."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0,
+                 policy: HostPolicy | None = None):
         self.base = base_url.rstrip("/")
         self.timeout = timeout_s
+        self.policy = policy if policy is not None else peer_policy(self.base)
 
     def _get(self, path: str):
-        with urllib.request.urlopen(self.base + path, timeout=self.timeout) as r:
-            return json.loads(r.read())
+        return self.policy.call(self._fetch, path)
+
+    def _fetch(self, path: str):
+        import urllib.error
+
+        faults.check("peer.http", url=self.base + path)
+        try:
+            with urllib.request.urlopen(self.base + path,
+                                        timeout=self.timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            if 400 <= e.code < 500:
+                raise PeerClientError(
+                    f"{e.code} from {self.base}{path}") from e
+            raise
 
     def block_starts(self, namespace, shard):
         from urllib.parse import quote

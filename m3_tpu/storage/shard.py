@@ -15,6 +15,7 @@ import numpy as np
 from m3_tpu.storage.buffer import ShardBuffer, merge_dedup
 from m3_tpu.storage.fileset import FilesetReader, FilesetWriter, list_filesets
 from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions
+from m3_tpu.utils import faults
 
 
 class Shard:
@@ -214,6 +215,8 @@ class Shard:
         persist/fs/snapshot_metadata_{read,write}.go)."""
         from m3_tpu.encoding.m3tsz import hostpath
 
+        faults.check("shard.snapshot", shard=self.shard_id,
+                     block_start=block_start)
         sealed = self.buffer.seal(block_start, drop=False)
         if sealed is None:
             return False
@@ -319,6 +322,11 @@ class Shard:
     def _flush_locked(self, block_start: int) -> bool:
         from m3_tpu.encoding.m3tsz import hostpath
 
+        # the kill-mid-flush seam: a crash anywhere before the checkpoint
+        # lands must leave the buffer window intact (seal below never
+        # drops) and the old volume readable
+        faults.check("shard.flush", shard=self.shard_id,
+                     block_start=block_start)
         self._drain_retired()
 
         # Seal WITHOUT dropping: the buffer window is the only copy until the
@@ -435,13 +443,14 @@ class Shard:
         import glob
         import os
 
-        pattern = os.path.join(
-            self.fs_root, self.namespace, str(self.shard_id),
-            f"fileset-{block_start}-*.db",
-        )
+        d = os.path.join(self.fs_root, self.namespace, str(self.shard_id))
+        # *.db.tmp: leftovers of a flush killed mid-write (atomic writers
+        # never expose them under final names; reclaim them here)
+        pattern = os.path.join(d, f"fileset-{block_start}-*.db")
+        paths = glob.glob(pattern) + glob.glob(pattern + ".tmp")
         # checkpoint first so a crash mid-delete leaves an "incomplete"
         # (ignored) volume rather than a corrupt-looking one
-        paths = sorted(glob.glob(pattern), key=lambda p: "checkpoint" not in p)
+        paths = sorted(paths, key=lambda p: "checkpoint" not in p)
         for p in paths:
             try:
                 os.remove(p)

@@ -15,8 +15,13 @@ hostops rate kernel), where the GIL protects nothing:
 3. drives the threaded entry points concurrently from multiple Python
    threads (encode/decode batches at nthreads>1, simultaneous rate_csr
    and agg_groups calls over shared input buffers),
-4. exits 0 when TSan stays silent, 66 (TSAN_OPTIONS exitcode) on any
-   reported race.
+4. stresses the fault-injection registry's lock discipline
+   (utils/faults.py): many threads hitting shared fault points while the
+   plan is concurrently reconfigured — counters, per-point RNGs, and the
+   fire schedule must stay consistent and deadlock-free (the registry sits
+   on every durability hot path, so a lock bug there corrupts chaos runs),
+5. exits 0 when TSan stays silent and the workloads hold their
+   invariants, 66 (TSAN_OPTIONS exitcode) on any reported race.
 """
 
 from __future__ import annotations
@@ -135,6 +140,46 @@ def main() -> int:
         wk.join()
     if errs:
         print(f"workload errors: {errs}", file=sys.stderr)
+        return 1
+
+    # 3) fault-registry lock discipline: concurrent check() on shared
+    # points while another thread reconfigures the active plan
+    from m3_tpu.utils import faults
+
+    fault_errs: list = []
+
+    def fault_worker(k):
+        try:
+            with open(os.devnull, "wb") as devnull:
+                for i in range(2_000):
+                    try:
+                        faults.check("race.shared", worker=k, i=i)
+                        if i % 499 == 0:
+                            faults.torn_write(devnull, b"x" * 64, "race.torn")
+                    except (faults.InjectedError, faults.InjectedTimeout,
+                            faults.SimulatedCrash):
+                        pass  # injected on purpose; anything else is a bug
+        except Exception as ex:  # noqa: BLE001
+            fault_errs.append((k, ex))
+
+    def toggler():
+        try:
+            for i in range(200):
+                faults.configure("race.shared=error:p0.01;race.torn=torn:p0.5",
+                                 seed=i)
+            faults.disable()
+        except Exception as ex:  # noqa: BLE001
+            fault_errs.append(("toggler", ex))
+
+    threads = [threading.Thread(target=fault_worker, args=(k,))
+               for k in range(6)] + [threading.Thread(target=toggler)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    faults.disable()
+    if fault_errs:
+        print(f"fault-registry errors: {fault_errs}", file=sys.stderr)
         return 1
     return 0
 

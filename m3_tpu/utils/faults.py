@@ -1,0 +1,302 @@
+"""Deterministic fault injection for the write/read durability seams.
+
+Role parity with the reference's failure-testing discipline (SURVEY §5:
+dtest failure schedules, commitlog corruption fixtures) generalized into
+one registry: production code declares named fault points at every
+durability/network seam —
+
+    faults.check("commitlog.fsync")          # may raise per the plan
+    faults.torn_write(f, payload, "commitlog.flush")  # may tear the write
+
+— and chaos tests (or an operator via environment) activate a *plan*:
+
+    M3_TPU_FAULTS="commitlog.fsync=error:p0.5;peer.http=timeout" \
+    M3_TPU_FAULTS_SEED=7 python ...
+
+Determinism contract: every probabilistic decision draws from a per-point
+RNG seeded by (seed, point), and the plan records the full fire schedule,
+so the same spec + seed replays byte-identical fault schedules (the
+checkpoint/recovery replay discipline TPU preemption forces everywhere).
+The clock and sleep are injectable: `sleep` serves delay faults and
+`clock` stamps each fire into `fire_times` — under a virtual clock the
+whole fault timeline is reproducible, under the real one it correlates
+fires with operator logs. (`schedule` itself carries no timestamps, so
+schedule equality across runs holds under any clock.)
+
+Overhead when disabled: `check` is one module-global load + None test —
+no dict lookup, no lock — so the hooks stay in hot paths (per-datapoint
+commitlog writes) for free.
+
+Spec grammar (';'-separated rules, later rules for the same point are
+tried after earlier ones):
+
+    point=action[:p<prob>][:n<hit>][:x<max>][:d<seconds>]
+
+    action  error    raise InjectedError (an OSError — real I/O failure
+                     handlers treat it identically)
+            timeout  raise InjectedTimeout (a TimeoutError)
+            crash    raise SimulatedCrash (NOT an OSError: seams that
+                     swallow I/O errors still die, like a real SIGKILL)
+            torn     at torn_write points: write a deterministic prefix
+                     of the payload, then SimulatedCrash; at plain check
+                     points it degrades to crash
+            delay    sleep d<seconds> (injectable), then continue
+    p<f>    fire with probability f per hit (default 1.0)
+    n<k>    fire only on the k-th hit of the point (1-based)
+    x<k>    fire at most k times
+    d<f>    delay seconds (delay action; default 0.01)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class InjectedError(OSError):
+    """Injected generic I/O failure."""
+
+
+class InjectedTimeout(TimeoutError):
+    """Injected timeout."""
+
+
+class SimulatedCrash(Exception):
+    """Injected process death at a fault point. Deliberately NOT an
+    OSError: seams that tolerate I/O errors must still propagate this,
+    the way no handler survives a SIGKILL."""
+
+
+@dataclass
+class FaultRule:
+    point: str
+    action: str                  # error | timeout | crash | torn | delay
+    probability: float = 1.0
+    fire_on: int | None = None   # n<k>: fire only on this hit (1-based)
+    max_fires: int | None = None # x<k>: total fire budget
+    delay_s: float = 0.01        # d<f>: for the delay action
+    fires: int = field(default=0, compare=False)
+
+
+_ACTIONS = ("error", "timeout", "crash", "torn", "delay")
+
+
+def parse_spec(spec: str) -> list[FaultRule]:
+    rules: list[FaultRule] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        point, sep, rhs = part.partition("=")
+        if not sep or not point.strip():
+            raise ValueError(f"bad fault rule (want point=action): {part!r}")
+        fields = rhs.split(":")
+        action = fields[0].strip()
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown fault action {action!r} in {part!r}")
+        rule = FaultRule(point.strip(), action)
+        for mod in fields[1:]:
+            mod = mod.strip()
+            if not mod:
+                continue
+            kind, val = mod[0], mod[1:]
+            if kind == "p":
+                rule.probability = float(val)
+            elif kind == "n":
+                rule.fire_on = int(val)
+            elif kind == "x":
+                rule.max_fires = int(val)
+            elif kind == "d":
+                rule.delay_s = float(val)
+            else:
+                raise ValueError(f"unknown fault modifier {mod!r} in {part!r}")
+        rules.append(rule)
+    return rules
+
+
+class FaultPlan:
+    """A parsed, seeded fault schedule. All counter/RNG state is guarded
+    by one lock (see tools/race_check.py's registry stress workload), and
+    every decision is appended to `schedule` so tests can assert that a
+    seed replays the exact same run."""
+
+    def __init__(self, rules: list[FaultRule], seed: int = 0,
+                 clock=time.monotonic, sleep=time.sleep):
+        self.seed = seed
+        self.clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._rules: dict[str, list[FaultRule]] = {}
+        for r in rules:
+            self._rules.setdefault(r.point, []).append(r)
+        self._hits: dict[str, int] = {}
+        self._rngs: dict[str, random.Random] = {}
+        # (point, hit_index, action) per fire, in decision order
+        self.schedule: list[tuple[str, int, str]] = []
+        # clock() at each fire, aligned with schedule: virtual clocks give
+        # reproducible timelines, the real one correlates with logs
+        self.fire_times: list[float] = []
+
+    def _rng(self, point: str) -> random.Random:
+        rng = self._rngs.get(point)
+        if rng is None:
+            rng = self._rngs[point] = random.Random(f"{self.seed}:{point}")
+        return rng
+
+    def decide(self, point: str) -> FaultRule | None:
+        """Count a hit at `point`; return the rule that fires, if any."""
+        rules = self._rules.get(point)
+        if rules is None:
+            return None
+        with self._lock:
+            hit = self._hits.get(point, 0) + 1
+            self._hits[point] = hit
+            for rule in rules:
+                if rule.max_fires is not None and rule.fires >= rule.max_fires:
+                    continue
+                if rule.fire_on is not None and hit != rule.fire_on:
+                    continue
+                if rule.probability < 1.0 and \
+                        self._rng(point).random() >= rule.probability:
+                    continue
+                rule.fires += 1
+                self.schedule.append((point, hit, rule.action))
+                self.fire_times.append(self.clock())
+                return rule
+            return None
+
+    def raise_for(self, rule: FaultRule, point: str, ctx: dict) -> None:
+        where = f"injected fault at {point}" + (f" {ctx}" if ctx else "")
+        if rule.action == "error":
+            raise InjectedError(where)
+        if rule.action == "timeout":
+            raise InjectedTimeout(where)
+        if rule.action in ("crash", "torn"):
+            raise SimulatedCrash(where)
+        if rule.action == "delay":
+            self._sleep(rule.delay_s)
+            return
+        raise AssertionError(f"unhandled fault action {rule.action}")
+
+    def check(self, point: str, ctx: dict) -> None:
+        rule = self.decide(point)
+        if rule is not None:
+            self.raise_for(rule, point, ctx)
+
+    def cut(self, point: str, length: int) -> int:
+        """Deterministic tear offset in [1, length) for a torn write."""
+        if length <= 1:
+            return 0
+        with self._lock:
+            return 1 + int(self._rng(point + "#cut").random() * (length - 1))
+
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._hits.get(point, 0)
+
+
+# the one module-level flag: None = injection disabled, every hook is a
+# single load+is-None test
+_ACTIVE: FaultPlan | None = None
+
+
+def enabled() -> bool:
+    return _ACTIVE is not None
+
+
+def plan() -> FaultPlan | None:
+    return _ACTIVE
+
+
+def configure(spec: str | None = None, seed: int | None = None,
+              clock=time.monotonic, sleep=time.sleep) -> FaultPlan:
+    """Activate a fault plan from `spec` (default: $M3_TPU_FAULTS) with
+    `seed` (default: $M3_TPU_FAULTS_SEED, else 0)."""
+    global _ACTIVE
+    if spec is None:
+        spec = os.environ.get("M3_TPU_FAULTS", "")
+    if seed is None:
+        seed = int(os.environ.get("M3_TPU_FAULTS_SEED", "0"))
+    p = FaultPlan(parse_spec(spec), seed=seed, clock=clock, sleep=sleep)
+    _ACTIVE = p
+    return p
+
+
+def disable() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+@contextlib.contextmanager
+def active(spec: str, seed: int = 0, clock=time.monotonic, sleep=time.sleep):
+    """Scoped activation for tests: always disables on exit."""
+    p = configure(spec, seed=seed, clock=clock, sleep=sleep)
+    try:
+        yield p
+    finally:
+        disable()
+
+
+def check(point: str, **ctx) -> None:
+    """Fault point: no-op unless a plan is active and a rule fires."""
+    p = _ACTIVE
+    if p is None:
+        return
+    p.check(point, ctx)
+
+
+def torn_write(f, data: bytes, point: str) -> None:
+    """Write `data` to file object `f`, or — when a rule fires at `point`
+    — inject: `torn` writes a deterministic prefix then raises
+    SimulatedCrash (the kill-at-an-arbitrary-byte-offset case every
+    durability format must survive); other actions raise before any byte
+    lands."""
+    p = _ACTIVE
+    if p is None:
+        f.write(data)
+        return
+    rule = p.decide(point)
+    if rule is None:
+        f.write(data)
+        return
+    if rule.action == "torn":
+        k = p.cut(point, len(data))
+        if k:
+            f.write(data[:k])
+            f.flush()
+        raise SimulatedCrash(f"torn write at {point} ({k}/{len(data)} bytes)")
+    p.raise_for(rule, point, {})
+
+
+class _FaultyIO:
+    """File-object proxy whose writes go through torn_write."""
+
+    def __init__(self, f, point: str):
+        self._f = f
+        self._point = point
+
+    def write(self, data: bytes):
+        torn_write(self._f, data, self._point)
+        return len(data)
+
+    def __getattr__(self, item):
+        return getattr(self._f, item)
+
+
+def wrap_io(f, point: str):
+    """Wrap a file object so its writes hit `point` (identity when
+    injection is disabled — zero proxy overhead in production)."""
+    if _ACTIVE is None:
+        return f
+    return _FaultyIO(f, point)
+
+
+# env-driven activation at import: a process launched with M3_TPU_FAULTS
+# set runs its whole life under the plan (chaos harnesses spawn real
+# dbnode/kvd/aggregator processes this way)
+if os.environ.get("M3_TPU_FAULTS"):
+    configure()

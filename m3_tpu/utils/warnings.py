@@ -1,0 +1,29 @@
+"""Structured partial-result warnings for degraded cluster reads.
+
+The reference coordinator attaches warning headers when a fanout returns
+incomplete data (warn-on-partial-results mode) instead of failing the
+whole query. This module is that contract for every read facade here:
+when consistency/coverage is still met but some replica, host, or zone
+failed, the read SUCCEEDS and carries one `ReadWarning` per degraded leg,
+so callers (HTTP APIs, dashboards, tests) can distinguish "complete" from
+"served degraded" without parsing log lines.
+
+Producers: client/session.Session.fetch/fetch_many (scope "session",
+name = host) and query/fanout.FanoutNamespace reads (scope "fanout",
+name = zone). Consumers read them from the `warnings` out-param or the
+facade's `last_warnings` attribute (reset per call).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ReadWarning:
+    scope: str   # which facade degraded: "session" | "fanout"
+    name: str    # the failed leg: host id or zone name
+    reason: str  # stringified cause, for operators
+
+    def __str__(self) -> str:
+        return f"{self.scope}:{self.name}: {self.reason}"

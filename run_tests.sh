@@ -7,11 +7,22 @@
 # Lanes:
 #   run_tests.sh fast   — deselects the `slow`-marked files (multi-process
 #                         clusters, XLA parity sweeps); target < 2 min
+#   run_tests.sh chaos  — opt-in seeded fault-injection stage: the
+#                         crash-recovery loop runs M3_TPU_CHAOS_ITERS
+#                         (default 200) kill-mid-flush iterations per
+#                         schedule; never part of tier-1
 #   run_tests.sh [...]  — full suite (extra args pass through to pytest)
 ARGS=("$@")
 if [ "${1:-}" = "fast" ]; then
   shift
   ARGS=(-m "not slow" "$@")
+elif [ "${1:-}" = "chaos" ]; then
+  shift
+  exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    M3_TPU_CHAOS_ITERS="${M3_TPU_CHAOS_ITERS:-200}" \
+    python -m pytest tests/test_crash_recovery.py tests/test_fault_injection.py \
+    -q -m chaos "$@"
 fi
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \

@@ -46,11 +46,20 @@ SLOW_FILES = {
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: excluded from the fast lane")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded long-loop fault-injection runs; excluded from tier-1 "
+        "(implies slow), opt-in via `run_tests.sh chaos`",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
         if os.path.basename(str(item.fspath)) in SLOW_FILES:
+            item.add_marker(pytest.mark.slow)
+        if item.get_closest_marker("chaos") is not None:
+            # chaos loops ride the slow marker too, so every existing
+            # `-m 'not slow'` lane (tier-1 included) skips them
             item.add_marker(pytest.mark.slow)
 
 

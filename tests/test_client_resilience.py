@@ -77,6 +77,73 @@ class TestCircuitBreaker:
         assert b.state == "closed"  # streak broke; not 2 consecutive
 
 
+class TestHalfOpenConcurrency:
+    """ISSUE 2 satellite: half-open admission under CONCURRENT probes, in
+    virtual time. The probe budget is the whole point of half-open — a
+    stampede of callers observing the cooldown expiry must not all hit
+    the recovering host at once."""
+
+    def _race_allow(self, breaker, n_threads):
+        """n_threads call allow() as simultaneously as a barrier can make
+        them; returns the admission results."""
+        import threading
+
+        barrier = threading.Barrier(n_threads)
+        results = [None] * n_threads
+
+        def probe(k):
+            barrier.wait()
+            results[k] = breaker.allow()
+
+        threads = [threading.Thread(target=probe, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return results
+
+    def test_exactly_probe_budget_admitted(self):
+        clock = FakeClock()
+        b = CircuitBreaker(BreakerConfig(failure_threshold=1,
+                                         open_timeout_s=5.0,
+                                         half_open_probes=3), clock)
+        b.allow(); b.on_failure()
+        assert b.state == "open"
+        clock.advance(5.1)
+        results = self._race_allow(b, 16)
+        assert sum(results) == 3  # exactly half_open_probes admitted
+        assert b.rejected == 13
+
+    def test_concurrent_probe_failure_reopens_and_sheds(self):
+        clock = FakeClock()
+        b = CircuitBreaker(BreakerConfig(failure_threshold=1,
+                                         open_timeout_s=5.0,
+                                         half_open_probes=2), clock)
+        b.allow(); b.on_failure()
+        clock.advance(5.1)
+        assert sum(self._race_allow(b, 8)) == 2
+        b.on_failure()  # one admitted probe fails
+        assert b.state == "open"  # back to cooldown immediately
+        # the other in-flight probe's result no longer matters for
+        # admission: everything is shed until the new cooldown expires
+        assert not any(self._race_allow(b, 8))
+        clock.advance(5.1)
+        assert sum(self._race_allow(b, 8)) == 2  # fresh probe budget
+
+    def test_concurrent_probe_success_closes_for_everyone(self):
+        clock = FakeClock()
+        b = CircuitBreaker(BreakerConfig(failure_threshold=1,
+                                         open_timeout_s=5.0,
+                                         half_open_probes=1), clock)
+        b.allow(); b.on_failure()
+        clock.advance(5.1)
+        assert sum(self._race_allow(b, 8)) == 1
+        b.on_success()
+        assert b.state == "closed"
+        assert all(self._race_allow(b, 8))  # closed admits everyone
+
+
 class TestHostPolicy:
     def test_retry_recovers_transient_failure(self):
         calls = []
@@ -101,6 +168,35 @@ class TestHostPolicy:
 
         with pytest.raises(TimeoutError):
             pol.call(always)
+
+    def test_jittered_backoff_bounded_and_seeded(self):
+        import random
+
+        sleeps = []
+        pol = HostPolicy(
+            "h",
+            BreakerConfig(retry_attempts=4, retry_backoff_s=0.1,
+                          retry_jitter_frac=0.25, failure_threshold=100),
+            sleep=sleeps.append, rng=random.Random(42))
+
+        def always(): raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            pol.call(always)
+        assert len(sleeps) == 3  # attempts - 1 backoffs
+        for i, s in enumerate(sleeps):
+            base = 0.1 * (2 ** i)
+            assert base <= s < base * 1.25  # jitter widens, never shrinks
+        # seeded rng: the jitter sequence replays
+        sleeps2 = []
+        pol2 = HostPolicy(
+            "h",
+            BreakerConfig(retry_attempts=4, retry_backoff_s=0.1,
+                          retry_jitter_frac=0.25, failure_threshold=100),
+            sleep=sleeps2.append, rng=random.Random(42))
+        with pytest.raises(ConnectionError):
+            pol2.call(always)
+        assert sleeps == sleeps2
 
     def test_open_breaker_short_circuits_without_calling(self):
         clock = FakeClock()

@@ -83,3 +83,84 @@ class TestPubSub:
             assert dropped == [b"x0", b"x1", b"x2"]
         finally:
             producer.close()
+
+
+class TestRequeueDedupe:
+    """Regression (ISSUE 2 satellite): a message must never be queued
+    twice — the writer's send-error requeue and the stale scan used to be
+    able to both enqueue the same id on a flappy link, double-sending it."""
+
+    def _idle_producer(self):
+        # port 1 never accepts: the writer thread loops in _connect and
+        # leaves the queue alone, so requeue paths can be driven directly
+        return Producer(("127.0.0.1", 1), retry_after_s=0.2)
+
+    def test_error_requeue_after_stale_scan_does_not_duplicate(self):
+        producer = self._idle_producer()
+        try:
+            msg_id = producer.publish(0, b"flappy")
+            with producer._cv:
+                # the writer popped it and is mid-send...
+                producer._queue.remove(msg_id)
+                producer._queued.discard(msg_id)
+                p = producer._pending[msg_id]
+                p.sent_at = time.monotonic() - 10  # long overdue
+                # ...the stale scan re-appends it...
+                producer._last_requeue_scan = 0.0
+                producer._requeue_stale_locked()
+                assert producer._queue.count(msg_id) == 1
+            # ...and THEN the in-flight send fails: must not enqueue again
+            producer._requeue_after_error(msg_id)
+            with producer._lock:
+                assert producer._queue.count(msg_id) == 1
+                assert producer._queued == set(producer._queue)
+        finally:
+            producer.close()
+
+    def test_stale_scan_skips_already_queued_and_acked(self):
+        producer = self._idle_producer()
+        try:
+            a = producer.publish(0, b"a")  # still queued
+            b = producer.publish(1, b"b")
+            with producer._cv:
+                # b was sent and acked mid-flight
+                producer._queue.remove(b)
+                producer._queued.discard(b)
+                del producer._pending[b]
+                producer._pending[a].sent_at = time.monotonic() - 10
+                producer._last_requeue_scan = 0.0
+                producer._requeue_stale_locked()
+                assert producer._queue.count(a) == 1  # queued: not doubled
+                assert b not in producer._queue      # acked: not revived
+            producer._requeue_after_error(b)  # late failure of acked msg
+            with producer._lock:
+                assert b not in producer._queue
+        finally:
+            producer.close()
+
+    def test_no_double_send_under_injected_socket_faults(self):
+        """End-to-end: a flappy link (injected send faults) redelivers but
+        the queue invariant (no duplicate ids) holds throughout, and every
+        message lands."""
+        from m3_tpu.utils import faults
+
+        got = []
+        consumer = Consumer(lambda s, p: got.append(p), ack_batch=1)
+        faults.configure("msg.producer.send=error:p0.3:x6", seed=13)
+        try:
+            producer = Producer(("127.0.0.1", consumer.port),
+                                retry_after_s=0.2)
+            for i in range(30):
+                producer.publish(0, b"m%d" % i)
+            deadline = time.monotonic() + 10
+            while producer.unacked and time.monotonic() < deadline:
+                with producer._lock:
+                    assert len(producer._queue) == len(set(producer._queue))
+                    assert set(producer._queue) == producer._queued
+                time.sleep(0.01)
+            assert producer.unacked == 0
+            assert set(got) == {b"m%d" % i for i in range(30)}
+        finally:
+            faults.disable()
+            producer.close()
+            consumer.close()
