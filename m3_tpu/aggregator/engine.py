@@ -30,6 +30,7 @@ from m3_tpu.metrics.policy import StoragePolicy
 from m3_tpu.metrics.rules import Matcher, PipelineStage, RuleSet
 from m3_tpu.metrics.transformation import TransformationType, apply as apply_transform
 from m3_tpu.ops import windowed_agg
+from m3_tpu.utils import faults
 from m3_tpu.utils.hash import murmur3_32
 
 # flush-history depth bound: stage-k windows close against the k-th
@@ -231,6 +232,10 @@ class Aggregator:
     def flush(self, now_ns: int) -> list[AggregatedMetric]:
         """Close every window whose end + buffer_past has passed and emit
         its aggregates; still-open windows are carried to the next flush."""
+        # fault point BEFORE any buffer is taken: an injected failure here
+        # leaves every pending sample buffered for the next flush tick
+        # (chaos tests assert a failed flush never drops closed windows)
+        faults.check("aggregator.flush", now_ns=now_ns)
         out: list[AggregatedMetric] = []
         with self._lock:
             self._watermark_ns = max(self._watermark_ns, now_ns)
@@ -388,6 +393,10 @@ def storage_flush_handler(db, namespace_for_policy: Callable[[StoragePolicy], st
     def handle(metrics: list[AggregatedMetric]) -> int:
         from m3_tpu.utils.instrument import Logger
 
+        # downstream-sink seam: an error/timeout here models the storage
+        # write path rejecting a whole flush batch (a crash kills the
+        # flush thread like a real SIGKILL would)
+        faults.check("aggregator.flush.handler", n_metrics=len(metrics))
         n = 0
         failed = 0
         first_err: Exception | None = None

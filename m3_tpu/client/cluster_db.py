@@ -19,9 +19,17 @@ from m3_tpu.storage.database import Datapoint
 class ClusterNamespace:
     """Namespace view: index scatter/gather + replica-merged reads."""
 
+    # resolver.fetch_tagged threads its per-query warnings list through
+    # the warnings= out-param (thread-safe); last_warnings stays as a
+    # single-threaded-caller convenience mirroring the session's
+    supports_read_warnings = True
+
     def __init__(self, cdb: "ClusterDatabase", name: str):
         self._cdb = cdb
         self.name = name
+        # partial-result contract (PR-2): ReadWarnings from the LAST read
+        # call on this facade, reset per call
+        self.last_warnings: list = []
 
     @property
     def limits(self):
@@ -33,7 +41,9 @@ class ClusterNamespace:
         retention-tier resolution then leaves this namespace alone)."""
         return self._cdb._ns_opts.get(self.name)
 
-    def query_ids(self, query, start_ns: int, end_ns: int, limit=None):
+    def query_ids(self, query, start_ns: int, end_ns: int, limit=None,
+                  warnings: list | None = None):
+        self.last_warnings = []
         docs = self._cdb.session.query_ids(
             self.name, query, start_ns, end_ns, limit)
         if self.limits is not None:
@@ -48,11 +58,16 @@ class ClusterNamespace:
             self.limits.add_datapoints(len(times))
         return times, vbits
 
-    def read_many(self, series_ids: list[bytes], start_ns: int, end_ns: int):
+    def read_many(self, series_ids: list[bytes], start_ns: int, end_ns: int,
+                  warnings: list | None = None):
         """Batched replica-merged reads: one request per host instead of
         one quorum fetch per series (the query hot path)."""
+        warns: list = []
         out = self._cdb.session.fetch_many(self.name, series_ids,
-                                           start_ns, end_ns)
+                                           start_ns, end_ns, warnings=warns)
+        self.last_warnings = warns
+        if warnings is not None:
+            warnings.extend(warns)
         if self.limits is not None:
             self.limits.add_datapoints(sum(len(t) for t, _ in out))
         return out

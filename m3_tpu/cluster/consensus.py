@@ -1,0 +1,794 @@
+"""Raft-lite consensus core for the metadata plane.
+
+The reference hangs every piece of cluster metadata off raft-replicated
+etcd (SURVEY §2.7); rounds 3-4 stood kvd up as a single writer plus one
+Watch-fed standby, with a documented dual-write hazard: a partitioned
+primary and a promoted standby could both accept writes. This module
+closes that hole by construction: a small, deterministic raft core —
+terms, randomized-timeout leader election, append-entries log
+replication, quorum commit, persisted vote/term/log, snapshot install
+for lagging followers — that kvd (and anything else needing a replicated
+state machine) layers on. No node can serve a write its majority did not
+commit, and no node can become leader without a majority vote.
+
+Design: MESSAGE-PASSING, not thread-per-RPC. A `RaftNode` is a pure
+state machine over three entry points —
+
+    outs = node.tick()                  # timers: elections, heartbeats
+    resp = node.handle(rpc, req)        # inbound RPC from a peer
+    outs = node.on_response(peer, rpc, req, resp)   # a peer's answer
+
+— each returning the outbound messages `(peer_id, rpc, payload)` the
+transition produced. The caller owns delivery: kvd drives a node with a
+real-clock tick thread + per-peer gRPC senders, while tests drive a
+whole cluster single-threaded under a VIRTUAL clock (`LocalRaftCluster`)
+so every election, partition, and log-divergence heal replays
+deterministically from a seed. The clock and the election-timeout RNG
+are injectable for exactly that reason.
+
+What of full raft is deliberately left out (metadata-plane scale: three
+nodes, tens of writes/sec):
+- no membership change protocol (the peer set is static config);
+- no pipelined/parallel append streams — one in-flight append per peer,
+  follow-ups ride the next ack or tick;
+- the persisted journal is one JSON blob rewritten atomically per
+  mutation (bounded by `compact_at`), not an incremental WAL;
+- read scalability features (follower reads, learner replicas) are
+  absent — linearizable reads are leader-lease with a read-index
+  fallback (`read_barrier`), nothing more.
+
+Safety features that are NOT skipped: the commit rule only counts
+replication of CURRENT-term entries (the figure-8 rule), leaders open
+their term with a no-op to commit prior-term tails, vote grants refuse
+candidates with stale logs, and followers ignore vote requests while a
+live leader is within the minimum election timeout (leader stickiness —
+what makes the leader lease safe under bounded clock drift).
+
+Fault seams (utils/faults): `consensus.vote`, `consensus.append`,
+`consensus.snapshot` fire inside the inbound handlers (an injected error
+is a dropped/failed RPC), `consensus.commit` fires before the leader
+advances its commit index, and `consensus.persist` /
+`consensus.persist.write` guard the journal exactly like the kvd store
+journal's seams.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+from m3_tpu.utils import faults
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+class NotLeader(Exception):
+    """Raised on submit/read at a non-leader; carries the leader hint."""
+
+    def __init__(self, leader_id: str | None):
+        super().__init__(f"not leader (leader hint: {leader_id})")
+        self.leader_id = leader_id
+
+
+class CommandLost(Exception):
+    """A submitted command's slot was taken by another leader's entry (or
+    leadership was lost before commit) — the command may or may not ever
+    commit; the caller must re-check state before retrying."""
+
+
+@dataclass
+class LogEntry:
+    term: int
+    command: bytes
+
+
+@dataclass(frozen=True)
+class Ticket:
+    """A submitted command's claim: (index, term) uniquely name a log slot
+    content-wise (the Log Matching property)."""
+
+    index: int
+    term: int
+
+
+class RaftNode:
+    """One consensus participant. Thread-safe; every public method may be
+    called from any thread. `apply_fn(index, command) -> result` is
+    invoked IN COMMIT ORDER under the node lock (keep it fast and never
+    call back into the node from it). Empty commands (the leader's
+    term-opening no-op) are applied too — state machines must treat
+    ``b""`` as a no-op.
+    """
+
+    def __init__(
+        self,
+        node_id: str,
+        peer_ids: list[str],
+        apply_fn,
+        storage_path: str | None = None,
+        snapshot_fn=None,
+        restore_fn=None,
+        clock=time.monotonic,
+        rng: random.Random | None = None,
+        election_timeout_s: tuple[float, float] = (1.0, 2.0),
+        heartbeat_s: float = 0.25,
+        compact_at: int = 1024,
+    ):
+        self.node_id = node_id
+        self.peer_ids = [p for p in peer_ids if p != node_id]
+        self.apply_fn = apply_fn
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.clock = clock
+        self._rng = rng or random.Random(f"raft:{node_id}")
+        self.election_timeout_s = election_timeout_s
+        self.heartbeat_s = heartbeat_s
+        self.compact_at = compact_at
+        self._storage_path = storage_path
+
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+
+        # persistent state
+        self.term = 0
+        self.voted_for: str | None = None
+        self._log: list[LogEntry] = []  # entries (snap_idx+1 .. last_index)
+        self._snap_idx = 0
+        self._snap_term = 0
+        self._snap_data: bytes = b""
+
+        # volatile state
+        self.role = FOLLOWER
+        self.leader_id: str | None = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self._votes: set[str] = set()
+        self._next_idx: dict[str, int] = {}
+        self._match_idx: dict[str, int] = {}
+        # send-time of the latest append this peer ACKED (leader lease)
+        self._lease_ack: dict[str, float] = {}
+        # leader stickiness for votes. Initialized to NOW, not -inf: a
+        # freshly (re)booted node must refuse term-advancing votes for
+        # one minimum election timeout — its pre-crash refusal state is
+        # volatile, and granting immediately would let a partitioned
+        # candidate depose a leader INSIDE that leader's lease window
+        # (the lease's safety rests on this guard). Liveness is
+        # unaffected: no election deadline fires sooner than the minimum
+        # timeout anyway.
+        self._last_leader_contact = self.clock()
+        self._force_hb = False
+        self._hb_due = 0.0
+        self._election_deadline = 0.0
+        # apply results for proposers, bounded (index -> (term, result))
+        self._results: dict[int, tuple[int, object]] = {}
+
+        self._restore()
+        if self.restore_fn is not None and self._snap_data:
+            self.restore_fn(self._snap_data)
+            self.last_applied = self._snap_idx
+        self.commit_index = self._snap_idx
+        self.last_applied = max(self.last_applied, self._snap_idx)
+        self._reset_election_deadline()
+
+    # -- log helpers (1-based indices; <= snap_idx is compacted away) --
+
+    @property
+    def last_index(self) -> int:
+        return self._snap_idx + len(self._log)
+
+    def term_at(self, idx: int) -> int | None:
+        if idx == 0:
+            return 0
+        if idx == self._snap_idx:
+            return self._snap_term
+        if self._snap_idx < idx <= self.last_index:
+            return self._log[idx - self._snap_idx - 1].term
+        return None  # compacted or beyond the log
+
+    def _entry(self, idx: int) -> LogEntry:
+        return self._log[idx - self._snap_idx - 1]
+
+    @property
+    def majority(self) -> int:
+        return (len(self.peer_ids) + 1) // 2 + 1
+
+    # -- persistence (the kvd journal discipline: atomic tmp+fsync+replace) --
+
+    def _persist(self) -> None:
+        if self._storage_path is None:
+            return
+        faults.check("consensus.persist", node=self.node_id)
+        payload = json.dumps({
+            "term": self.term,
+            "voted_for": self.voted_for,
+            "snap_idx": self._snap_idx,
+            "snap_term": self._snap_term,
+            "snap": self._snap_data.hex(),
+            "log": [[e.term, e.command.hex()] for e in self._log],
+        }).encode()
+        tmp = self._storage_path + ".tmp"
+        with open(tmp, "wb") as f:
+            faults.torn_write(f, payload, "consensus.persist.write")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._storage_path)
+
+    def _restore(self) -> None:
+        if self._storage_path is None or not os.path.exists(self._storage_path):
+            return
+        try:
+            with open(self._storage_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return  # torn tmp never lands under the final name
+        self.term = doc["term"]
+        self.voted_for = doc["voted_for"]
+        self._snap_idx = doc["snap_idx"]
+        self._snap_term = doc["snap_term"]
+        self._snap_data = bytes.fromhex(doc["snap"])
+        self._log = [LogEntry(t, bytes.fromhex(c)) for t, c in doc["log"]]
+
+    # -- timers --
+
+    def _reset_election_deadline(self) -> None:
+        lo, hi = self.election_timeout_s
+        self._election_deadline = self.clock() + lo + self._rng.random() * (hi - lo)
+
+    def tick(self) -> list[tuple[str, str, dict]]:
+        """Advance timers; returns outbound (peer, rpc, payload) messages."""
+        with self._lock:
+            now = self.clock()
+            if self.role != LEADER:
+                if now >= self._election_deadline:
+                    return self._start_election()
+                return []
+            if self._force_hb or now >= self._hb_due:
+                self._force_hb = False
+                self._hb_due = now + self.heartbeat_s
+                return [self._replicate_msg(p) for p in self.peer_ids]
+            return []
+
+    # -- elections --
+
+    def _start_election(self) -> list[tuple[str, str, dict]]:
+        self.term += 1
+        self.role = CANDIDATE
+        self.voted_for = self.node_id
+        self.leader_id = None
+        self._votes = {self.node_id}
+        self._persist()
+        self._reset_election_deadline()
+        self._cond.notify_all()
+        if self._has_majority(self._votes):  # single-node cluster
+            return self._become_leader()
+        req = {
+            "term": self.term,
+            "cand": self.node_id,
+            "last_idx": self.last_index,
+            "last_term": self.term_at(self.last_index),
+        }
+        return [(p, "vote", dict(req)) for p in self.peer_ids]
+
+    def _has_majority(self, votes: set[str]) -> bool:
+        return len(votes) >= self.majority
+
+    def _become_leader(self) -> list[tuple[str, str, dict]]:
+        self.role = LEADER
+        self.leader_id = self.node_id
+        nxt = self.last_index + 1
+        self._next_idx = {p: nxt for p in self.peer_ids}
+        self._match_idx = {p: 0 for p in self.peer_ids}
+        self._lease_ack = {}
+        # open the term with a no-op so the previous term's tail commits
+        # (a leader may only COUNT replicas of its own-term entries)
+        self._log.append(LogEntry(self.term, b""))
+        self._persist()
+        self._maybe_advance_commit()
+        self._hb_due = self.clock() + self.heartbeat_s
+        self._cond.notify_all()
+        return [self._replicate_msg(p) for p in self.peer_ids]
+
+    def _step_down(self, term: int, leader: str | None = None) -> None:
+        changed = term != self.term
+        self.term = term
+        if changed:
+            self.voted_for = None
+        self.role = FOLLOWER
+        if leader is not None:
+            self.leader_id = leader
+        self._reset_election_deadline()
+        if changed:
+            self._persist()
+        self._cond.notify_all()
+
+    # -- replication --
+
+    def _replicate_msg(self, peer: str) -> tuple[str, str, dict]:
+        """The next append (or snapshot install) for `peer`."""
+        nxt = self._next_idx.get(peer, self.last_index + 1)
+        if nxt <= self._snap_idx:
+            return (peer, "snapshot", {
+                "term": self.term,
+                "leader": self.node_id,
+                "last_idx": self._snap_idx,
+                "last_term": self._snap_term,
+                "state": self._snap_data.hex(),
+                "_sent": self.clock(),
+            })
+        prev = nxt - 1
+        entries = [[e.term, e.command.hex()]
+                   for e in self._log[nxt - self._snap_idx - 1:]]
+        return (peer, "append", {
+            "term": self.term,
+            "leader": self.node_id,
+            "prev_idx": prev,
+            "prev_term": self.term_at(prev),
+            "entries": entries,
+            "commit": self.commit_index,
+            "_sent": self.clock(),
+        })
+
+    # -- inbound RPC --
+
+    def handle(self, rpc: str, req: dict) -> dict:
+        if rpc == "vote":
+            return self._handle_vote(req)
+        if rpc == "append":
+            return self._handle_append(req)
+        if rpc == "snapshot":
+            return self._handle_snapshot(req)
+        raise ValueError(f"unknown raft rpc {rpc!r}")
+
+    def _handle_vote(self, req: dict) -> dict:
+        faults.check("consensus.vote", node=self.node_id)
+        with self._lock:
+            now = self.clock()
+            # leader stickiness: within one minimum election timeout of
+            # hearing a leader (or of BOOTING — see __init__), refuse to
+            # advance terms for a challenger. This is what makes the
+            # leader LEASE safe: a partitioned candidate cannot recruit
+            # voters that may still be inside a live leader's window.
+            if (req["term"] > self.term
+                    and now - self._last_leader_contact
+                    < self.election_timeout_s[0]):
+                return {"term": self.term, "granted": False}
+            if req["term"] > self.term:
+                self._step_down(req["term"])
+            granted = False
+            if req["term"] == self.term and \
+                    self.voted_for in (None, req["cand"]):
+                my_last_term = self.term_at(self.last_index) or 0
+                up_to_date = (req["last_term"], req["last_idx"]) >= \
+                    (my_last_term, self.last_index)
+                if up_to_date:
+                    granted = True
+                    if self.voted_for is None:
+                        self.voted_for = req["cand"]
+                        self._persist()
+                    self._reset_election_deadline()
+            return {"term": self.term, "granted": granted}
+
+    def _handle_append(self, req: dict) -> dict:
+        faults.check("consensus.append", node=self.node_id)
+        with self._lock:
+            if req["term"] < self.term:
+                return {"term": self.term, "ok": False}
+            self._step_down(req["term"], leader=req["leader"])
+            self._last_leader_contact = self.clock()
+            prev = req["prev_idx"]
+            entries = [LogEntry(t, bytes.fromhex(c)) for t, c in req["entries"]]
+            if prev < self._snap_idx:
+                # everything at/below the snapshot is committed state here;
+                # skip the already-covered prefix of the batch
+                drop = self._snap_idx - prev
+                entries = entries[drop:]
+                prev = self._snap_idx
+            if prev > self.last_index:
+                return {"term": self.term, "ok": False,
+                        "conflict": self.last_index + 1}
+            pt = self.term_at(prev)
+            if pt != req["prev_term"] and prev > self._snap_idx:
+                # fast backup: point the leader at the first index of the
+                # conflicting term instead of decrementing one at a time
+                conflict = prev
+                while conflict > self._snap_idx + 1 and \
+                        self.term_at(conflict - 1) == pt:
+                    conflict -= 1
+                del self._log[prev - self._snap_idx - 1:]
+                self._persist()
+                return {"term": self.term, "ok": False, "conflict": conflict}
+            changed = False
+            for j, e in enumerate(entries):
+                idx = prev + 1 + j
+                if idx <= self.last_index:
+                    if self.term_at(idx) == e.term:
+                        continue  # already have it (log matching)
+                    del self._log[idx - self._snap_idx - 1:]  # divergence
+                self._log.append(e)
+                changed = True
+            if changed:
+                self._persist()
+            match = prev + len(entries)
+            # conservative commit bound: only entries VERIFIED to match
+            # the leader (<= match) may commit — our tail beyond them
+            # could still be a stale term's divergence awaiting truncation
+            commit = min(req["commit"], match)
+            if commit > self.commit_index:
+                self.commit_index = commit
+                self._apply_committed()
+            return {"term": self.term, "ok": True, "match": match}
+
+    def _handle_snapshot(self, req: dict) -> dict:
+        faults.check("consensus.snapshot", node=self.node_id)
+        with self._lock:
+            if req["term"] < self.term:
+                return {"term": self.term, "ok": False}
+            self._step_down(req["term"], leader=req["leader"])
+            self._last_leader_contact = self.clock()
+            if req["last_idx"] <= self._snap_idx:
+                return {"term": self.term, "ok": True,
+                        "match": self._snap_idx}
+            state = bytes.fromhex(req["state"])
+            if self.term_at(req["last_idx"]) == req["last_term"]:
+                # log already holds the snapshot point: just compact to it
+                del self._log[: req["last_idx"] - self._snap_idx]
+            else:
+                self._log = []
+            self._snap_idx = req["last_idx"]
+            self._snap_term = req["last_term"]
+            self._snap_data = state
+            if self.restore_fn is not None:
+                self.restore_fn(state)
+            self.commit_index = max(self.commit_index, self._snap_idx)
+            self.last_applied = max(self.last_applied, self._snap_idx)
+            self._persist()
+            self._apply_committed()
+            self._cond.notify_all()
+            return {"term": self.term, "ok": True, "match": self._snap_idx}
+
+    # -- responses --
+
+    def on_response(self, peer: str, rpc: str, req: dict,
+                    resp: dict | None) -> list[tuple[str, str, dict]]:
+        if resp is None:
+            return []
+        with self._lock:
+            if resp["term"] > self.term:
+                self._step_down(resp["term"])
+                return []
+            if rpc == "vote":
+                if self.role == CANDIDATE and req["term"] == self.term \
+                        and resp.get("granted"):
+                    self._votes.add(peer)
+                    if self._has_majority(self._votes):
+                        return self._become_leader()
+                return []
+            if self.role != LEADER or req["term"] != self.term:
+                return []
+            if rpc == "snapshot" and resp.get("ok"):
+                self._match_idx[peer] = max(
+                    self._match_idx.get(peer, 0), resp["match"])
+                self._next_idx[peer] = self._match_idx[peer] + 1
+                self._lease_ack[peer] = req["_sent"]
+                self._cond.notify_all()
+                if self._next_idx[peer] <= self.last_index:
+                    return [self._replicate_msg(peer)]
+                return []
+            if rpc != "append":
+                return []
+            if resp.get("ok"):
+                self._match_idx[peer] = max(
+                    self._match_idx.get(peer, 0), resp["match"])
+                self._next_idx[peer] = self._match_idx[peer] + 1
+                self._lease_ack[peer] = req["_sent"]
+                self._maybe_advance_commit()
+                self._cond.notify_all()
+                if self._next_idx[peer] <= self.last_index:
+                    return [self._replicate_msg(peer)]
+                return []
+            conflict = resp.get("conflict", self._next_idx.get(peer, 2) - 1)
+            self._next_idx[peer] = max(1, min(
+                conflict, self._next_idx.get(peer, self.last_index + 1) - 1))
+            return [self._replicate_msg(peer)]
+
+    def _maybe_advance_commit(self) -> None:
+        for n in range(self.last_index, self.commit_index, -1):
+            if self.term_at(n) != self.term:
+                break  # only own-term entries commit by counting (fig. 8)
+            acks = 1 + sum(1 for p in self.peer_ids
+                           if self._match_idx.get(p, 0) >= n)
+            if acks >= self.majority:
+                faults.check("consensus.commit", node=self.node_id, index=n)
+                self.commit_index = n
+                self._apply_committed()
+                break
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            idx = self.last_applied + 1
+            e = self._entry(idx)
+            result = self.apply_fn(idx, e.command)
+            self.last_applied = idx
+            self._results[idx] = (e.term, result)
+            if len(self._results) > 2048:
+                for k in sorted(self._results)[:1024]:
+                    del self._results[k]
+        self._maybe_compact()
+        self._cond.notify_all()
+
+    def _maybe_compact(self) -> None:
+        if self.snapshot_fn is None or len(self._log) <= self.compact_at:
+            return
+        if self.last_applied <= self._snap_idx:
+            return
+        state = self.snapshot_fn()
+        new_term = self.term_at(self.last_applied)
+        del self._log[: self.last_applied - self._snap_idx]
+        self._snap_idx = self.last_applied
+        self._snap_term = new_term
+        self._snap_data = state
+        self._persist()
+
+    # -- client surface --
+
+    def submit(self, command: bytes) -> Ticket:
+        """Append a command at the leader; raises NotLeader elsewhere.
+        Returns the (index, term) ticket; commit/apply happens as
+        replication proceeds (wait() blocks for it in live mode)."""
+        with self._lock:
+            if self.role != LEADER:
+                raise NotLeader(self.leader_id)
+            self._log.append(LogEntry(self.term, command))
+            self._persist()
+            idx = self.last_index
+            self._force_hb = True  # replicate now, not next heartbeat
+            if not self.peer_ids:
+                self._maybe_advance_commit()
+            return Ticket(idx, self.term)
+
+    def wait(self, ticket: Ticket, timeout_s: float = 10.0):
+        """Block until the ticket's entry applies; returns apply_fn's
+        result. Raises CommandLost if the slot committed under a different
+        term (leadership was lost and the log rewritten)."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while True:
+                got = self._results.get(ticket.index)
+                if got is not None:
+                    term, result = got
+                    if term != ticket.term:
+                        raise CommandLost(
+                            f"index {ticket.index} committed at term {term}, "
+                            f"submitted at {ticket.term}")
+                    return result
+                if self.last_applied >= ticket.index:
+                    raise CommandLost(f"result for {ticket.index} evicted")
+                # a newer term having overwritten our slot surfaces fast
+                if self.term_at(ticket.index) not in (ticket.term, None) \
+                        or (self.role != LEADER
+                            and self.last_index < ticket.index):
+                    raise CommandLost(
+                        f"slot {ticket.index} rewritten before commit")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"no quorum commit for index {ticket.index} "
+                        f"within {timeout_s}s")
+                self._cond.wait(min(remaining, 0.1))
+
+    # -- linearizable reads: leader lease, read-index fallback --
+
+    def has_lease(self) -> bool:
+        """True while a quorum acked an append sent within the lease
+        window — no other node can have won an election meanwhile (vote
+        stickiness holds challengers off for election_timeout_s[0])."""
+        with self._lock:
+            return self._lease_until() > self.clock()
+
+    def _lease_until(self) -> float:
+        if self.role != LEADER:
+            return -1e18
+        acks = sorted([self.clock()] +
+                      [self._lease_ack.get(p, -1e18) for p in self.peer_ids],
+                      reverse=True)
+        quorum_ack = acks[self.majority - 1]
+        return quorum_ack + self.election_timeout_s[0] * 0.9
+
+    def read_barrier(self, timeout_s: float = 5.0) -> bool:
+        """Linearizable read point: returns True once this node is
+        CONFIRMED leader with every write committed before the call
+        applied locally. Fast path is the leader lease; the fallback is
+        raft's read-index protocol (heartbeat round confirming the term,
+        then wait for the apply watermark). Either path first requires an
+        entry of the CURRENT term committed (the term-opening no-op): a
+        fresh leader's commit_index may still trail entries a previous
+        leader committed, and serving before the no-op lands would read a
+        stale state machine."""
+        deadline = time.monotonic() + timeout_s
+        read_idx = None
+        start = None
+        with self._cond:
+            while True:
+                if self.role != LEADER:
+                    return False
+                if read_idx is None and \
+                        self.term_at(self.commit_index) == self.term:
+                    read_idx = self.commit_index
+                    start = self.clock()
+                    if self._lease_until() > start and \
+                            self.last_applied >= read_idx:
+                        return True
+                if read_idx is not None:
+                    acked = 1 + sum(
+                        1 for p in self.peer_ids
+                        if self._lease_ack.get(p, -1e18) >= start)
+                    if acked >= self.majority and \
+                            self.last_applied >= read_idx:
+                        return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._force_hb = True
+                self._cond.wait(min(remaining, 0.05))
+
+    # -- introspection --
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "node": self.node_id,
+                "role": self.role,
+                "term": self.term,
+                "leader": self.leader_id,
+                "commit": self.commit_index,
+                "applied": self.last_applied,
+                "last_index": self.last_index,
+                "snap_idx": self._snap_idx,
+            }
+
+
+# ---------------------------------------------------------------------------
+# deterministic in-process cluster (virtual clock + partitionable links)
+# ---------------------------------------------------------------------------
+
+
+class LocalRaftCluster:
+    """N RaftNodes over an in-memory, PARTITIONABLE message bus under one
+    virtual clock — the deterministic harness the consensus unit tests
+    and the seeded chaos sweep drive. `step()` advances virtual time,
+    ticks every live node, and delivers the produced messages in FIFO
+    order; a blocked link or dead node silently eats the message (exactly
+    what a real partition does to UDP^WgRPC). Faults injected inside
+    handlers (consensus.vote/append/snapshot) surface as dropped RPCs."""
+
+    def __init__(self, node_ids: list[str], make_apply, tmp_dir: str | None = None,
+                 seed: int = 0, dt: float = 0.05, make_snapshot=None,
+                 make_restore=None, **node_kw):
+        self.now = 0.0
+        self.dt = dt
+        self.node_ids = list(node_ids)
+        self._make_apply = make_apply
+        self._make_snapshot = make_snapshot
+        self._make_restore = make_restore
+        self._tmp_dir = tmp_dir
+        self._node_kw = node_kw
+        self.rng = random.Random(seed)
+        self.nodes: dict[str, RaftNode] = {}
+        self.down: set[str] = set()
+        self.blocked: set[tuple[str, str]] = set()  # directed (src, dst)
+        self.pending: list[tuple[str, str, str, dict]] = []  # src,dst,rpc,req
+        for nid in self.node_ids:
+            self._make_node(nid)
+
+    def _make_node(self, nid: str) -> RaftNode:
+        path = os.path.join(self._tmp_dir, f"{nid}.raft") \
+            if self._tmp_dir else None
+        node = RaftNode(
+            nid, self.node_ids, self._make_apply(nid),
+            storage_path=path,
+            snapshot_fn=self._make_snapshot(nid) if self._make_snapshot else None,
+            restore_fn=self._make_restore(nid) if self._make_restore else None,
+            clock=lambda: self.now,
+            rng=random.Random(f"{self.rng.random()}:{nid}"),
+            **self._node_kw)
+        self.nodes[nid] = node
+        return node
+
+    # -- nemesis controls --
+
+    def kill(self, nid: str) -> None:
+        self.down.add(nid)
+        self.pending = [m for m in self.pending
+                        if m[0] != nid and m[1] != nid]
+
+    def restart(self, nid: str) -> RaftNode:
+        """Bring a killed node back from its persisted journal (volatile
+        state — votes in flight, leadership — dies with the process)."""
+        self.down.discard(nid)
+        return self._make_node(nid)
+
+    def partition(self, *groups: list[str]) -> None:
+        """Only links WITHIN a group stay up; everything across is cut."""
+        self.blocked = set()
+        group_of = {}
+        for gi, g in enumerate(groups):
+            for nid in g:
+                group_of[nid] = gi
+        for a in self.node_ids:
+            for b in self.node_ids:
+                if a != b and group_of.get(a) != group_of.get(b):
+                    self.blocked.add((a, b))
+
+    def heal(self) -> None:
+        self.blocked = set()
+
+    def _link_up(self, src: str, dst: str) -> bool:
+        return (src not in self.down and dst not in self.down
+                and (src, dst) not in self.blocked)
+
+    # -- the pump --
+
+    def step(self) -> None:
+        self.now += self.dt
+        for nid in self.node_ids:
+            if nid in self.down:
+                continue
+            for out in self.nodes[nid].tick():
+                self.pending.append((nid, *out))
+        batch, self.pending = self.pending, []
+        for src, dst, rpc, req in batch:
+            if not self._link_up(src, dst):
+                continue
+            try:
+                resp = self.nodes[dst].handle(rpc, req)
+            except Exception:  # noqa: BLE001 - injected fault = dropped RPC
+                continue
+            if src in self.down or not self._link_up(dst, src):
+                continue  # the answer dies on the return path
+            try:
+                for out in self.nodes[src].on_response(dst, rpc, req, resp):
+                    self.pending.append((src, *out))
+            except Exception:  # noqa: BLE001
+                continue
+
+    def run_until(self, cond, max_steps: int = 2000) -> bool:
+        for _ in range(max_steps):
+            if cond():
+                return True
+            self.step()
+        return cond()
+
+    # -- helpers --
+
+    def live(self) -> list[RaftNode]:
+        return [self.nodes[n] for n in self.node_ids if n not in self.down]
+
+    def leader(self) -> RaftNode | None:
+        """The live leader of the HIGHEST term, if any."""
+        leaders = [n for n in self.live() if n.role == LEADER]
+        return max(leaders, key=lambda n: n.term) if leaders else None
+
+    def wait_leader(self, max_steps: int = 2000) -> RaftNode:
+        if not self.run_until(lambda: self.leader() is not None, max_steps):
+            raise TimeoutError("no leader elected")
+        return self.leader()
+
+    def submit_and_commit(self, command: bytes, max_steps: int = 2000):
+        """Drive a command through the current leader to APPLIED on the
+        leader; returns apply_fn's result."""
+        ldr = self.wait_leader(max_steps)
+        t = ldr.submit(command)
+        if not self.run_until(
+                lambda: ldr.last_applied >= t.index or ldr.role != LEADER
+                or ldr.term_at(t.index) != t.term, max_steps):
+            raise TimeoutError(f"no commit for {t}")
+        got = ldr._results.get(t.index)
+        if got is None or got[0] != t.term:
+            raise CommandLost(str(t))
+        return got[1]

@@ -22,21 +22,25 @@ keep-alives (process death included) — which is what makes
 kill-the-leader failover work. Plain writes are persistent (etcd
 put-without-lease semantics).
 
-Survivability (round-4 hardening):
-- revisions are epoch-based, monotonic across restarts, so surviving
-  clients never drop post-restart events as replays;
-- the ephemeral-key set is journaled (reserved key ``_kvd/eph``); a
-  restarted or promoted server grace-leases restored ephemeral keys —
-  dead owners' election keys are reaped after the grace TTL while live
-  owners re-grant their session (keepalive "notfound" → re-grant +
+Survivability (round-4 hardening + round-6 consensus):
+- revisions are monotonic across restarts (single-node: epoch-based;
+  replicated: the raft log index, identical on every node), so surviving
+  clients never drop post-restart or post-failover events as replays;
+- single-node mode journals the ephemeral-key set (reserved key
+  ``_kvd/eph``); a restarted server grace-leases restored ephemeral
+  keys — dead owners' election keys are reaped after the grace TTL while
+  live owners re-grant their session (keepalive "notfound" → re-grant +
   re-assert) and keep their keys;
-- a standby (``--standby-of``) replicates the primary over its Watch
-  stream and promotes itself when the primary stays unreachable; clients
-  accept a comma-separated target list and fail over on transport errors
-  or standby rejections. Single-standby promotion is NOT a quorum
-  protocol (a partitioned primary plus a promoted standby can dual-write;
-  the reference avoids this with raft-replicated etcd — documented
-  deployment caveat).
+- REPLICATED mode (``--node-id`` + ``--peers``, any odd N) runs every
+  mutation — set/cas/delete, lease grant/revoke/expiry — through a
+  raft-lite log (cluster/consensus.py): the leader acks a write only
+  after a MAJORITY committed it, followers answer ``notleader:<addr>``
+  and clients re-route on the hint, reads are linearizable via the
+  leader lease with a read-index fallback, and lagging or restarted
+  nodes catch up by log replay or snapshot install. No node can become
+  writable without winning a majority vote, so the old single-standby
+  dual-write hazard is structurally impossible — there is no promotion
+  path outside consensus.
 
 Wire schema (hand-rolled protowire over raw-bytes gRPC, house style of
 query/remote.py — no protobuf codegen):
@@ -65,6 +69,7 @@ import threading
 import time
 from concurrent import futures
 
+from m3_tpu.cluster import consensus
 from m3_tpu.cluster.kv import (
     FileKVStore,
     KeyNotFound,
@@ -206,29 +211,37 @@ class _Lease:
 
 
 class KvdServer:
-    """Single-writer metadata server. All mutations serialize through the
-    backing store's lock — one writer means every CAS observes the latest
-    committed version (linearizable without needing raft here; multi-node
-    replication of kvd itself is a deployment concern, as running etcd is
-    for the reference)."""
+    """Metadata server. SINGLE-NODE mode (no peers): all mutations
+    serialize through the backing store's lock — one writer means every
+    CAS observes the latest committed version (linearizable without
+    consensus). REPLICATED mode (node_id + peers): every mutation is a
+    command in a raft-lite log (cluster/consensus.py); the leader acks
+    only on majority commit, followers reject writes/reads with a leader
+    hint, and the lease table rides the replicated state machine — the
+    etcd shape the reference leans on, in-house."""
 
-    # reserved store key tracking which keys are lease-attached; rides the
-    # journal AND standby replication, so a restarted/promoted server knows
-    # which restored keys are ephemeral and must be grace-reaped unless
-    # their owner re-attaches (etcd persists leases in raft state; this is
-    # the single-writer equivalent)
+    # single-node mode only: reserved store key tracking which keys are
+    # lease-attached; rides the journal so a restarted server knows which
+    # restored keys are ephemeral and must be grace-reaped unless their
+    # owner re-attaches (replicated mode carries the whole lease table in
+    # raft snapshots instead, the way etcd persists leases in raft state)
     EPH_KEY = "_kvd/eph"
 
     def __init__(self, listen: str, journal_path: str | None = None,
-                 max_workers: int = 16, standby_of: str | None = None,
-                 promote_after_s: float = 5.0,
-                 orphan_grace_ms: int = 10_000):
+                 max_workers: int = 16, node_id: str | None = None,
+                 peers: dict[str, str] | None = None,
+                 orphan_grace_ms: int = 10_000,
+                 election_timeout_s: tuple[float, float] = (1.0, 2.0),
+                 heartbeat_s: float = 0.25):
         import grpc
 
-        self.store: KVStore = FileKVStore(journal_path) if journal_path else KVStore()
+        self._replicated = bool(peers) and len(peers) > 1
+        if self._replicated and node_id not in peers:
+            raise ValueError(f"node_id {node_id!r} missing from peers")
+        self._node_id = node_id
+        self._peers = dict(peers or {})
         self._leases: dict[int, _Lease] = {}
         self._key_lease: dict[str, int] = {}  # current lease owner per key
-        self._lease_seq = int(time.time() * 1e3) % 1_000_000 * 1_000
         self._lock = threading.Lock()
         self._eph_persist_lock = threading.Lock()
         self._subs: list[tuple[str, queue.SimpleQueue]] = []
@@ -237,18 +250,40 @@ class KvdServer:
         # server-global revision, stamped on every change event: versions
         # restart at 1 when a key is deleted and re-created, so clients
         # dedupe replayed events by revision, not version (etcd's
-        # store-revision idea). EPOCH-BASED so it stays monotonic across a
-        # restart — a fresh counter would start below clients' cached revs
-        # and every post-restart event would be silently dropped as a
-        # replay (round-4 advisor finding).
+        # store-revision idea). Single-node: EPOCH-BASED so it stays
+        # monotonic across a restart — a fresh counter would start below
+        # clients' cached revs and every post-restart event would be
+        # silently dropped as a replay (round-4 advisor finding).
+        # Replicated: the RAFT LOG INDEX (shifted to leave per-command
+        # event room), identical on every node — a client failing over to
+        # another replica keeps deduping correctly.
         self._rev = (time.time_ns() // 1_000_000) << 16
         self._key_rev: dict[str, int] = {}
-        # standby mode: follow a primary until it dies, then promote
-        self._standby = threading.Event()
-        self._promote_after_s = promote_after_s
-        self._primary = standby_of
-        if standby_of:
-            self._standby.set()
+        self._raft: consensus.RaftNode | None = None
+        # proposals park their gRPC worker in the quorum wait (up to
+        # 10s); cap them BELOW the pool size so inbound raft RPCs —
+        # the traffic that resolves a quorum loss — can always get a
+        # worker while writers are stalled
+        self._propose_gate = threading.BoundedSemaphore(
+            max(2, max_workers - 4))
+
+        if self._replicated:
+            # the raft journal (log + snapshots) IS the durability story;
+            # the store itself is in-memory state rebuilt by replay
+            self.store: KVStore = KVStore()
+            self._lease_seq = 0  # replicated state: deterministic ids
+            self._rev = 0
+            self._was_leader = False
+            self._raft = consensus.RaftNode(
+                node_id, list(self._peers), self._apply_command,
+                storage_path=journal_path,
+                snapshot_fn=self._snapshot_state,
+                restore_fn=self._restore_state,
+                election_timeout_s=election_timeout_s,
+                heartbeat_s=heartbeat_s)
+        else:
+            self.store = FileKVStore(journal_path) if journal_path else KVStore()
+            self._lease_seq = int(time.time() * 1e3) % 1_000_000 * 1_000
 
         # every store mutation fans out to subscriber queues (the store
         # has per-key watches only, so intercept its notify fanout)
@@ -264,6 +299,8 @@ class KvdServer:
             "LeaseKeepAlive": self._lease_keepalive,
             "LeaseRevoke": self._lease_revoke,
             "Health": lambda req, ctx: b"ok",
+            "Status": self._status,
+            "Raft": self._raft_rpc,
         }
 
         outer = self
@@ -284,10 +321,9 @@ class KvdServer:
         self._server.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
-        if standby_of:
-            self._follower = threading.Thread(target=self._follow_loop,
-                                              daemon=True)
-            self._follower.start()
+        if self._replicated:
+            self._driver = _RaftDriver(self._raft, self._peers, self._node_id,
+                                       self._closed)
         else:
             # journal restore: grace-lease restored ephemeral keys so a
             # dead owner's election/advert keys are reaped (after the
@@ -341,9 +377,181 @@ class KvdServer:
             if key.startswith(prefix):
                 q.put(ev)
 
+    # -- replicated mode: the consensus plumbing --
+
+    def _raft_rpc(self, req: bytes, ctx) -> bytes:
+        """Inbound raft RPC from a peer (vote/append/snapshot). Injected
+        faults inside the handler surface as a gRPC error — the sender
+        drops the message, exactly a lossy link."""
+        if self._raft is None:
+            raise RuntimeError("not a replicated kvd")
+        doc = json.loads(req.decode())
+        return json.dumps(self._raft.handle(doc["rpc"], doc["req"])).encode()
+
+    def _status(self, req: bytes, ctx) -> bytes:
+        doc = {"node": self._node_id, "replicated": self._replicated}
+        if self._raft is not None:
+            doc.update(self._raft.status())
+        else:
+            doc.update({"role": "leader"})
+        return json.dumps(doc).encode()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._raft is None or self._raft.role == consensus.LEADER
+
+    def _leader_hint(self) -> str:
+        lid = self._raft.leader_id if self._raft is not None else None
+        return self._peers.get(lid, "") if lid else ""
+
+    def _propose(self, cmd: dict, timeout_s: float = 10.0) -> dict:
+        """Run a command through the replicated log; returns the apply
+        result once a MAJORITY committed it. NotLeader propagates to the
+        caller (mapped to a notleader hint for clients)."""
+        ticket = self._raft.submit(json.dumps(cmd).encode())
+        self._driver.poke()  # replicate now, not at the next tick
+        return self._raft.wait(ticket, timeout_s)
+
+    def _mutate(self, cmd: dict) -> bytes:
+        if not self._propose_gate.acquire(timeout=2.0):
+            # every proposal slot is parked waiting on quorum: shed this
+            # write with a hint (the client backs off and retries) rather
+            # than consume a worker the raft handlers need to recover
+            return _enc_resp(err="notleader:" + self._leader_hint())
+        try:
+            res = self._propose(cmd)
+        except consensus.NotLeader:
+            return _enc_resp(err="notleader:" + self._leader_hint())
+        except (consensus.CommandLost, TimeoutError):
+            # leadership lost mid-commit: the command MAY still commit
+            # later — the client re-routes and re-reads/retries
+            return _enc_resp(err="notleader:" + self._leader_hint())
+        finally:
+            self._propose_gate.release()
+        return _enc_resp(version=res.get("version", 0),
+                         err=res.get("err", ""),
+                         lease_id=res.get("lease", 0))
+
+    def _read_ready(self) -> bytes | None:
+        """Replicated-mode linearizable read gate: leader lease fast
+        path, read-index fallback; non-leaders hand back a hint."""
+        if self._raft is None:
+            return None
+        if self._raft.role != consensus.LEADER or \
+                not self._raft.read_barrier(timeout_s=5.0):
+            return _enc_resp(err="notleader:" + self._leader_hint())
+        return None
+
+    def _apply_command(self, index: int, command: bytes):
+        """Replicated state machine: executed in commit order on EVERY
+        node. Deterministic by construction — versions come from store
+        state, lease ids from a replicated counter, and the lease-liveness
+        check reads the replicated lease table (no clock reads), so all
+        replicas compute identical results."""
+        if not command:
+            return None  # the leader's term-opening no-op
+        cmd = json.loads(command.decode())
+        # event revisions derive from the LOG INDEX — identical on every
+        # node, monotonic across restarts/failovers (<<16 leaves room for
+        # multi-key commands like a lease revoke reaping many keys)
+        with self._lock:
+            self._rev = max(self._rev, index << 16)
+        op = cmd["op"]
+        if op in ("set", "cas"):
+            lease = cmd.get("l", 0)
+            if lease:
+                with self._lock:
+                    if lease not in self._leases:
+                        # the lease expired (a committed revoke) before
+                        # this write committed: ephemeral-or-nothing, and
+                        # the check is ATOMIC with the write here — no
+                        # rollback dance needed (single-node mode keeps
+                        # one; see _rollback_noleased)
+                        return {"err": "nolease"}
+            data = bytes.fromhex(cmd["d"])
+            if op == "cas":
+                try:
+                    version = self.store.check_and_set(
+                        cmd["k"], cmd.get("e") or 0, data)
+                except VersionMismatch as e:
+                    return {"err": f"conflict:{e}"}
+            else:
+                version = self.store.set(cmd["k"], data)
+            self._attach_lease(cmd["k"], lease, persist=False)
+            return {"version": version}
+        if op == "del":
+            try:
+                self.store.delete(cmd["k"])
+            except KeyNotFound:
+                return {"err": "notfound"}
+            self._attach_lease(cmd["k"], 0, persist=False)
+            return {"version": 1}
+        if op == "grant":
+            with self._lock:
+                self._lease_seq += 1
+                lease_obj = _Lease(self._lease_seq, cmd.get("ttl") or 10_000)
+                self._leases[lease_obj.lease_id] = lease_obj
+            return {"lease": lease_obj.lease_id, "version": lease_obj.ttl_ms}
+        if op == "rev":
+            self._expire([cmd["l"]])
+            return {"lease": cmd["l"]}
+        return {"err": f"unknown op {op}"}
+
+    def _snapshot_state(self) -> bytes:
+        """Full state-machine image for lagging followers / compaction."""
+        with self.store._lock, self._lock:
+            doc = {
+                "data": {k: [vv.version, vv.data.hex()]
+                         for k, vv in self.store._data.items()},
+                "leases": {str(le.lease_id): le.ttl_ms
+                           for le in self._leases.values()},
+                "key_lease": dict(self._key_lease),
+                "seq": self._lease_seq,
+                "rev": self._rev,
+                "key_rev": dict(self._key_rev),
+            }
+        return json.dumps(doc).encode()
+
+    def _restore_state(self, state: bytes) -> None:
+        doc = json.loads(state.decode())
+        now = time.monotonic()
+        st = self.store
+        with st._lock:
+            old = dict(st._data)
+            st._data = {k: VersionedValue(v, bytes.fromhex(h))
+                        for k, (v, h) in doc["data"].items()}
+            changed = [(k, vv) for k, vv in st._data.items()
+                       if old.get(k) != vv]
+            gone = [k for k in old if k not in st._data]
+        with self._lock:
+            self._rev = max(self._rev, doc.get("rev", 0))
+            for k, r in doc.get("key_rev", {}).items():
+                self._key_rev[k] = max(self._key_rev.get(k, 0), r)
+            self._lease_seq = doc["seq"]
+            grace_s = max(self._orphan_grace_ms / 1e3, 1.0)
+            self._leases = {}
+            for lid_s, ttl in doc["leases"].items():
+                le = _Lease(int(lid_s), ttl)
+                # restored leases get the orphan grace: their owners were
+                # keepaliving another leader and need a window to re-attach
+                le.expires_at = now + max(grace_s, ttl / 1e3)
+                self._leases[le.lease_id] = le
+            self._key_lease = {k: int(v) for k, v in doc["key_lease"].items()}
+            for le in self._leases.values():
+                le.keys = {k for k, lid in self._key_lease.items()
+                           if lid == le.lease_id}
+        # live subscribers on a lagging follower hear about the jump
+        for k, vv in changed:
+            self.store._notify(k, vv)
+        for k in gone:
+            self.store._notify(k, None)
+
     # -- unary handlers --
 
     def _get(self, req: bytes, ctx) -> bytes:
+        not_ready = self._read_ready()
+        if not_ready is not None:
+            return not_ready
         key, *_ = _dec_req(req)
         try:
             vv = self.store.get(key)
@@ -356,9 +564,10 @@ class KvdServer:
             return lease in self._leases
 
     def _set(self, req: bytes, ctx) -> bytes:
-        if self._standby.is_set():
-            return _enc_resp(err="standby")
         key, data, _exp, lease, _p, _t = _dec_req(req)
+        if self._replicated:
+            return self._mutate(
+                {"op": "set", "k": key, "d": data.hex(), "l": lease})
         if lease and not self._lease_live(lease):
             # a write meant to be EPHEMERAL must never silently become
             # persistent because its lease expired in flight — an
@@ -432,9 +641,10 @@ class KvdServer:
                 pass
 
     def _cas(self, req: bytes, ctx) -> bytes:
-        if self._standby.is_set():
-            return _enc_resp(err="standby")
         key, data, expect, lease, _p, _t = _dec_req(req)
+        if self._replicated:
+            return self._mutate({"op": "cas", "k": key, "d": data.hex(),
+                                 "e": expect or 0, "l": lease})
         if lease and not self._lease_live(lease):
             return _enc_resp(err="nolease")
         prior, prior_lease = self._prior_state(key) if lease else (None, 0)
@@ -448,9 +658,9 @@ class KvdServer:
         return _enc_resp(version=version)
 
     def _delete(self, req: bytes, ctx) -> bytes:
-        if self._standby.is_set():
-            return _enc_resp(err="standby")
         key, *_ = _dec_req(req)
+        if self._replicated:
+            return self._mutate({"op": "del", "k": key})
         try:
             self.store.delete(key)
         except KeyNotFound:
@@ -459,6 +669,9 @@ class KvdServer:
         return _enc_resp(version=1)
 
     def _keys(self, req: bytes, ctx) -> bytes:
+        not_ready = self._read_ready()
+        if not_ready is not None:
+            return not_ready
         _k, _d, _e, _l, prefix, _t = _dec_req(req)
         return _enc_resp(keys=self.store.keys(prefix))
 
@@ -497,6 +710,8 @@ class KvdServer:
         own lock so concurrent attach/expire can't journal a stale
         snapshot last (the snapshot is taken while holding it; _lock alone
         can't be held across store.set — the broadcast re-takes it)."""
+        if self._replicated:
+            return  # the lease table rides raft snapshots, not the store
         with self._eph_persist_lock:
             with self._lock:
                 eph = sorted(self._key_lease)
@@ -510,10 +725,10 @@ class KvdServer:
             self.store.set(self.EPH_KEY, data)
 
     def _lease_grant(self, req: bytes, ctx) -> bytes:
-        if self._standby.is_set():
-            return _enc_resp(err="standby")
         _k, _d, _e, _l, _p, ttl_ms = _dec_req(req)
         ttl_ms = ttl_ms or 10_000
+        if self._replicated:
+            return self._mutate({"op": "grant", "ttl": ttl_ms})
         with self._lock:
             self._lease_seq += 1
             lease = _Lease(self._lease_seq, ttl_ms)
@@ -521,6 +736,12 @@ class KvdServer:
         return _enc_resp(lease_id=lease.lease_id, version=ttl_ms)
 
     def _lease_keepalive(self, req: bytes, ctx) -> bytes:
+        # keepalives refresh LEADER-LOCAL soft state (expires_at), never
+        # the log: expiry itself only happens via a committed revoke, so
+        # the timer freshness needn't be replicated — a new leader re-arms
+        # every lease with the orphan grace instead (see _reap_loop)
+        if self._replicated and self._raft.role != consensus.LEADER:
+            return _enc_resp(err="notleader:" + self._leader_hint())
         _k, _d, _e, lease_id, _p, _t = _dec_req(req)
         with self._lock:
             lease = self._leases.get(lease_id)
@@ -531,17 +752,50 @@ class KvdServer:
 
     def _lease_revoke(self, req: bytes, ctx) -> bytes:
         _k, _d, _e, lease_id, _p, _t = _dec_req(req)
+        if self._replicated:
+            # surface _mutate's response as-is: a follower must answer
+            # with its notleader hint so the client re-routes the revoke
+            # (swallowing it would turn graceful resign into a TTL wait)
+            return self._mutate({"op": "rev", "l": lease_id})
         self._expire([lease_id])
         return _enc_resp(lease_id=lease_id or 1)
 
     def _reap_loop(self) -> None:
         while not self._closed.wait(0.25):
             now = time.monotonic()
+            if self._replicated:
+                self._reap_replicated(now)
+                continue
             with self._lock:
                 dead = [lid for lid, le in self._leases.items()
                         if le.expires_at <= now]
             if dead:
                 self._expire(dead)
+
+    def _reap_replicated(self, now: float) -> None:
+        """Leader-driven lease expiry: an expired lease is REVOKED VIA THE
+        LOG, so keys are only reaped once a majority commits it — a
+        minority-partitioned ex-leader can never reap an election key
+        (its propose has no quorum), which is precisely the dual-write
+        hole the old standby promotion had."""
+        is_leader = self._raft.role == consensus.LEADER
+        with self._lock:
+            if is_leader and not self._was_leader:
+                # leadership gained: re-arm every lease with the orphan
+                # grace — owners were keepaliving the previous leader and
+                # need a window to re-attach before expiry commits
+                grace_s = max(self._orphan_grace_ms / 1e3, 1.0)
+                for le in self._leases.values():
+                    le.expires_at = max(le.expires_at,
+                                        now + max(grace_s, le.ttl_ms / 1e3))
+            self._was_leader = is_leader
+            dead = [lid for lid, le in self._leases.items()
+                    if le.expires_at <= now] if is_leader else []
+        for lid in dead:
+            try:
+                self._propose({"op": "rev", "l": lid}, timeout_s=2.0)
+            except Exception:  # noqa: BLE001 - lost leadership / no quorum:
+                break          # the next leader's reaper takes over
 
     def _expire(self, lease_ids: list[int]) -> None:
         any_owned = False
@@ -564,112 +818,6 @@ class KvdServer:
                     pass
         if any_owned:
             self._persist_eph()
-
-    # -- standby: follow the primary, promote when it dies --
-
-    @property
-    def is_standby(self) -> bool:
-        return self._standby.is_set()
-
-    def _apply_replica(self, key: str, version: int, data: bytes,
-                       deleted: bool) -> None:
-        """Apply a replicated primary event preserving its exact version
-        (the store's own mutators would renumber)."""
-        st = self.store
-        with st._lock:
-            if deleted:
-                if st._data.pop(key, None) is None:
-                    return
-                st._persist()
-                st._notify(key, None)
-            else:
-                cur = st._data.get(key)
-                if cur is not None and cur.version == version and \
-                        cur.data == data:
-                    return
-                vv = VersionedValue(version, data)
-                st._data[key] = vv
-                st._persist()
-                st._notify(key, vv)
-
-    def _follow_loop(self) -> None:
-        """Replicate the primary's full keyspace over its Watch stream;
-        promote to writable when the primary stays unreachable longer than
-        promote_after_s. Single-standby failover — NOT a quorum protocol;
-        a partitioned-but-alive primary and a promoted standby can both
-        accept writes (the reference avoids this by running raft-replicated
-        etcd; documented deployment caveat)."""
-        import grpc
-
-        last_ok = time.monotonic()
-        connected = False
-        # promotion requires a replica of the keyspace: either a bootstrap
-        # snapshot completed THIS session, or the journal restored one
-        # (else a standby restarted during a permanent primary outage
-        # could never promote — review finding)
-        ever_synced = bool(self.store.keys())
-        while not self._closed.is_set() and self._standby.is_set():
-            channel = None
-            try:
-                channel = grpc.insecure_channel(self._primary)
-                stub = channel.unary_stream(_method("Watch"))
-                stream = stub(_enc_req(prefix=""))
-                seen: set[str] = set()
-                in_bootstrap = True
-                for raw in stream:
-                    connected = True
-                    last_ok = time.monotonic()
-                    key, version, data, deleted, done, rev = _dec_event(raw)
-                    # adopt the primary's revision clock: local re-stamps
-                    # must stay ABOVE every rev the primary ever issued, or
-                    # clients that cached primary revs drop all standby
-                    # events as replays after failover
-                    if rev:
-                        with self._lock:
-                            if rev > self._rev:
-                                self._rev = rev
-                    if done:
-                        # reconnect reconcile: replicated keys missing from
-                        # the fresh snapshot were deleted while we were away
-                        for k in [k for k in self.store.keys()
-                                  if k not in seen]:
-                            self._apply_replica(k, 0, b"", deleted=True)
-                        in_bootstrap = False
-                        ever_synced = True
-                        continue
-                    if in_bootstrap:
-                        seen.add(key)
-                    self._apply_replica(key, version, data, deleted)
-                    if self._closed.is_set() or not self._standby.is_set():
-                        return
-            except Exception:  # noqa: BLE001 - stream down: maybe promote
-                if connected:
-                    # death observed just now — an idle-but-alive stream
-                    # doesn't advance last_ok, so restart the clock here
-                    last_ok = time.monotonic()
-                    connected = False
-            finally:
-                if channel is not None:
-                    try:
-                        channel.close()
-                    except Exception:  # noqa: BLE001
-                        pass
-            if self._closed.wait(0.3):
-                return
-            if ever_synced and \
-                    time.monotonic() - last_ok > self._promote_after_s:
-                # never promote a standby that has no replica of the
-                # keyspace — an empty promoted server would dual-write
-                # against a primary that was merely slow to boot
-                self._promote()
-                return
-
-    def _promote(self) -> None:
-        """Become the writable metadata server: grace-lease the replicated
-        ephemeral keys (their owners' leases lived on the dead primary) and
-        start accepting writes."""
-        self._grace_lease_ephemerals()
-        self._standby.clear()
 
     # -- watch streaming --
 
@@ -707,7 +855,92 @@ class KvdServer:
 
     def close(self) -> None:
         self._closed.set()
+        if self._raft is not None:
+            self._driver.poke()  # unblock sender/tick threads promptly
         self._server.stop(grace=0.5).wait()
+
+
+class _RaftDriver:
+    """Live-mode pump for a replicated kvd's RaftNode: one tick thread
+    advances timers, one sender thread per peer delivers outbound
+    messages over gRPC (method Kvd/Raft) and feeds responses back. Each
+    peer's queue keeps only the LATEST message per rpc type — a newer
+    append carries everything a superseded one did, so there is exactly
+    one in-flight message per (peer, rpc) and a slow peer can never build
+    an unbounded backlog."""
+
+    TICK_S = 0.02
+
+    def __init__(self, node: consensus.RaftNode, peers: dict[str, str],
+                 node_id: str, closed: threading.Event):
+        self._node = node
+        self._closed = closed
+        self._addrs = dict(peers)
+        self._wake = threading.Event()
+        self._cv = threading.Condition()
+        self._pending: dict[str, dict[str, dict]] = {
+            p: {} for p in peers if p != node_id}
+        threading.Thread(target=self._tick_loop, daemon=True).start()
+        for p in self._pending:
+            threading.Thread(target=self._send_loop, args=(p,),
+                             daemon=True).start()
+
+    def poke(self) -> None:
+        self._wake.set()
+        with self._cv:
+            self._cv.notify_all()
+
+    def _queue(self, outs) -> None:
+        if not outs:
+            return
+        with self._cv:
+            for peer, rpc, req in outs:
+                self._pending.setdefault(peer, {})[rpc] = req
+            self._cv.notify_all()
+
+    def _tick_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                self._queue(self._node.tick())
+            except Exception:  # noqa: BLE001 - injected persist fault etc.
+                pass
+            self._wake.wait(self.TICK_S)
+            self._wake.clear()
+
+    def _send_loop(self, peer: str) -> None:
+        import grpc
+
+        channel = stub = None
+        while not self._closed.is_set():
+            with self._cv:
+                box = self._pending[peer]
+                if not box:
+                    self._cv.wait(0.2)
+                    continue
+                # elections must not starve behind a fat append
+                rpc = next(r for r in ("vote", "snapshot", "append")
+                           if r in box)
+                req = box.pop(rpc)
+            try:
+                if channel is None:
+                    channel = grpc.insecure_channel(self._addrs[peer])
+                    stub = channel.unary_unary(_method("Raft"))
+                raw = stub(json.dumps({"rpc": rpc, "req": req}).encode(),
+                           timeout=2.0)
+                resp = json.loads(raw)
+            except Exception:  # noqa: BLE001 - peer down/partitioned:
+                try:           # drop; the next tick/heartbeat retries
+                    if channel is not None:
+                        channel.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                channel = stub = None
+                self._closed.wait(0.05)
+                continue
+            try:
+                self._queue(self._node.on_response(peer, rpc, req, resp))
+            except Exception:  # noqa: BLE001 - injected fault in response
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -728,9 +961,9 @@ class KvdClient(KVStore):
         super().__init__()
         import grpc
 
-        # comma-separated failover list: primary first, standbys after.
-        # RPCs rotate to the next target on transport errors or "standby"
-        # responses, so a promoted standby is picked up automatically.
+        # comma-separated failover list (the quorum replica set). RPCs
+        # rotate on transport errors and follow notleader hints, so the
+        # current raft leader is found automatically.
         self._targets = [t.strip() for t in target.split(",") if t.strip()]
         self._cur = 0
         self.timeout_s = timeout_s
@@ -785,11 +1018,36 @@ class KvdClient(KVStore):
                 self._targets[self._cur % len(self._targets)])
             self._stubs = {}
 
+    def _redirect(self, addr: str) -> None:
+        """Jump straight to a hinted leader address; an empty/absent hint
+        (an election in progress) degrades to plain rotation."""
+        import grpc
+
+        if not addr:
+            self._rotate()
+            return
+        with self._stub_lock:
+            if addr in self._targets:
+                self._cur = self._targets.index(addr)
+            else:
+                # hints can name replicas outside the configured list
+                # (operator gave a partial list); adopt them — bounded by
+                # the cluster size
+                self._targets.append(addr)
+                self._cur = len(self._targets) - 1
+            try:
+                self._channel.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._channel = grpc.insecure_channel(addr)
+            self._stubs = {}
+
     def _call(self, name: str, req: bytes):
-        """Unary call with failover: rotate targets on transport errors and
-        on standby rejections; single-target clients retry once (server
-        restart)."""
-        attempts = max(5, 2 * len(self._targets))
+        """Unary call with failover: rotate targets on transport errors,
+        follow ``notleader:<addr>`` hints from quorum-mode followers (a
+        fresh election may leave the hint empty for a round — then rotate
+        and retry); single-target clients retry on server restart."""
+        attempts = max(8, 2 * len(self._targets) + 4)
         last_exc: Exception | None = None
         for i in range(attempts):
             try:
@@ -803,9 +1061,11 @@ class KvdClient(KVStore):
                 if self._closed.wait(min(0.2 * (i + 1), 1.0)):
                     break
                 continue
-            if resp[2] == "standby":
-                self._rotate()
-                if self._closed.wait(min(0.2 * (i + 1), 1.0)):
+            err = resp[2]
+            if err.startswith("notleader"):
+                last_exc = KVError(f"{self.target}: {err}")
+                self._redirect(err.partition(":")[2])
+                if self._closed.wait(min(0.1 * (i + 1), 0.5)):
                     break
                 continue
             return resp
@@ -931,8 +1191,8 @@ class KvdClient(KVStore):
                     if self._closed.is_set():
                         return
             except Exception:  # noqa: BLE001 - reconnect on any stream error
-                # rotate so watch-only clients also fail over to a
-                # promoted standby (unary RPCs rotate in _call)
+                # rotate so watch-only clients also fail over to another
+                # replica (unary RPCs rotate in _call)
                 self._rotate()
                 if self._closed.wait(0.5):
                     return
@@ -1053,8 +1313,9 @@ class KvdClient(KVStore):
     def end_session(self) -> None:
         if self._lease_id:
             try:
-                self._stub("LeaseRevoke")(
-                    _enc_req(lease_id=self._lease_id), timeout=self.timeout_s)
+                # through _call so a quorum plane re-routes the revoke to
+                # the leader (a follower would silently drop it otherwise)
+                self._call("LeaseRevoke", _enc_req(lease_id=self._lease_id))
             except Exception:  # noqa: BLE001 - server may already be gone
                 pass
             self._lease_id = 0
@@ -1146,6 +1407,25 @@ class LeaseElection:
 # ---------------------------------------------------------------------------
 
 
+def parse_peers(spec) -> dict[str, str]:
+    """``n1=host:port,n2=host:port,...`` (or an already-parsed dict from a
+    config file) -> {node_id: address}."""
+    if not spec:
+        return {}
+    if isinstance(spec, dict):
+        return {str(k): str(v) for k, v in spec.items()}
+    out = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        nid, sep, addr = part.partition("=")
+        if not sep:
+            raise ValueError(f"bad peer spec (want id=host:port): {part!r}")
+        out[nid.strip()] = addr.strip()
+    return out
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="m3kvd metadata server")
     ap.add_argument("--listen", default="127.0.0.1:0")
@@ -1153,11 +1433,15 @@ def main(argv=None) -> None:
                     help="journal path (ON by default; --no-journal for "
                          "a volatile store)")
     ap.add_argument("--no-journal", action="store_true")
-    ap.add_argument("--standby-of", default="",
-                    help="follow this primary kvd and promote if it dies")
+    ap.add_argument("--node-id", default="",
+                    help="this node's id in --peers (quorum mode)")
+    ap.add_argument("--peers", default="",
+                    help="n1=host:port,n2=host:port,... — the full "
+                         "replica set, this node included (quorum mode)")
     ap.add_argument("-f", "--config", default="", help="yaml/json config file")
     args = ap.parse_args(argv)
-    listen, journal, standby = args.listen, args.journal, args.standby_of
+    listen, journal = args.listen, args.journal
+    node_id, peers = args.node_id, args.peers
     if args.config:
         from m3_tpu.utils.config import load_config
 
@@ -1165,15 +1449,17 @@ def main(argv=None) -> None:
         kvd_cfg = cfg.get("kvd", {}) if isinstance(cfg, dict) else {}
         listen = kvd_cfg.get("listen", listen)
         journal = kvd_cfg.get("journal", journal)
-        standby = kvd_cfg.get("standby_of", standby)
+        node_id = kvd_cfg.get("node_id", node_id)
+        peers = kvd_cfg.get("peers", peers)
     if args.no_journal:
         journal = ""
-    if standby and journal == "kvd.journal":
-        # a primary and standby launched from one directory must not
-        # clobber each other's journal
-        journal = "kvd.standby.journal"
+    peer_map = parse_peers(peers)
+    if peer_map and journal == "kvd.journal":
+        # replicas launched from one directory must not clobber each
+        # other's journal
+        journal = f"kvd.{node_id}.journal"
     server = KvdServer(listen, journal_path=journal or None,
-                       standby_of=standby or None)
+                       node_id=node_id or None, peers=peer_map or None)
     print(f"m3kvd listening on port {server.port}", flush=True)
     try:  # port discovery file for orchestrators spawning with port 0
         with open("kvd.port", "w") as f:

@@ -23,6 +23,7 @@ import zlib
 from m3_tpu.index import packed
 from m3_tpu.index.index import IndexBlock, NamespaceIndex
 from m3_tpu.index.segment import Segment
+from m3_tpu.utils import faults
 
 _MAGIC = b"M3IXSEG1"
 
@@ -59,11 +60,16 @@ def persist_index(index: NamespaceIndex, root: str, namespace: str,
             continue
         payload = blk.sealed[0].to_bytes()
         # packed buffers are written verbatim (their own magic leads) so
-        # the loader can mmap them in place; trailer guards torn writes
+        # the loader can mmap them in place; trailer guards torn writes.
+        # Fault seams mirror the fileset's: index.persist fires BEFORE any
+        # byte lands (per-block), index.persist.write can tear the tmp
+        # file — either way the committed segment under the final name
+        # stays intact and bootstrap falls back to the tag-scan rebuild.
+        faults.check("index.persist", block=bs)
         raw = payload + struct.pack(">I", zlib.adler32(payload))
         tmp = _path(root, namespace, bs) + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(raw)
+            faults.torn_write(f, raw, "index.persist.write")
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, _path(root, namespace, bs))

@@ -182,25 +182,37 @@ class CoordinatorAPI:
     # -- request handling --
 
     def handle(self, method: str, path: str, query: dict, body: bytes):
-        """Returns (status, content_type, payload)."""
+        """Returns (status, content_type, payload, headers) — routes may
+        return the legacy 3-tuple; headers default to {}."""
         # one resource budget per request, enforced in the storage read
         # path (covers PromQL, Graphite render, and remote read alike)
         limits = getattr(self.db, "limits", None)
         try:
             if limits is not None:
                 limits.start_query()
-            return self._route(method, path, query, body)
+            res = self._route(method, path, query, body)
+            return res if len(res) == 4 else (*res, {})
         except QueryLimitError as e:
             return 422, "application/json", json.dumps(
                 {"status": "error", "errorType": "query_limit", "error": str(e)}
-            ).encode()
+            ).encode(), {}
         except Exception as e:  # surface as prometheus-style error envelope
             return 400, "application/json", json.dumps(
                 {"status": "error", "errorType": "bad_data", "error": str(e)}
-            ).encode()
+            ).encode(), {}
         finally:
             if limits is not None:
                 limits.end_query()
+
+    def _warning_headers(self) -> dict:
+        """PR-2 partial-result contract, threaded out to HTTP: one
+        M3-Warnings header value per degraded read leg (failed session
+        host, skipped fanout zone) recorded by the engine for THIS query.
+        An absent header means the result is complete."""
+        warns = getattr(self.engine, "last_warnings", None)
+        if not warns:
+            return {}
+        return {"M3-Warnings": ",".join(str(w) for w in warns)}
 
     def _route(self, method, path, q, body):
         if path == "/health":
@@ -472,7 +484,9 @@ class CoordinatorAPI:
         end = _parse_time(q["end"][0])
         step = _parse_step(q["step"][0])
         result, eval_ts = self.engine.query_range(expr, start, end, step)
-        return 200, "application/json", self._render(result, eval_ts, matrix=True)
+        return (200, "application/json",
+                self._render(result, eval_ts, matrix=True),
+                self._warning_headers())
 
     def _m3ql_query_range(self, q):
         """M3QL pipe-syntax range query (the reference's experimental
@@ -485,7 +499,9 @@ class CoordinatorAPI:
         end = _parse_time(q["end"][0])
         step = _parse_step(q["step"][0])
         result, eval_ts = self.engine.query_range_expr(expr, start, end, step)
-        return 200, "application/json", self._render(result, eval_ts, matrix=True)
+        return (200, "application/json",
+                self._render(result, eval_ts, matrix=True),
+                self._warning_headers())
 
     def _query_instant(self, q):
         expr = q["query"][0]
@@ -495,7 +511,9 @@ class CoordinatorAPI:
 
             t = _time.time_ns()
         result, eval_ts = self.engine.query_instant(expr, t)
-        return 200, "application/json", self._render(result, eval_ts, matrix=False)
+        return (200, "application/json",
+                self._render(result, eval_ts, matrix=False),
+                self._warning_headers())
 
     def _render(self, result, eval_ts, matrix: bool):
         ts_sec = eval_ts.astype(np.float64) / NS
@@ -554,7 +572,13 @@ class CoordinatorAPI:
                 data = {"resultType": "vector", "result": out}
         else:
             data = {"resultType": "string", "result": [ts_sec[0], result.value]}
-        return json.dumps({"status": "success", "data": data}).encode()
+        doc = {"status": "success", "data": data}
+        # prometheus envelope convention: a top-level "warnings" list
+        # accompanies a SUCCEEDING partial result (mirrors M3-Warnings)
+        warns = getattr(self.engine, "last_warnings", None)
+        if warns:
+            doc["warnings"] = [str(w) for w in warns]
+        return json.dumps(doc).encode()
 
     def _time_range(self, q):
         ns = self.db.namespaces[self.namespace]
@@ -608,10 +632,13 @@ class CoordinatorAPI:
                         q = {**parse_qs(body.decode()), **q}
                     except UnicodeDecodeError:
                         pass  # mislabeled binary body; routes read it raw
-                status, ctype, payload = api.handle(method, u.path, q, body)
+                status, ctype, payload, headers = api.handle(
+                    method, u.path, q, body)
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
+                for k, v in headers.items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(payload)
 

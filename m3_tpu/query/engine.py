@@ -120,8 +120,25 @@ class Engine:
         # default subquery resolution when [range:] omits the step
         # (upstream: the global evaluation interval)
         self.subquery_step_ns = subquery_step_ns
+        # partial-result contract (PR-2): ReadWarnings every degraded
+        # storage leg recorded during the LAST query, reset per query —
+        # the HTTP layer turns these into M3-Warnings headers. THREAD-
+        # LOCAL: the coordinator serves concurrent requests through one
+        # Engine, and a shared field would leak query A's warnings into
+        # query B's response (or hide A's entirely).
+        import threading as _threading
+
+        self._warn_tls = _threading.local()
 
     # -- public API --
+
+    @property
+    def last_warnings(self) -> list:
+        """ReadWarnings from the last query evaluated ON THIS THREAD
+        (reset per query). The HTTP handler reads this on the request
+        thread that ran the query, so concurrent requests never observe
+        each other's warnings."""
+        return getattr(self._warn_tls, "last", [])
 
     def _active_limits(self) -> "QueryLimits":
         """The CURRENT database-wide binding (storage accounting consults
@@ -145,22 +162,28 @@ class Engine:
         limits.start_query()
         from m3_tpu.utils import trace
 
+        self._warn_tls.sink = sink = []
         try:
             with trace.span(trace.ENGINE_QUERY, steps=len(eval_ts)):
                 _resolve_at_sentinels(expr, int(eval_ts[0]), int(eval_ts[-1]))
                 return self._eval(expr, eval_ts), eval_ts
         finally:
+            self._warn_tls.sink = None
+            self._warn_tls.last = sink
             limits.end_query()
 
     def query_instant(self, q: str, t_ns: int):
         eval_ts = np.array([t_ns], dtype=np.int64)
         limits = self._active_limits()
         limits.start_query()
+        self._warn_tls.sink = sink = []
         try:
             expr = promql.parse(q)
             _resolve_at_sentinels(expr, t_ns, t_ns)
             return self._eval(expr, eval_ts), eval_ts
         finally:
+            self._warn_tls.sink = None
+            self._warn_tls.last = sink
             limits.end_query()
 
     # -- fetch --
@@ -192,7 +215,8 @@ class Engine:
                                                t_min, t_max, self.now_fn())
                    if self.resolve_tiers else [self.namespace])
         docs, series = resolver.fetch_tagged(
-            self.db, ns_list, matchers_to_query(sel.matchers), t_min, t_max)
+            self.db, ns_list, matchers_to_query(sel.matchers), t_min, t_max,
+            warnings=getattr(self._warn_tls, "sink", None))
         labels = []
         per_series = []
         for doc, (times, vbits) in zip(docs, series):

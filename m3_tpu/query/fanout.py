@@ -41,6 +41,11 @@ class FanoutError(RuntimeError):
 class FanoutNamespace:
     """One namespace viewed across the local db + remote zones."""
 
+    # resolver.fetch_tagged threads its per-query warnings list through
+    # the warnings= out-param (thread-safe) instead of draining the
+    # shared last_warnings field
+    supports_read_warnings = True
+
     def __init__(self, fdb: "FanoutDatabase", name: str):
         self._fdb = fdb
         self.name = name
@@ -75,7 +80,8 @@ class FanoutNamespace:
                 warnings.append(ReadWarning("fanout", zone.name, str(e)))
             return None
 
-    def query_ids(self, query, start_ns: int, end_ns: int, limit=None):
+    def query_ids(self, query, start_ns: int, end_ns: int, limit=None,
+                  warnings: list | None = None):
         from m3_tpu.index.query import query_to_json
 
         warns: list[ReadWarning] = []
@@ -99,6 +105,8 @@ class FanoutNamespace:
         if limit is not None:
             docs = docs[:limit]
         self.last_warnings = warns
+        if warnings is not None:
+            warnings.extend(warns)
         return docs
 
     # -- reads (replica-style sample merge across zones) --

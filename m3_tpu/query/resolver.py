@@ -74,7 +74,8 @@ def resolve_namespaces(db, unagg: str, t_min: int, t_max: int,
 
 
 def fetch_tagged(db, namespaces: list[str], index_query, t_min: int,
-                 t_max: int, limit=None, keep_empty: bool = False):
+                 t_max: int, limit=None, keep_empty: bool = False,
+                 warnings: list | None = None):
     """Query + read the namespaces and stitch per series.
 
     Returns (docs, [(times, value_bits)]) aligned lists, one entry per
@@ -88,15 +89,27 @@ def fetch_tagged(db, namespaces: list[str], index_query, t_min: int,
     fetch+decode dispatch per (shard, block, volume) group (or one RPC per
     node on cluster facades), so a 10k-series PromQL fetch costs a handful
     of decode dispatches, not 10k.
+
+    ``warnings`` (out-param) accumulates the ReadWarnings degraded
+    cluster facades recorded for these reads — the engine carries them to
+    its results and the HTTP layer to response headers (PR-2 contract).
+    It is threaded INTO facades advertising ``supports_read_warnings``
+    (fanout, cluster session) as their own warnings= out-param, the
+    per-call thread-safe channel — never read back from shared facade
+    state, which concurrent queries would cross-contaminate.
     """
     by_id: dict[bytes, list] = {}  # id -> [doc, times, vbits]
     empties: dict[bytes, object] = {}  # matched but no samples anywhere
     for ns_name in namespaces:
         ns = db.namespaces[ns_name]
-        docs = ns.query_ids(index_query, t_min, t_max, limit=limit) \
-            if limit is not None else ns.query_ids(index_query, t_min, t_max)
+        kw = {"warnings": warnings} if warnings is not None and \
+            getattr(ns, "supports_read_warnings", False) else {}
+        if limit is not None:
+            docs = ns.query_ids(index_query, t_min, t_max, limit=limit, **kw)
+        else:
+            docs = ns.query_ids(index_query, t_min, t_max, **kw)
         ids = [d.series_id for d in docs]
-        results = ns.read_many(ids, t_min, t_max)
+        results = ns.read_many(ids, t_min, t_max, **kw)
         for doc, (times, vbits) in zip(docs, results):
             if len(times) == 0:
                 if keep_empty and doc.series_id not in by_id:

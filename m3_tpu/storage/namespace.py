@@ -129,8 +129,7 @@ class Namespace:
         decode WORK (an over-limit query aborts after at most one chunk
         of extra decode, not after materializing the whole match set)."""
         by_shard: dict[int, list[int]] = {}
-        for i, sid in enumerate(series_ids):
-            shard_id = self.shard_set.lookup(sid)
+        for i, shard_id in enumerate(self.shard_set.lookup_many(series_ids)):
             if shard_id not in self.shards:
                 raise KeyError(f"shard {shard_id} not owned by this node")
             by_shard.setdefault(shard_id, []).append(i)

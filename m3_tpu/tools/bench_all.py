@@ -316,16 +316,13 @@ def config5_sharded_quantile():
     q_idx = int(T * 0.99)
     k = T - q_idx  # selection depth: sorted[q_idx] == k-th largest
 
-    def kth_largest(v, kk):
-        # iterative masked-max selection over the TIME axis of the
+    def kth_largest_time_major(v, kk):
+        # iterative masked-max selection over the TIME axis of a
         # time-major [T, S] elem grid: kk-1 passes peel the larger
         # elements, pass kk's max is the answer. O(kk*T) elementwise — no
         # sort, no top_k (XLA:CPU lowers top_k to a full variadic sort;
-        # TPU tiles elementwise reductions onto the VPU directly). The
-        # time-major layout makes each reduction a vertical SIMD op across
-        # series lanes instead of a horizontal within-row reduce (~6x on
-        # XLA:CPU; same orientation the TPU VPU prefers with series on the
-        # 128-lane axis).
+        # TPU tiles elementwise reductions onto the VPU directly). Each
+        # pass's reduction is a vertical SIMD op across series lanes.
         for _ in range(kk - 1):
             m = jnp.max(v, axis=0, keepdims=True)
             # mask exactly one occurrence of the max per series
@@ -333,32 +330,56 @@ def config5_sharded_quantile():
             v = jnp.where(first & (v == m), -jnp.inf, v)
         return jnp.max(v, axis=0)
 
-    # group counts depend only on the shard->group placement, not on the
-    # flushed values: precompute once (the host baseline likewise only
-    # does the per-flush work — partition + scatter-add — in its timed
-    # section)
+    # group counts AND the group->series one-hot placement matrix depend
+    # only on the shard->group placement, not on the flushed values:
+    # precompute both once (the host baseline likewise only does the
+    # per-flush work — partition + scatter-add — in its timed section)
     cnt_host = np.bincount(gids, minlength=G).astype(np.float64)
+    onehot_t_host = np.zeros((G, S))
+    onehot_t_host[gids, np.arange(S)] = 1.0
 
-    def per_shard(v, g, cnt):
-        q = kth_largest(v, k)
-        seg = jax.ops.segment_sum(q, g, num_segments=G)
-        seg = jax.lax.psum(seg, "shard")
-        return seg / cnt
+    # the segment reduction is a one-hot MATVEC, not segment_sum:
+    # XLA:CPU lowers segment_sum to a serial scatter-add, while
+    # [G, S_shard] @ [S_shard] runs through the tuned GEMV (a TPU tiles
+    # it onto the MXU). Orientation matters: the GROUP-major [G, S]
+    # one-hot makes every output group one contiguous SIMD dot; the
+    # [S, G] orientation (q @ oh) pays a stride-G gather per group —
+    # profiled ~2.6x between them, ~4x over segment_sum
 
+    def per_shard_select(v, oht, cnt):  # time-major [T, S_shard]
+        seg = oht @ kth_largest_time_major(v, k)
+        return jax.lax.psum(seg, "shard") / cnt
+
+    def per_shard_max(v, oht, cnt):  # series-major [S_shard, T]
+        seg = oht @ jnp.max(v, axis=1)
+        return jax.lax.psum(seg, "shard") / cnt
+
+    # layout is ours to choose for device-resident state, PER selection
+    # depth: k == 1 (p99 over a 64-pt window) degenerates to a plain max,
+    # which the series-major [S, T] grid serves with one contiguous
+    # horizontal reduce per row — profiled ~1.9x over running the k=1
+    # peel on the time-major grid. Deeper selections keep the time-major
+    # grid the iterative peel prefers. The host baseline keeps its own
+    # best layout (row-major [S, T] for np.partition) either way.
+    if k == 1:
+        fn, spec, dev_vals = per_shard_max, P("shard", None), vals
+    else:
+        fn, spec, dev_vals = per_shard_select, P(None, "shard"), vals.T.copy()
     quantile_rollup = jax.jit(shard_map(
-        per_shard, mesh=mesh,
-        in_specs=(P(None, "shard"), P("shard"), P()), out_specs=P(),
+        fn, mesh=mesh,
+        in_specs=(spec, P(None, "shard"), P()), out_specs=P(),
     ))
 
-    # the device elem grid is stored time-major [T, S] (layout is ours to
-    # choose for device-resident state); the host baseline keeps its own
-    # best layout (row-major [S, T] for np.partition)
-    jv = jax.device_put(jnp.asarray(vals.T.copy()),
-                        jax.NamedSharding(mesh, P(None, "shard")))
-    jg = jax.device_put(jnp.asarray(gids), jax.NamedSharding(mesh, P("shard")))
+    jv = jax.device_put(jnp.asarray(dev_vals), jax.NamedSharding(mesh, spec))
+    joh = jax.device_put(jnp.asarray(onehot_t_host),
+                         jax.NamedSharding(mesh, P(None, "shard")))
     jc = jax.device_put(jnp.asarray(np.maximum(cnt_host, 1.0)),
                         jax.NamedSharding(mesh, P()))
-    dt = _time(lambda: quantile_rollup(jv, jg, jc))
+    # both sides run the same iteration count, high enough to average
+    # out scheduler noise (at 3 iters the run-to-run spread exceeded the
+    # device/host gap on shared-CPU hosts)
+    iters = 15
+    dt = _time(lambda: quantile_rollup(jv, joh, jc), iters=iters)
 
     # host numpy baseline of the same computation
     def host():
@@ -368,11 +389,11 @@ def config5_sharded_quantile():
         return out
 
     t0 = time.perf_counter()
-    for _ in range(3):
+    for _ in range(iters):
         host()
-    dt_host = (time.perf_counter() - t0) / 3
+    dt_host = (time.perf_counter() - t0) / iters
     # correctness: device result == host result
-    dev = np.asarray(quantile_rollup(jv, jg, jc))
+    dev = np.asarray(quantile_rollup(jv, joh, jc))
     ok = np.allclose(dev, host() / np.maximum(cnt_host, 1), rtol=1e-9)
     _emit(f"#5 {n_dev}-shard timer quantile rollup {S}x{T}"
           + ("" if ok else " (CORRECTNESS FAILED)"),

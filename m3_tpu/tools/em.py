@@ -313,6 +313,30 @@ class ClusterEnv:
                 out[name] = {"error": str(e)}
         return out
 
+    def deploy_kvd_quorum(self, ports: dict[str, int],
+                          service: str = "kvd",
+                          env: dict | None = None) -> str:
+        """Deploy an N-node quorum kvd metadata plane, one replica per
+        named agent (N should be odd; {agent_name: port}). Each agent gets
+        a config naming ITSELF in the shared peer set, so the replicas
+        elect a leader among themselves and followers hint clients to it.
+        ``env`` rides each start (e.g. PYTHONPATH / fault specs for chaos
+        runs). Returns the comma-separated client target list (hand it to
+        KvdClient / kv_addr). Kill any replica with
+        ``stop(service, sig="SIGKILL")`` — the survivors re-elect and the
+        restarted process rejoins from its raft journal."""
+        peers = {name: f"127.0.0.1:{port}" for name, port in ports.items()}
+        peer_spec = ",".join(f"{n}={a}" for n, a in peers.items())
+        for name in ports:
+            agent = self.agents[name]
+            agent.put_file("kvd.yml", (
+                f"kvd:\n  listen: {peers[name]}\n"
+                f"  journal: kvd.{name}.journal\n"
+                f"  node_id: {name}\n"
+                f"  peers: {peer_spec}\n"))
+            agent.start(service, "m3_tpu.cluster.kvd", "kvd.yml", env=env)
+        return ",".join(peers.values())
+
     def teardown(self) -> None:
         for agent in self.agents.values():
             try:

@@ -10,7 +10,10 @@
 #   run_tests.sh chaos  — opt-in seeded fault-injection stage: the
 #                         crash-recovery loop runs M3_TPU_CHAOS_ITERS
 #                         (default 200) kill-mid-flush iterations per
-#                         schedule; never part of tier-1
+#                         schedule, and the consensus sweep runs the same
+#                         number of partition/leader-kill/heal rounds
+#                         against the raft-lite metadata plane under a
+#                         virtual clock; never part of tier-1
 #   run_tests.sh [...]  — full suite (extra args pass through to pytest)
 ARGS=("$@")
 if [ "${1:-}" = "fast" ]; then
@@ -22,6 +25,7 @@ elif [ "${1:-}" = "chaos" ]; then
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     M3_TPU_CHAOS_ITERS="${M3_TPU_CHAOS_ITERS:-200}" \
     python -m pytest tests/test_crash_recovery.py tests/test_fault_injection.py \
+    tests/test_consensus.py \
     -q -m chaos "$@"
 fi
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

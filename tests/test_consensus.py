@@ -1,0 +1,413 @@
+"""Raft-lite consensus core (cluster/consensus.py): virtual-time unit
+tests for the safety properties (election safety, log matching,
+commit-index monotonicity, lease reads, snapshot install, journal
+restore) plus the seeded chaos sweep — partitions, leader kills,
+restarts, divergence heals — asserting the metadata-plane invariant: no
+acked write is ever lost and no two nodes accept conflicting writes in
+the same term.
+
+Everything here runs under LocalRaftCluster's VIRTUAL clock: a (seed)
+pair replays the exact same elections and message interleavings, so a
+failure reproduces byte-identically (the PR-2 determinism discipline)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from m3_tpu.cluster.consensus import (
+    LEADER,
+    CommandLost,
+    LocalRaftCluster,
+    NotLeader,
+    RaftNode,
+)
+from m3_tpu.utils import faults
+
+
+def make_cluster(tmp_path, n=3, seed=0, stores=None, **node_kw):
+    """A cluster whose state machines are per-node dicts applying
+    ``key=value`` commands; `stores` lets the caller observe them."""
+    stores = stores if stores is not None else {}
+
+    def make_apply(nid):
+        # a (re)started node begins from an empty state machine: the
+        # snapshot restore + committed-log replay rebuild it (the raft
+        # contract a real process restart follows)
+        store = stores.setdefault(nid, {})
+        store.clear()
+
+        def apply(index, cmd: bytes):
+            if not cmd:
+                return None
+            k, _, v = cmd.partition(b"=")
+            store[k.decode()] = v.decode()
+            return index
+
+        return apply
+
+    def make_snapshot(nid):
+        return lambda: json.dumps(stores[nid]).encode()
+
+    def make_restore(nid):
+        def restore(state: bytes):
+            stores[nid].clear()
+            stores[nid].update(json.loads(state.decode()))
+
+        return restore
+
+    node_kw.setdefault("election_timeout_s", (1.0, 2.0))
+    node_kw.setdefault("heartbeat_s", 0.25)
+    return LocalRaftCluster(
+        [f"n{i}" for i in range(n)], make_apply, tmp_dir=str(tmp_path),
+        seed=seed, make_snapshot=make_snapshot, make_restore=make_restore,
+        **node_kw), stores
+
+
+class TestElections:
+    def test_single_leader_elected(self, tmp_path):
+        c, _ = make_cluster(tmp_path)
+        ldr = c.wait_leader()
+        assert ldr.role == LEADER
+        # election safety: never two leaders in one term
+        leaders = [n for n in c.live() if n.role == LEADER
+                   and n.term == ldr.term]
+        assert len(leaders) == 1
+
+    def test_no_leader_without_majority(self, tmp_path):
+        """A minority partition can NEVER elect — the structural fix for
+        the old kvd standby's dual-write hole."""
+        c, _ = make_cluster(tmp_path)
+        ldr = c.wait_leader()
+        minority = ldr.node_id
+        others = [n for n in c.node_ids if n != minority]
+        c.partition([minority], others)
+        # the cut-off ex-leader steps down... never wins a new election
+        c.run_until(lambda: False, max_steps=200)  # ~10s virtual
+        assert all(c.nodes[minority].term >= 0 for _ in [0])
+        majority_leader = [n for n in c.live()
+                           if n.role == LEADER and n.node_id != minority]
+        assert majority_leader, "majority side must elect"
+        # any residual leadership on the minority side is a STALE term
+        if c.nodes[minority].role == LEADER:
+            assert c.nodes[minority].term < majority_leader[0].term
+
+    def test_stale_log_candidate_loses(self, tmp_path):
+        c, _ = make_cluster(tmp_path)
+        for i in range(3):
+            c.submit_and_commit(b"k%d=v%d" % (i, i))
+        ldr = c.wait_leader()
+        behind = next(n for n in c.live() if n.node_id != ldr.node_id)
+        # cut one follower off, commit more, then let it campaign alone
+        # against the up-to-date nodes
+        rest = [n for n in c.node_ids if n != behind.node_id]
+        c.partition(rest, [behind.node_id])
+        c.submit_and_commit(b"k9=v9")
+        c.heal()
+        c.run_until(lambda: c.leader() is not None
+                    and c.leader().last_applied >= 5, max_steps=400)
+        # the stale-log node never became the leader of the final term
+        final = c.leader()
+        assert final.term_at(final.commit_index) is not None
+        assert c.nodes[behind.node_id].role != LEADER or \
+            c.nodes[behind.node_id].last_index >= final.commit_index
+
+
+class TestReplication:
+    def test_commit_requires_majority_and_applies_everywhere(self, tmp_path):
+        c, stores = make_cluster(tmp_path)
+        assert c.submit_and_commit(b"a=1") is not None
+        c.submit_and_commit(b"b=2")
+        c.run_until(lambda: all(
+            n.last_applied == c.leader().last_applied for n in c.live()),
+            max_steps=400)
+        for nid in c.node_ids:
+            assert stores[nid] == {"a": "1", "b": "2"}
+
+    def test_commit_index_monotonic(self, tmp_path):
+        c, _ = make_cluster(tmp_path)
+        seen = {nid: 0 for nid in c.node_ids}
+        for i in range(6):
+            c.submit_and_commit(b"k%d=%d" % (i, i))
+            for nid in c.node_ids:
+                ci = c.nodes[nid].commit_index
+                assert ci >= seen[nid], "commit index regressed"
+                seen[nid] = ci
+
+    def test_divergent_log_is_overwritten(self, tmp_path):
+        """Log matching: an old leader's uncommitted tail is truncated
+        and replaced by the new leader's entries after the heal."""
+        c, stores = make_cluster(tmp_path)
+        ldr = c.wait_leader()
+        others = [n for n in c.node_ids if n != ldr.node_id]
+        # isolate the leader, then feed it entries it can never commit
+        c.partition([ldr.node_id], others)
+        t = ldr.submit(b"lost=1")
+        ldr.submit(b"lost=2")
+        assert ldr._results.get(t.index) is None  # no quorum, no apply
+        # the majority side elects and commits a different history
+        c.run_until(lambda: any(
+            n.role == LEADER and n.node_id != ldr.node_id
+            for n in c.live()), max_steps=400)
+        new = next(n for n in c.live()
+                   if n.role == LEADER and n.node_id != ldr.node_id)
+        t2 = new.submit(b"kept=1")
+        c.run_until(lambda: new.last_applied >= t2.index, max_steps=400)
+        c.heal()
+        c.run_until(lambda: all(
+            n.last_applied >= t2.index for n in c.live()), max_steps=600)
+        for nid in c.node_ids:
+            assert "lost" not in stores[nid], \
+                "uncommitted divergent entry survived the heal"
+            assert stores[nid].get("kept") == "1"
+        # the old leader's slot now holds the new term's entry
+        with pytest.raises(CommandLost):
+            ldr.wait(t, timeout_s=0.05)
+
+    def test_submit_at_follower_raises_not_leader(self, tmp_path):
+        c, _ = make_cluster(tmp_path)
+        ldr = c.wait_leader()
+        # the hint arrives with the first heartbeat
+        c.run_until(lambda: all(n.leader_id == ldr.node_id
+                                for n in c.live()), max_steps=200)
+        follower = next(n for n in c.live() if n.role != LEADER)
+        with pytest.raises(NotLeader) as ei:
+            follower.submit(b"x=1")
+        assert ei.value.leader_id == ldr.node_id
+
+
+class TestLeaseAndReads:
+    def test_leader_holds_lease_after_acked_heartbeats(self, tmp_path):
+        c, _ = make_cluster(tmp_path)
+        c.submit_and_commit(b"a=1")
+        ldr = c.leader()
+        assert ldr.has_lease()
+
+    def test_partitioned_leader_loses_lease(self, tmp_path):
+        c, _ = make_cluster(tmp_path)
+        c.submit_and_commit(b"a=1")
+        ldr = c.leader()
+        others = [n for n in c.node_ids if n != ldr.node_id]
+        c.partition([ldr.node_id], others)
+        # advance past the lease window with no acks arriving
+        for _ in range(60):
+            c.step()
+        assert not ldr.has_lease(), \
+            "a quorum-cut leader must not serve lease reads"
+
+
+class TestSnapshotAndRestart:
+    def test_snapshot_installs_on_lagging_follower(self, tmp_path):
+        c, stores = make_cluster(tmp_path, compact_at=8)
+        c.wait_leader()
+        lag = next(n for n in c.live() if n.role != LEADER).node_id
+        rest = [n for n in c.node_ids if n != lag]
+        c.partition(rest, [lag])
+        for i in range(30):  # >> compact_at: the log prefix is gone
+            c.submit_and_commit(b"k%d=%d" % (i, i))
+        ldr = c.leader()
+        assert ldr._snap_idx > 0, "leader should have compacted"
+        c.heal()
+        c.run_until(lambda: c.nodes[lag].last_applied >= ldr.last_applied,
+                    max_steps=800)
+        assert stores[lag] == stores[ldr.node_id]
+
+    def test_restart_rejoins_from_journal(self, tmp_path):
+        c, stores = make_cluster(tmp_path)
+        for i in range(5):
+            c.submit_and_commit(b"k%d=%d" % (i, i))
+        victim = c.leader().node_id
+        c.kill(victim)
+        c.run_until(lambda: c.leader() is not None, max_steps=400)
+        c.submit_and_commit(b"post=1")
+        c.restart(victim)
+        c.run_until(lambda: c.nodes[victim].last_applied >=
+                    c.leader().last_applied, max_steps=600)
+        assert stores[victim].get("post") == "1"
+        assert all(stores[victim].get(f"k{i}") == str(i) for i in range(5))
+
+    def test_vote_persists_across_restart(self, tmp_path):
+        """A restarted node must remember its vote (double-voting in one
+        term elects two leaders)."""
+        c, _ = make_cluster(tmp_path)
+        c.wait_leader()
+        n0 = c.nodes["n0"]
+        term, voted = n0.term, n0.voted_for
+        c.kill("n0")
+        n0b = c.restart("n0")
+        assert n0b.term == term and n0b.voted_for == voted
+
+
+class TestFaultSeams:
+    def test_vote_faults_drop_elections_then_recover(self, tmp_path):
+        with faults.active("consensus.vote=error:x20"):
+            c, _ = make_cluster(tmp_path)
+            # the first elections lose their vote RPCs; once the budget
+            # (x20) is spent the cluster must still converge
+            ldr = c.wait_leader(max_steps=3000)
+            assert ldr is not None
+        assert faults.plan() is None
+
+    def test_append_faults_slow_but_never_fork(self, tmp_path):
+        with faults.active("consensus.append=error:p0.3", seed=7):
+            c, stores = make_cluster(tmp_path, seed=7)
+            for i in range(5):
+                c.submit_and_commit(b"k%d=%d" % (i, i), max_steps=4000)
+        c.run_until(lambda: all(
+            n.last_applied == c.leader().last_applied for n in c.live()),
+            max_steps=800)
+        want = {f"k{i}": str(i) for i in range(5)}
+        for nid in c.node_ids:
+            assert stores[nid] == want
+
+    def test_persist_faults_crash_the_node_not_the_protocol(self, tmp_path):
+        c, _ = make_cluster(tmp_path)
+        c.wait_leader()
+        with faults.active("consensus.persist=error:n1"):
+            # the next journal write fails loudly (the harness treats the
+            # raised fault as that node dropping its message)
+            c.run_until(lambda: faults.plan().hits("consensus.persist") > 0,
+                        max_steps=400)
+
+
+# ---------------------------------------------------------------------------
+# the seeded chaos sweep (ISSUE 3 acceptance: >= 200 iterations)
+# ---------------------------------------------------------------------------
+
+
+def _check_invariants(c, acked, stores):
+    """The metadata-plane safety contract, checked between nemesis ops."""
+    # election safety: at most one leader per term among live nodes
+    by_term: dict[int, set] = {}
+    for n in c.live():
+        if n.role == LEADER:
+            by_term.setdefault(n.term, set()).add(n.node_id)
+    for term, who in by_term.items():
+        assert len(who) == 1, f"two leaders in term {term}: {who}"
+    # log matching on committed prefixes: no two nodes hold different
+    # commands at the same committed (index, term) slot
+    nodes = c.live()
+    for i, a in enumerate(nodes):
+        for b in nodes[i + 1:]:
+            top = min(a.commit_index, b.commit_index)
+            lo = max(a._snap_idx, b._snap_idx)
+            for idx in range(lo + 1, top + 1):
+                ta, tb = a.term_at(idx), b.term_at(idx)
+                if ta is None or tb is None:
+                    continue
+                assert ta == tb, f"committed term mismatch at {idx}"
+                assert a._entry(idx).command == b._entry(idx).command, \
+                    f"conflicting committed command at {idx}"
+
+
+@pytest.mark.chaos
+def test_chaos_partition_leader_kill_sweep(tmp_path):
+    """≥200 seeded nemesis rounds of leader kill / symmetric+asymmetric
+    partition / heal / restart while clients write through whatever
+    leader exists. Invariants: every ACKED (quorum-committed) write
+    survives to the healed cluster's converged state, committed prefixes
+    never conflict, and no term ever has two leaders."""
+    iters = int(os.environ.get("M3_TPU_CHAOS_ITERS", "200"))
+    seed = int(os.environ.get("M3_TPU_FAULTS_SEED", "0"))
+    c, stores = make_cluster(tmp_path, seed=seed, compact_at=64)
+    rng = c.rng
+    acked: dict[str, str] = {}  # writes a quorum ACKED, keyed k -> v
+    seq = 0
+    for round_no in range(iters):
+        op = rng.random()
+        if op < 0.15 and len(c.down) < 1:
+            ldr = c.leader()
+            if ldr is not None:
+                c.kill(ldr.node_id)
+        elif op < 0.25 and c.down:
+            c.restart(sorted(c.down)[rng.randrange(len(c.down))])
+        elif op < 0.40:
+            ids = list(c.node_ids)
+            rng.shuffle(ids)
+            cut = 1 + rng.randrange(len(ids) - 1)
+            c.partition(ids[:cut], ids[cut:])
+        elif op < 0.55:
+            c.heal()
+        # a few client writes against whoever leads right now
+        for _ in range(rng.randrange(1, 4)):
+            ldr = c.leader()
+            if ldr is None or ldr.node_id in c.down:
+                break
+            seq += 1
+            k, v = f"key{seq % 40}", f"v{seq}"
+            try:
+                t = ldr.submit(f"{k}={v}".encode())
+            except NotLeader:
+                break
+            # pump a bounded number of steps; the write is ACKED only if
+            # the submitting term's entry APPLIED (quorum committed)
+            for _ in range(40):
+                c.step()
+                got = ldr._results.get(t.index)
+                if got is not None or ldr.node_id in c.down:
+                    break
+            got = ldr._results.get(t.index)
+            if got is not None and ldr.term_at(t.index) == t.term \
+                    and ldr.commit_index >= t.index:
+                acked[k] = v
+        for _ in range(rng.randrange(0, 10)):
+            c.step()
+        _check_invariants(c, acked, stores)
+    # heal everything and converge
+    c.heal()
+    for nid in sorted(c.down):
+        c.restart(nid)
+    assert c.run_until(
+        lambda: c.leader() is not None and all(
+            n.last_applied == c.leader().commit_index and
+            n.commit_index == c.leader().commit_index for n in c.live()),
+        max_steps=4000), "cluster failed to converge after final heal"
+    _check_invariants(c, acked, stores)
+    # durability: every acked write is visible in the converged state
+    # unless a LATER acked write to the same key superseded it
+    final = stores[c.leader().node_id]
+    for k, v in acked.items():
+        assert k in final, f"acked key {k} lost"
+    # all live state machines agree
+    for nid in c.node_ids:
+        assert stores[nid] == final, f"state machine divergence on {nid}"
+
+
+@pytest.mark.chaos
+def test_chaos_sweep_is_deterministic(tmp_path):
+    """The same seed replays the same schedule: run two small sweeps and
+    compare the full committed history (the PR-2 replay discipline)."""
+    histories = []
+    for sub in ("a", "b"):
+        d = tmp_path / sub
+        d.mkdir()
+        c, stores = make_cluster(d, seed=1234)
+        rng = c.rng
+        for _ in range(30):
+            if rng.random() < 0.2:
+                ldr = c.leader()
+                if ldr is not None:
+                    c.kill(ldr.node_id)
+            elif c.down and rng.random() < 0.5:
+                c.restart(sorted(c.down)[0])
+            ldr = c.leader()
+            if ldr is not None and ldr.node_id not in c.down:
+                try:
+                    ldr.submit(b"x=%d" % rng.randrange(100))
+                except NotLeader:
+                    pass
+            for _ in range(20):
+                c.step()
+        c.heal()
+        for nid in sorted(c.down):
+            c.restart(nid)
+        c.run_until(lambda: c.leader() is not None and all(
+            n.last_applied == c.leader().commit_index for n in c.live()),
+            max_steps=3000)
+        ldr = c.leader()
+        histories.append([
+            (idx, ldr.term_at(idx), ldr._entry(idx).command)
+            for idx in range(ldr._snap_idx + 1, ldr.commit_index + 1)])
+    assert histories[0] == histories[1]

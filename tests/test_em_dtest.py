@@ -278,3 +278,65 @@ class TestKvdFailoverDtest:
             a.close()
             b.close()
             agent.close()
+
+
+class TestKvdQuorumDtest:
+    def test_quorum_plane_survives_process_sigkill(self, tmp_path):
+        """ISSUE 3 at the PROCESS level: em deploys a 3-replica kvd plane
+        (deploy_kvd_quorum), a client commits writes through the leader,
+        em SIGKILLs one replica — the survivors keep serving (majority),
+        the acked writes stay readable, and the restarted process rejoins
+        from its raft journal."""
+        import pathlib
+        import time as _time
+
+        from m3_tpu.cluster.kvd import KvdClient
+
+        env_extra = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                     "PYTHONPATH": str(pathlib.Path(
+                         __file__).resolve().parents[1])}
+        agents = {}
+        handles = {}
+        for name in ("r0", "r1", "r2"):
+            agent = EmAgent(str(tmp_path / name), "127.0.0.1:0",
+                            agent_id=name)
+            agents[name] = agent
+            handles[name] = AgentClient(f"http://127.0.0.1:{agent.port}")
+        env = ClusterEnv(handles)
+        ports = {name: free_port() for name in agents}
+        c = None
+        try:
+            targets = env.deploy_kvd_quorum(ports, env=env_extra)
+            c = KvdClient(targets, timeout_s=5.0)
+
+            def plane_up():
+                try:
+                    c.keys()
+                    return True
+                except Exception:  # noqa: BLE001
+                    return False
+
+            ClusterEnv.wait_until(plane_up, timeout_s=60,
+                                  desc="quorum plane up")
+            assert c.set("placement/prod", b"v1") == 1
+
+            # SIGKILL one replica: the majority keeps serving
+            handles["r1"].stop("kvd", sig="SIGKILL")
+            _time.sleep(0.5)
+            assert c.get("placement/prod").data == b"v1"
+            c.set("placement/prod", b"v2")
+            assert c.get("placement/prod").data == b"v2"
+
+            # the restarted process rejoins from its journal and the
+            # plane still serves (placed state reused by the agent)
+            handles["r1"].start("kvd")
+            ClusterEnv.wait_until(
+                lambda: handles["r1"].status("kvd")["running"],
+                timeout_s=30, desc="replica back")
+            assert c.get("placement/prod").data == b"v2"
+        finally:
+            if c is not None:
+                c.close()
+            env.teardown()
+            for agent in agents.values():
+                agent.close()

@@ -684,3 +684,209 @@ class TestNetworkSeams:
             with pytest.raises(faults.SimulatedCrash):
                 api.handle("GET", "/read?namespace=default", {}, b"")
         db.close()
+
+
+class TestAggregatorAndIndexSeams:
+    """PR-3 satellite: fault points for the aggregator flush path and
+    index persistence (ROADMAP PR-2 follow-up)."""
+
+    def _agg(self):
+        from m3_tpu.aggregator.engine import Aggregator
+        from m3_tpu.metrics.aggregation import (
+            AggregationType as A, MetricType,
+        )
+        from m3_tpu.metrics.filters import TagFilter
+        from m3_tpu.metrics.policy import StoragePolicy
+        from m3_tpu.metrics.rules import MappingRule, RuleSet
+
+        rs = RuleSet(mapping_rules=[
+            MappingRule("m", TagFilter.parse("app:web"),
+                        (StoragePolicy.parse("10s:2d"),),
+                        aggregations=(A.SUM,)),
+        ])
+        return Aggregator(ruleset=rs)
+
+    def test_failed_flush_keeps_buffered_samples(self):
+        """An injected flush failure loses NOTHING: the buffered samples
+        stay and the next (healthy) flush emits the full aggregate."""
+        agg = self._agg()
+        tags = [(b"app", b"web")]
+        agg.add(__import__("m3_tpu.metrics.aggregation",
+                           fromlist=["MetricType"]).MetricType.COUNTER,
+                b"reqs", tags, START + SEC, 2.0)
+        agg.add(__import__("m3_tpu.metrics.aggregation",
+                           fromlist=["MetricType"]).MetricType.COUNTER,
+                b"reqs", tags, START + 2 * SEC, 3.0)
+        with faults.active("aggregator.flush=error:n1"):
+            with pytest.raises(faults.InjectedError):
+                agg.flush(START + 60 * SEC)
+        out = agg.flush(START + 60 * SEC)
+        assert len(out) == 1
+        assert out[0].value == 5.0
+
+    def test_flush_handler_fault_models_sink_outage(self, tmp_path):
+        from m3_tpu.aggregator.engine import (
+            AggregatedMetric, storage_flush_handler,
+        )
+        from m3_tpu.metrics.policy import StoragePolicy
+
+        handler = storage_flush_handler(object(), lambda p: None)
+        m = AggregatedMetric(b"s", ((b"__name__", b"s"),), START, 1.0,
+                             StoragePolicy.parse("10s:2d"))
+        with faults.active("aggregator.flush.handler=timeout:n1"):
+            with pytest.raises(faults.InjectedTimeout):
+                handler([m])
+        assert handler([m]) == 0  # namespace_for_policy -> None: skipped
+
+    def test_index_persist_crash_leaves_committed_segment(self, tmp_path):
+        """A crash (or torn tmp write) during index persist never damages
+        the previously committed segment; bootstrap restores it."""
+        from m3_tpu.index import persist as ip
+        from m3_tpu.index.index import NamespaceIndex
+
+        idx = NamespaceIndex(2 * HOUR)
+        idx.insert(b"a", [(b"k", b"v")], START)
+        assert ip.persist_index(idx, str(tmp_path), "ns") == 1
+        idx.insert(b"b", [(b"k", b"v")], START)
+        with faults.active("index.persist=crash"):
+            with pytest.raises(faults.SimulatedCrash):
+                ip.persist_index(idx, str(tmp_path), "ns")
+        idx2 = NamespaceIndex(2 * HOUR)
+        assert ip.load_index(idx2, str(tmp_path), "ns") == {START}
+        from m3_tpu.index.query import TermQuery
+
+        assert len(idx2.query(TermQuery(b"k", b"v"),
+                              START, START + 2 * HOUR)) == 1
+
+    def test_index_persist_torn_write_detected_by_trailer(self, tmp_path):
+        """A TORN segment write dies before os.replace, so only .tmp
+        debris remains; the committed name never holds a torn file."""
+        import os as _os
+
+        from m3_tpu.index import persist as ip
+        from m3_tpu.index.index import NamespaceIndex
+
+        idx = NamespaceIndex(2 * HOUR)
+        idx.insert(b"a", [(b"k", b"v")], START)
+        with faults.active("index.persist.write=torn"):
+            with pytest.raises(faults.SimulatedCrash):
+                ip.persist_index(idx, str(tmp_path), "ns")
+        seg_dir = _os.path.join(str(tmp_path), "ns", "_index")
+        names = _os.listdir(seg_dir)
+        assert all(n.endswith(".tmp") for n in names), names
+        idx2 = NamespaceIndex(2 * HOUR)
+        assert ip.load_index(idx2, str(tmp_path), "ns") == set()
+
+
+class TestWarningsToHTTP:
+    """PR-3 satellite: the PR-2 ReadWarning contract threaded out through
+    the promql engine (engine.last_warnings) and the HTTP query APIs
+    (M3-Warnings header + envelope warnings list)."""
+
+    def _fanout_db(self, tmp_path):
+        from m3_tpu.query.fanout import FanoutDatabase
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.options import DatabaseOptions
+
+        local = Database(str(tmp_path / "local"),
+                         DatabaseOptions(n_shards=2))
+        local.create_namespace("default")
+        local.open(START)
+        local.write_tagged("default", b"reqs", [(b"app", b"web")],
+                           START + SEC, 1.0)
+
+        class DeadZone:
+            name = "zone-b"
+
+            def read_many(self, *a, **k):
+                raise ConnectionError("zone unreachable")
+
+            def query_ids(self, *a, **k):
+                raise ConnectionError("zone unreachable")
+
+            def close(self):
+                pass
+
+        return FanoutDatabase(local, [DeadZone()])
+
+    def test_engine_records_warnings_per_query(self, tmp_path):
+        from m3_tpu.query.engine import Engine
+
+        fdb = self._fanout_db(tmp_path)
+        eng = Engine(fdb, "default", resolve_tiers=False)
+        result, _ts = eng.query_range(
+            "reqs", START, START + 2 * SEC, SEC)
+        assert [(w.scope, w.name) for w in eng.last_warnings] == \
+            [("fanout", "zone-b"), ("fanout", "zone-b")]  # ids + reads
+        # a healthy query RESETS the engine's warnings
+        fdb.zones.clear()
+        eng.query_range("reqs", START, START + 2 * SEC, SEC)
+        assert eng.last_warnings == []
+        fdb.close()
+
+    def test_http_api_sets_m3_warnings_header(self, tmp_path):
+        import json as _json
+
+        from m3_tpu.query.api import CoordinatorAPI
+
+        fdb = self._fanout_db(tmp_path)
+        api = CoordinatorAPI(fdb, "default")
+        api.engine.resolve_tiers = False
+        status, _ct, payload, headers = api.handle(
+            "GET", "/api/v1/query_range",
+            {"query": ["reqs"], "start": [str(START // 10**9)],
+             "end": [str(START // 10**9 + 2)], "step": ["1"]}, b"")
+        assert status == 200
+        assert "fanout:zone-b" in headers.get("M3-Warnings", "")
+        doc = _json.loads(payload)
+        assert doc["status"] == "success"
+        assert any("zone-b" in w for w in doc["warnings"])
+        # a complete result carries NO warnings header
+        fdb.zones.clear()
+        status, _ct, payload, headers = api.handle(
+            "GET", "/api/v1/query",
+            {"query": ["reqs"], "time": [str(START // 10**9 + 1)]}, b"")
+        assert status == 200
+        assert "M3-Warnings" not in headers
+        assert "warnings" not in _json.loads(payload)
+        fdb.close()
+
+    def test_concurrent_queries_do_not_share_warnings(self, tmp_path):
+        """Warnings are per-query, PER-THREAD: a degraded query and a
+        healthy query running concurrently through ONE engine must each
+        see exactly their own warnings (the coordinator serves parallel
+        requests through a shared Engine)."""
+        import threading as _threading
+
+        from m3_tpu.query.engine import Engine
+
+        fdb = self._fanout_db(tmp_path)
+        eng = Engine(fdb, "default", resolve_tiers=False)
+        start_gate = _threading.Barrier(2)
+        results: dict[str, list] = {}
+
+        def degraded():
+            start_gate.wait()
+            for _ in range(5):
+                eng.query_range("reqs", START, START + 2 * SEC, SEC)
+            results["degraded"] = list(eng.last_warnings)
+
+        def healthy():
+            start_gate.wait()
+            for _ in range(5):
+                # no selector match in the dead zone path? the zone dies
+                # per-query; a scalar query never touches storage at all
+                eng.query_range("1 + 1", START, START + 2 * SEC, SEC)
+            results["healthy"] = list(eng.last_warnings)
+
+        ts = [_threading.Thread(target=degraded),
+              _threading.Thread(target=healthy)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert results["healthy"] == [], \
+            "healthy query observed another thread's warnings"
+        assert all(w.name == "zone-b" for w in results["degraded"])
+        assert results["degraded"], "degraded query lost its warnings"
+        fdb.close()

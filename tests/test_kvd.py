@@ -506,45 +506,224 @@ class TestKvdRestartSurvivability:
             a.close()
             check.close()
 
-    def test_standby_replicates_and_promotes(self, tmp_path):
-        """Primary + standby: writes replicate; killing the primary
-        promotes the standby; a multi-target client fails over and an
-        election re-establishes on the promoted standby."""
-        p1, p2 = _free_port(), _free_port()
-        prim = KvdServer(f"127.0.0.1:{p1}",
-                         journal_path=str(tmp_path / "prim.json"))
-        stby = KvdServer(f"127.0.0.1:{p2}",
-                         journal_path=str(tmp_path / "stby.json"),
-                         standby_of=f"127.0.0.1:{p1}",
-                         promote_after_s=1.0, orphan_grace_ms=2_000)
-        c = KvdClient(f"127.0.0.1:{p1},127.0.0.1:{p2}")
-        try:
-            el = LeaseElection(c, "agg", "leader-1", ttl_ms=600)
-            assert el.is_leader()
-            c.set("placement/prod", b"v1")
-            wait_for(lambda: _store_has(stby, "placement/prod", b"v1"),
-                     desc="replicated to standby")
-            wait_for(lambda: _store_has(stby, "_election/agg", b"leader-1"),
-                     desc="election replicated")
-            assert stby.is_standby
+def _quorum_plane(tmp_path, n=3, **kw):
+    """An n-node replicated kvd plane; returns ({node_id: server}, peers)."""
+    ports = [_free_port() for _ in range(n)]
+    peers = {f"n{i}": f"127.0.0.1:{p}" for i, p in enumerate(ports)}
+    kw.setdefault("election_timeout_s", (0.4, 0.8))
+    kw.setdefault("heartbeat_s", 0.1)
+    servers = {
+        nid: KvdServer(addr, journal_path=str(tmp_path / f"{nid}.raft"),
+                       node_id=nid, peers=peers, **kw)
+        for nid, addr in peers.items()
+    }
+    wait_for(lambda: any(s.is_leader for s in servers.values()),
+             desc="initial leader election")
+    return servers, peers
 
-            prim.close()
-            wait_for(lambda: not stby.is_standby, timeout_s=15,
-                     desc="standby promoted")
-            # client fails over; persistent data intact on the standby
+
+class TestKvdQuorum:
+    """The raft-replicated metadata plane (ISSUE 3): writes commit on a
+    majority, followers hint clients to the leader, leader death fails
+    over without ever opening a dual-write window, and every existing kvd
+    consumer (elections, placements, runtime options) runs unchanged."""
+
+    def test_write_survives_leader_kill(self, tmp_path):
+        servers, peers = _quorum_plane(tmp_path)
+        c = KvdClient(",".join(peers.values()))
+        try:
+            el = LeaseElection(c, "agg", "leader-1", ttl_ms=800)
+            assert el.is_leader()
+            assert c.set("placement/prod", b"v1") == 1
+            lead = next(nid for nid, s in servers.items() if s.is_leader)
+            servers[lead].close()
+            # client follows notleader hints to the new leader; the acked
+            # write survives (it was majority-committed)
             assert c.get("placement/prod").data == b"v1"
             c.set("placement/prod", b"v2")
             assert c.get("placement/prod").data == b"v2"
-            # the leader re-grants on the standby and keeps (or regains)
-            # leadership before/after the grace reap
+            # the client's session lease re-arms on the new leader and
+            # the ephemeral election key survives the failover
             wait_for(el.is_leader, timeout_s=15,
-                     desc="leadership re-established on standby")
-            assert stby.store.get("_election/agg").data == b"leader-1"
+                     desc="leadership survives kvd failover")
+            survivors = [s for nid, s in servers.items() if nid != lead]
+            wait_for(lambda: any(
+                _store_has(s, "placement/prod", b"v2") for s in survivors),
+                desc="replicated to a survivor")
         finally:
             c.close()
-            stby.close()
-            if prim._server:  # already closed above; double-close is safe
-                pass
+            for s in servers.values():
+                if not s._closed.is_set():
+                    s.close()
+
+    def test_follower_rejects_with_leader_hint(self, tmp_path):
+        from m3_tpu.cluster.kvd import _dec_resp, _enc_req
+
+        servers, peers = _quorum_plane(tmp_path)
+        try:
+            lead = next(nid for nid, s in servers.items() if s.is_leader)
+            follower = next(s for nid, s in servers.items() if nid != lead)
+            err = _dec_resp(follower._set(
+                _enc_req(key="k", data=b"v"), None))[2]
+            assert err.startswith("notleader:")
+            assert err.partition(":")[2] == peers[lead]
+            # reads are leader-only too (linearizable by construction)
+            err = _dec_resp(follower._get(_enc_req(key="k"), None))[2]
+            assert err.startswith("notleader:")
+        finally:
+            for s in servers.values():
+                s.close()
+
+    def test_minority_cannot_promote_or_commit(self, tmp_path):
+        """THE dual-write test: with 2 of 3 nodes dead, the survivor —
+        leader or not — must neither win an election nor commit a write.
+        The old standby mode failed exactly this."""
+        servers, peers = _quorum_plane(tmp_path)
+        try:
+            lead = next(nid for nid, s in servers.items() if s.is_leader)
+            for nid in list(servers):
+                if nid != lead:
+                    servers[nid].close()
+            survivor = servers[lead]
+            t = survivor._raft.submit(b'{"op":"set","k":"x","d":"00","l":0}')
+            with pytest.raises(TimeoutError):
+                survivor._raft.wait(t, timeout_s=2.0)
+            assert survivor._raft.commit_index < t.index
+            # and a client write fails loudly instead of forking state
+            c = KvdClient(peers[lead], timeout_s=1.0)
+            try:
+                with pytest.raises(Exception):
+                    c.set("fork", b"never")
+            finally:
+                c.close()
+        finally:
+            for s in servers.values():
+                if not s._closed.is_set():
+                    s.close()
+
+    def test_no_promotion_without_majority(self, tmp_path):
+        """A follower cut off with the leader dead stays a follower: no
+        single node ever becomes writable alone."""
+        servers, peers = _quorum_plane(tmp_path)
+        try:
+            lead = next(nid for nid, s in servers.items() if s.is_leader)
+            followers = [nid for nid in servers if nid != lead]
+            # kill the leader AND one follower: the last node lacks quorum
+            servers[lead].close()
+            servers[followers[0]].close()
+            last = servers[followers[1]]
+            time.sleep(3.0)  # several election timeouts
+            assert not last.is_leader, \
+                "minority node promoted itself — dual-write hazard"
+        finally:
+            for s in servers.values():
+                if not s._closed.is_set():
+                    s.close()
+
+    def test_restarted_replica_catches_up(self, tmp_path):
+        servers, peers = _quorum_plane(tmp_path)
+        c = KvdClient(",".join(peers.values()))
+        try:
+            c.set("a", b"1")
+            lead = next(nid for nid, s in servers.items() if s.is_leader)
+            victim = next(nid for nid in servers if nid != lead)
+            addr = peers[victim]
+            servers[victim].close()
+            c.set("b", b"2")  # committed by the remaining majority
+            servers[victim] = KvdServer(
+                addr, journal_path=str(tmp_path / f"{victim}.raft"),
+                node_id=victim, peers=peers,
+                election_timeout_s=(0.4, 0.8), heartbeat_s=0.1)
+            wait_for(lambda: _store_has(servers[victim], "b", b"2"),
+                     desc="restarted replica replayed the log")
+            assert _store_has(servers[victim], "a", b"1")
+        finally:
+            c.close()
+            for s in servers.values():
+                if not s._closed.is_set():
+                    s.close()
+
+    def test_existing_consumers_run_unchanged(self, tmp_path):
+        """Services discovery, LeaderService CAS elections, runtime
+        options and placement records — the PR-0..2 kvd consumers — all
+        pass against the 3-node plane through the stock KvdClient."""
+        from m3_tpu.cluster.services import LeaderService, Services
+
+        servers, peers = _quorum_plane(tmp_path)
+        c = KvdClient(",".join(peers.values()))
+        try:
+            # service discovery
+            sd = Services(c, heartbeat_ttl_s=10.0)
+            sd.advertise("dbnode", "node-1", "127.0.0.1:9000")
+            sd.advertise("dbnode", "node-2", "127.0.0.1:9001")
+            assert [a.instance_id for a in sd.instances("dbnode")] == \
+                ["node-1", "node-2"]
+            # CAS-record leader election (the non-lease recipe)
+            la = LeaderService(c, "flush", "inst-a", lease_ttl_s=10.0)
+            lb = LeaderService(c, "flush", "inst-b", lease_ttl_s=10.0)
+            assert la.campaign()
+            assert not lb.campaign()
+            assert lb.leader() == "inst-a"
+            la.resign()
+            assert lb.campaign()
+            # runtime options + placement-style persistent records
+            c.set("runtime/options", b'{"write_new_series_async": true}')
+            assert c.get("runtime/options").version == 1
+            c.check_and_set("runtime/options", 1, b'{"x": 1}')
+            with pytest.raises(VersionMismatch):
+                c.check_and_set("runtime/options", 1, b'{"y": 2}')
+            keys = c.keys("runtime/")
+            assert keys == ["runtime/options"]
+        finally:
+            c.close()
+            for s in servers.values():
+                s.close()
+
+    def test_revoke_reroutes_from_follower(self, tmp_path):
+        """end_session through a client currently pointed at a FOLLOWER:
+        the revoke follows the notleader hint and the ephemeral key is
+        reaped by the committed revoke — graceful resign stays graceful
+        across failover, never a TTL wait."""
+        servers, peers = _quorum_plane(tmp_path)
+        c = KvdClient(",".join(peers.values()))
+        probe = KvdClient(",".join(peers.values()))
+        try:
+            c.start_session(ttl_ms=60_000)  # long TTL: expiry can't help
+            c.set("_election/x", b"me", ephemeral=True)
+            lead = next(nid for nid, s in servers.items() if s.is_leader)
+            follower_addr = next(a for nid, a in peers.items()
+                                 if nid != lead)
+            c._redirect(follower_addr)  # point the client off-leader
+            c.end_session()
+            wait_for(lambda: not _has(probe, "_election/x"), timeout_s=10,
+                     desc="revoke committed via leader hint")
+        finally:
+            c.close()
+            probe.close()
+            for s in servers.values():
+                s.close()
+
+    def test_watch_push_across_replicas(self, tmp_path):
+        """A watch on one replica sees writes committed via the leader;
+        revisions (raft indices) dedupe across failover."""
+        servers, peers = _quorum_plane(tmp_path)
+        writer = KvdClient(",".join(peers.values()))
+        lead = next(nid for nid, s in servers.items() if s.is_leader)
+        follower_addr = next(a for nid, a in peers.items() if nid != lead)
+        watcher = KvdClient(",".join(peers.values()))
+        watcher._targets = [follower_addr] + [
+            a for a in peers.values() if a != follower_addr]
+        got = []
+        try:
+            watcher.watch("cfg", lambda k, vv: got.append(vv))
+            writer.set("cfg", b"v1")
+            wait_for(lambda: any(vv and vv.data == b"v1" for vv in got),
+                     desc="committed write pushed through a follower")
+        finally:
+            writer.close()
+            watcher.close()
+            for s in servers.values():
+                s.close()
 
 
 def _has(client, key) -> bool:
