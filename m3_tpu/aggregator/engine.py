@@ -232,6 +232,15 @@ class Aggregator:
     def flush(self, now_ns: int) -> list[AggregatedMetric]:
         """Close every window whose end + buffer_past has passed and emit
         its aggregates; still-open windows are carried to the next flush."""
+        from m3_tpu.utils import trace
+        from m3_tpu.utils.instrument import default_registry
+
+        with trace.span(trace.AGG_FLUSH), \
+                default_registry().root_scope("aggregator").histogram(
+                    "flush_seconds"):
+            return self._flush_traced(now_ns)
+
+    def _flush_traced(self, now_ns: int) -> list[AggregatedMetric]:
         # fault point BEFORE any buffer is taken: an injected failure here
         # leaves every pending sample buffered for the next flush tick
         # (chaos tests assert a failed flush never drops closed windows)

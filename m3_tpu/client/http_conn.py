@@ -49,12 +49,16 @@ class HTTPNodeConnection:
         return c
 
     def _request(self, method: str, path: str, body: bytes | None = None):
+        from m3_tpu.utils import trace
+
+        # the active trace context rides every node RPC as a W3C-style
+        # traceparent header, so node-side spans join the caller's trace
+        headers = trace.inject_headers({"Content-Type": "application/json"})
         last_err: Exception | None = None
         for attempt in range(2):  # one transparent reconnect for stale conns
             c = self._conn()
             try:
-                c.request(method, path, body=body,
-                          headers={"Content-Type": "application/json"})
+                c.request(method, path, body=body, headers=headers)
                 r = c.getresponse()
                 payload = r.read()
                 if r.status >= 400:
@@ -169,6 +173,12 @@ class HTTPNodeConnection:
         })
         return [base64.b64decode(v)
                 for v in self._request("GET", f"/label_values?{qs}") or []]
+
+    def debug_traces(self, trace_id: str) -> list[dict]:
+        """The node's spans for one trace (coordinator-side stitching)."""
+        doc = self._request(
+            "GET", f"/debug/traces?trace_id={trace_id}") or {}
+        return doc.get("spans", [])
 
     def health(self) -> bool:
         try:

@@ -35,9 +35,12 @@ from m3_tpu.cluster.topology import (
     required_acks,
 )
 from m3_tpu.storage.buffer import merge_dedup
-from m3_tpu.utils import faults
+from m3_tpu.utils import faults, trace
 from m3_tpu.utils.hash import murmur3_32
+from m3_tpu.utils.instrument import default_registry
 from m3_tpu.utils.warnings import ReadWarning
+
+_scope = default_registry().root_scope("session")
 
 
 class NodeConnection(Protocol):
@@ -81,6 +84,9 @@ class Session:
         # concurrent writers race host_policy's check-then-insert; a lock
         # keeps one HostPolicy (and so one breaker state) per host
         self._policies_lock = threading.Lock()
+        # per-host latency observers (racing first-writes both bind the
+        # same underlying histogram entry, so last-wins is harmless)
+        self._host_observers: dict[str, object] = {}
         # partial-result contract: when a read meets its consistency level
         # but some replica failed, the read SUCCEEDS and the degraded legs
         # are recorded here (reset per fetch/fetch_many call) and in the
@@ -106,17 +112,36 @@ class Session:
                 self._policies[host] = pol
             return pol
 
-    def _host_call(self, host: str, fn, *args, **kwargs):
-        pol = self.host_policy(host)
-        if faults.enabled():
-            # inject INSIDE the policy wrapper so the host's breaker and
-            # retry accounting see injected failures exactly like real ones
-            def faulted(*a, **k):
-                faults.check("session.host_call", host=host)
-                return fn(*a, **k)
+    def _observe_host(self, host: str):
+        """Cached per-host latency observer (hosts come from the bounded
+        topology): avoids rebuilding a subscope + metric key per RPC on
+        this hot fan-out seam."""
+        obs = self._host_observers.get(host)
+        if obs is None:
+            obs = _scope.subscope("host_call", host=host) \
+                .histogram_handle("seconds")
+            self._host_observers[host] = obs
+        return obs
 
-            return pol.call(faulted, *args, **kwargs)
-        return pol.call(fn, *args, **kwargs)
+    def _host_call(self, host: str, fn, *args, **kwargs):
+        import time as _time
+
+        pol = self.host_policy(host)
+        observe = self._observe_host(host)
+        t0 = _time.perf_counter()
+        try:
+            if faults.enabled():
+                # inject INSIDE the policy wrapper so the host's breaker
+                # and retry accounting see injected failures exactly like
+                # real ones
+                def faulted(*a, **k):
+                    faults.check("session.host_call", host=host)
+                    return fn(*a, **k)
+
+                return pol.call(faulted, *args, **kwargs)
+            return pol.call(fn, *args, **kwargs)
+        finally:
+            observe(_time.perf_counter() - t0)
 
     def _shard(self, series_id: bytes) -> int:
         return murmur3_32(series_id, self.shard_seed) % self.topology.n_shards
@@ -283,6 +308,13 @@ class Session:
         some series below the read consistency level; otherwise the batch
         succeeds and each failed leg is reported as a ReadWarning via
         self.last_warnings / the warnings out-param."""
+        with trace.span(trace.SESSION_FETCH, series=len(series_ids)), \
+                _scope.histogram("fetch_many_seconds"):
+            return self._fetch_many_traced(namespace, series_ids, start_ns,
+                                           end_ns, warnings)
+
+    def _fetch_many_traced(self, namespace, series_ids, start_ns, end_ns,
+                           warnings):
         self.last_warnings = []  # never serve a prior call's warnings
         if is_unstrict(self.read_consistency):
             need = 1

@@ -62,6 +62,14 @@ import time
 from dataclasses import dataclass
 
 from m3_tpu.utils import faults
+from m3_tpu.utils.instrument import default_registry
+
+# replication-seam latency distributions, pre-registered via handles so
+# /metrics exposes the consensus seams (zero-count) from process start:
+# append-entries handling, and submit->apply commit latency (RaftNode.wait)
+_scope = default_registry().root_scope("consensus")
+_observe_append = _scope.histogram_handle("append_seconds")
+_observe_commit = _scope.histogram_handle("commit_seconds")
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -376,6 +384,13 @@ class RaftNode:
 
     def _handle_append(self, req: dict) -> dict:
         faults.check("consensus.append", node=self.node_id)
+        t0 = time.perf_counter()
+        try:
+            return self._handle_append_timed(req)
+        finally:
+            _observe_append(time.perf_counter() - t0)
+
+    def _handle_append_timed(self, req: dict) -> dict:
         with self._lock:
             if req["term"] < self.term:
                 return {"term": self.term, "ok": False}
@@ -506,6 +521,7 @@ class RaftNode:
             if acks >= self.majority:
                 faults.check("consensus.commit", node=self.node_id, index=n)
                 self.commit_index = n
+                _scope.counter("commits")
                 self._apply_committed()
                 break
 
@@ -555,7 +571,12 @@ class RaftNode:
     def wait(self, ticket: Ticket, timeout_s: float = 10.0):
         """Block until the ticket's entry applies; returns apply_fn's
         result. Raises CommandLost if the slot committed under a different
-        term (leadership was lost and the log rewritten)."""
+        term (leadership was lost and the log rewritten).
+
+        A successful wait records the submit->apply latency into the
+        consensus commit histogram — the consensus-plane price of every
+        replicated mutation (single-node raft included)."""
+        t0 = time.perf_counter()
         deadline = time.monotonic() + timeout_s
         with self._cond:
             while True:
@@ -566,6 +587,7 @@ class RaftNode:
                         raise CommandLost(
                             f"index {ticket.index} committed at term {term}, "
                             f"submitted at {ticket.term}")
+                    _observe_commit(time.perf_counter() - t0)
                     return result
                 if self.last_applied >= ticket.index:
                     raise CommandLost(f"result for {ticket.index} evicted")

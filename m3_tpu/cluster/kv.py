@@ -272,6 +272,13 @@ class FileKVStore(KVStore):
         # kvd.persist injects failures BEFORE any byte lands and
         # kvd.persist.write can tear the tmp file — either way the
         # committed journal under the final name stays intact
+        from m3_tpu.utils.instrument import default_registry
+
+        with default_registry().root_scope("kvd").histogram(
+                "persist_seconds"):
+            self._persist_timed()
+
+    def _persist_timed(self) -> None:
         faults.check("kvd.persist")
         tmp = self._path + ".tmp"
         payload = json.dumps(

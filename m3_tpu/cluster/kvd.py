@@ -289,15 +289,31 @@ class KvdServer:
         # has per-key watches only, so intercept its notify fanout)
         self._wrap_store_notifications()
 
+        def traced(name, fn):
+            # server half of kvd trace propagation: clients send their
+            # context as gRPC metadata; the handler's span (and anything
+            # the state machine does under it) joins the caller's trace
+            def call(req, ctx):
+                from m3_tpu.utils import trace as _trace
+
+                tctx = _trace.from_grpc_context(ctx)
+                if tctx is None:
+                    return fn(req, ctx)
+                with _trace.activate(tctx), \
+                        _trace.span(_trace.KVD_HANDLE, method=name):
+                    return fn(req, ctx)
+
+            return call
+
         handlers_unary = {
-            "Get": self._get,
-            "Set": self._set,
-            "Cas": self._cas,
-            "Delete": self._delete,
-            "Keys": self._keys,
-            "LeaseGrant": self._lease_grant,
+            "Get": traced("Get", self._get),
+            "Set": traced("Set", self._set),
+            "Cas": traced("Cas", self._cas),
+            "Delete": traced("Delete", self._delete),
+            "Keys": traced("Keys", self._keys),
+            "LeaseGrant": traced("LeaseGrant", self._lease_grant),
             "LeaseKeepAlive": self._lease_keepalive,
-            "LeaseRevoke": self._lease_revoke,
+            "LeaseRevoke": traced("LeaseRevoke", self._lease_revoke),
             "Health": lambda req, ctx: b"ok",
             "Status": self._status,
             "Raft": self._raft_rpc,
@@ -407,7 +423,9 @@ class KvdServer:
     def _propose(self, cmd: dict, timeout_s: float = 10.0) -> dict:
         """Run a command through the replicated log; returns the apply
         result once a MAJORITY committed it. NotLeader propagates to the
-        caller (mapped to a notleader hint for clients)."""
+        caller (mapped to a notleader hint for clients). The
+        submit -> majority-commit latency lands in the consensus commit
+        histogram (recorded by RaftNode.wait)."""
         ticket = self._raft.submit(json.dumps(cmd).encode())
         self._driver.poke()  # replicate now, not at the next tick
         return self._raft.wait(ticket, timeout_s)
@@ -1047,6 +1065,8 @@ class KvdClient(KVStore):
         follow ``notleader:<addr>`` hints from quorum-mode followers (a
         fresh election may leave the hint empty for a round — then rotate
         and retry); single-target clients retry on server restart."""
+        from m3_tpu.utils import trace
+
         attempts = max(8, 2 * len(self._targets) + 4)
         last_exc: Exception | None = None
         for i in range(attempts):
@@ -1054,7 +1074,11 @@ class KvdClient(KVStore):
                 # injected transport faults drive the same rotate/retry
                 # failover path a dead kvd does
                 faults.check("kvd.rpc", method=name, target=self.target)
-                resp = _dec_resp(self._stub(name)(req, timeout=self.timeout_s))
+                with trace.span(trace.KVD_RPC, method=name,
+                                target=self.target):
+                    resp = _dec_resp(self._stub(name)(
+                        req, timeout=self.timeout_s,
+                        metadata=trace.grpc_metadata()))
             except Exception as e:  # noqa: BLE001 - grpc transport error
                 last_exc = e
                 self._rotate()

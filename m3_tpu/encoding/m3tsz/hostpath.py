@@ -169,6 +169,10 @@ def decode_streams_batch(streams: list[bytes | None], unit: TimeUnit,
     fast rungs reject (annotation/time-unit markers) degrade per stream,
     never the whole group.
     """
+    import time as _time
+
+    from m3_tpu.utils import querystats, trace
+
     empty = (np.empty(0, np.int64), np.empty(0, np.uint64))
     out: list = [empty] * len(streams)
     todo = [i for i, s in enumerate(streams) if s]
@@ -180,41 +184,76 @@ def decode_streams_batch(streams: list[bytes | None], unit: TimeUnit,
     dispatch.counters["m3tsz_decode_batch_groups"] += 1
     forced = _forced_batch_path()
     decoded = None
+    rung = "scalar"
     use_device = forced == "device" or (not forced and _device_decode())
     use_native = forced == "native" or (not forced and not use_device)
-    if use_device:
-        decoded = _decode_streams_device(subset, unit, int_optimized)
-    if decoded is None and use_native and not int_optimized:
-        from m3_tpu.encoding.m3tsz import native
+    with trace.span(trace.DECODE_BATCH, streams=len(subset)) as sp:
+        t0 = _time.perf_counter()
+        if use_device:
+            decoded = _decode_streams_device(subset, unit, int_optimized)
+            rung = "device"
+        if decoded is None and use_native and not int_optimized:
+            from m3_tpu.encoding.m3tsz import native
 
-        if native.available():
-            try:
-                t, v, ns = native.decode_batch(subset, unit)
-            except ValueError:
-                # a marker-bearing stream poisons the whole native batch:
-                # degrade per stream (decode_stream isolates the bad ones)
-                decoded = [decode_stream(s, unit, int_optimized)
-                           for s in subset]
-            else:
-                dispatch.counters["m3tsz_decode_native_batch"] += 1
-                decoded = [(t[b, : int(ns[b])].copy(),
-                            v[b, : int(ns[b])].copy())
-                           for b in range(len(subset))]
-    if decoded is None:
-        from m3_tpu.encoding.m3tsz import decode as scalar_decode
+            if native.available():
+                try:
+                    t, v, ns = native.decode_batch(subset, unit)
+                except ValueError:
+                    # a marker-bearing stream poisons the whole native
+                    # batch: degrade per stream (decode_stream isolates
+                    # the bad ones)
+                    decoded = [decode_stream(s, unit, int_optimized)
+                               for s in subset]
+                else:
+                    dispatch.counters["m3tsz_decode_native_batch"] += 1
+                    decoded = [(t[b, : int(ns[b])].copy(),
+                                v[b, : int(ns[b])].copy())
+                               for b in range(len(subset))]
+                    rung = "native"
+        if decoded is None:
+            from m3_tpu.encoding.m3tsz import decode as scalar_decode
 
-        dispatch.counters["m3tsz_decode_scalar_batch"] += 1
-        decoded = []
-        for s in subset:
-            dps = scalar_decode(s, int_optimized=int_optimized,
-                                default_time_unit=unit)
-            if not dps:
-                decoded.append(empty)
-                continue
-            t = np.array([d.timestamp_ns for d in dps], np.int64)
-            v = np.array([np.float64(d.value) for d in dps],
-                         np.float64).view(np.uint64)
-            decoded.append((t, v))
+            dispatch.counters["m3tsz_decode_scalar_batch"] += 1
+            decoded = []
+            for s in subset:
+                dps = scalar_decode(s, int_optimized=int_optimized,
+                                    default_time_unit=unit)
+                if not dps:
+                    decoded.append(empty)
+                    continue
+                t = np.array([d.timestamp_ns for d in dps], np.int64)
+                v = np.array([np.float64(d.value) for d in dps],
+                             np.float64).view(np.uint64)
+                decoded.append((t, v))
+        dt = _time.perf_counter() - t0
+        # device-op profiling: which rung served this group (visible on
+        # /metrics per rung), how long it took, how many bytes it chewed —
+        # the per-query record gets the same attribution
+        n_bytes = sum(len(s) for s in subset)
+        sc = _decode_scope(rung)
+        sc.observe("seconds", dt)
+        sc.counter("streams", len(subset))
+        sc.counter("bytes", n_bytes)
+        querystats.record(blocks_read=1, bytes_decoded=n_bytes,
+                          decode_rung=rung)
+        if sp is not None:
+            sp.tags["path"] = rung
+            sp.tags["bytes"] = n_bytes
     for i, r in zip(todo, decoded):
         out[i] = r
     return out
+
+
+_decode_scopes: dict = {}
+
+
+def _decode_scope(rung: str):
+    """Cached per-rung metrics scope (decode.batch{path=rung})."""
+    sc = _decode_scopes.get(rung)
+    if sc is None:
+        from m3_tpu.utils.instrument import default_registry
+
+        sc = default_registry().root_scope("decode").subscope("batch",
+                                                              path=rung)
+        _decode_scopes[rung] = sc
+    return sc

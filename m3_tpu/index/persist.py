@@ -66,13 +66,17 @@ def persist_index(index: NamespaceIndex, root: str, namespace: str,
         # file — either way the committed segment under the final name
         # stays intact and bootstrap falls back to the tag-scan rebuild.
         faults.check("index.persist", block=bs)
+        from m3_tpu.utils.instrument import default_registry
+
         raw = payload + struct.pack(">I", zlib.adler32(payload))
         tmp = _path(root, namespace, bs) + ".tmp"
-        with open(tmp, "wb") as f:
-            faults.torn_write(f, raw, "index.persist.write")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, _path(root, namespace, bs))
+        with default_registry().root_scope("index").histogram(
+                "persist_seconds"):
+            with open(tmp, "wb") as f:
+                faults.torn_write(f, raw, "index.persist.write")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, _path(root, namespace, bs))
         # record the POST-compact doc count: pre-compact sums double-count
         # series duplicated across segments and would mask later inserts
         blk.persisted_docs = blk.sealed[0].n_docs

@@ -11,10 +11,16 @@ from __future__ import annotations
 
 import socket
 import threading
+from contextlib import nullcontext as _nullcontext
 from typing import Callable
 
 from m3_tpu.msg.protocol import recv_frame, send_frame
-from m3_tpu.utils import faults
+from m3_tpu.utils import faults, trace
+from m3_tpu.utils.instrument import default_registry
+
+_scope = default_registry().root_scope("msg")
+# pre-resolved: this seam runs once per ingested frame
+_observe_recv = _scope.histogram_handle("recv_seconds")
 
 
 class Consumer:
@@ -67,7 +73,21 @@ class Consumer:
                 if header.get("type") != "msg":
                     continue
                 try:
-                    self.handler(header.get("shard", 0), payload)
+                    # the envelope's trace context (if any) wraps the
+                    # handler, so downstream writes join the publisher's
+                    # trace; the recv histogram times handler + delivery
+                    import time as _time
+
+                    ctx = trace.parse_traceparent(header.get("tp"))
+                    t0 = _time.perf_counter()
+                    try:
+                        with trace.activate(ctx) if ctx is not None else \
+                                _nullcontext(), \
+                                trace.span(trace.MSG_RECV,
+                                           shard=header.get("shard", 0)):
+                            self.handler(header.get("shard", 0), payload)
+                    finally:
+                        _observe_recv(_time.perf_counter() - t0)
                     self.num_processed += 1
                 except Exception:
                     continue  # no ack -> producer redelivers

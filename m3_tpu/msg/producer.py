@@ -14,10 +14,16 @@ from __future__ import annotations
 import socket
 import threading
 import time
+from contextlib import nullcontext as _nullcontext
 from dataclasses import dataclass
 
 from m3_tpu.msg.protocol import recv_frame, send_frame
-from m3_tpu.utils import faults
+from m3_tpu.utils import faults, trace
+from m3_tpu.utils.instrument import default_registry
+
+_scope = default_registry().root_scope("msg")
+# pre-resolved: this seam runs once per sent frame
+_observe_send = _scope.histogram_handle("send_seconds")
 
 
 @dataclass
@@ -27,6 +33,9 @@ class _Pending:
     payload: bytes
     sent_at: float = 0.0
     attempts: int = 0
+    # publisher's trace context (traceparent string): rides the frame
+    # envelope so the consumer's handler spans join the publishing trace
+    tp: str | None = None
 
 
 class Producer:
@@ -82,7 +91,10 @@ class Producer:
                         self.on_drop(dropped)
             msg_id = self._next_id
             self._next_id += 1
-            self._pending[msg_id] = _Pending(msg_id, shard, payload)
+            ctx = trace.current()
+            self._pending[msg_id] = _Pending(
+                msg_id, shard, payload,
+                tp=ctx.to_traceparent() if ctx is not None else None)
             self._queue.append(msg_id)
             self._queued.add(msg_id)
             self._cv.notify()
@@ -144,12 +156,20 @@ class Producer:
             if p is None:
                 continue  # acked while queued
             try:
-                faults.check("msg.producer.send", msg_id=p.msg_id)
-                send_frame(
-                    self._sock,
-                    {"type": "msg", "id": p.msg_id, "shard": p.shard},
-                    p.payload,
-                )
+                header = {"type": "msg", "id": p.msg_id, "shard": p.shard}
+                if p.tp:
+                    header["tp"] = p.tp  # envelope trace propagation
+                ctx = trace.parse_traceparent(p.tp)
+                t0 = time.perf_counter()
+                try:
+                    with trace.activate(ctx) if ctx is not None else \
+                            _nullcontext(), \
+                            trace.span(trace.MSG_SEND, msg_id=p.msg_id,
+                                       shard=p.shard):
+                        faults.check("msg.producer.send", msg_id=p.msg_id)
+                        send_frame(self._sock, header, p.payload)
+                finally:
+                    _observe_send(time.perf_counter() - t0)
                 with self._lock:
                     p.sent_at = time.monotonic()
                     p.attempts += 1

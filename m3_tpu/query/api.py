@@ -171,6 +171,38 @@ class CoordinatorAPI:
         self.writer = None
         # optional AdminAPI (namespace/placement/topic CRUD; query/admin.py)
         self.admin = None
+        # per-namespace engine cache for ?namespace= query routing (the
+        # self-monitoring loop's _m3_system namespace is queried this way)
+        self._engines: dict[str, Engine] = {namespace: self.engine}
+        self._engines_lock = threading.Lock()
+        from m3_tpu.utils.instrument import default_registry
+
+        self._scope = default_registry().root_scope("coordinator")
+
+    # bound on cached per-namespace engines: namespaces are operator-
+    # created (bounded), but the ?namespace= value is client-supplied
+    MAX_ENGINES = 64
+
+    def _engine_for(self, namespace: str) -> Engine:
+        # validate before caching: an unknown namespace must not grow the
+        # cache (fanout facades union remote zones, so remote-only names
+        # are only checkable there at query time — they still pass)
+        if namespace != self.namespace \
+                and namespace not in self.db.namespaces \
+                and not getattr(self.db, "zones", None):
+            raise ValueError(f"unknown namespace {namespace!r}")
+        with self._engines_lock:
+            eng = self._engines.get(namespace)
+            if eng is None:
+                if len(self._engines) >= self.MAX_ENGINES:
+                    # drop an arbitrary non-default entry (engines are
+                    # cheap to rebuild; correctness never depends on one)
+                    for key in list(self._engines):
+                        if key != self.namespace:
+                            del self._engines[key]
+                            break
+                eng = self._engines[namespace] = Engine(self.db, namespace)
+        return eng
 
     def _write(self, name: bytes, tags, t_ns: int, value: float):
         if self.writer is not None:
@@ -181,35 +213,53 @@ class CoordinatorAPI:
 
     # -- request handling --
 
-    def handle(self, method: str, path: str, query: dict, body: bytes):
+    def handle(self, method: str, path: str, query: dict, body: bytes,
+               headers=None):
         """Returns (status, content_type, payload, headers) — routes may
-        return the legacy 3-tuple; headers default to {}."""
+        return the legacy 3-tuple; headers default to {}.
+
+        Trace ingress: the head-based sampling decision for the whole
+        request is made HERE (or honored from a propagated `traceparent`
+        in `headers`), every downstream hop — engine, session, storage
+        nodes — follows it, and the response echoes the trace id in an
+        `M3-Trace-Id` header so a slow query is one /debug/traces lookup
+        away."""
+        from m3_tpu.utils import trace
+
         # one resource budget per request, enforced in the storage read
         # path (covers PromQL, Graphite render, and remote read alike)
         limits = getattr(self.db, "limits", None)
+        ctx = trace.start_request(headers)
         try:
             if limits is not None:
                 limits.start_query()
-            res = self._route(method, path, query, body)
-            return res if len(res) == 4 else (*res, {})
+            with trace.activate(ctx), \
+                    trace.span(trace.API_REQUEST, path=path, method=method), \
+                    self._scope.histogram("request_seconds"):
+                res = self._route(method, path, query, body)
+            status, ctype, payload, hdrs = res if len(res) == 4 \
+                else (*res, {})
         except QueryLimitError as e:
-            return 422, "application/json", json.dumps(
+            status, ctype, payload, hdrs = 422, "application/json", json.dumps(
                 {"status": "error", "errorType": "query_limit", "error": str(e)}
             ).encode(), {}
         except Exception as e:  # surface as prometheus-style error envelope
-            return 400, "application/json", json.dumps(
+            status, ctype, payload, hdrs = 400, "application/json", json.dumps(
                 {"status": "error", "errorType": "bad_data", "error": str(e)}
             ).encode(), {}
         finally:
             if limits is not None:
                 limits.end_query()
+        if trace.default_tracer().enabled:
+            hdrs = {**hdrs, "M3-Trace-Id": ctx.trace_id}
+        return status, ctype, payload, hdrs
 
-    def _warning_headers(self) -> dict:
+    def _warning_headers(self, engine=None) -> dict:
         """PR-2 partial-result contract, threaded out to HTTP: one
         M3-Warnings header value per degraded read leg (failed session
         host, skipped fanout zone) recorded by the engine for THIS query.
         An absent header means the result is complete."""
-        warns = getattr(self.engine, "last_warnings", None)
+        warns = getattr(engine or self.engine, "last_warnings", None)
         if not warns:
             return {}
         return {"M3-Warnings": ",".join(str(w) for w in warns)}
@@ -243,11 +293,13 @@ class CoordinatorAPI:
         if path == "/debug/dump":
             return self._debug_dump()
         if path == "/debug/traces":
-            from m3_tpu.utils.trace import default_tracer
+            return self._debug_traces(method, q, body)
+        if path == "/debug/slow_queries":
+            from m3_tpu.utils import querystats
 
-            limit = int(q.get("limit", ["200"])[0])
+            limit = int(q.get("limit", ["50"])[0])
             return 200, "application/json", json.dumps(
-                {"spans": default_tracer().recent(limit)}
+                {"queries": querystats.slow_queries(limit)}
             ).encode()
         if path == "/api/v1/prom/remote/write" and method == "POST":
             return self._remote_write(body)
@@ -276,6 +328,59 @@ class CoordinatorAPI:
             return self._graphite_find(q)
         return 404, "application/json", json.dumps(
             {"status": "error", "error": f"unknown path {path}"}
+        ).encode()
+
+    def _debug_traces(self, method, q, body: bytes):
+        """GET: recent spans, or — with ?trace_id= — the ONE stitched
+        cross-process tree for that trace: local ring spans merged with
+        every storage node's (cluster session connections expose
+        /debug/traces on the node API). POST: runtime toggle
+        ({"enabled": bool, "sample_every": int})."""
+        from m3_tpu.utils import trace
+
+        tracer = trace.default_tracer()
+        if method == "POST":
+            doc = json.loads(body or b"{}")
+            if "enabled" in doc:
+                tracer.enabled = bool(doc["enabled"])
+            if "sample_every" in doc:
+                tracer.sample_every = max(1, int(doc["sample_every"]))
+            return 200, "application/json", json.dumps(
+                {"enabled": tracer.enabled,
+                 "sample_every": tracer.sample_every}
+            ).encode()
+        trace_id = q.get("trace_id", [None])[0]
+        if not trace_id:
+            limit = int(q.get("limit", ["200"])[0])
+            return 200, "application/json", json.dumps(
+                {"spans": tracer.recent(limit)}
+            ).encode()
+        spans = tracer.find(trace_id)
+        # cluster mode: gather the nodes' halves of the trace (their spans
+        # live in their own process rings)
+        session = getattr(self.db, "session", None)
+        for host, conn in (getattr(session, "connections", None) or {}).items():
+            fetch = getattr(conn, "debug_traces", None)
+            if fetch is None:
+                continue
+            try:
+                spans.extend(fetch(trace_id))
+            except Exception:  # noqa: BLE001 - a dead node must not hide
+                continue      # the rest of the trace
+        # dedupe by span id: in-process test topologies (and co-located
+        # services) share one ring, so the same span can arrive twice
+        seen: set[str] = set()
+        unique = []
+        for s in spans:
+            sid = s.get("span_id") or ""
+            if sid and sid in seen:
+                continue
+            seen.add(sid)
+            unique.append(s)
+        spans = sorted(unique, key=lambda s: s.get("start_unix_ns", 0))
+        return 200, "application/json", json.dumps(
+            {"trace_id": trace_id, "count": len(spans), "spans": spans,
+             "tree": trace.build_tree(spans)}
         ).encode()
 
     def _debug_dump(self):
@@ -478,15 +583,22 @@ class CoordinatorAPI:
         payload = snappy.compress(protowire.encode_read_response(results))
         return 200, "application/x-protobuf", payload
 
+    def _query_engine(self, q) -> Engine:
+        """Engine for the request's ?namespace= (default: the configured
+        one) — how PromQL reaches the `_m3_system` self-monitoring tier."""
+        ns = q.get("namespace", [self.namespace])[0]
+        return self._engine_for(ns)
+
     def _query_range(self, q):
         expr = q["query"][0]
         start = _parse_time(q["start"][0])
         end = _parse_time(q["end"][0])
         step = _parse_step(q["step"][0])
-        result, eval_ts = self.engine.query_range(expr, start, end, step)
+        engine = self._query_engine(q)
+        result, eval_ts = engine.query_range(expr, start, end, step)
         return (200, "application/json",
-                self._render(result, eval_ts, matrix=True),
-                self._warning_headers())
+                self._render(result, eval_ts, matrix=True, engine=engine),
+                self._warning_headers(engine))
 
     def _m3ql_query_range(self, q):
         """M3QL pipe-syntax range query (the reference's experimental
@@ -494,14 +606,17 @@ class CoordinatorAPI:
         AST and evaluate on the shared engine."""
         from m3_tpu.query import m3ql
 
-        expr = m3ql.parse(q["query"][0])
+        raw = q["query"][0]
+        expr = m3ql.parse(raw)
         start = _parse_time(q["start"][0])
         end = _parse_time(q["end"][0])
         step = _parse_step(q["step"][0])
-        result, eval_ts = self.engine.query_range_expr(expr, start, end, step)
+        engine = self._query_engine(q)
+        result, eval_ts = engine.query_range_expr(expr, start, end, step,
+                                                  query_text=raw)
         return (200, "application/json",
-                self._render(result, eval_ts, matrix=True),
-                self._warning_headers())
+                self._render(result, eval_ts, matrix=True, engine=engine),
+                self._warning_headers(engine))
 
     def _query_instant(self, q):
         expr = q["query"][0]
@@ -510,12 +625,13 @@ class CoordinatorAPI:
             import time as _time
 
             t = _time.time_ns()
-        result, eval_ts = self.engine.query_instant(expr, t)
+        engine = self._query_engine(q)
+        result, eval_ts = engine.query_instant(expr, t)
         return (200, "application/json",
-                self._render(result, eval_ts, matrix=False),
-                self._warning_headers())
+                self._render(result, eval_ts, matrix=False, engine=engine),
+                self._warning_headers(engine))
 
-    def _render(self, result, eval_ts, matrix: bool):
+    def _render(self, result, eval_ts, matrix: bool, engine=None):
         ts_sec = eval_ts.astype(np.float64) / NS
         if isinstance(result, Scalar):
             if matrix:
@@ -573,11 +689,17 @@ class CoordinatorAPI:
         else:
             data = {"resultType": "string", "result": [ts_sec[0], result.value]}
         doc = {"status": "success", "data": data}
+        engine = engine or self.engine
         # prometheus envelope convention: a top-level "warnings" list
         # accompanies a SUCCEEDING partial result (mirrors M3-Warnings)
-        warns = getattr(self.engine, "last_warnings", None)
+        warns = getattr(engine, "last_warnings", None)
         if warns:
             doc["warnings"] = [str(w) for w in warns]
+        # per-query stats (series matched, blocks read, bytes decoded,
+        # cache hit/miss, decode rungs, stage timings) ride the envelope
+        stats = getattr(engine, "last_stats", None)
+        if stats is not None:
+            doc["stats"] = stats.to_dict()
         return json.dumps(doc).encode()
 
     def _time_range(self, q):
@@ -633,7 +755,7 @@ class CoordinatorAPI:
                     except UnicodeDecodeError:
                         pass  # mislabeled binary body; routes read it raw
                 status, ctype, payload, headers = api.handle(
-                    method, u.path, q, body)
+                    method, u.path, q, body, headers=self.headers)
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))

@@ -140,6 +140,14 @@ class Engine:
         each other's warnings."""
         return getattr(self._warn_tls, "last", [])
 
+    @property
+    def last_stats(self):
+        """QueryStats of the last query evaluated ON THIS THREAD (same
+        thread-local discipline as last_warnings): series matched, blocks
+        read, bytes decoded, cache hit/miss, decode rungs, stage timings.
+        The HTTP layer embeds it in the response envelope under `stats`."""
+        return getattr(self._warn_tls, "last_stats", None)
+
     def _active_limits(self) -> "QueryLimits":
         """The CURRENT database-wide binding (storage accounting consults
         db.limits, so activation must target the same object even if
@@ -148,10 +156,10 @@ class Engine:
 
     def query_range(self, q: str, start_ns: int, end_ns: int, step_ns: int):
         return self.query_range_expr(promql.parse(q), start_ns, end_ns,
-                                     step_ns)
+                                     step_ns, query_text=q)
 
     def query_range_expr(self, expr: Expr, start_ns: int, end_ns: int,
-                         step_ns: int):
+                         step_ns: int, query_text: str = ""):
         """Evaluate a pre-parsed AST (PromQL or any front-end compiling to
         it — M3QL, Graphite-on-tags) over the step grid."""
         if step_ns <= 0:
@@ -160,14 +168,21 @@ class Engine:
         limits = self._active_limits()
         limits.check_steps(len(eval_ts))
         limits.start_query()
-        from m3_tpu.utils import trace
+        from m3_tpu.utils import querystats, trace
 
         self._warn_tls.sink = sink = []
+        st = querystats.start(query=query_text, namespace=self.namespace)
         try:
-            with trace.span(trace.ENGINE_QUERY, steps=len(eval_ts)):
-                _resolve_at_sentinels(expr, int(eval_ts[0]), int(eval_ts[-1]))
-                return self._eval(expr, eval_ts), eval_ts
+            with trace.span(trace.ENGINE_QUERY, steps=len(eval_ts)) as sp:
+                if sp is not None:
+                    st.trace_id = sp.trace_id
+                with querystats.stage("eval"):
+                    _resolve_at_sentinels(expr, int(eval_ts[0]),
+                                          int(eval_ts[-1]))
+                    return self._eval(expr, eval_ts), eval_ts
         finally:
+            querystats.finish(st)
+            self._warn_tls.last_stats = st
             self._warn_tls.sink = None
             self._warn_tls.last = sink
             limits.end_query()
@@ -176,12 +191,21 @@ class Engine:
         eval_ts = np.array([t_ns], dtype=np.int64)
         limits = self._active_limits()
         limits.start_query()
+        from m3_tpu.utils import querystats, trace
+
         self._warn_tls.sink = sink = []
+        st = querystats.start(query=q, namespace=self.namespace)
         try:
-            expr = promql.parse(q)
-            _resolve_at_sentinels(expr, t_ns, t_ns)
-            return self._eval(expr, eval_ts), eval_ts
+            with trace.span(trace.ENGINE_QUERY, steps=1) as sp:
+                if sp is not None:
+                    st.trace_id = sp.trace_id
+                with querystats.stage("eval"):
+                    expr = promql.parse(q)
+                    _resolve_at_sentinels(expr, t_ns, t_ns)
+                    return self._eval(expr, eval_ts), eval_ts
         finally:
+            querystats.finish(st)
+            self._warn_tls.last_stats = st
             self._warn_tls.sink = None
             self._warn_tls.last = sink
             limits.end_query()

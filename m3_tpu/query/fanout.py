@@ -122,6 +122,15 @@ class FanoutNamespace:
         the surviving zones' merge plus one ReadWarning per skipped zone
         (self.last_warnings / the warnings out-param) — never an
         exception."""
+        from m3_tpu.utils import trace
+
+        with trace.span(trace.FANOUT_READ, namespace=self.name,
+                        series=len(series_ids),
+                        zones=len(self._fdb.zones)):
+            return self._read_many_traced(series_ids, start_ns, end_ns,
+                                          warnings)
+
+    def _read_many_traced(self, series_ids, start_ns, end_ns, warnings):
         warns: list[ReadWarning] = []
         local = self._local
         if local is not None:

@@ -34,6 +34,8 @@ from concurrent import futures
 
 import numpy as np
 
+from contextlib import nullcontext as _nullcontext
+
 from m3_tpu.utils.protowire import field_bytes, field_varint, iter_fields
 
 _SERVICE = "m3.remote.Query"
@@ -186,11 +188,40 @@ class RemoteQueryServer:
         self.db = db
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers))
+        from m3_tpu.utils.instrument import default_registry
+
+        scope = default_registry().root_scope("remote")
+
+        def traced(name, fn):
+            # server half of cross-zone trace propagation: the client sent
+            # the coordinator's context as gRPC metadata; this zone's spans
+            # join that trace (and honor its sampling decision); the
+            # per-method histogram feeds this zone's /metrics
+            observe = scope.subscope("serve", method=name) \
+                .histogram_handle("seconds")
+
+            def call(req, ctx):
+                import time as _time
+
+                from m3_tpu.utils import trace
+
+                tctx = trace.from_grpc_context(ctx)
+                t0 = _time.perf_counter()
+                try:
+                    with trace.activate(tctx) if tctx is not None else \
+                            _nullcontext():
+                        with trace.span(f"query.remote.{name}"):
+                            return fn(req, ctx)
+                finally:
+                    observe(_time.perf_counter() - t0)
+
+            return call
+
         handlers = {
-            "QueryIds": self._query_ids,
-            "ReadMany": self._read_many,
-            "LabelNames": self._labels,
-            "LabelValues": self._labels,
+            "QueryIds": traced("query_ids", self._query_ids),
+            "ReadMany": traced("read_many", self._read_many),
+            "LabelNames": traced("label_names", self._labels),
+            "LabelValues": traced("label_values", self._labels),
             "Health": lambda req, ctx: b"ok",
         }
 
@@ -266,6 +297,14 @@ class RemoteZone:
                 self._stubs[method] = st
         return st
 
+    def _call(self, method: str, req: bytes):
+        """One unary call carrying the active trace context as metadata,
+        so the remote zone's spans stitch into this coordinator's trace."""
+        from m3_tpu.utils import trace
+
+        return self._stub(method)(req, timeout=self.timeout_s,
+                                  metadata=trace.grpc_metadata())
+
     def close(self) -> None:
         with self._lock:
             if self._channel is not None:
@@ -277,25 +316,23 @@ class RemoteZone:
 
     def query_ids(self, namespace: str, query_json: dict, start: int,
                   end: int, limit=None):
-        resp = self._stub("QueryIds")(
-            _enc_query_ids_req(namespace, query_json, start, end, limit),
-            timeout=self.timeout_s)
+        resp = self._call("QueryIds", _enc_query_ids_req(
+            namespace, query_json, start, end, limit))
         return [_dec_doc(d) for d in _dec_repeated(resp)]
 
     def read_many(self, namespace: str, series_ids, start: int, end: int):
-        resp = self._stub("ReadMany")(
-            _enc_read_many_req(namespace, series_ids, start, end),
-            timeout=self.timeout_s)
+        resp = self._call("ReadMany", _enc_read_many_req(
+            namespace, series_ids, start, end))
         return [_dec_series(s) for s in _dec_repeated(resp)]
 
     def label_names(self, namespace: str, start: int, end: int):
-        resp = self._stub("LabelNames")(
-            _enc_labels_req(namespace, b"", start, end), timeout=self.timeout_s)
+        resp = self._call("LabelNames", _enc_labels_req(
+            namespace, b"", start, end))
         return _dec_repeated(resp)
 
     def label_values(self, namespace: str, field: bytes, start: int, end: int):
-        resp = self._stub("LabelValues")(
-            _enc_labels_req(namespace, field, start, end), timeout=self.timeout_s)
+        resp = self._call("LabelValues", _enc_labels_req(
+            namespace, field, start, end))
         return _dec_repeated(resp)
 
     def healthy(self) -> bool:

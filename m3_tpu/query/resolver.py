@@ -98,18 +98,24 @@ def fetch_tagged(db, namespaces: list[str], index_query, t_min: int,
     per-call thread-safe channel — never read back from shared facade
     state, which concurrent queries would cross-contaminate.
     """
+    from m3_tpu.utils import querystats
+
     by_id: dict[bytes, list] = {}  # id -> [doc, times, vbits]
     empties: dict[bytes, object] = {}  # matched but no samples anywhere
     for ns_name in namespaces:
         ns = db.namespaces[ns_name]
         kw = {"warnings": warnings} if warnings is not None and \
             getattr(ns, "supports_read_warnings", False) else {}
-        if limit is not None:
-            docs = ns.query_ids(index_query, t_min, t_max, limit=limit, **kw)
-        else:
-            docs = ns.query_ids(index_query, t_min, t_max, **kw)
+        with querystats.stage("query_ids"):
+            if limit is not None:
+                docs = ns.query_ids(index_query, t_min, t_max, limit=limit,
+                                    **kw)
+            else:
+                docs = ns.query_ids(index_query, t_min, t_max, **kw)
+        querystats.record(series_matched=len(docs))
         ids = [d.series_id for d in docs]
-        results = ns.read_many(ids, t_min, t_max, **kw)
+        with querystats.stage("read_many"):
+            results = ns.read_many(ids, t_min, t_max, **kw)
         for doc, (times, vbits) in zip(docs, results):
             if len(times) == 0:
                 if keep_empty and doc.series_id not in by_id:

@@ -157,6 +157,23 @@ class CoordinatorService:
             placement_key=cl_cfg.get("placement_key"),
         )
         self.carbon: CarbonIngester | None = None
+        # M3-monitors-M3: optional self-scrape loop ingesting this
+        # process's metrics registry into the `_m3_system` namespace so
+        # platform p99s are queryable with the platform's own PromQL
+        # (?namespace=_m3_system on the query endpoints)
+        sm_cfg = config.get("self_monitor", {}) or {}
+        self.self_monitor = None
+        if sm_cfg.get("enabled"):
+            from m3_tpu.utils.selfscrape import SELF_NAMESPACE, SelfMonitor
+
+            self.self_monitor = SelfMonitor(
+                self.db,
+                interval_s=float(sm_cfg.get("interval_s", 10.0)),
+                namespace=sm_cfg.get("namespace", SELF_NAMESPACE),
+            )
+            if not self.self_monitor.enabled:
+                self.log.info("self-monitor disabled: no local storage "
+                              "namespace available")
         self._stop = threading.Event()
 
     def _apply_ruleset(self, rs) -> None:
@@ -313,6 +330,8 @@ class CoordinatorService:
                             scope.counter("downsample_flushed", flushed)
                         stats = self.db.tick()
                         scope.counter("blocks_flushed", stats["flushed"])
+                        if self.self_monitor is not None:
+                            self.self_monitor.maybe_scrape()
                 except Exception as e:  # noqa: BLE001 - a transient KV/IO
                     # error must not kill the long-running coordinator
                     self.log.info("tick error; continuing", error=str(e))

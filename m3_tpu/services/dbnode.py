@@ -21,7 +21,7 @@ from urllib.parse import parse_qs, urlparse
 from m3_tpu.services.coordinator import namespace_options
 from m3_tpu.storage.database import Database
 from m3_tpu.storage.options import DatabaseOptions
-from m3_tpu.utils import faults
+from m3_tpu.utils import faults, trace
 from m3_tpu.utils.config import load_config
 from m3_tpu.utils.instrument import Logger, default_registry
 
@@ -29,11 +29,45 @@ from m3_tpu.utils.instrument import Logger, default_registry
 class NodeAPI:
     """The node RPC surface (write/read/blocks-metadata/blocks-stream)."""
 
+    # the routed surface; unknown paths share one histogram label so a
+    # port scanner cannot grow metric cardinality without bound
+    KNOWN_PATHS = frozenset({
+        "/health", "/bootstrapped", "/metrics", "/debug/traces", "/write",
+        "/write_batch", "/read_batch", "/read", "/query_ids",
+        "/label_names", "/label_values", "/blocks/starts",
+        "/blocks/metadata", "/blocks/stream",
+    })
+
     def __init__(self, db: Database):
         self.db = db
         self._server: ThreadingHTTPServer | None = None
+        scope = default_registry().root_scope("dbnode")
+        # per-path latency histograms, pre-resolved (bounded set)
+        self._observe_handle = {
+            p: scope.subscope("handle", path=p).histogram_handle("seconds")
+            for p in self.KNOWN_PATHS
+        }
+        self._observe_other = scope.subscope(
+            "handle", path="other").histogram_handle("seconds")
 
-    def handle(self, method, path, q, body):
+    def handle(self, method, path, q, body, headers=None):
+        """One node RPC. A propagated `traceparent` header joins this
+        node's spans (request handling, storage read, decode rung) to the
+        coordinator's trace; the per-path latency histogram feeds the
+        node's /metrics."""
+        import time as _time
+
+        ctx = trace.start_request(headers)
+        observe = self._observe_handle.get(path, self._observe_other)
+        t0 = _time.perf_counter()
+        try:
+            with trace.activate(ctx), \
+                    trace.span(trace.DBNODE_HANDLE, path=path):
+                return self._handle_traced(method, path, q, body)
+        finally:
+            observe(_time.perf_counter() - t0)
+
+    def _handle_traced(self, method, path, q, body):
         try:
             if path in ("/health", "/bootstrapped"):
                 # exempt from injection so orchestrators can still see the
@@ -44,6 +78,8 @@ class NodeAPI:
             faults.check("dbnode.handle", path=path)
             if path == "/metrics":
                 return 200, default_registry().render_prometheus()
+            if path == "/debug/traces":
+                return self._debug_traces(method, q, body)
             if path == "/write" and method == "POST":
                 doc = json.loads(body)
                 if "tags_b64" in doc:  # binary-safe wire (tags are bytes)
@@ -187,6 +223,26 @@ class NodeAPI:
         except Exception as e:
             return 400, json.dumps({"error": str(e)}).encode()
 
+    def _debug_traces(self, method, q, body):
+        """Node half of the distributed-trace surface: the coordinator's
+        /debug/traces?trace_id= gathers these to stitch the full tree.
+        POST toggles recording ({"enabled": bool, "sample_every": int})."""
+        tracer = trace.default_tracer()
+        if method == "POST":
+            doc = json.loads(body or b"{}")
+            if "enabled" in doc:
+                tracer.enabled = bool(doc["enabled"])
+            if "sample_every" in doc:
+                tracer.sample_every = max(1, int(doc["sample_every"]))
+            return 200, json.dumps(
+                {"enabled": tracer.enabled,
+                 "sample_every": tracer.sample_every}).encode()
+        trace_id = q.get("trace_id", [None])[0]
+        if trace_id:
+            return 200, json.dumps({"spans": tracer.find(trace_id)}).encode()
+        limit = int(q.get("limit", ["200"])[0])
+        return 200, json.dumps({"spans": tracer.recent(limit)}).encode()
+
     def serve(self, host="0.0.0.0", port=9000) -> int:
         api = self
 
@@ -195,7 +251,8 @@ class NodeAPI:
                 u = urlparse(self.path)
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
-                status, payload = api.handle(method, u.path, parse_qs(u.query), body)
+                status, payload = api.handle(method, u.path, parse_qs(u.query),
+                                             body, headers=self.headers)
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))

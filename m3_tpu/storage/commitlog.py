@@ -32,8 +32,21 @@ import zlib
 from dataclasses import dataclass
 
 from m3_tpu.utils import faults
+from m3_tpu.utils.instrument import default_registry
 
 _MAGIC = 0xC0881706
+
+# fsync latency distribution — the durability seam whose p99 bounds write
+# ack latency; exposed as db_commitlog_fsync_seconds_bucket on /metrics
+_scope = default_registry().root_scope("db")
+
+
+def _fsync_timed(fileno: int) -> None:
+    import time as _time
+
+    t0 = _time.perf_counter()
+    os.fsync(fileno)
+    _scope.observe("commitlog_fsync_seconds", _time.perf_counter() - t0)
 
 
 @dataclass
@@ -104,7 +117,7 @@ class CommitLogWriter:
             if not self._buf:
                 if fsync:
                     faults.check("commitlog.fsync")
-                    os.fsync(self._f.fileno())
+                    _fsync_timed(self._f.fileno())
                 return
             payload = bytes(self._buf)
             self._buf.clear()
@@ -116,7 +129,7 @@ class CommitLogWriter:
             self._f.flush()
             if fsync:
                 faults.check("commitlog.fsync")
-                os.fsync(self._f.fileno())
+                _fsync_timed(self._f.fileno())
         except BaseException as e:
             self._failed = e
             raise

@@ -20,8 +20,15 @@ from m3_tpu.storage import commitlog
 from m3_tpu.storage.namespace import Namespace
 from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions
 from m3_tpu.storage.sharding import ShardSet
+from m3_tpu.utils.instrument import default_registry
 
 log = logging.getLogger(__name__)
+
+# write-seam latency histogram (p50/p99 derivable from /metrics _bucket
+# series); the handle pre-resolves the metric key so the per-datapoint
+# hot path pays one lock + bisect per observation, nothing more
+_scope = default_registry().root_scope("db")
+_observe_write = _scope.histogram_handle("write_seconds")
 
 
 @dataclass
@@ -364,6 +371,7 @@ class Database:
 
     def write(self, namespace: str, series_id: bytes, t_ns: int, value: float,
               encoded_tags: bytes = b"") -> None:
+        t0 = time.perf_counter()
         ns = self.namespaces[namespace]
         shard = ns.shard_for(series_id)  # validate ownership BEFORE logging
         vbits = _f64_to_bits(value)
@@ -378,12 +386,14 @@ class Database:
             from m3_tpu.utils.ident import decode_tags
 
             ns.index.insert(series_id, decode_tags(encoded_tags), t_ns)
+        _observe_write(time.perf_counter() - t0)
 
     def write_tagged(self, namespace: str, metric_name: bytes,
                      tags: list[tuple[bytes, bytes]], t_ns: int, value: float) -> bytes:
         """Write + index a datapoint; returns the canonical series id."""
         from m3_tpu.utils.ident import encode_tags, tags_to_id
 
+        t0 = time.perf_counter()
         ns = self.namespaces[namespace]
         fields = [(b"__name__", metric_name), *tags] if metric_name else list(tags)
         series_id = tags_to_id(metric_name, tags)
@@ -397,6 +407,7 @@ class Database:
         shard.write(series_id, t_ns, vbits, enc)
         if ns.index is not None:
             ns.index.insert(series_id, fields, t_ns)
+        _observe_write(time.perf_counter() - t0)
         return series_id
 
     def query(self, namespace: str, matchers, start_ns: int, end_ns: int,

@@ -122,14 +122,18 @@ class FilesetWriter:
         """temp + fsync + rename: the final name only ever points at a
         complete, durable file (a crash leaves at most a .tmp, which
         list_filesets/bootstrap never look at)."""
+        from m3_tpu.utils.instrument import default_registry
+
         faults.check("fileset.persist", suffix=suffix)
         path = self._path(suffix)
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            faults.torn_write(f, payload, "fileset.write")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        with default_registry().root_scope("fileset").histogram(
+                "persist_seconds"):
+            with open(tmp, "wb") as f:
+                faults.torn_write(f, payload, "fileset.write")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
 
     def close(self) -> dict:
         os.makedirs(os.path.dirname(self._path("info")), exist_ok=True)

@@ -128,6 +128,16 @@ class Namespace:
         configured, shard batches are chunked so the limit still bounds
         decode WORK (an over-limit query aborts after at most one chunk
         of extra decode, not after materializing the whole match set)."""
+        from m3_tpu.utils import trace
+        from m3_tpu.utils.instrument import default_registry
+
+        with trace.span(trace.READ_MANY, namespace=self.name,
+                        series=len(series_ids)), \
+                default_registry().root_scope("db") \
+                .histogram("read_many_seconds"):
+            return self._read_many_traced(series_ids, start_ns, end_ns)
+
+    def _read_many_traced(self, series_ids, start_ns, end_ns):
         by_shard: dict[int, list[int]] = {}
         for i, shard_id in enumerate(self.shard_set.lookup_many(series_ids)):
             if shard_id not in self.shards:

@@ -134,16 +134,23 @@ class HTTPPeer:
     def _fetch(self, path: str):
         import urllib.error
 
-        faults.check("peer.http", url=self.base + path)
-        try:
-            with urllib.request.urlopen(self.base + path,
-                                        timeout=self.timeout) as r:
-                return json.loads(r.read())
-        except urllib.error.HTTPError as e:
-            if 400 <= e.code < 500:
-                raise PeerClientError(
-                    f"{e.code} from {self.base}{path}") from e
-            raise
+        from m3_tpu.utils import trace
+        from m3_tpu.utils.instrument import default_registry
+
+        with trace.span(trace.PEER_HTTP, peer=self.base), \
+                default_registry().root_scope("peer").histogram(
+                    "http_seconds"):
+            faults.check("peer.http", url=self.base + path)
+            req = urllib.request.Request(self.base + path,
+                                         headers=trace.inject_headers())
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                if 400 <= e.code < 500:
+                    raise PeerClientError(
+                        f"{e.code} from {self.base}{path}") from e
+                raise
 
     def block_starts(self, namespace, shard):
         from urllib.parse import quote

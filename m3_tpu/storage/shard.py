@@ -169,6 +169,10 @@ class Shard:
                         parts[i].append((ct, cv))
                     continue
                 miss_idx.append(i)
+            from m3_tpu.utils import querystats
+
+            querystats.record(cache_hits=n - len(miss_idx),
+                              cache_misses=len(miss_idx))
             if not miss_idx:
                 continue
             # batched fetch: one merge-join walk of the volume's index for
@@ -316,8 +320,12 @@ class Shard:
             r.close()
 
     def _flush_traced(self, block_start: int) -> bool:
-        with self._maint_lock:
-            return self._flush_locked(block_start)
+        from m3_tpu.utils.instrument import default_registry
+
+        with default_registry().root_scope("db").histogram(
+                "shard_flush_seconds"):
+            with self._maint_lock:
+                return self._flush_locked(block_start)
 
     def _flush_locked(self, block_start: int) -> bool:
         from m3_tpu.encoding.m3tsz import hostpath

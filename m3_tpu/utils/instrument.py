@@ -1,20 +1,25 @@
 """Instrumentation: metrics scopes + structured logging.
 
 Role parity with the reference's x/instrument (tally scopes + zap logging):
-a process-local metrics registry with counters/gauges/timers and tagged
-subscopes, exportable in Prometheus text format (served on /metrics by the
-services), plus a minimal structured logger. The platform monitors itself
-with the same metric model it stores.
+a process-local metrics registry with counters/gauges/timers/histograms and
+tagged subscopes, exportable in strict Prometheus text format (served on
+/metrics by the services, `# TYPE` metadata + escaped labels + safe
+NaN/Inf), plus a minimal structured logger. The platform monitors itself
+with the same metric model it stores: the coordinator's self-scrape loop
+(utils/selfscrape.py) ingests this registry into the `_m3_system`
+namespace so p99s over these histograms are one PromQL query away.
 """
 
 from __future__ import annotations
 
+import bisect
 import json
+import math
 import sys
 import threading
 import time
 from collections import defaultdict
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 
 @dataclass
@@ -32,6 +37,53 @@ class _Timer:
     count: int = 0
     total_s: float = 0.0
     max_s: float = 0.0
+
+
+# log-bucketed histogram bounds: powers of two from ~1us to ~64s — 14
+# buckets per 1000x decade, enough that p99 interpolation error stays
+# under ~2x anywhere in the range while one histogram costs ~30 ints
+DEFAULT_BUCKETS: tuple = tuple(2.0 ** e for e in range(-20, 7))
+
+
+@dataclass
+class _Histogram:
+    bounds: tuple = DEFAULT_BUCKETS
+    counts: list = field(default_factory=lambda: [0] * (len(DEFAULT_BUCKETS) + 1))
+    sum: float = 0.0
+    count: int = 0
+
+    def observe_locked(self, value: float) -> None:
+        """Record one observation; caller holds the registry lock."""
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.sum += value
+        self.count += 1
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        """[(upper_bound, cumulative_count)] incl. the +Inf bucket."""
+        out = []
+        running = 0
+        for ub, c in zip(self.bounds, self.counts):
+            running += c
+            out.append((ub, running))
+        out.append((math.inf, running + self.counts[-1]))
+        return out
+
+    def quantile(self, q: float) -> float:
+        """Interpolated quantile (the histogram_quantile rule) — used by
+        in-process consumers (slow-query thresholds, tests)."""
+        if self.count == 0:
+            return math.nan
+        rank = q * self.count
+        running = 0
+        prev_ub = 0.0
+        for ub, c in zip(self.bounds, self.counts):
+            if running + c >= rank:
+                if c == 0:
+                    return ub
+                return prev_ub + (ub - prev_ub) * (rank - running) / c
+            running += c
+            prev_ub = ub
+        return self.bounds[-1]
 
 
 class Scope:
@@ -77,6 +129,75 @@ class Scope:
 
         return _Ctx()
 
+    def observe(self, name: str, value: float) -> None:
+        """One histogram observation (seconds for latency seams). Unlike a
+        timer, the distribution survives: p50/p99 are derivable from the
+        `_bucket` exposition instead of only count/total/max."""
+        with self._registry._lock:
+            self._registry.histograms[(self._name(name), self._tags)] \
+                .observe_locked(value)
+
+    def histogram(self, name: str):
+        """Context manager observing a duration into the histogram."""
+        scope = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                scope.observe(name, time.perf_counter() - self.t0)
+
+        return _Ctx()
+
+    def histogram_handle(self, name: str):
+        """Pre-resolved observe(value) callable for HOT paths: the metric
+        key is built once here and the closure binds everything it touches,
+        so each observation is a bisect (outside the lock — bounds are
+        immutable) plus three adds under a bare acquire/release. Scope
+        .observe rebuilds the key string and enters a context manager per
+        call — measurably slower on per-datapoint seams."""
+        reg = self._registry
+        with reg._lock:
+            h = reg.histograms[(self._name(name), self._tags)]
+        acquire = reg._lock.acquire
+        release = reg._lock.release
+        bounds = h.bounds
+        counts = h.counts
+        _bisect = bisect.bisect_left
+
+        def observe(value: float) -> None:
+            i = _bisect(bounds, value)
+            acquire()
+            counts[i] += 1
+            h.sum += value
+            h.count += 1
+            release()
+
+        return observe
+
+
+def _prom_name(name: str) -> str:
+    return name.replace(".", "_").replace("-", "_")
+
+
+def _escape_label(v) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_number(value) -> str:
+    """Exposition-safe value: NaN / +Inf / -Inf tokens, floats via repr."""
+    v = float(value)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 2 ** 53:
+        return str(int(v))
+    return repr(v)
+
 
 class MetricsRegistry:
     def __init__(self) -> None:
@@ -84,31 +205,77 @@ class MetricsRegistry:
         self.counters: dict = defaultdict(_Counter)
         self.gauges: dict = defaultdict(_Gauge)
         self.timers: dict = defaultdict(_Timer)
+        self.histograms: dict = defaultdict(_Histogram)
 
     def root_scope(self, prefix: str = "") -> Scope:
         return Scope(self, prefix)
 
-    def render_prometheus(self) -> bytes:
-        """Prometheus text exposition of everything recorded."""
-        out = []
-
-        def fmt(name, tags, value):
-            name = name.replace(".", "_").replace("-", "_")
-            if tags:
-                t = ",".join(f'{k}="{v}"' for k, v in tags)
-                out.append(f"{name}{{{t}}} {value}")
-            else:
-                out.append(f"{name} {value}")
-
+    def snapshot(self):
+        """Point-in-time copy of every metric, one lock acquisition:
+        (counters, gauges, timers, histograms) dicts keyed (name, tags).
+        Histogram entries are (bounds, counts, sum, count) tuples."""
         with self._lock:
-            for (name, tags), c in sorted(self.counters.items()):
-                fmt(name, tags, c.value)
-            for (name, tags), g in sorted(self.gauges.items()):
-                fmt(name, tags, g.value)
-            for (name, tags), t in sorted(self.timers.items()):
-                fmt(name + "_count", tags, t.count)
-                fmt(name + "_total_seconds", tags, round(t.total_s, 9))
-                fmt(name + "_max_seconds", tags, round(t.max_s, 9))
+            counters = {k: c.value for k, c in self.counters.items()}
+            gauges = {k: g.value for k, g in self.gauges.items()}
+            timers = {k: (t.count, t.total_s, t.max_s)
+                      for k, t in self.timers.items()}
+            hists = {k: (h.bounds, list(h.counts), h.sum, h.count)
+                     for k, h in self.histograms.items()}
+        return counters, gauges, timers, hists
+
+    def render_prometheus(self) -> bytes:
+        """Strict Prometheus text exposition: `# TYPE` metadata per family,
+        escaped label values, NaN/±Inf rendered as exposition tokens, and
+        histograms as cumulative `_bucket`/`_sum`/`_count` series. The
+        device-dispatch counters (utils/dispatch) are merged in so the
+        XLA / native / scalar path choice is visible on /metrics."""
+        out: list[str] = []
+        typed: set[str] = set()
+
+        def fmt(name, tags, value, mtype=None):
+            name = _prom_name(name)
+            if mtype is not None and name not in typed:
+                typed.add(name)
+                out.append(f"# TYPE {name} {mtype}")
+            if tags:
+                t = ",".join(f'{k}="{_escape_label(v)}"' for k, v in tags)
+                out.append(f"{name}{{{t}}} {_fmt_number(value)}")
+            else:
+                out.append(f"{name} {_fmt_number(value)}")
+
+        counters, gauges, timers, hists = self.snapshot()
+        for (name, tags), v in sorted(counters.items()):
+            fmt(name, tags, v, "counter")
+        for (name, tags), v in sorted(gauges.items()):
+            fmt(name, tags, v, "gauge")
+        for (name, tags), (count, total_s, max_s) in sorted(timers.items()):
+            fmt(name + "_count", tags, count, "counter")
+            fmt(name + "_total_seconds", tags, round(total_s, 9), "counter")
+            fmt(name + "_max_seconds", tags, round(max_s, 9), "gauge")
+        for (name, tags), (bounds, counts, hsum, hcount) in sorted(hists.items()):
+            h = _Histogram(bounds, counts, hsum, hcount)
+            base = _prom_name(name)
+            if base not in typed:
+                typed.add(base)
+                out.append(f"# TYPE {base} histogram")
+            for ub, cum in h.cumulative():
+                le = "+Inf" if math.isinf(ub) else _fmt_number(ub)
+                fmt(name + "_bucket", (*tags, ("le", le)), cum)
+            fmt(name + "_sum", tags, round(hsum, 9))
+            fmt(name + "_count", tags, hcount)
+        # device-dispatch path counters ("op" or "op[path]" keys)
+        try:
+            from m3_tpu.utils import dispatch
+
+            items = sorted(dispatch.counters.items())
+        except Exception:  # noqa: BLE001 - never break /metrics
+            items = []
+        for key, v in items:
+            op, _, path = key.partition("[")
+            tags = (("op", op),)
+            if path:
+                tags += (("path", path.rstrip("]")),)
+            fmt("m3_dispatch_ops_total", tags, v, "counter")
         return ("\n".join(out) + "\n").encode()
 
 

@@ -1,0 +1,173 @@
+"""Per-query statistics + the slow-query log.
+
+Role parity with the reference's query diagnostics (per-fetch result
+metadata + slow-query logging in the coordinator): a `QueryStats` record
+rides a thread-local through the engine -> resolver -> storage -> decode
+call stack, so every layer can account what THIS query cost without
+threading a parameter through a dozen signatures:
+
+- the engine opens/finishes the record (query text, namespace, trace id,
+  total duration) and exposes it per thread as `Engine.last_stats`;
+- the resolver records series matched and per-stage durations
+  (query_ids / read_many);
+- the block cache records hits/misses, the decode ladder records which
+  rung served each (shard, block, volume) group and the bytes decoded.
+
+Finished records land in a bounded ring served at /debug/slow_queries;
+`M3_TPU_SLOW_QUERY_MS` sets the admission threshold (default 0: every
+query is kept — the ring IS the query log until an operator raises the
+bar). The HTTP layer embeds the record in the response envelope under
+`stats`.
+
+In cluster mode the storage/decode counters accrue on the STORAGE node
+processes (their own /metrics histograms cover them); the coordinator's
+record still carries matching, stage timing and duration.
+
+Overhead when no query is active: each hook is one thread-local read.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class QueryStats:
+    query: str = ""
+    namespace: str = ""
+    start_unix_ns: int = 0
+    trace_id: str = ""
+    series_matched: int = 0
+    blocks_read: int = 0
+    bytes_decoded: int = 0
+    cache_hits: int = 0
+    cache_misses: int = 0
+    # decode rung -> groups served (device / native / scalar / cache)
+    decode_rungs: dict = field(default_factory=dict)
+    # stage name -> seconds (query_ids, read_many, eval)
+    stages: dict = field(default_factory=dict)
+    duration_s: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "query": self.query,
+            "namespace": self.namespace,
+            "start_unix_ns": self.start_unix_ns,
+            "trace_id": self.trace_id,
+            "duration_ms": round(self.duration_s * 1e3, 3),
+            "series_matched": self.series_matched,
+            "blocks_read": self.blocks_read,
+            "bytes_decoded": self.bytes_decoded,
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "decode_rungs": dict(self.decode_rungs),
+            "stages_ms": {k: round(v * 1e3, 3) for k, v in self.stages.items()},
+        }
+
+
+_tls = threading.local()
+_ring_lock = threading.Lock()
+_ring: deque[QueryStats] = deque(maxlen=256)
+
+
+def _env_threshold_s() -> float:
+    try:
+        return float(os.environ.get("M3_TPU_SLOW_QUERY_MS", "0")) / 1e3
+    except ValueError:
+        return 0.0
+
+
+_threshold_s = _env_threshold_s()
+
+
+def set_threshold_ms(ms: float) -> None:
+    """Admission threshold for the slow-query ring (0 keeps everything)."""
+    global _threshold_s
+    _threshold_s = max(0.0, float(ms)) / 1e3
+
+
+def current() -> QueryStats | None:
+    return getattr(_tls, "current", None)
+
+
+def start(query: str = "", namespace: str = "") -> QueryStats:
+    """Open a record for this thread's query. Nested engines (subqueries,
+    front-ends compiling through the same engine) keep the OUTER record:
+    the inner call gets the same object back with a depth mark, and only
+    the matching outermost `finish` closes it."""
+    cur = getattr(_tls, "current", None)
+    if cur is not None:
+        cur._depth = getattr(cur, "_depth", 0) + 1  # type: ignore[attr-defined]
+        return cur
+    st = QueryStats(query=query, namespace=namespace,
+                    start_unix_ns=time.time_ns())
+    st._t0 = time.perf_counter()  # type: ignore[attr-defined]
+    st._depth = 0  # type: ignore[attr-defined]
+    _tls.current = st
+    return st
+
+
+def finish(st: QueryStats) -> None:
+    """Close the record, stamp duration, admit to the ring. A nested
+    finish (depth > 0) only pops one level — the outer query keeps
+    accruing; object identity alone can't tell owner from nested caller
+    since start() hands the same record back."""
+    if getattr(_tls, "current", None) is not st:
+        return
+    depth = getattr(st, "_depth", 0)
+    if depth > 0:
+        st._depth = depth - 1  # type: ignore[attr-defined]
+        return
+    _tls.current = None
+    st.duration_s = time.perf_counter() - getattr(st, "_t0", time.perf_counter())
+    if st.duration_s >= _threshold_s:
+        with _ring_lock:
+            _ring.append(st)
+
+
+def record(series_matched: int = 0, blocks_read: int = 0,
+           bytes_decoded: int = 0, cache_hits: int = 0,
+           cache_misses: int = 0, decode_rung: str | None = None) -> None:
+    """Accrue deltas onto the active query's record (no-op outside one)."""
+    st = getattr(_tls, "current", None)
+    if st is None:
+        return
+    st.series_matched += series_matched
+    st.blocks_read += blocks_read
+    st.bytes_decoded += bytes_decoded
+    st.cache_hits += cache_hits
+    st.cache_misses += cache_misses
+    if decode_rung is not None:
+        st.decode_rungs[decode_rung] = st.decode_rungs.get(decode_rung, 0) + 1
+
+
+@contextmanager
+def stage(name: str):
+    """Time a named stage of the active query (no-op outside one)."""
+    st = getattr(_tls, "current", None)
+    if st is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        st.stages[name] = st.stages.get(name, 0.0) + time.perf_counter() - t0
+
+
+def slow_queries(limit: int = 50) -> list[dict]:
+    """Ring contents, slowest first."""
+    with _ring_lock:
+        entries = list(_ring)
+    entries.sort(key=lambda s: s.duration_s, reverse=True)
+    return [s.to_dict() for s in entries[:limit]]
+
+
+def clear() -> None:
+    with _ring_lock:
+        _ring.clear()

@@ -1,0 +1,130 @@
+"""Self-monitoring loop: ingest the process's own metrics registry into a
+`_m3_system` namespace — M3 monitors M3.
+
+The reference deployment scrapes each component's /metrics with a separate
+Prometheus and often remote-writes that back into M3. This module closes
+the loop in-process: a scrape snapshots utils/instrument's registry (one
+lock acquisition) and writes every sample through the normal ingest path
+into a dedicated namespace, so platform health — including p99s over the
+latency histograms, via histogram_quantile over the `_bucket` series — is
+queryable with the platform's own PromQL (`?namespace=_m3_system` on the
+query endpoints).
+
+Series naming mirrors the Prometheus exposition exactly (name mangling,
+`_bucket`/`_sum`/`_count` suffixes, `le` labels), so dashboards written
+against /metrics port to PromQL over `_m3_system` unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+from m3_tpu.utils.instrument import (
+    MetricsRegistry,
+    _fmt_number,
+    _prom_name,
+    default_registry,
+)
+
+SELF_NAMESPACE = "_m3_system"
+
+
+def ensure_namespace(db, namespace: str = SELF_NAMESPACE) -> bool:
+    """Create the self-monitoring namespace on the LOCAL storage under
+    `db` (facades unwrap to their local zone). False when there is no
+    local storage to host it — a pure cluster-client coordinator
+    (ClusterDatabase) routes writes to nodes that never registered the
+    namespace, so self-scrape stays off there."""
+    target = getattr(db, "local", db)
+    create = getattr(target, "create_namespace", None)
+    # a real local Database owns a block cache; client facades don't
+    if create is None or getattr(target, "block_cache", None) is None:
+        return False
+    create(namespace)
+    return True
+
+
+def _write(db, namespace: str, name: str, tags, t_ns: int, value: float,
+           extra_tags: tuple = ()) -> int:
+    if math.isnan(value) or math.isinf(value):
+        return 0  # not representable as a sane sample; /metrics still has it
+    fields = sorted(
+        [(str(k).encode(), str(v).encode()) for k, v in tags]
+        + [(str(k).encode(), str(v).encode()) for k, v in extra_tags]
+    )
+    db.write_tagged(namespace, _prom_name(name).encode(), fields, t_ns,
+                    float(value))
+    return 1
+
+
+def scrape_once(db, registry: MetricsRegistry | None = None,
+                namespace: str = SELF_NAMESPACE,
+                now_ns: int | None = None) -> int:
+    """One self-scrape: registry snapshot -> series writes. Returns the
+    number of samples written. The caller created the namespace
+    (ensure_namespace) — a missing one raises like any bad write."""
+    registry = registry or default_registry()
+    now_ns = now_ns if now_ns is not None else time.time_ns()
+    counters, gauges, timers, hists = registry.snapshot()
+    n = 0
+    for (name, tags), v in counters.items():
+        n += _write(db, namespace, name, tags, now_ns, v)
+    for (name, tags), v in gauges.items():
+        n += _write(db, namespace, name, tags, now_ns, v)
+    for (name, tags), (count, total_s, max_s) in timers.items():
+        n += _write(db, namespace, name + "_count", tags, now_ns, count)
+        n += _write(db, namespace, name + "_total_seconds", tags, now_ns,
+                    total_s)
+        n += _write(db, namespace, name + "_max_seconds", tags, now_ns, max_s)
+    for (name, tags), (bounds, counts, hsum, hcount) in hists.items():
+        running = 0
+        for ub, c in zip(bounds, counts):
+            running += c
+            n += _write(db, namespace, name + "_bucket", tags, now_ns,
+                        running, extra_tags=(("le", _fmt_number(ub)),))
+        n += _write(db, namespace, name + "_bucket", tags, now_ns,
+                    running + counts[-1], extra_tags=(("le", "+Inf"),))
+        n += _write(db, namespace, name + "_sum", tags, now_ns, hsum)
+        n += _write(db, namespace, name + "_count", tags, now_ns, hcount)
+    # device-dispatch path counters, same shape /metrics exposes them in
+    # (m3_dispatch_ops_total{op,path}) so dashboards port unchanged
+    try:
+        from m3_tpu.utils import dispatch
+
+        items = sorted(dispatch.counters.items())
+    except Exception:  # noqa: BLE001 - never break the scrape
+        items = []
+    for key, v in items:
+        op, _, path = key.partition("[")
+        tags = (("op", op),) + ((("path", path.rstrip("]")),) if path else ())
+        n += _write(db, namespace, "m3_dispatch_ops_total", tags, now_ns, v)
+    return n
+
+
+class SelfMonitor:
+    """Tick-driven self-scrape for a service loop: call `maybe_scrape()`
+    every tick; it scrapes when `interval_s` has elapsed."""
+
+    def __init__(self, db, interval_s: float = 10.0,
+                 namespace: str = SELF_NAMESPACE, registry=None,
+                 clock=time.monotonic):
+        self.db = db
+        self.interval_s = interval_s
+        self.namespace = namespace
+        self.registry = registry or default_registry()
+        self._clock = clock
+        self._last = 0.0
+        self.samples_written = 0
+        self.enabled = ensure_namespace(db, namespace)
+
+    def maybe_scrape(self, now_ns: int | None = None) -> int:
+        if not self.enabled:
+            return 0
+        now = self._clock()
+        if now - self._last < self.interval_s:
+            return 0
+        self._last = now
+        n = scrape_once(self.db, self.registry, self.namespace, now_ns)
+        self.samples_written += n
+        return n

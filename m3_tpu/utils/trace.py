@@ -1,23 +1,45 @@
-"""Named tracepoints + in-process span recording.
+"""Distributed tracing: named tracepoints, span identity, W3C propagation.
 
 Role parity with the reference's OpenTracing plumbing
 (/root/reference/src/dbnode/tracepoint/tracepoint.go named operation
-constants, x/context StartSampledTraceSpan, x/opentracing/tracing.go): hot
-paths open named spans that nest via a thread-local stack and land in a
-bounded ring buffer exposed at /debug/traces. Sampling keeps the
-steady-state cost to a perf_counter call; an OTLP-style exporter can drain
-the ring without touching the serving path.
+constants, x/context StartSampledTraceSpan, x/opentracing/tracing.go),
+upgraded from process-local span recording to real distributed traces:
+
+- every recorded Span carries (trace_id, span_id, parent_span_id), so a
+  fan-out query stitches into ONE tree across coordinator, client session
+  and storage nodes;
+- the context propagates across processes as a W3C-`traceparent`-style
+  header (``00-<trace_id>-<span_id>-<flags>``) on HTTP requests, as gRPC
+  metadata on remote-zone/kvd RPCs, and as an envelope field on m3msg
+  frames;
+- the sampling decision is HEAD-BASED: made once at ingress
+  (``start_request``) and honored by every downstream hop via the
+  propagated flags bit, so a trace is never half-recorded;
+- spans land in a bounded per-process ring exposed at /debug/traces; the
+  coordinator's handler additionally gathers matching spans from its
+  storage nodes and returns the stitched cross-process tree.
+
+Steady-state cost: an unsampled request pays one thread-local read per
+tracepoint; a disabled tracer pays one attribute check. The sampler is a
+lock-free ``itertools.count`` (atomic under CPython), replacing the old
+documented-racy ``_counter % sample_every`` increment.
+
+``M3_TPU_TRACE_SAMPLE`` overrides the default tracer's sampling: ``0``
+disables tracing, ``N`` samples one root trace in N.
 """
 
 from __future__ import annotations
 
+import itertools
+import os
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
-# tracepoint name constants (the tracepoint.go role)
+# tracepoint name constants (the tracepoint.go role). The observability
+# check (tools/check_observability.py) asserts these values stay unique.
 DB_WRITE = "storage.db.write"
 DB_QUERY = "storage.db.query"
 INDEX_QUERY = "index.query"
@@ -25,6 +47,65 @@ SHARD_FLUSH = "storage.shard.flush"
 ENGINE_QUERY = "query.engine.query_range"
 SESSION_FETCH = "client.session.fetch_many"
 AGG_FLUSH = "aggregator.flush"
+READ_MANY = "storage.ns.read_many"
+DECODE_BATCH = "storage.decode.batch"
+DBNODE_HANDLE = "dbnode.handle"
+API_REQUEST = "query.api.request"
+FANOUT_READ = "query.fanout.read_many"
+MSG_SEND = "msg.producer.send"
+MSG_RECV = "msg.consumer.handle"
+KVD_RPC = "kvd.client.rpc"
+KVD_HANDLE = "kvd.server.handle"
+PEER_HTTP = "storage.peer.http"
+
+_ZERO_SPAN_ID = "0" * 16
+# placeholder trace id carried by a negative head decision's context —
+# never recorded, only propagated so descendants stay silent too
+_UNSAMPLED_TRACE_ID = "f" * 32
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """The propagated identity of the active span (or of the head sampling
+    decision before any span opened: span_id == "" then)."""
+
+    trace_id: str  # 32 hex chars (16 bytes)
+    span_id: str   # 16 hex chars (8 bytes); "" = decision-only context
+    sampled: bool = True
+
+    def to_traceparent(self) -> str:
+        return (f"00-{self.trace_id}-{self.span_id or _ZERO_SPAN_ID}-"
+                f"{'01' if self.sampled else '00'}")
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def parse_traceparent(value: str | None) -> SpanContext | None:
+    """``00-<32 hex>-<16 hex>-<2 hex flags>`` -> SpanContext, else None.
+    Unknown versions parse leniently (same field layout), per the W3C
+    forward-compat rule; malformed values are ignored, never raised on."""
+    if not value:
+        return None
+    parts = value.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if version == "ff" or len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+        sampled = bool(int(flags, 16) & 1)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32:
+        return None
+    return SpanContext(trace_id, span_id, sampled)
 
 
 @dataclass
@@ -32,8 +113,11 @@ class Span:
     name: str
     start_ns: int
     duration_ns: int = 0
-    parent: str | None = None
+    parent: str | None = None  # parent tracepoint NAME (legacy surface)
     tags: dict = field(default_factory=dict)
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: str | None = None
 
     def to_dict(self) -> dict:
         return {
@@ -41,12 +125,22 @@ class Span:
             "start_unix_ns": self.start_ns,
             "duration_us": round(self.duration_ns / 1000, 1),
             "parent": self.parent,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
             **({"tags": self.tags} if self.tags else {}),
         }
 
 
 class Tracer:
-    """Bounded recorder; one per process (default_tracer())."""
+    """Bounded recorder; one per process (default_tracer()).
+
+    Sampling: a tracepoint hit with NO active context is a trace root and
+    draws a head decision from the lock-free counter (1-in-sample_every).
+    A hit under an active context follows that context's decision — the
+    ingress decides once, everything below (including remote hops that
+    propagated the flags bit) honors it.
+    """
 
     def __init__(self, capacity: int = 2048, sample_every: int = 1):
         self.capacity = capacity
@@ -54,7 +148,9 @@ class Tracer:
         self._spans: deque[Span] = deque(maxlen=capacity)
         self._tl = threading.local()
         self._lock = threading.Lock()
-        self._counter = 0
+        # lock-free sampler: next() on itertools.count is atomic in
+        # CPython (a single C call), unlike the old racy `_counter += 1`
+        self._count = itertools.count()
         self.enabled = True
 
     def _stack(self) -> list:
@@ -63,31 +159,115 @@ class Tracer:
             st = self._tl.stack = []
         return st
 
+    # -- context plumbing --
+
+    def current(self) -> SpanContext | None:
+        """The active SpanContext on this thread (propagated or opened by
+        an enclosing span), or None outside any trace."""
+        return getattr(self._tl, "ctx", None)
+
+    def sample_head(self) -> bool:
+        """One head-based sampling decision (root of a new trace)."""
+        if not self.enabled:
+            return False
+        return next(self._count) % self.sample_every == 0
+
+    def start_request(self, headers=None) -> SpanContext:
+        """Ingress context: honor a propagated ``traceparent`` if present,
+        else mint a new root trace with a head sampling decision. Always
+        returns a context (so the response can echo the trace id);
+        `sampled=False` contexts make every downstream tracepoint a no-op.
+
+        `headers` is any case-insensitive-ish mapping (http.client
+        HTTPMessage, dict, or None)."""
+        tp = None
+        if headers is not None:
+            get = getattr(headers, "get", None)
+            if get is not None:
+                tp = get("traceparent") or get("Traceparent")
+        ctx = parse_traceparent(tp)
+        if ctx is not None:
+            return ctx
+        return SpanContext(new_trace_id(), "", self.sample_head())
+
+    @contextmanager
+    def activate(self, ctx: SpanContext | None):
+        """Install `ctx` as this thread's active context for the scope
+        (server-side of a propagated hop)."""
+        tl = self._tl
+        prev = getattr(tl, "ctx", None)
+        tl.ctx = ctx
+        try:
+            yield ctx
+        finally:
+            tl.ctx = prev
+
+    def inject_headers(self, extra: dict | None = None) -> dict:
+        """Headers carrying the active context ({} when none/disabled)."""
+        ctx = self.current()
+        out = dict(extra) if extra else {}
+        if ctx is not None and self.enabled:
+            out["traceparent"] = ctx.to_traceparent()
+        return out
+
+    # -- spans --
+
     @contextmanager
     def span(self, name: str, **tags):
         if not self.enabled:
             yield None
             return
-        self._counter += 1  # racy increment is fine for sampling
-        if self._counter % self.sample_every:
+        tl = self._tl
+        ctx = getattr(tl, "ctx", None)
+        if ctx is None:
+            # trace root: head decision. A NEGATIVE decision still installs
+            # a not-sampled context for the span's extent — descendant
+            # tracepoints must follow this root's decision, not draw their
+            # own (which would record orphan bottom-half trees)
+            if next(self._count) % self.sample_every:
+                tl.ctx = SpanContext(_UNSAMPLED_TRACE_ID, "", False)
+                try:
+                    yield None
+                finally:
+                    tl.ctx = None
+                return
+            trace_id = new_trace_id()
+            parent_sid: str | None = None
+        elif not ctx.sampled:
             yield None
             return
+        else:
+            trace_id = ctx.trace_id
+            parent_sid = ctx.span_id or None
+        sid = new_span_id()
         stack = self._stack()
-        parent = stack[-1] if stack else None
-        sp = Span(name, time.time_ns(), parent=parent, tags=dict(tags))
+        parent_name = stack[-1] if stack else None
+        sp = Span(name, time.time_ns(), parent=parent_name, tags=dict(tags),
+                  trace_id=trace_id, span_id=sid, parent_span_id=parent_sid)
         stack.append(name)
+        prev_ctx = ctx
+        tl.ctx = SpanContext(trace_id, sid, True)
         t0 = time.perf_counter_ns()
         try:
             yield sp
         finally:
             sp.duration_ns = time.perf_counter_ns() - t0
             stack.pop()
+            tl.ctx = prev_ctx
             with self._lock:
                 self._spans.append(sp)
+
+    # -- ring access --
 
     def recent(self, limit: int = 200) -> list[dict]:
         with self._lock:
             spans = list(self._spans)[-limit:]
+        return [s.to_dict() for s in spans]
+
+    def find(self, trace_id: str) -> list[dict]:
+        """Every ring span belonging to `trace_id`, oldest first."""
+        with self._lock:
+            spans = [s for s in self._spans if s.trace_id == trace_id]
         return [s.to_dict() for s in spans]
 
     def clear(self) -> None:
@@ -95,7 +275,48 @@ class Tracer:
             self._spans.clear()
 
 
-_default = Tracer()
+def build_tree(spans: list[dict]) -> list[dict]:
+    """Nest span dicts into parent->children trees by span id. Spans whose
+    parent_span_id is absent from the set become roots (the cross-process
+    gather may be partial); duplicates (same span_id, e.g. a span served
+    by both the local ring and a node's) dedupe, first occurrence wins."""
+    by_id: dict[str, dict] = {}
+    ordered: list[dict] = []
+    for s in spans:
+        sid = s.get("span_id") or ""
+        if sid and sid in by_id:
+            continue
+        node = {**s, "children": []}
+        if sid:
+            by_id[sid] = node
+        ordered.append(node)
+    roots = []
+    for node in ordered:
+        parent = node.get("parent_span_id")
+        if parent and parent in by_id and by_id[parent] is not node:
+            by_id[parent]["children"].append(node)
+        else:
+            roots.append(node)
+    return roots
+
+
+def _env_sample() -> tuple[int, bool]:
+    """(sample_every, enabled) from M3_TPU_TRACE_SAMPLE (0 disables)."""
+    raw = os.environ.get("M3_TPU_TRACE_SAMPLE", "")
+    if not raw:
+        return 1, True
+    try:
+        n = int(raw)
+    except ValueError:
+        return 1, True
+    if n <= 0:
+        return 1, False
+    return n, True
+
+
+_sample_every, _enabled = _env_sample()
+_default = Tracer(sample_every=_sample_every)
+_default.enabled = _enabled
 
 
 def default_tracer() -> Tracer:
@@ -105,3 +326,39 @@ def default_tracer() -> Tracer:
 def span(name: str, **tags):
     """Open a span on the process tracer: `with trace.span(trace.DB_WRITE):`"""
     return _default.span(name, **tags)
+
+
+def current() -> SpanContext | None:
+    return _default.current()
+
+
+def activate(ctx: SpanContext | None):
+    return _default.activate(ctx)
+
+
+def start_request(headers=None) -> SpanContext:
+    return _default.start_request(headers)
+
+
+def inject_headers(extra: dict | None = None) -> dict:
+    return _default.inject_headers(extra)
+
+
+def grpc_metadata() -> tuple | None:
+    """The active context as gRPC metadata, or None outside a trace."""
+    ctx = _default.current()
+    if ctx is None or not _default.enabled:
+        return None
+    return (("traceparent", ctx.to_traceparent()),)
+
+
+def from_grpc_context(grpc_ctx) -> SpanContext | None:
+    """Extract a propagated context from a grpc.ServicerContext."""
+    try:
+        md = grpc_ctx.invocation_metadata()
+    except Exception:  # noqa: BLE001 - non-grpc test doubles
+        return None
+    for key, value in md or ():
+        if key == "traceparent":
+            return parse_traceparent(value)
+    return None

@@ -15,6 +15,9 @@
 #                         against the raft-lite metadata plane under a
 #                         virtual clock; never part of tier-1
 #   run_tests.sh [...]  — full suite (extra args pass through to pytest)
+# static observability pass: tracepoint names unique; every fault point
+# has a metric/span at its seam (tools/check_observability.py)
+python tools/check_observability.py || exit 1
 ARGS=("$@")
 if [ "${1:-}" = "fast" ]; then
   shift

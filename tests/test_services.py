@@ -59,9 +59,10 @@ class TestInstrument:
         with s.timer("latency"):
             pass
         text = reg.render_prometheus().decode()
-        assert 'svc_api_requests{endpoint="write"} 3.0' in text
+        assert 'svc_api_requests{endpoint="write"} 3' in text
         assert 'svc_api_inflight{endpoint="write"} 5' in text
         assert "svc_api_latency_count" in text
+        assert "# TYPE svc_api_requests counter" in text
 
     def test_logger_json(self, capsys):
         import io

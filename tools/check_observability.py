@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Static observability pass (wired into run_tests.sh).
+
+Two invariants, both cheap enough to run before every test lane:
+
+1. Tracepoint constants in m3_tpu/utils/trace.py are UNIQUE — two
+   tracepoints sharing a name would silently merge in every trace tree
+   and /debug/traces filter.
+
+2. Every fault point declared via utils/faults (faults.check /
+   faults.torn_write / faults.wrap_io with a literal point name) lives in
+   a module that also instruments that seam — a metrics scope
+   (instrument histogram/counter/timer) or a trace span. A fault point
+   without observability is a seam we can break but not see.
+
+Exit code 0 = clean; 1 = violations (each printed with file:line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "m3_tpu")
+
+# modules whose fault-point mentions are documentation or test scaffolding,
+# not production seams
+EXEMPT = {
+    os.path.join("utils", "faults.py"),      # the registry itself (docs)
+    os.path.join("tools", "race_check.py"),  # stress harness
+}
+
+# call attributes that count as "instrumented" when referenced in a module
+_OBS_ATTRS = {"span", "histogram", "observe", "counter", "timer", "gauge",
+              "subscope", "root_scope"}
+
+
+def _tracepoint_constants(path: str) -> list[tuple[str, str]]:
+    tree = ast.parse(open(path).read())
+    out = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.isupper() \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            name = node.targets[0].id
+            if name.startswith("_"):
+                continue
+            out.append((name, node.value.value))
+    return out
+
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self):
+        self.fault_points: list[tuple[str, int]] = []  # (point, lineno)
+        self.instrumented = False
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        attr = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if attr in ("check", "torn_write", "wrap_io"):
+            owner = getattr(fn, "value", None)
+            owner_name = owner.id if isinstance(owner, ast.Name) else None
+            if owner_name in ("faults", None) or attr == "check":
+                for arg in node.args:
+                    if isinstance(arg, ast.Constant) and \
+                            isinstance(arg.value, str) and "." in arg.value:
+                        self.fault_points.append((arg.value, node.lineno))
+                        break
+        if attr in _OBS_ATTRS:
+            self.instrumented = True
+        self.generic_visit(node)
+
+
+def main() -> int:
+    failures: list[str] = []
+
+    # 1. tracepoint uniqueness
+    tp_path = os.path.join(PKG, "utils", "trace.py")
+    seen: dict[str, str] = {}
+    for name, value in _tracepoint_constants(tp_path):
+        if value in seen:
+            failures.append(
+                f"{tp_path}: tracepoint {name} duplicates {seen[value]} "
+                f"(both {value!r})")
+        seen[value] = name
+
+    # 2. fault points have observability at their seam
+    catalog: dict[str, list[str]] = {}
+    for dirpath, _dirs, files in os.walk(PKG):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, PKG)
+            if rel in EXEMPT:
+                continue
+            try:
+                tree = ast.parse(open(path).read())
+            except SyntaxError as e:
+                failures.append(f"{path}: unparseable: {e}")
+                continue
+            sc = _Scanner()
+            sc.visit(tree)
+            if not sc.fault_points:
+                continue
+            for point, lineno in sc.fault_points:
+                catalog.setdefault(point, []).append(f"{rel}:{lineno}")
+            if not sc.instrumented:
+                pts = ", ".join(p for p, _ in sc.fault_points)
+                failures.append(
+                    f"{path}: declares fault point(s) [{pts}] but has no "
+                    f"metric scope or trace span at the seam")
+
+    if failures:
+        print("check_observability: FAILED", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print(f"check_observability: OK — {len(seen)} tracepoints unique, "
+          f"{len(catalog)} fault points instrumented at their seams")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
