@@ -406,24 +406,63 @@ def storage_flush_handler(db, namespace_for_policy: Callable[[StoragePolicy], st
         # write path rejecting a whole flush batch (a crash kills the
         # flush thread like a real SIGKILL would)
         faults.check("aggregator.flush.handler", n_metrics=len(metrics))
-        n = 0
-        failed = 0
-        first_err: Exception | None = None
+        # one storage-side batch per target namespace (db.write_batch's
+        # columnar pass) instead of one write_tagged per metric; facades
+        # without the batch surface keep the per-metric loop
+        by_ns: dict[str, list] = {}
         for m in metrics:
             ns = namespace_for_policy(m.policy)
             if ns is None:
                 continue
             tags = [(k, v) for k, v in m.tags if k != b"__name__"]
             name = dict(m.tags).get(b"__name__", b"")
-            try:
-                db.write_tagged(ns, name, tags, m.timestamp_ns, m.value)
-                n += 1
-            except Exception as e:  # noqa: BLE001 - count, don't abort the
-                # whole flush: one bad namespace (e.g. not configured on the
-                # storage nodes in cluster mode) must not drop the rest
-                failed += 1
-                if first_err is None:
-                    first_err = e
+            by_ns.setdefault(ns, []).append(
+                (name, tags, m.timestamp_ns, m.value))
+        n = 0
+        failed = 0
+        first_err: Exception | str | None = None
+        write_batch = getattr(db, "write_batch", None)
+        # cluster facades batch through write_tagged_batch (one
+        # /write_batch request per storage host via session.write_many)
+        tagged_batch = None if write_batch is not None \
+            else getattr(db, "write_tagged_batch", None)
+        for ns, entries in by_ns.items():
+            # per-entry (or per-namespace) failures count, never abort the
+            # whole flush: one bad namespace (e.g. not configured on the
+            # storage nodes in cluster mode) must not drop the rest
+            if write_batch is not None:
+                try:
+                    res = write_batch(ns, entries)
+                except faults.SimulatedCrash:
+                    raise  # no handler survives a kill
+                except Exception as e:  # noqa: BLE001 - whole-batch failure
+                    failed += len(entries)
+                    first_err = first_err if first_err is not None else e
+                    continue
+                bad = [r for r in res if r is not None]
+                failed += len(bad)
+                n += len(entries) - len(bad)
+                if bad and first_err is None:
+                    first_err = bad[0]
+                continue
+            if tagged_batch is not None:
+                try:
+                    n += tagged_batch(ns, entries)
+                    continue
+                except faults.SimulatedCrash:
+                    raise
+                except Exception:  # noqa: BLE001 - all-or-error surface:
+                    # retry per metric below so one sub-consistency entry
+                    # (or unconfigured namespace) keeps per-entry counting
+                    pass
+            for name, tags, t_ns, value in entries:
+                try:
+                    db.write_tagged(ns, name, tags, t_ns, value)
+                    n += 1
+                except Exception as e:  # noqa: BLE001 - count and carry on
+                    failed += 1
+                    if first_err is None:
+                        first_err = e
         if failed:
             Logger("downsample").info(
                 "aggregated writes failed (is the target namespace "

@@ -128,13 +128,24 @@ class HTTPNodeConnection:
 
     def read_batch(self, namespace: str, series_ids: list[bytes],
                    start_ns: int, end_ns: int) -> list[list[Datapoint]]:
-        """One round-trip for many series (the host-queue batching role)."""
-        rows = self._request("POST", "/read_batch", json.dumps({
+        """One round-trip for many series (the host-queue batching role).
+        The node's response envelope carries its storage-side QueryStats
+        counters (blocks/bytes/cache/rungs), merged here onto the calling
+        thread's active query record; a bare JSON list (a pre-envelope
+        node) still parses."""
+        doc = self._request("POST", "/read_batch", json.dumps({
             "namespace": namespace,
             "series_ids": [base64.b64encode(s).decode() for s in series_ids],
             "start_ns": int(start_ns),
             "end_ns": int(end_ns),
         }).encode()) or []
+        if isinstance(doc, dict):
+            from m3_tpu.utils import querystats
+
+            querystats.merge_storage(doc.get("stats"))
+            rows = doc.get("rows") or []
+        else:
+            rows = doc
         return [[Datapoint(int(t), float(v)) for t, v in row] for row in rows]
 
     # -- index query surface --

@@ -26,9 +26,39 @@ class IndexBlock:
         self._cache: Segment | None = None  # sealed view of `mutable`
         self._cache_docs = 0
         self.persisted_docs = -1  # doc count at last persist (persist.py)
+        # series ids present anywhere in this block (mutable OR sealed),
+        # built lazily: the insert pre-filter. Without it a re-insert of a
+        # series that compaction moved into a sealed segment lands a
+        # duplicate doc in the fresh mutable segment — growing n_docs and
+        # so invalidating the sealed-view cache (a re-seal on the next
+        # query) for a series the block already serves. None = not built
+        # yet (or invalidated by an external sealed-segment install).
+        self._seen: set[bytes] | None = None
+
+    def seen_series(self) -> set[bytes]:
+        """The block's series membership set (built on first use). Sealed
+        segments contribute via series_ids() — id-blob slices, NOT the
+        docs facade, which would decode every tag blob just to read ids
+        (a restored block's first write would stall on an O(docs) tag
+        decode otherwise)."""
+        if self._seen is None:
+            seen = set(self.mutable._by_series)
+            for seg in self.sealed:
+                ids_of = getattr(seg, "series_ids", None)
+                if ids_of is not None:
+                    seen.update(ids_of())
+                else:  # segment types without the cheap surface
+                    for doc in seg.docs:
+                        seen.add(doc.series_id)
+            self._seen = seen
+        return self._seen
 
     def insert(self, series_id: bytes, fields) -> None:
+        seen = self.seen_series()
+        if series_id in seen:
+            return  # already present (mutable or sealed): nothing to add
         self.mutable.insert(series_id, fields)
+        seen.add(series_id)
 
     def segments(self) -> list[Segment]:
         segs = list(self.sealed)
@@ -89,6 +119,33 @@ class NamespaceIndex:
 
     def insert(self, series_id: bytes, fields: list[tuple[bytes, bytes]], t_ns: int) -> None:
         self._block_for(t_ns).insert(series_id, fields)
+
+    def insert_many(self, series_ids: list[bytes], fields_list: list,
+                    ts_ns) -> int:
+        """Batched insert with the per-block seen-set pre-filter applied
+        up front: rows group by target index block, and series already
+        present in their block never touch the mutable segment — so a
+        steady-state write batch of existing series costs one set probe
+        per row and leaves the sealed-view cache valid (no re-seal on the
+        next query). Returns docs actually inserted."""
+        import numpy as np
+
+        ts = np.asarray(ts_ns, np.int64)
+        bs_arr = ts - (ts % self.block_size_ns)
+        inserted = 0
+        # one row-index gather per distinct target block (batches land in
+        # 1-2 blocks), then the per-row work is a single set probe
+        for bs in np.unique(bs_arr).tolist():
+            blk = self._block_for(bs)  # bs is already block-aligned
+            seen = blk.seen_series()
+            for i in np.nonzero(bs_arr == bs)[0].tolist():
+                sid = series_ids[i]
+                if sid in seen:
+                    continue
+                blk.mutable.insert(sid, fields_list[i])
+                seen.add(sid)
+                inserted += 1
+        return inserted
 
     def _overlapping(self, start_ns: int, end_ns: int) -> list[IndexBlock]:
         out = []

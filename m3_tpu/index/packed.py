@@ -135,6 +135,15 @@ class PackedSegment:
         self._regex_cache: OrderedDict = OrderedDict()
         self._vocab_clean_cache: bool | None = None
 
+    def series_ids(self):
+        """Every doc's series id, sliced straight out of the id blob —
+        no Document construction, no tag decode. The write path's
+        per-block membership set (IndexBlock.seen_series) builds from
+        this; going through `docs` would decode every tag blob."""
+        off = self._sid_off
+        blob = self._sid_blob
+        return [bytes(blob[off[i] : off[i + 1]]) for i in range(self.n_docs)]
+
     @property
     def _vocab_clean(self) -> bool:
         """Vocab is regex-scannable iff no term contains a newline. Computed

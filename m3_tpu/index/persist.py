@@ -139,6 +139,7 @@ def load_index(index: NamespaceIndex, root: str, namespace: str,
         if blk is None:
             blk = index._blocks[bs] = IndexBlock()
         blk.sealed.append(seg)
+        blk._seen = None  # membership grew outside insert: rebuild lazily
         blk.persisted_docs = sum(s.n_docs for s in blk.segments())
         restored.add(bs)
     return restored

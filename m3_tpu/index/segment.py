@@ -78,6 +78,10 @@ class Segment:
     def n_docs(self) -> int:
         return len(self.docs)
 
+    def series_ids(self) -> list[bytes]:
+        """Every doc's series id (membership-set building, no field walk)."""
+        return [d.series_id for d in self.docs]
+
     def field_names(self) -> list[bytes]:
         return sorted(self._fields)
 

@@ -97,36 +97,65 @@ class NodeAPI:
                 return 200, b'{"ok":true}'
             if path == "/write_batch" and method == "POST":
                 # op-batched writes (the host-queue batching role,
-                # reference client/host_queue.go write batching)
+                # reference client/host_queue.go): the wire parses per
+                # entry, then the STORAGE side runs as ONE columnar pass
+                # (db.write_batch) — no per-entry write loop. Per-entry
+                # error isolation is preserved end to end: a malformed
+                # wire entry or a storage-rejected one degrades that
+                # entry's result slot, never the batch.
                 doc = json.loads(body)
                 namespace = doc.get("namespace", "default")
-                results = []
-                for e in doc["entries"]:
+                entries: list = []
+                parse_err: dict[int, str] = {}
+                for k, e in enumerate(doc["entries"]):
                     try:
-                        tags = [(base64.b64decode(k), base64.b64decode(v))
-                                for k, v in e["tags_b64"]]
-                        self.db.write_tagged(
-                            namespace,
+                        tags = [(base64.b64decode(kk), base64.b64decode(v))
+                                for kk, v in e["tags_b64"]]
+                        entries.append((
                             base64.b64decode(e.get("metric_b64", "")), tags,
                             int(e["timestamp_ns"]), float(e["value"]),
-                        )
-                        results.append(None)
+                        ))
                     except Exception as ex:  # noqa: BLE001 - per-entry error
-                        results.append(str(ex))
+                        parse_err[k] = str(ex)
+                        entries.append(None)
+                good = [e for e in entries if e is not None]
+                try:
+                    batch_res = iter(self.db.write_batch(namespace, good))
+                except (faults.SimulatedCrash, faults.InjectedError,
+                        faults.InjectedTimeout):
+                    raise  # node-level fault semantics stay 503/kill
+                except Exception as ex:  # noqa: BLE001 - a whole-batch
+                    # storage failure (e.g. unknown namespace) degrades
+                    # every entry, NOT the request: a 4xx/5xx here would
+                    # feed the client's breaker and shed a healthy node
+                    # over a misconfigured namespace
+                    batch_res = iter([str(ex)] * len(good))
+                results = [parse_err[k] if entries[k] is None
+                           else next(batch_res)
+                           for k in range(len(entries))]
                 return 200, json.dumps({"results": results}).encode()
             if path == "/read_batch" and method == "POST":
+                from m3_tpu.utils import querystats
+
                 doc = json.loads(body)
                 # one batched storage read for the whole request: a single
                 # fused fetch+decode dispatch per (shard, block, volume)
-                # group instead of one decode per series
-                rows = self.db.read_batch(
-                    doc.get("namespace", "default"),
-                    [base64.b64decode(s) for s in doc["series_ids"]],
-                    int(doc["start_ns"]), int(doc["end_ns"]),
-                )
+                # group instead of one decode per series. The storage
+                # counters the read accrues (blocks/bytes/cache/rungs)
+                # ride the response envelope back to the coordinator's
+                # QueryStats record — in cluster mode they live HERE, and
+                # without the envelope the coordinator reports zeros.
+                with querystats.collect() as st:
+                    rows = self.db.read_batch(
+                        doc.get("namespace", "default"),
+                        [base64.b64decode(s) for s in doc["series_ids"]],
+                        int(doc["start_ns"]), int(doc["end_ns"]),
+                    )
                 out = [[[d.timestamp_ns, d.value] for d in dps]
                        for dps in rows]
-                return 200, json.dumps(out).encode()
+                return 200, json.dumps(
+                    {"rows": out,
+                     "stats": querystats.storage_counters(st)}).encode()
             if path == "/read":
                 dps = self.db.read(
                     q["namespace"][0], base64.b64decode(q["series_id"][0]),

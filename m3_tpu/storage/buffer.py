@@ -71,6 +71,26 @@ class _ColumnLog:
         self.vbits[self.n] = vbits
         self.n += 1
 
+    def extend(self, sidx: np.ndarray, t_ns: np.ndarray,
+               vbits: np.ndarray) -> None:
+        """Bulk append: one capacity check + three slice-assigns for the
+        whole batch (write_many's per-window store), vs one append per
+        row. Row order is preserved, so seal's last-write-wins dedup
+        resolves batched and per-point writes identically."""
+        m = len(sidx)
+        need = self.n + m
+        if need > len(self.sidx):
+            cap = len(self.sidx)
+            while cap < need:
+                cap *= 2
+            self.sidx = np.resize(self.sidx, cap)
+            self.times = np.resize(self.times, cap)
+            self.vbits = np.resize(self.vbits, cap)
+        self.sidx[self.n : need] = sidx
+        self.times[self.n : need] = t_ns
+        self.vbits[self.n : need] = vbits
+        self.n = need
+
     def view(self):
         return self.sidx[: self.n], self.times[: self.n], self.vbits[: self.n]
 
@@ -129,6 +149,32 @@ class ShardBuffer:
                 log = self._logs[bs] = _ColumnLog()
             log.append(idx, t_ns, vbits)
             return idx
+
+    def write_many(self, series_ids: list[bytes], times: np.ndarray,
+                   vbits: np.ndarray, tags_list: list[bytes]) -> None:
+        """Bulk write under ONE lock acquisition: resolve (registering)
+        every series index, then ONE _ColumnLog.extend per block window
+        in the batch — numpy slice-assign, not N appends. Equivalent to
+        calling write() per row; rows keep arrival order per window so
+        seal-time conflict resolution is unchanged."""
+        with self._lock:
+            reg = self._series
+            idxs = np.empty(len(series_ids), np.int32)
+            for i, sid in enumerate(series_ids):
+                idx = reg.get(sid)
+                if idx is None:
+                    idx = len(self.series_ids)
+                    reg[sid] = idx
+                    self.series_ids.append(sid)
+                    self.series_tags.append(tags_list[i])
+                idxs[i] = idx
+            bs = times - (times % self._block_size_ns)
+            for w in np.unique(bs):
+                sel = bs == w
+                log = self._logs.get(int(w))
+                if log is None:
+                    log = self._logs[int(w)] = _ColumnLog()
+                log.extend(idxs[sel], times[sel], vbits[sel])
 
     # -- read path --
 

@@ -20,6 +20,7 @@ from m3_tpu.storage import commitlog
 from m3_tpu.storage.namespace import Namespace
 from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions
 from m3_tpu.storage.sharding import ShardSet
+from m3_tpu.utils import faults
 from m3_tpu.utils.instrument import default_registry
 
 log = logging.getLogger(__name__)
@@ -29,6 +30,9 @@ log = logging.getLogger(__name__)
 # hot path pays one lock + bisect per observation, nothing more
 _scope = default_registry().root_scope("db")
 _observe_write = _scope.histogram_handle("write_seconds")
+# the batched seam observes ONCE per batch; the points counter keeps
+# throughput accounting comparable with the per-point histogram's count
+_observe_write_batch = _scope.histogram_handle("write_batch_seconds")
 
 
 @dataclass
@@ -409,6 +413,139 @@ class Database:
             ns.index.insert(series_id, fields, t_ns)
         _observe_write(time.perf_counter() - t0)
         return series_id
+
+    def write_batch(self, namespace: str, entries) -> list[str | None]:
+        """Storage-side batched writes — the real surface behind dbnode
+        /write_batch. entries = [(metric_name, tags, t_ns, value)], the
+        session/HTTP batch shape. A batch is processed as COLUMNS, not a
+        loop: one tags_to_id/encode_tags pass with a per-batch memo for
+        repeated series, one vectorized shard-routing pass (ownership
+        validated BEFORE logging, per-point order), ONE commitlog append
+        (CommitLogWriter.write_many — byte-identical framing to the
+        per-point path), one buffer lock per (shard, window) group, and
+        one pre-filtered index insert_many pass. Per-entry error
+        isolation: a bad entry (malformed, unowned shard) degrades that
+        entry only; a commitlog failure degrades every un-acked entry in
+        the batch (they were never durably logged) without touching the
+        buffers. Returns per-entry error strings aligned to the input
+        (None = written)."""
+        from m3_tpu.utils import trace
+
+        t0 = time.perf_counter()
+        try:
+            with trace.span(trace.DB_WRITE_BATCH, namespace=namespace,
+                            entries=len(entries)):
+                results, n_ok = self._write_batch_traced(namespace, entries)
+        finally:
+            _observe_write_batch(time.perf_counter() - t0)
+        _scope.counter("write_batch_points", n_ok)
+        return results
+
+    def _write_batch_traced(self, namespace, entries
+                            ) -> tuple[list[str | None], int]:
+        from m3_tpu.utils.ident import encode_tags, tags_to_id
+
+        ns = self.namespaces[namespace]
+        n = len(entries)
+        results: list[str | None] = [None] * n
+        if n == 0:
+            return results, 0
+        # one fault-point hit per BATCH (the per-point path hits db-level
+        # seams per datapoint); an injected error fails the whole call,
+        # exactly like the HTTP handler's node-level faults
+        faults.check("db.write_batch", namespace=namespace, entries=n)
+        # identity pass: one tags_to_id/encode_tags per DISTINCT series —
+        # ingest batches repeat series heavily, the memo is the point.
+        # Scalars accumulate in python lists (one vectorized np.array at
+        # the end: per-element ndarray stores dominate the loop otherwise)
+        memo: dict = {}
+        series_ids: list = [None] * n
+        encs: list = [None] * n
+        fields_list: list = [None] * n
+        t_list: list = [0] * n
+        v_list: list = [0.0] * n
+        for i, e in enumerate(entries):
+            try:
+                metric_name, tags, t_ns, value = e
+                key = (metric_name, tuple(tags))
+                try:
+                    got = memo.get(key)
+                except TypeError:  # tags arrived as [[k, v], ...]: the
+                    # tuple holds unhashable lists — normalize
+                    key = (metric_name, tuple(map(tuple, tags)))
+                    got = memo.get(key)
+                if got is None:
+                    fields = [(b"__name__", metric_name), *tags] \
+                        if metric_name else list(tags)
+                    got = (tags_to_id(metric_name, tags),
+                           encode_tags(fields), fields)
+                    memo[key] = got
+                series_ids[i], encs[i], fields_list[i] = got
+                t_list[i] = int(t_ns)
+                v_list[i] = float(value)
+            except Exception as ex:  # noqa: BLE001 - per-entry isolation
+                results[i] = str(ex)
+        times = np.array(t_list, np.int64)
+        vbits = np.array(v_list, np.float64).view(np.uint64)
+        ok0 = [i for i in range(n) if results[i] is None]
+        # vectorized shard routing; ownership errors recorded BEFORE any
+        # logging so an unowned row never lands in the WAL. In the common
+        # all-entries-clean case the routed rows ARE entry indices; a
+        # degraded batch routes the ok subset and maps rows back through it
+        clean = len(ok0) == n
+        route_ids = series_ids if clean else [series_ids[i] for i in ok0]
+        by_shard, route_errors = ns.route_many(route_ids)
+        if not clean:  # routed positions index ok0, not the entry list
+            ok0_arr = np.asarray(ok0, np.intp)
+            by_shard = {s: ok0_arr[rows] for s, rows in by_shard.items()}
+        for k, msg in route_errors.items():
+            results[k if clean else ok0[k]] = msg
+        ok = [i for i in ok0 if results[i] is None] if route_errors else ok0
+        if not ok:
+            return results, 0
+        clog = self._commitlogs.get(namespace)
+        if clog is not None:
+            all_ok = len(ok) == n
+            ok_idx = None if all_ok else np.asarray(ok, np.intp)
+            try:
+                clog.write_many(
+                    series_ids if all_ok else [series_ids[i] for i in ok],
+                    encs if all_ok else [encs[i] for i in ok],
+                    times if all_ok else times[ok_idx],
+                    vbits if all_ok else vbits[ok_idx],
+                    int(ns.opts.write_time_unit))
+            except faults.SimulatedCrash:
+                raise  # no handler survives a kill
+            except Exception as ex:  # noqa: BLE001 - WAL failure: nothing
+                # past this point is acked; degrade every pending entry
+                # and leave the buffers untouched (an un-logged buffered
+                # write would be silently lost by a crash)
+                for i in ok:
+                    results[i] = str(ex)
+                return results, 0
+            r = ns.opts.retention
+            windows = self._log_windows[namespace]
+            t_ok = times if all_ok else times[ok_idx]
+            for w in np.unique(t_ok - (t_ok % r.block_size_ns)).tolist():
+                windows.add(int(w))
+        # buffer + index: reuse the routing pass; `results` doubles as the
+        # error vector so entries degraded above skip the index insert
+        ns.write_many(series_ids, times, vbits, encs, fields_list,
+                      routed=(by_shard, results))
+        return results, len(ok)
+
+    def write_tagged_batch(self, namespace: str, entries) -> int:
+        """The cluster-facade batch surface (ClusterDatabase parity) over
+        write_batch: all-or-error semantics — raises naming the first
+        failures instead of returning per-entry results. Lets the
+        coordinator ingest path op-batch against a LOCAL database too."""
+        results = self.write_batch(namespace, entries)
+        bad = [r for r in results if r is not None]
+        if bad:
+            raise RuntimeError(
+                f"write_batch: {len(bad)}/{len(results)} entries failed "
+                f"(first: {bad[:3]})")
+        return len(results)
 
     def query(self, namespace: str, matchers, start_ns: int, end_ns: int,
               limit: int | None = None):

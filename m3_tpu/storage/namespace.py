@@ -96,6 +96,66 @@ class Namespace:
         if self.index is not None:
             self.index.insert(series_id, tags, t_ns)
 
+    def route_many(self, series_ids: list[bytes]
+                   ) -> tuple[dict[int, "object"], dict[int, str]]:
+        """Vectorized series->shard routing for a batch: one murmur3 pass
+        (ShardSet.lookup_many), then one row-index gather per distinct
+        shard — no per-row python loop. Returns ({owned shard id: row
+        index ndarray}, {row index: error} for rows landing on unowned
+        shards — sparse, so the clean path allocates nothing per row).
+        Split from write_many so Database.write_batch can validate
+        ownership BEFORE logging, the per-point write order."""
+        import numpy as np
+
+        shards_arr = np.asarray(self.shard_set.lookup_many(series_ids),
+                                np.int64)
+        by_shard: dict[int, object] = {}
+        errors: dict[int, str] = {}
+        for s in np.unique(shards_arr).tolist():
+            rows = np.nonzero(shards_arr == s)[0]
+            if s in self.shards:
+                by_shard[s] = rows
+            else:
+                msg = f"shard {s} not owned by this node"
+                for i in rows.tolist():
+                    errors[i] = msg
+        return by_shard, errors
+
+    def write_many(self, series_ids: list[bytes], times, value_bits,
+                   tags_list: list[bytes], fields_list: list | None = None,
+                   routed: tuple | None = None) -> list[str | None]:
+        """Storage-side batched writes (the write half of read_many's
+        contract): rows route in one vectorized murmur3 pass
+        (ShardSet.lookup_many — pass `routed` to reuse a route_many
+        result), each owned shard takes its rows through ONE buffer lock
+        per (shard, window) group (Shard.write_many), and the reverse
+        index sees one pre-filtered insert_many pass. Rows landing on
+        unowned shards degrade per entry — the batch never fails
+        wholesale. Returns per-row error strings (None = written)."""
+        import numpy as np
+
+        n = len(series_ids)
+        if routed is not None:
+            by_shard, errors = routed
+        else:
+            by_shard, err_map = self.route_many(series_ids)
+            errors = [err_map.get(i) for i in range(n)] if err_map \
+                else [None] * n
+        for shard_id, rows in by_shard.items():
+            ridx = np.asarray(rows, np.intp)
+            rows_l = rows.tolist() if hasattr(rows, "tolist") else list(rows)
+            self.shards[shard_id].write_many(
+                [series_ids[i] for i in rows_l], times[ridx],
+                value_bits[ridx], [tags_list[i] for i in rows_l])
+        if self.index is not None and fields_list is not None:
+            ok = [i for i in range(n)
+                  if errors[i] is None and fields_list[i] is not None]
+            if ok:
+                self.index.insert_many([series_ids[i] for i in ok],
+                                       [fields_list[i] for i in ok],
+                                       times[np.asarray(ok, np.intp)])
+        return errors
+
     def query_ids(self, query: Query, start_ns: int, end_ns: int, limit=None):
         """Matched index docs for the time range (storage QueryIDs role).
 

@@ -85,6 +85,26 @@ class Shard:
             self._write_seq[bs] = self._write_seq.get(bs, 0) + 1
         return idx
 
+    def write_many(self, series_ids: list[bytes], times: np.ndarray,
+                   vbits: np.ndarray, tags_list: list[bytes]) -> None:
+        """Bulk write: one buffer lock for the whole shard-local batch
+        (ShardBuffer.write_many) and one warm/cold + write-seq update per
+        touched window instead of per point."""
+        self.buffer.write_many(series_ids, times, vbits, tags_list)
+        bs = times - (times % self.opts.retention.block_size_ns)
+        uniq, counts = np.unique(bs, return_counts=True)
+        for w, c in zip(uniq.tolist(), counts.tolist()):
+            if w in self._filesets:
+                self.cold_writes += c
+            else:
+                self.warm_writes += c
+        # seq bumps AFTER the points are in the buffer: a snapshot racing
+        # in between re-snapshots next pass instead of marking the window
+        # clean without the points (same rule as the per-point write)
+        with self._seq_lock:
+            for w, c in zip(uniq.tolist(), counts.tolist()):
+                self._write_seq[w] = self._write_seq.get(w, 0) + c
+
     def write_seq(self, block_start: int) -> int:
         return self._write_seq.get(block_start, 0)
 

@@ -1,5 +1,7 @@
-"""BASELINE.md configs #1-#5 as one harness, plus #6: the batched
-read_many path (config #3's fetch leg measured directly).
+"""BASELINE.md configs #1-#5 as one harness, plus #6 (the batched
+read_many path — config #3's fetch leg measured directly), #7 (the
+write-hot-path observability overhead guard) and #8 (the batched
+write_batch ingest path vs the per-entry loop).
 
 Prints one JSON line per config (same shape as bench.py). Sizes are
 env-tunable; defaults are sized to finish on CPU in a few minutes —
@@ -557,10 +559,96 @@ def config7_tracing_overhead():
           ratio * rate_off, rate_off)
 
 
+def config8_write_batch():
+    """Batched ingest (the write-side twin of #6): Database.write_batch —
+    one columnar pass per (namespace, shard): memoized series identity,
+    vectorized murmur3 shard routing, ONE commitlog append per batch,
+    one buffer lock per (shard, window) group, pre-filtered index
+    inserts — vs the per-entry write_tagged loop it replaces. Both
+    single-threaded with commitlog + index ON (the real ingest path).
+    Correctness: both databases must read back identically and their
+    commitlogs must replay the same entry stream."""
+    import tempfile
+
+    from m3_tpu.storage import commitlog
+    from m3_tpu.storage.database import Database
+    from m3_tpu.storage.options import (
+        DatabaseOptions, IndexOptions, NamespaceOptions, RetentionOptions,
+    )
+    from m3_tpu.utils.ident import tags_to_id
+
+    NS = 10**9
+    START = 1_600_000_000 * NS
+
+    def new_db(root):
+        db = Database(root, DatabaseOptions(n_shards=8))
+        db.create_namespace("default", NamespaceOptions(
+            retention=RetentionOptions(retention_ns=1000 * 3600 * NS,
+                                       block_size_ns=3600 * NS),
+            index=IndexOptions(enabled=True, block_size_ns=3600 * NS),
+            writes_to_commitlog=True, snapshot_enabled=False))
+        db.open(START)
+        return db
+
+    names = [b"m%05d" % i for i in range(1000)]
+    for B in (10_000, 100_000):
+        # ~2000 distinct series, 2 block windows: a realistic ingest mix
+        # of repeated identities across shards
+        entries = [
+            (names[i % 1000], [(b"host", b"h%03d" % (i % 100))],
+             START + (i % 7200) * NS, float(i))
+            for i in range(B)
+        ]
+        with tempfile.TemporaryDirectory() as r1, \
+                tempfile.TemporaryDirectory() as r2, \
+                tempfile.TemporaryDirectory() as rw:
+            warm = new_db(rw)  # warm both code paths off the timed dbs
+            warm.write_batch("default", entries[:256])
+            for m, tg, t, v in entries[:256]:
+                warm.write_tagged("default", m, tg, t, v)
+            warm.close()
+
+            db_b = new_db(r1)
+            t0 = time.perf_counter()
+            results = db_b.write_batch("default", entries)
+            dt_batch = time.perf_counter() - t0
+            ok = all(r is None for r in results)
+
+            db_l = new_db(r2)
+            t0 = time.perf_counter()
+            for m, tg, t, v in entries:
+                db_l.write_tagged("default", m, tg, t, v)
+            dt_loop = time.perf_counter() - t0
+
+            # parity: sampled series read identically, and both WALs
+            # replay the same entry stream
+            sample = {tags_to_id(m, tg) for m, tg, _t, _v in entries[::503]}
+            for sid in sample:
+                bt, bv = db_b.namespaces["default"].read(
+                    sid, START, START + 7200 * NS)
+                lt, lv = db_l.namespaces["default"].read(
+                    sid, START, START + 7200 * NS)
+                ok = ok and np.array_equal(bt, lt) and np.array_equal(bv, lv)
+            db_b._commitlogs["default"].flush(fsync=True)
+            db_l._commitlogs["default"].flush(fsync=True)
+            eb = commitlog.replay(
+                commitlog.log_files(db_b.commitlog_dir("default"))[0])
+            el = commitlog.replay(
+                commitlog.log_files(db_l.commitlog_dir("default"))[0])
+            ok = ok and [(e.series_id, e.time_ns, e.value_bits) for e in eb] \
+                == [(e.series_id, e.time_ns, e.value_bits) for e in el]
+            db_b.close()
+            db_l.close()
+        _emit(f"#8 write_batch {B} entries commitlog+index "
+              "[columnar per (shard, window), 1t]"
+              + ("" if ok else " (CORRECTNESS FAILED)"),
+              B / dt_batch, B / dt_loop)
+
+
 def main(argv=None) -> None:
     global _ACCEL
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8")
     ap.add_argument("--record", default=None,
                     help="also append the JSON lines to this file")
     args = ap.parse_args(argv)
@@ -586,7 +674,7 @@ def main(argv=None) -> None:
     fns = {"1": config1_codec_roundtrip, "2": config2_rollup,
            "3": config3_promql_rate_sum, "4": config4_regex_postings,
            "5": config5_sharded_quantile, "6": config6_read_many,
-           "7": config7_tracing_overhead}
+           "7": config7_tracing_overhead, "8": config8_write_batch}
     for c in args.configs.split(","):
         c = c.strip()
         try:

@@ -147,6 +147,47 @@ def record(series_matched: int = 0, blocks_read: int = 0,
 
 
 @contextmanager
+def collect():
+    """Scoped storage-counter collection WITHOUT slow-query-ring
+    admission: the node half of the /read_batch stats envelope. Pushes a
+    fresh record (shadowing any active one) so the yielded counters
+    cover exactly the wrapped work; the previous record is restored on
+    exit, unchanged — whoever reads the envelope decides to merge."""
+    prev = getattr(_tls, "current", None)
+    st = QueryStats()
+    _tls.current = st
+    try:
+        yield st
+    finally:
+        _tls.current = prev
+
+
+def storage_counters(st: QueryStats) -> dict:
+    """The storage-side counters a node embeds in its /read_batch
+    response envelope (merged coordinator-side via merge_storage)."""
+    return {"series": st.series_matched, "blocks": st.blocks_read,
+            "bytes": st.bytes_decoded, "cache_hits": st.cache_hits,
+            "cache_misses": st.cache_misses, "rungs": dict(st.decode_rungs)}
+
+
+def merge_storage(doc: dict | None) -> None:
+    """Accrue a node's returned storage counters onto this thread's
+    active record (the coordinator half; no-op outside a query) — so in
+    cluster mode /debug/slow_queries and the response `stats` envelope
+    carry the nodes' blocks/bytes/cache/rung counts, not zeros."""
+    st = getattr(_tls, "current", None)
+    if st is None or not doc:
+        return
+    st.series_matched += int(doc.get("series", 0))
+    st.blocks_read += int(doc.get("blocks", 0))
+    st.bytes_decoded += int(doc.get("bytes", 0))
+    st.cache_hits += int(doc.get("cache_hits", 0))
+    st.cache_misses += int(doc.get("cache_misses", 0))
+    for rung, cnt in (doc.get("rungs") or {}).items():
+        st.decode_rungs[rung] = st.decode_rungs.get(rung, 0) + int(cnt)
+
+
+@contextmanager
 def stage(name: str):
     """Time a named stage of the active query (no-op outside one)."""
     st = getattr(_tls, "current", None)

@@ -45,48 +45,48 @@ def ensure_namespace(db, namespace: str = SELF_NAMESPACE) -> bool:
     return True
 
 
-def _write(db, namespace: str, name: str, tags, t_ns: int, value: float,
-           extra_tags: tuple = ()) -> int:
+def _entry(out: list, name: str, tags, t_ns: int, value: float,
+           extra_tags: tuple = ()) -> None:
     if math.isnan(value) or math.isinf(value):
-        return 0  # not representable as a sane sample; /metrics still has it
+        return  # not representable as a sane sample; /metrics still has it
     fields = sorted(
         [(str(k).encode(), str(v).encode()) for k, v in tags]
         + [(str(k).encode(), str(v).encode()) for k, v in extra_tags]
     )
-    db.write_tagged(namespace, _prom_name(name).encode(), fields, t_ns,
-                    float(value))
-    return 1
+    out.append((_prom_name(name).encode(), fields, t_ns, float(value)))
 
 
 def scrape_once(db, registry: MetricsRegistry | None = None,
                 namespace: str = SELF_NAMESPACE,
                 now_ns: int | None = None) -> int:
-    """One self-scrape: registry snapshot -> series writes. Returns the
-    number of samples written. The caller created the namespace
-    (ensure_namespace) — a missing one raises like any bad write."""
+    """One self-scrape: registry snapshot -> ONE batched ingest. Every
+    sample of the tick ships through db.write_batch as a single
+    columnar storage pass (per-sample write_tagged only for facades
+    without the batch surface). Returns the number of samples written.
+    The caller created the namespace (ensure_namespace) — a missing one
+    raises like any bad write."""
     registry = registry or default_registry()
     now_ns = now_ns if now_ns is not None else time.time_ns()
     counters, gauges, timers, hists = registry.snapshot()
-    n = 0
+    entries: list = []
     for (name, tags), v in counters.items():
-        n += _write(db, namespace, name, tags, now_ns, v)
+        _entry(entries, name, tags, now_ns, v)
     for (name, tags), v in gauges.items():
-        n += _write(db, namespace, name, tags, now_ns, v)
+        _entry(entries, name, tags, now_ns, v)
     for (name, tags), (count, total_s, max_s) in timers.items():
-        n += _write(db, namespace, name + "_count", tags, now_ns, count)
-        n += _write(db, namespace, name + "_total_seconds", tags, now_ns,
-                    total_s)
-        n += _write(db, namespace, name + "_max_seconds", tags, now_ns, max_s)
+        _entry(entries, name + "_count", tags, now_ns, count)
+        _entry(entries, name + "_total_seconds", tags, now_ns, total_s)
+        _entry(entries, name + "_max_seconds", tags, now_ns, max_s)
     for (name, tags), (bounds, counts, hsum, hcount) in hists.items():
         running = 0
         for ub, c in zip(bounds, counts):
             running += c
-            n += _write(db, namespace, name + "_bucket", tags, now_ns,
-                        running, extra_tags=(("le", _fmt_number(ub)),))
-        n += _write(db, namespace, name + "_bucket", tags, now_ns,
-                    running + counts[-1], extra_tags=(("le", "+Inf"),))
-        n += _write(db, namespace, name + "_sum", tags, now_ns, hsum)
-        n += _write(db, namespace, name + "_count", tags, now_ns, hcount)
+            _entry(entries, name + "_bucket", tags, now_ns, running,
+                   extra_tags=(("le", _fmt_number(ub)),))
+        _entry(entries, name + "_bucket", tags, now_ns,
+               running + counts[-1], extra_tags=(("le", "+Inf"),))
+        _entry(entries, name + "_sum", tags, now_ns, hsum)
+        _entry(entries, name + "_count", tags, now_ns, hcount)
     # device-dispatch path counters, same shape /metrics exposes them in
     # (m3_dispatch_ops_total{op,path}) so dashboards port unchanged
     try:
@@ -98,8 +98,19 @@ def scrape_once(db, registry: MetricsRegistry | None = None,
     for key, v in items:
         op, _, path = key.partition("[")
         tags = (("op", op),) + ((("path", path.rstrip("]")),) if path else ())
-        n += _write(db, namespace, "m3_dispatch_ops_total", tags, now_ns, v)
-    return n
+        _entry(entries, "m3_dispatch_ops_total", tags, now_ns, v)
+    write_batch = getattr(db, "write_batch", None)
+    if write_batch is not None:
+        results = write_batch(namespace, entries)
+        bad = [r for r in results if r is not None]
+        if bad:  # scrape failures must stay loud, like the old raise
+            raise RuntimeError(
+                f"self-scrape: {len(bad)}/{len(entries)} samples failed "
+                f"(first: {bad[0]})")
+        return len(entries)
+    for name, fields, t_ns, v in entries:
+        db.write_tagged(namespace, name, fields, t_ns, v)
+    return len(entries)
 
 
 class SelfMonitor:

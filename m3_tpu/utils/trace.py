@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 # tracepoint name constants (the tracepoint.go role). The observability
 # check (tools/check_observability.py) asserts these values stay unique.
 DB_WRITE = "storage.db.write"
+DB_WRITE_BATCH = "storage.db.write_batch"
 DB_QUERY = "storage.db.query"
 INDEX_QUERY = "index.query"
 SHARD_FLUSH = "storage.shard.flush"

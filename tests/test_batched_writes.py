@@ -1,0 +1,465 @@
+"""Batched write path (ISSUE 5 tentpole): Database.write_batch processes
+a batch as columns — one identity pass with a per-batch memo, vectorized
+shard routing, ONE commitlog append, one buffer lock per (shard, window)
+group, pre-filtered index inserts — and must be INDISTINGUISHABLE from
+the per-entry write_tagged loop: identical buffer reads, byte-identical
+commitlog output, identical replay streams, identical index results.
+Plus per-entry fault isolation and the deterministic crash-mid-batch
+durability case (the seeded chaos sweep lives in test_crash_recovery.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from m3_tpu.storage import commitlog
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import (
+    DatabaseOptions,
+    IndexOptions,
+    NamespaceOptions,
+    RetentionOptions,
+)
+from m3_tpu.utils import faults
+from m3_tpu.utils.ident import tags_to_id
+
+HOUR = 3600 * 10**9
+SEC = 10**9
+START = 1_599_998_400_000_000_000  # 2h-aligned block start
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.disable()
+    yield
+    faults.disable()
+
+
+def small_opts(index: bool = True) -> NamespaceOptions:
+    return NamespaceOptions(
+        retention=RetentionOptions(
+            retention_ns=24 * HOUR,
+            block_size_ns=2 * HOUR,
+            buffer_past_ns=10 * 60 * SEC,
+        ),
+        index=IndexOptions(enabled=index, block_size_ns=2 * HOUR),
+        snapshot_enabled=False,
+    )
+
+
+def make_db(path: str, n_shards: int = 4, owned=None,
+            flush_every: int = 1 << 20) -> Database:
+    db = Database(path, DatabaseOptions(
+        n_shards=n_shards, owned_shards=owned,
+        commitlog_flush_every_bytes=flush_every))
+    db.create_namespace("default", small_opts())
+    db.open(START)
+    return db
+
+
+def entries_mixed(n: int = 400):
+    """A realistic batch: repeated identities (memo hits), several shards,
+    two block windows, interleaved NEW series registrations."""
+    return [
+        (b"metric-%02d" % (i % 23), [(b"host", b"h%02d" % (i % 5))],
+         START + (i % (4 * 3600)) * SEC, float(i))
+        for i in range(n)
+    ]
+
+
+def sid_of(entry) -> bytes:
+    metric, tags, _t, _v = entry
+    return tags_to_id(metric, [tuple(kv) for kv in tags])
+
+
+def read_all(db: Database, sid: bytes):
+    t, v = db.namespaces["default"].read(sid, START, START + 24 * HOUR)
+    return t.tolist(), v.view(np.float64).tolist()
+
+
+# ---------------------------------------------------------------------------
+# batch vs loop parity
+# ---------------------------------------------------------------------------
+
+
+class TestBatchLoopParity:
+    def test_reads_and_commitlog_bytes_identical(self, tmp_path):
+        """The acceptance bar: same entries through write_batch and the
+        per-entry loop leave identical buffer state AND byte-identical
+        commitlog files (new-series register records spliced at their
+        first occurrence, exactly where write() would emit them)."""
+        ents = entries_mixed()
+        db_b = make_db(str(tmp_path / "batch"))
+        db_l = make_db(str(tmp_path / "loop"))
+        results = db_b.write_batch("default", ents)
+        assert results == [None] * len(ents)
+        for m, tags, t, v in ents:
+            db_l.write_tagged("default", m, tags, t, v)
+
+        for sid in sorted({sid_of(e) for e in ents}):
+            assert read_all(db_b, sid) == read_all(db_l, sid)
+
+        # per-shard accounting parity: warm/cold splits and write seqs
+        ns_b, ns_l = db_b.namespaces["default"], db_l.namespaces["default"]
+        for shard_id in ns_b.shards:
+            sb, sl = ns_b.shards[shard_id], ns_l.shards[shard_id]
+            assert (sb.warm_writes, sb.cold_writes) == \
+                (sl.warm_writes, sl.cold_writes)
+            assert sb._write_seq == sl._write_seq
+
+        db_b._commitlogs["default"].flush(fsync=True)
+        db_l._commitlogs["default"].flush(fsync=True)
+        [pb] = commitlog.log_files(db_b.commitlog_dir("default"))
+        [pl] = commitlog.log_files(db_l.commitlog_dir("default"))
+        assert open(pb, "rb").read() == open(pl, "rb").read()
+        db_b.close()
+        db_l.close()
+
+    def test_commitlog_replay_roundtrip(self, tmp_path):
+        """Batched WAL entries replay into the same datapoints after a
+        hard kill — bootstrap sees nothing batch-specific."""
+        ents = entries_mixed(200)
+        db = make_db(str(tmp_path / "db"))
+        assert db.write_batch("default", ents) == [None] * len(ents)
+        db._commitlogs["default"].flush(fsync=True)
+        expect = {sid: read_all(db, sid) for sid in {sid_of(e) for e in ents}}
+        # hard kill: no close() flush niceties
+        for log in db._commitlogs.values():
+            log._f.close()
+        db._commitlogs.clear()
+
+        db2 = make_db(str(tmp_path / "db"))
+        for sid, want in expect.items():
+            assert read_all(db2, sid) == want
+        db2.close()
+
+    def test_index_query_parity_and_tag_wire_shapes(self, tmp_path):
+        from m3_tpu.index.query import TermQuery
+
+        ents = entries_mixed(200)
+        # JSON-wire shape (lists, not tuples) must memoize + insert the same
+        ents += [(b"wire", [[b"dc", b"dc1"]], START + i * SEC, float(i))
+                 for i in range(3)]
+        db_b = make_db(str(tmp_path / "batch"))
+        db_l = make_db(str(tmp_path / "loop"))
+        assert db_b.write_batch("default", ents) == [None] * len(ents)
+        for m, tags, t, v in ents:
+            db_l.write_tagged("default", m, [tuple(kv) for kv in tags], t, v)
+        for q in (TermQuery(b"host", b"h01"), TermQuery(b"dc", b"dc1")):
+            got_b = db_b.namespaces["default"].query_ids(
+                q, START, START + 24 * HOUR)
+            got_l = db_l.namespaces["default"].query_ids(
+                q, START, START + 24 * HOUR)
+            assert sorted(d.series_id for d in got_b) == \
+                sorted(d.series_id for d in got_l)
+            assert len(got_b) > 0
+        db_b.close()
+        db_l.close()
+
+    def test_steady_state_skips_mutable_and_reseal(self, tmp_path):
+        """The seen-set pre-filter: a second batch of already-indexed
+        series must not touch the mutable segment — so the sealed-view
+        cache stays valid (no re-seal on the next query)."""
+        ents = entries_mixed(100)
+        db = make_db(str(tmp_path / "db"))
+        db.write_batch("default", ents)
+        index = db.namespaces["default"].index
+        before = {bs: (blk.mutable.n_docs, [id(s) for s in blk.segments()])
+                  for bs, blk in index._blocks.items()}
+        # same series, later timestamps within the same index blocks
+        again = [(m, tags, t + SEC, v + 1) for m, tags, t, v in ents]
+        assert db.write_batch("default", again) == [None] * len(again)
+        for bs, blk in index._blocks.items():
+            n_docs, seg_ids = before[bs]
+            assert blk.mutable.n_docs == n_docs
+            assert [id(s) for s in blk.segments()] == seg_ids
+        db.close()
+
+    def test_seen_set_survives_compaction(self, tmp_path):
+        """After compact() moves docs into sealed segments, re-writing
+        those series must not re-insert duplicate docs into the fresh
+        mutable segment (the re-seal-per-insert failure mode)."""
+        ents = entries_mixed(60)
+        db = make_db(str(tmp_path / "db"))
+        db.write_batch("default", ents)
+        index = db.namespaces["default"].index
+        index.compact()
+        assert all(blk.mutable.n_docs == 0 for blk in index._blocks.values())
+        db.write_batch("default", ents)
+        assert all(blk.mutable.n_docs == 0 for blk in index._blocks.values())
+        db.close()
+
+    def test_empty_and_single_entry(self, tmp_path):
+        db = make_db(str(tmp_path / "db"))
+        assert db.write_batch("default", []) == []
+        [res] = db.write_batch(
+            "default", [(b"one", [(b"k", b"v")], START, 1.5)])
+        assert res is None
+        t, v = read_all(db, tags_to_id(b"one", [(b"k", b"v")]))
+        assert t == [START] and v == [1.5]
+        db.close()
+
+    def test_session_write_many_uses_in_process_batch(self, tmp_path):
+        """An in-process Database now exposes the conn.write_batch
+        surface, so Session.write_many op-batches without HTTP."""
+        called = []
+        db = make_db(str(tmp_path / "db"), n_shards=4)
+        orig = db.write_batch
+        db.write_batch = lambda ns, ents: called.append(len(ents)) or \
+            orig(ns, ents)
+
+        from m3_tpu.client.session import Session
+        from m3_tpu.cluster import placement as pl
+        from m3_tpu.cluster.placement import Instance
+        from m3_tpu.cluster.topology import ConsistencyLevel, TopologyMap
+
+        p = pl.initial_placement([Instance("n1")], n_shards=4,
+                                 replica_factor=1)
+        topo = TopologyMap(p)
+        sess = Session(topo, {"n1": db},
+                       write_consistency=ConsistencyLevel.ONE)
+        ents = [(b"s-%d" % i, [(b"k", b"v")], START + i * SEC, float(i))
+                for i in range(32)]
+        assert sess.write_many("default", ents) == 32
+        assert called == [32]
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# per-entry fault isolation
+# ---------------------------------------------------------------------------
+
+
+class TestFaultIsolation:
+    def test_unowned_shard_degrades_entry_not_batch(self, tmp_path):
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+        db.create_namespace("default", small_opts())
+        db.open(START)
+        ents = entries_mixed(100)
+        # drop ownership of the shards two sample series route to
+        ns = db.namespaces["default"]
+        victim_sids = {sid_of(ents[0]), sid_of(ents[1])}
+        victim_shards = {ns.shard_set.lookup(s) for s in victim_sids}
+        keep = set(range(4)) - victim_shards
+        db.assign_shards(keep, START)
+        results = db.write_batch("default", ents)
+        for e, r in zip(ents, results):
+            routed = ns.shard_set.lookup(sid_of(e))
+            if routed in victim_shards:
+                assert r is not None and "not owned" in r
+            else:
+                assert r is None
+        assert any(r is not None for r in results)
+        assert any(r is None for r in results)
+        db.close()
+
+    def test_malformed_entry_degrades_entry_not_batch(self, tmp_path):
+        db = make_db(str(tmp_path / "db"))
+        good = (b"ok", [(b"k", b"v")], START, 1.0)
+        bad_ts = (b"bad", [(b"k", b"v")], "not-a-timestamp", 1.0)
+        bad_val = (b"bad2", [(b"k", b"v")], START, "NaNify")
+        results = db.write_batch("default", [good, bad_ts, bad_val, good])
+        assert results[0] is None and results[3] is None
+        assert results[1] is not None and results[2] is not None
+        t, _v = read_all(db, tags_to_id(b"ok", [(b"k", b"v")]))
+        assert t == [START]
+        db.close()
+
+    def test_commitlog_error_degrades_whole_batch_but_not_neighbors(
+            self, tmp_path):
+        """An injected WAL failure (commitlog.write fires per BATCH now)
+        fails every entry of that batch — none were durably logged, none
+        may reach the buffers — while earlier and later batches are
+        untouched."""
+        db = make_db(str(tmp_path / "db"))
+        b1 = [(b"a", [(b"k", b"v")], START + i * SEC, float(i))
+              for i in range(10)]
+        b2 = [(b"b", [(b"k", b"v")], START + i * SEC, float(i))
+              for i in range(10)]
+        b3 = [(b"c", [(b"k", b"v")], START + i * SEC, float(i))
+              for i in range(10)]
+        with faults.active("commitlog.write=error:n2"):
+            assert db.write_batch("default", b1) == [None] * 10
+            res2 = db.write_batch("default", b2)
+            assert all(r is not None for r in res2)
+            assert db.write_batch("default", b3) == [None] * 10
+        assert read_all(db, sid_of(b1[0]))[0]  # batch 1 landed
+        assert read_all(db, sid_of(b2[0])) == ([], [])  # batch 2 fully out
+        assert read_all(db, sid_of(b3[0]))[0]  # batch 3 landed
+        db.close()
+
+    def test_db_write_batch_fault_point_fires_per_batch(self, tmp_path):
+        db = make_db(str(tmp_path / "db"))
+        ents = entries_mixed(50)
+        with faults.active("db.write_batch=error:n1") as plan:
+            with pytest.raises(faults.InjectedError):
+                db.write_batch("default", ents)
+            assert db.write_batch("default", ents) == [None] * len(ents)
+            # one hit per BATCH, not per entry — and the schedule is the
+            # deterministic record a replay asserts against
+            assert plan.hits("db.write_batch") == 2
+            assert plan.schedule == [("db.write_batch", 1, "error")]
+        db.close()
+
+    def test_unknown_namespace_degrades_entries_not_request(self, tmp_path):
+        """A whole-batch storage failure at the node (unknown namespace)
+        answers 200 with per-entry errors — a 4xx would feed the client's
+        breaker and shed a healthy node over a misconfigured namespace."""
+        import json
+
+        from m3_tpu.services.dbnode import NodeAPI
+
+        db = make_db(str(tmp_path / "db"))
+        api = NodeAPI(db)
+        import base64
+
+        b64 = lambda b: base64.b64encode(b).decode()  # noqa: E731
+        status, payload = api.handle("POST", "/write_batch", {}, json.dumps({
+            "namespace": "nope",
+            "entries": [{"metric_b64": b64(b"m"),
+                         "tags_b64": [[b64(b"k"), b64(b"v")]],
+                         "timestamp_ns": START, "value": 1.0}] * 3,
+        }).encode())
+        assert status == 200
+        results = json.loads(payload)["results"]
+        assert len(results) == 3 and all(r is not None for r in results)
+        db.close()
+
+    def test_flush_handler_batches_cluster_facade(self, tmp_path):
+        """The aggregator flush handler op-batches against cluster
+        facades too (write_tagged_batch), falling back to per-metric
+        writes — with per-entry counting — when the batch raises."""
+        from m3_tpu.aggregator.engine import (
+            AggregatedMetric, storage_flush_handler,
+        )
+        from m3_tpu.metrics.policy import StoragePolicy
+
+        calls = {"batch": 0, "single": 0}
+
+        class FacadeStub:  # ClusterDatabase shape: no write_batch
+            def write_tagged_batch(self, ns, entries):
+                calls["batch"] += 1
+                if ns == "flaky":
+                    raise RuntimeError("below consistency")
+                return len(entries)
+
+            def write_tagged(self, ns, name, tags, t_ns, value):
+                calls["single"] += 1
+
+        policy = StoragePolicy(10 * SEC, 24 * HOUR)
+        mk = lambda i: AggregatedMetric(  # noqa: E731
+            series_id=b"s%d" % i, tags=((b"__name__", b"m"), (b"k", b"v")),
+            timestamp_ns=START + i * SEC, value=float(i), policy=policy)
+        handler = storage_flush_handler(
+            FacadeStub(), lambda p: "ok" if True else None)
+        assert handler([mk(0), mk(1)]) == 2
+        assert calls == {"batch": 1, "single": 0}
+        handler = storage_flush_handler(FacadeStub(), lambda p: "flaky")
+        assert handler([mk(0), mk(1)]) == 2  # per-metric fallback counted
+        assert calls["single"] == 2
+
+    def test_crash_mid_batch_flush_keeps_acked_writes(self, tmp_path):
+        """Deterministic crash-mid-batch-flush: a torn chunk written
+        while a batch crosses the flush threshold kills the writer; the
+        previously ACKED (fsynced) batch must survive salvage replay.
+        (The seeded sweep over offsets is chaos-lane —
+        test_crash_recovery.py::TestChaosFull.)"""
+        db = make_db(str(tmp_path / "db"), flush_every=512)
+        acked = [(b"acked", [(b"k", b"v")], START + i * SEC, float(i))
+                 for i in range(20)]
+        assert db.write_batch("default", acked) == [None] * 20
+        db._commitlogs["default"].flush(fsync=True)  # the durability ack
+        doomed = [(b"doomed-%03d" % i, [(b"k", b"v")], START + i * SEC,
+                   float(i)) for i in range(200)]  # crosses flush_every
+        with faults.active("commitlog.flush=torn"):
+            with pytest.raises(faults.SimulatedCrash):
+                db.write_batch("default", doomed)
+        # hard kill + recover
+        for log in db._commitlogs.values():
+            log._f.close()
+        db._commitlogs.clear()
+        db2 = make_db(str(tmp_path / "db"))
+        t, v = read_all(db2, tags_to_id(b"acked", [(b"k", b"v")]))
+        assert t == [START + i * SEC for i in range(20)]
+        assert v == [float(i) for i in range(20)]
+        db2.close()
+
+
+# ---------------------------------------------------------------------------
+# /read_batch stats envelope + selfscrape batching
+# ---------------------------------------------------------------------------
+
+
+class TestStatsEnvelope:
+    def test_node_envelope_and_coordinator_merge(self, tmp_path):
+        import base64
+        import json
+
+        from m3_tpu.services.dbnode import NodeAPI
+        from m3_tpu.utils import querystats
+
+        db = make_db(str(tmp_path / "db"))
+        ents = entries_mixed(50)
+        db.write_batch("default", ents)
+        db.flush_all()  # flushed volumes so the read decodes (rungs/bytes)
+        api = NodeAPI(db)
+        sids = sorted({sid_of(e) for e in ents})
+        b64 = lambda b: base64.b64encode(b).decode()  # noqa: E731
+        status, payload = api.handle("POST", "/read_batch", {}, json.dumps({
+            "namespace": "default",
+            "series_ids": [b64(s) for s in sids],
+            "start_ns": START, "end_ns": START + 24 * HOUR,
+        }).encode())
+        assert status == 200
+        doc = json.loads(payload)
+        assert set(doc) == {"rows", "stats"}
+        assert len(doc["rows"]) == len(sids)
+        stats = doc["stats"]
+        assert stats["blocks"] > 0 and stats["bytes"] > 0
+        assert stats["rungs"]  # some decode rung served the groups
+
+        # coordinator half: the envelope merges onto the active record
+        st = querystats.start("probe", "default")
+        querystats.merge_storage(stats)
+        querystats.finish(st)
+        assert st.blocks_read == stats["blocks"]
+        assert st.bytes_decoded == stats["bytes"]
+        assert st.decode_rungs == stats["rungs"]
+        db.close()
+
+    def test_collect_shields_outer_record(self):
+        from m3_tpu.utils import querystats
+
+        outer = querystats.start("outer")
+        with querystats.collect() as st:
+            querystats.record(blocks_read=3, bytes_decoded=10)
+        assert (st.blocks_read, st.bytes_decoded) == (3, 10)
+        assert (outer.blocks_read, outer.bytes_decoded) == (0, 0)
+        assert querystats.current() is outer
+        querystats.finish(outer)
+
+
+class TestSelfscrapeBatch:
+    def test_scrape_once_is_one_batch(self, tmp_path):
+        from m3_tpu.utils import selfscrape
+        from m3_tpu.utils.instrument import MetricsRegistry
+
+        reg = MetricsRegistry()
+        scope = reg.root_scope("t")
+        scope.counter("hits", 5)
+        scope.observe("lat_seconds", 0.25)
+        db = make_db(str(tmp_path / "db"))
+        selfscrape.ensure_namespace(db)
+        calls = []
+        orig = db.write_batch
+        db.write_batch = lambda ns, ents: calls.append((ns, len(ents))) or \
+            orig(ns, ents)
+        n = selfscrape.scrape_once(db, reg, now_ns=START)
+        assert n > 0
+        assert len(calls) == 1 and calls[0] == (selfscrape.SELF_NAMESPACE, n)
+        # the samples are queryable in the self namespace
+        t, v = db.namespaces[selfscrape.SELF_NAMESPACE].read(
+            tags_to_id(b"t_hits", []), START, START + HOUR)
+        assert t.tolist() == [START] and v.view(np.float64).tolist() == [5.0]
+        db.close()

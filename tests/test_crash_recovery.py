@@ -282,8 +282,9 @@ def _chaos_iteration(root: str, seed: int) -> tuple[bool, int]:
     db2.open(START + 3 * HOUR)
     by_sid: dict[bytes, dict[int, float]] = {}
     for (sid, t), v in acked.items():
-        got = by_sid.setdefault(sid, read_all(db2, sid))
-        assert got.get(t) == v, \
+        if sid not in by_sid:
+            by_sid[sid] = read_all(db2, sid)
+        assert by_sid[sid].get(t) == v, \
             f"seed={seed}: acked write {(sid, t, v)} lost after recovery"
 
     # peer leg: a brand-new node bootstrapped from the survivor serves
@@ -303,6 +304,61 @@ def _chaos_iteration(root: str, seed: int) -> tuple[bool, int]:
     return crashed, len(acked)
 
 
+BATCH_CHAOS_SPEC = CHAOS_SPEC + ";db.write_batch=error:p0.03"
+
+
+def _chaos_iteration_batched(root: str, seed: int) -> tuple[bool, int]:
+    """The batched twin of _chaos_iteration: writes arrive through
+    db.write_batch (ISSUE 5), acked per batch after a commitlog fsync.
+    The invariant is identical — no entry of an ACKED batch is ever lost
+    after a kill mid-batch-flush + salvage replay — and per-entry
+    results gate what may enter the pending set at all."""
+    from m3_tpu.utils.ident import tags_to_id
+
+    db = make_db(os.path.join(root, "db"))
+    db.open(START)
+    acked: dict[tuple[bytes, int], float] = {}
+    pending: dict[tuple[bytes, int], float] = {}
+    crashed = False
+    try:
+        for step in range(12):
+            entries = []
+            for k in range(6):
+                i = step * 6 + k
+                entries.append((b"m-%d" % (i % 5), [(b"k", b"v")],
+                                START + i * 90 * SEC, float(seed * 1000 + i)))
+            try:
+                results = db.write_batch("default", entries)
+            except (faults.InjectedError, faults.InjectedTimeout):
+                continue  # whole batch refused: nothing pending from it
+            for (m, tags, t, v), err in zip(entries, results):
+                if err is None:
+                    pending[(tags_to_id(m, tags), t)] = v
+            if step % 3 == 2:
+                db._commitlogs["default"].flush(fsync=True)
+                acked.update(pending)
+                pending.clear()
+            if step % 5 == 4:
+                db.tick(now_ns=START + 3 * HOUR)
+    except (faults.SimulatedCrash, faults.InjectedError,
+            faults.InjectedTimeout):
+        crashed = True
+    finally:
+        faults.disable()
+        hard_kill(db)
+
+    db2 = make_db(os.path.join(root, "db"))
+    db2.open(START + 3 * HOUR)
+    by_sid: dict[bytes, dict[int, float]] = {}
+    for (sid, t), v in acked.items():
+        if sid not in by_sid:
+            by_sid[sid] = read_all(db2, sid)
+        assert by_sid[sid].get(t) == v, \
+            f"seed={seed}: acked batched write {(sid, t, v)} lost"
+    db2.close()
+    return crashed, len(acked)
+
+
 class TestChaosQuick:
     def test_chaos_iterations_quick(self, tmp_path):
         """A handful of seeds in tier-1 so the harness itself never rots;
@@ -313,6 +369,15 @@ class TestChaosQuick:
             crashed, _n = _chaos_iteration(str(tmp_path / str(seed)), seed)
             crashes += crashed
         assert crashes >= 1  # the spec is hot enough to matter
+
+    def test_chaos_batched_iterations_quick(self, tmp_path):
+        crashes = 0
+        for seed in range(6):
+            faults.configure(BATCH_CHAOS_SPEC, seed=seed)
+            crashed, _n = _chaos_iteration_batched(
+                str(tmp_path / str(seed)), seed)
+            crashes += crashed
+        assert crashes >= 1
 
 
 @pytest.mark.chaos
@@ -326,5 +391,21 @@ class TestChaosFull:
             crashes += crashed
             acked_total += n
         # the sweep must actually exercise the crash paths, not no-op
+        assert crashes >= iters // 10
+        assert acked_total > 0
+
+    def test_chaos_batched_kill_mid_flush_never_loses_acked_writes(
+            self, tmp_path):
+        """The same seeded sweep with the ISSUE-5 batched write path:
+        crash-mid-batch-flush (torn WAL chunks included) never loses an
+        entry of an acked batch."""
+        iters = int(os.environ.get("M3_TPU_CHAOS_ITERS", "200"))
+        crashes = acked_total = 0
+        for seed in range(iters):
+            faults.configure(BATCH_CHAOS_SPEC, seed=seed)
+            crashed, n = _chaos_iteration_batched(
+                str(tmp_path / str(seed)), seed)
+            crashes += crashed
+            acked_total += n
         assert crashes >= iters // 10
         assert acked_total > 0
