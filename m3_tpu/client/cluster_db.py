@@ -139,9 +139,26 @@ class ClusterDatabase:
         return self.session.write_tagged(
             namespace, metric_name, tags, t_ns, value)
 
-    def write_tagged_batch(self, namespace: str, entries) -> int:
-        """[(metric_name, tags, t_ns, value)] with one request per host."""
+    def write_batch(self, namespace: str, entries) -> list[str | None]:
+        """[(metric_name, tags, t_ns, value)] with one request per host;
+        per-entry results aligned to the input (None = acked at the write
+        consistency level) — the Database.write_batch surface, so callers
+        with per-entry error handling (remote write, aggregated flushes,
+        self-scrape) run unchanged against a quorum deployment."""
         return self.session.write_many(namespace, entries)
+
+    def write_tagged_batch(self, namespace: str, entries) -> int:
+        """All-or-error facade over write_batch (Database parity): raises
+        naming the first failures instead of returning per-entry slots."""
+        results = self.write_batch(namespace, entries)
+        bad = [r for r in results if r is not None]
+        if bad:
+            from m3_tpu.client.session import ConsistencyError
+
+            raise ConsistencyError(
+                f"batched write: {len(bad)}/{len(results)} entries below "
+                f"consistency (first: {bad[:3]})")
+        return len(results)
 
     # -- read paths --
 

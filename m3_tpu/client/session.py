@@ -175,12 +175,18 @@ class Session:
             )
         return result
 
-    def write_many(self, namespace: str, entries) -> int:
+    def write_many(self, namespace: str, entries) -> list[str | None]:
         """Quorum-replicated BATCHED writes: one request per host carrying
         every entry whose shard that host owns (the host-queue op-batching
         role, reference client/host_queue.go:199-280). entries:
-        [(metric_name, tags, t_ns, value)]. Returns entries written at the
-        consistency level; raises ConsistencyError naming the failures."""
+        [(metric_name, tags, t_ns, value)].
+
+        Returns PER-ENTRY results aligned to the input: None for an entry
+        acked at the write consistency level, an error string naming its
+        ack shortfall (and the failures that caused it) otherwise — one
+        sub-consistency entry degrades its own slot, never the batch
+        (Database.write_batch parity; ClusterDatabase.write_tagged_batch
+        restores the old all-or-raise surface on top)."""
         from m3_tpu.utils.ident import tags_to_id
 
         need = required_acks(self.write_consistency,
@@ -226,14 +232,14 @@ class Session:
                     acks[i] += 1
                 else:
                     errors.append((host, err))
-        failed = [i for i, a in enumerate(acks) if a < need]
-        if failed:
-            raise ConsistencyError(
-                f"batched write: {len(failed)}/{len(entries)} entries below "
-                f"{self.write_consistency.value} "
-                f"(first failures: {errors[:3]})"
-            )
-        return len(entries)
+        out: list[str | None] = [None] * len(entries)
+        for i, a in enumerate(acks):
+            if a < need:
+                out[i] = (
+                    f"{a}/{need} acks (level={self.write_consistency.value}, "
+                    f"first failures: {errors[:3]})"
+                )
+        return out
 
     # -- read path --
 
@@ -325,11 +331,16 @@ class Session:
         successes = {sid: 0 for sid in series_ids}
         parts: dict[bytes, list] = {sid: [] for sid in series_ids}
         errors = []
+        import time as _time
+
+        from m3_tpu.utils import querystats
+
         for host, conn in self.connections.items():
             readable = self._readable_shards_of(host)
             want = [sid for sid in series_ids if shard_of[sid] in readable]
             if not want:
                 continue
+            leg_t0 = _time.perf_counter()
             try:
                 # one batched request per host: HTTP conns AND in-process
                 # Databases expose read_batch (the storage side fuses the
@@ -345,7 +356,13 @@ class Session:
                             for sid in want]
             except Exception as e:  # noqa: BLE001 - per-host failure
                 errors.append((host, e))
+                querystats.record_node_leg(
+                    host, _time.perf_counter() - leg_t0)
                 continue
+            # per-node share of this fan-out read, onto the active query
+            # record (EXPLAIN ANALYZE renders one plan leg per node)
+            querystats.record_node_leg(
+                host, _time.perf_counter() - leg_t0, rows=len(want))
             for sid, dps in zip(want, rows):
                 successes[sid] += 1
                 if dps:

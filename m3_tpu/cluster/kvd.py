@@ -335,6 +335,14 @@ class KvdServer:
         self._server.add_generic_rpc_handlers((_Handler(),))
         self.port = self._server.add_insecure_port(listen)
         self._server.start()
+        # OTLP-style telemetry export (M3_TPU_EXPORT_* env — kvd has no
+        # service config file): ships the kvd span ring + consensus seam
+        # histograms to the same collector as the other services
+        from m3_tpu.utils.export import exporter_from_config
+
+        self._exporter = exporter_from_config(None, "kvd")
+        if self._exporter is not None:
+            self._exporter.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
         if self._replicated:
@@ -875,6 +883,8 @@ class KvdServer:
         self._closed.set()
         if self._raft is not None:
             self._driver.poke()  # unblock sender/tick threads promptly
+        if self._exporter is not None:
+            self._exporter.close()  # final best-effort flush
         self._server.stop(grace=0.5).wait()
 
 

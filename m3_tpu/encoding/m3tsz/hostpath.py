@@ -63,13 +63,18 @@ def encode_blocks(times, vbits, starts, n_points,
         from m3_tpu.encoding.m3tsz import tpu_int
 
         encode_fn = tpu_int.encode_bits_int
+        jitted = tpu_int._encode_bits_int_jit
     else:
         encode_fn = m3tsz_tpu.encode_bits
+        jitted = m3tsz_tpu._encode_bits_jit
     dispatch.counters["m3tsz_encode_device"] += 1
-    blocks = encode_fn(
-        jnp.asarray(times), jnp.asarray(vbits),
-        jnp.asarray(starts), jnp.asarray(n_points), unit,
-    )
+    # plan-cache attribution: did this shape bucket hit the jit cache or
+    # pay a trace+compile? (compute.jit_* on /metrics)
+    with dispatch.jit_tracker("m3tsz_encode", jitted):
+        blocks = encode_fn(
+            jnp.asarray(times), jnp.asarray(vbits),
+            jnp.asarray(starts), jnp.asarray(n_points), unit,
+        )
     if bool(blocks.overflow):
         raise OverflowError("batched encode overflow")
     return m3tsz_tpu.blocks_to_bytes(blocks)
@@ -134,11 +139,13 @@ def _decode_streams_device(streams: list[bytes], unit: TimeUnit,
     if int_optimized:
         from m3_tpu.encoding.m3tsz import tpu_int
 
-        dec = tpu_int.decode_int(words, unit, max_points=max_points)
+        with dispatch.jit_tracker("m3tsz_decode", tpu_int.decode_int):
+            dec = tpu_int.decode_int(words, unit, max_points=max_points)
         vals = _np.asarray(dec.values, _np.float64)
         vbits = vals.view(_np.uint64)
     else:
-        dec = m3tsz_tpu.decode(words, unit, max_points=max_points)
+        with dispatch.jit_tracker("m3tsz_decode", m3tsz_tpu._decode_jit):
+            dec = m3tsz_tpu.decode(words, unit, max_points=max_points)
         vbits = _np.asarray(dec.value_bits, _np.uint64)
     times = _np.asarray(dec.times, _np.int64)
     err = _np.asarray(dec.error)
@@ -234,6 +241,12 @@ def decode_streams_batch(streams: list[bytes | None], unit: TimeUnit,
         sc.observe("seconds", dt)
         sc.counter("streams", len(subset))
         sc.counter("bytes", n_bytes)
+        # batch-size DISTRIBUTION per rung (count-shaped bounds): whether
+        # batches are big enough to amortize a dispatch is the question
+        # the per-rung counters alone can't answer
+        from m3_tpu.utils.instrument import COUNT_BUCKETS
+
+        sc.observe("batch_size", float(len(subset)), bounds=COUNT_BUCKETS)
         querystats.record(blocks_read=1, bytes_decoded=n_bytes,
                           decode_rung=rung)
         if sp is not None:

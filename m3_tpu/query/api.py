@@ -158,6 +158,32 @@ def _fmt_value(v: float) -> str:
     return repr(float(v))
 
 
+def _wants_openmetrics(q, headers) -> bool:
+    """Scrape-format selection shared by the coordinator and node
+    /metrics endpoints: EXPLICIT `?format=openmetrics` only. The
+    exemplar exposition keeps the PR-4 family names (counters without
+    the `_total` suffix OpenMetrics mandates) so `_m3_system` series and
+    dashboards line up across formats — which means a stock Prometheus
+    scraper, whose default Accept header advertises openmetrics-text,
+    must keep getting the always-valid text/plain 0.0.4 render unless an
+    operator opts this scrape in."""
+    fmt = (q.get("format", [""])[0] if q else "").lower()
+    return fmt in ("openmetrics", "openmetrics-text")
+
+
+def _render_metrics(q, headers):
+    """(status, content_type, payload) for a /metrics scrape: OpenMetrics
+    with exemplars when negotiated, strict Prometheus text otherwise."""
+    from m3_tpu.utils.instrument import default_registry
+
+    reg = default_registry()
+    if _wants_openmetrics(q, headers):
+        return (200,
+                "application/openmetrics-text; version=1.0.0; charset=utf-8",
+                reg.render_openmetrics())
+    return 200, "text/plain; version=0.0.4", reg.render_prometheus()
+
+
 class CoordinatorAPI:
     """HTTP facade over a Database + PromQL Engine."""
 
@@ -236,7 +262,7 @@ class CoordinatorAPI:
             with trace.activate(ctx), \
                     trace.span(trace.API_REQUEST, path=path, method=method), \
                     self._scope.histogram("request_seconds"):
-                res = self._route(method, path, query, body)
+                res = self._route(method, path, query, body, headers)
             status, ctype, payload, hdrs = res if len(res) == 4 \
                 else (*res, {})
         except QueryLimitError as e:
@@ -264,7 +290,7 @@ class CoordinatorAPI:
             return {}
         return {"M3-Warnings": ",".join(str(w) for w in warns)}
 
-    def _route(self, method, path, q, body):
+    def _route(self, method, path, q, body, headers=None):
         if path == "/health":
             return 200, "application/json", b'{"ok":true}'
         if path == "/ready":
@@ -286,20 +312,28 @@ class CoordinatorAPI:
                 status, payload = res
                 return status, "application/json", payload
         if path == "/metrics":
-            from m3_tpu.utils.instrument import default_registry
-
-            return (200, "text/plain; version=0.0.4",
-                    default_registry().render_prometheus())
+            return _render_metrics(q, headers)
         if path == "/debug/dump":
             return self._debug_dump()
         if path == "/debug/traces":
             return self._debug_traces(method, q, body)
+        if path == "/debug/explain":
+            from m3_tpu.query import explain as explain_mod
+
+            trace_id = q.get("trace_id", [None])[0]
+            if trace_id:
+                return 200, "application/json", json.dumps(
+                    {"plans": explain_mod.find(trace_id)}).encode()
+            limit = int(q.get("limit", ["20"])[0])
+            return 200, "application/json", json.dumps(
+                {"plans": explain_mod.recent(limit)}).encode()
         if path == "/debug/slow_queries":
             from m3_tpu.utils import querystats
 
             limit = int(q.get("limit", ["50"])[0])
             return 200, "application/json", json.dumps(
-                {"queries": querystats.slow_queries(limit)}
+                {"queries": querystats.slow_queries(limit),
+                 "threshold_ms": round(querystats.threshold_s() * 1e3, 3)}
             ).encode()
         if path == "/api/v1/prom/remote/write" and method == "POST":
             return self._remote_write(body)
@@ -489,11 +523,23 @@ class CoordinatorAPI:
                     tags.append((k, v))
             for ts_ms, value in ts.samples:
                 entries.append((name, tags, ts_ms * 1_000_000, value))
-        batch = getattr(self.db, "write_tagged_batch", None)
+        batch = getattr(self.db, "write_batch", None)
         if self.writer is None and batch is not None:
             # no downsampler rules to run per-sample: one op-batched
-            # request per storage node (host-queue batching role)
-            n = batch(self.namespace, entries)
+            # request per storage node (host-queue batching role) with
+            # PER-ENTRY results — one sub-consistency sample degrades its
+            # own slot, and the response names the shortfall instead of
+            # failing (or silently acking) the whole batch
+            results = batch(self.namespace, entries)
+            bad = [r for r in results if r is not None]
+            n = len(results) - len(bad)
+            if bad:
+                return 500, "application/json", json.dumps(
+                    {"status": "error", "errorType": "partial_write",
+                     "samples": n, "failed": len(bad),
+                     "error": f"{len(bad)}/{len(results)} samples failed "
+                              f"(first: {bad[0]})"}
+                ).encode()
         else:
             for name, tags, t_ns, value in entries:
                 self._write(name, tags, t_ns, value)
@@ -589,15 +635,52 @@ class CoordinatorAPI:
         ns = q.get("namespace", [self.namespace])[0]
         return self._engine_for(ns)
 
+    @staticmethod
+    def _explain_mode(q) -> bool | None:
+        """?explain= → None (off), False (plan only), True (analyze)."""
+        raw = (q.get("explain", [""])[0] or "").lower()
+        if not raw:
+            return None
+        if raw == "analyze":
+            return True
+        if raw in ("plan", "true", "1"):
+            return False
+        raise ValueError(f"explain must be 'plan' or 'analyze', got {raw!r}")
+
+    def _run_explained(self, q, engine, run):
+        """Run one engine evaluation, collecting its plan tree when
+        ?explain= asks for one. Returns ((result, eval_ts), plan_doc) —
+        plan_doc is None without explain; with it, the finished record
+        (tree + trace id + envelope-parity stats) also lands in the
+        /debug/explain ring."""
+        mode = self._explain_mode(q)
+        if mode is None:
+            return run(), None
+        from m3_tpu.query import explain as explain_mod
+
+        with explain_mod.collect(analyze=mode) as col:
+            out = run()
+        doc = col.to_dict()
+        st = engine.last_stats
+        if st is not None:
+            doc["query"] = st.query
+            doc["trace_id"] = st.trace_id
+            if mode:
+                doc["stats"] = st.to_dict()
+        explain_mod.remember(doc)
+        return out, doc
+
     def _query_range(self, q):
         expr = q["query"][0]
         start = _parse_time(q["start"][0])
         end = _parse_time(q["end"][0])
         step = _parse_step(q["step"][0])
         engine = self._query_engine(q)
-        result, eval_ts = engine.query_range(expr, start, end, step)
+        (result, eval_ts), plan = self._run_explained(
+            q, engine, lambda: engine.query_range(expr, start, end, step))
         return (200, "application/json",
-                self._render(result, eval_ts, matrix=True, engine=engine),
+                self._render(result, eval_ts, matrix=True, engine=engine,
+                             explain_doc=plan),
                 self._warning_headers(engine))
 
     def _m3ql_query_range(self, q):
@@ -612,10 +695,12 @@ class CoordinatorAPI:
         end = _parse_time(q["end"][0])
         step = _parse_step(q["step"][0])
         engine = self._query_engine(q)
-        result, eval_ts = engine.query_range_expr(expr, start, end, step,
-                                                  query_text=raw)
+        (result, eval_ts), plan = self._run_explained(
+            q, engine, lambda: engine.query_range_expr(
+                expr, start, end, step, query_text=raw))
         return (200, "application/json",
-                self._render(result, eval_ts, matrix=True, engine=engine),
+                self._render(result, eval_ts, matrix=True, engine=engine,
+                             explain_doc=plan),
                 self._warning_headers(engine))
 
     def _query_instant(self, q):
@@ -626,12 +711,15 @@ class CoordinatorAPI:
 
             t = _time.time_ns()
         engine = self._query_engine(q)
-        result, eval_ts = engine.query_instant(expr, t)
+        (result, eval_ts), plan = self._run_explained(
+            q, engine, lambda: engine.query_instant(expr, t))
         return (200, "application/json",
-                self._render(result, eval_ts, matrix=False, engine=engine),
+                self._render(result, eval_ts, matrix=False, engine=engine,
+                             explain_doc=plan),
                 self._warning_headers(engine))
 
-    def _render(self, result, eval_ts, matrix: bool, engine=None):
+    def _render(self, result, eval_ts, matrix: bool, engine=None,
+                explain_doc=None):
         ts_sec = eval_ts.astype(np.float64) / NS
         if isinstance(result, Scalar):
             if matrix:
@@ -700,6 +788,10 @@ class CoordinatorAPI:
         stats = getattr(engine, "last_stats", None)
         if stats is not None:
             doc["stats"] = stats.to_dict()
+        # ?explain= : the resolved plan tree (with per-stage timings,
+        # dispatch rungs and per-node legs under analyze) rides along
+        if explain_doc is not None:
+            doc["explain"] = explain_doc
         return json.dumps(doc).encode()
 
     def _time_range(self, q):
@@ -739,6 +831,18 @@ class CoordinatorAPI:
     # -- server lifecycle --
 
     def serve(self, host: str = "127.0.0.1", port: int = 7201) -> int:
+        # arm percentile-based slow-query admission: the bar follows the
+        # live p99 of THIS coordinator's request-latency histogram (with
+        # M3_TPU_SLOW_QUERY_MS as floor, and as the sole bar until the
+        # histogram holds enough samples to trust)
+        from m3_tpu.utils import querystats
+        from m3_tpu.utils.instrument import default_registry
+
+        reg = default_registry()
+        # .get, not [..]: the defaultdict must not grow outside its lock
+        self._adaptive_source = \
+            lambda: reg.histograms.get(("coordinator.request_seconds", ()))
+        querystats.set_adaptive_source(self._adaptive_source)
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -785,6 +889,13 @@ class CoordinatorAPI:
         return self._server.server_address[1]
 
     def shutdown(self):
+        from m3_tpu.utils import querystats
+
+        # identity-scoped: only disarm the bar if WE registered it — a
+        # sibling CoordinatorAPI's registration must survive our shutdown
+        src = getattr(self, "_adaptive_source", None)
+        if src is not None:
+            querystats.clear_adaptive_source(src)
         if self._server:
             self._server.shutdown()
             self._server = None

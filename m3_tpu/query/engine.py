@@ -251,6 +251,20 @@ class Engine:
     # -- evaluation --
 
     def _eval(self, e: Expr, eval_ts: np.ndarray):
+        """Evaluate one AST node. When an EXPLAIN collector is active on
+        this thread (query/explain.py), the node also becomes one plan-
+        tree entry carrying, in analyze mode, its wall time and the
+        QueryStats deltas (series/blocks/bytes/rungs/remote legs) its
+        subtree accrued; inactive, this is one thread-local read."""
+        from m3_tpu.query import explain as explain_mod
+
+        col = explain_mod.current()
+        if col is None:
+            return self._eval_node(e, eval_ts)
+        with col.node(e):
+            return self._eval_node(e, eval_ts)
+
+    def _eval_node(self, e: Expr, eval_ts: np.ndarray):
         if isinstance(e, NumberLiteral):
             return Scalar(np.full(len(eval_ts), e.value))
         if isinstance(e, StringLiteral):
@@ -354,7 +368,18 @@ class Engine:
         evaluated at step-aligned instants and rewrapped as ragged samples
         so every temporal function runs unchanged on it."""
         if isinstance(arg, MatrixSelector):
-            labels, raws = self._fetch(arg.selector, eval_ts, arg.range_ns)
+            # matrix selectors are consumed here rather than via _eval, so
+            # give the plan tree its selector stage explicitly (the
+            # selector → range function → aggregation shape)
+            import contextlib
+
+            from m3_tpu.query import explain as explain_mod
+
+            col = explain_mod.current()
+            with col.node(arg) if col is not None \
+                    else contextlib.nullcontext():
+                labels, raws = self._fetch(arg.selector, eval_ts,
+                                           arg.range_ns)
             return labels, raws, self._resolve_ts(arg.selector, eval_ts), arg.range_ns
         # subquery: evaluate the inner expr once over the union of aligned
         # instants covering every parent step's window

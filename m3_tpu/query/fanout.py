@@ -68,6 +68,11 @@ class FanoutNamespace:
     # -- index scatter --
 
     def _zone_call(self, zone, fn, *args, warnings: list | None = None):
+        import time as _time
+
+        from m3_tpu.utils import querystats
+
+        t0 = _time.perf_counter()
         try:
             faults.check("fanout.zone", zone=zone.name)
             return fn(*args)
@@ -79,6 +84,11 @@ class FanoutNamespace:
             if warnings is not None:
                 warnings.append(ReadWarning("fanout", zone.name, str(e)))
             return None
+        finally:
+            # per-zone share of this read, onto the active query record
+            # (EXPLAIN ANALYZE shows one plan leg per remote zone)
+            querystats.record_node_leg(f"zone:{zone.name}",
+                                       _time.perf_counter() - t0)
 
     def query_ids(self, query, start_ns: int, end_ns: int, limit=None,
                   warnings: list | None = None):

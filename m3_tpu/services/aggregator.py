@@ -82,6 +82,14 @@ class AggregatorService:
         self._stop = threading.Event()
         self.scope = default_registry().root_scope(
             "aggregator").subscope("svc", instance=self.instance_id)
+        # OTLP-style telemetry export (config `export:` / M3_TPU_EXPORT_*
+        # env): the aggregator's ingest/flush counters and msg-seam
+        # histograms drain to the same collector as the other services
+        from m3_tpu.utils.export import exporter_from_config
+
+        self.exporter = exporter_from_config(config, "aggregator")
+        if self.exporter is not None:
+            self.exporter.start()
 
     def _on_message(self, shard: int, payload: bytes) -> None:
         mt, sid, tags, t_ns, value = decode_metric(payload)
@@ -132,6 +140,8 @@ class AggregatorService:
             self.consumer.close()
         if self.producer:
             self.producer.close()
+        if self.exporter is not None:
+            self.exporter.close()  # final best-effort flush
         self.election.resign()
         self.log.info("aggregator stopped")
 

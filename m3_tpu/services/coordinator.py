@@ -174,6 +174,17 @@ class CoordinatorService:
             if not self.self_monitor.enabled:
                 self.log.info("self-monitor disabled: no local storage "
                               "namespace available")
+        # OTLP-style telemetry export: background drainer shipping this
+        # process's span ring + metrics registry to the configured
+        # collector (config `export:` section / M3_TPU_EXPORT_* env);
+        # None when unconfigured — no thread, no overhead
+        from m3_tpu.utils.export import exporter_from_config
+
+        self.exporter = exporter_from_config(config, "coordinator")
+        if self.exporter is not None:
+            self.exporter.start()
+            self.log.info("telemetry exporter started",
+                          sink=type(self.exporter.sink).__name__)
         self._stop = threading.Event()
 
     def _apply_ruleset(self, rs) -> None:
@@ -345,6 +356,8 @@ class CoordinatorService:
             self.carbon.close()
         if self.remote_server is not None:
             self.remote_server.close()
+        if self.exporter is not None:
+            self.exporter.close()  # final best-effort flush
         self.db.close()
         self.log.info("coordinator stopped")
 

@@ -63,11 +63,11 @@ class NodeAPI:
         try:
             with trace.activate(ctx), \
                     trace.span(trace.DBNODE_HANDLE, path=path):
-                return self._handle_traced(method, path, q, body)
+                return self._handle_traced(method, path, q, body, headers)
         finally:
             observe(_time.perf_counter() - t0)
 
-    def _handle_traced(self, method, path, q, body):
+    def _handle_traced(self, method, path, q, body, headers=None):
         try:
             if path in ("/health", "/bootstrapped"):
                 # exempt from injection so orchestrators can still see the
@@ -77,7 +77,14 @@ class NodeAPI:
             # breaker/consistency paths like a real sick node
             faults.check("dbnode.handle", path=path)
             if path == "/metrics":
-                return 200, default_registry().render_prometheus()
+                from m3_tpu.query.api import _render_metrics
+
+                # exemplar-capable OpenMetrics under content negotiation,
+                # same contract (incl. Content-Type) as the coordinator
+                # /metrics: a 3-tuple carries the negotiated type to the
+                # HTTP handler
+                status, ctype, payload = _render_metrics(q, headers)
+                return status, payload, ctype
             if path == "/debug/traces":
                 return self._debug_traces(method, q, body)
             if path == "/write" and method == "POST":
@@ -280,10 +287,14 @@ class NodeAPI:
                 u = urlparse(self.path)
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
-                status, payload = api.handle(method, u.path, parse_qs(u.query),
-                                             body, headers=self.headers)
+                status, payload, *rest = api.handle(
+                    method, u.path, parse_qs(u.query), body,
+                    headers=self.headers)
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                # routes may return a negotiated content type as a third
+                # element (/metrics OpenMetrics exposition)
+                self.send_header("Content-Type",
+                                 rest[0] if rest else "application/json")
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
@@ -354,6 +365,14 @@ class DBNodeService:
         if self.kv is not None:
             self.runtime.watch_kv(self.kv)
         self.api = NodeAPI(self.db)
+        # OTLP-style telemetry export (config `export:` / M3_TPU_EXPORT_*
+        # env): storage nodes ship their span rings + seam histograms to
+        # the same collector as the coordinator, so exported traces stitch
+        from m3_tpu.utils.export import exporter_from_config
+
+        self.exporter = exporter_from_config(config, "dbnode")
+        if self.exporter is not None:
+            self.exporter.start()
         self._stop = threading.Event()
 
     # -- placement plumbing --
@@ -553,6 +572,8 @@ class DBNodeService:
     def shutdown(self) -> None:
         self._stop.set()
         self.api.shutdown()
+        if self.exporter is not None:
+            self.exporter.close()  # final best-effort flush
         self.db.close()
         self.log.info("dbnode stopped")
 

@@ -33,6 +33,12 @@ _observe_write = _scope.histogram_handle("write_seconds")
 # the batched seam observes ONCE per batch; the points counter keeps
 # throughput accounting comparable with the per-point histogram's count
 _observe_write_batch = _scope.histogram_handle("write_batch_seconds")
+# batch-size distribution (count-shaped bounds): whether ingest batches
+# amortize the columnar pass is invisible from latency alone
+from m3_tpu.utils.instrument import COUNT_BUCKETS  # noqa: E402
+
+_observe_write_batch_size = _scope.histogram_handle(
+    "write_batch_size", bounds=COUNT_BUCKETS)
 
 
 @dataclass
@@ -160,13 +166,22 @@ class Database:
         Replay runs in SALVAGE mode: a corrupt interior chunk truncates
         that log (dropping everything after it, with a warning naming the
         offset and byte count) instead of raising — a damaged WAL must
-        degrade bootstrap, never brick it."""
+        degrade bootstrap, never brick it.
+
+        Each surviving log replays as ONE columnar batch through
+        Namespace.write_many (vectorized shard routing, one buffer lock
+        per (shard, window) group, one index insert_many pass with the
+        tag blobs decoded once per distinct series) instead of a
+        per-point write loop; entry order is preserved per window, so
+        seal-time last-write-wins resolves exactly as the per-point
+        replay did. Unowned shards degrade per row (the old loop's
+        silent skip)."""
         from m3_tpu.utils.ident import decode_tags
 
         retired = self._retired_logs.setdefault(name, [])
         cutoff = None
+        r = ns.opts.retention
         if now_ns is not None:
-            r = ns.opts.retention
             cutoff = r.block_start(now_ns - r.retention_ns)
         for path in commitlog.log_files(self.commitlog_dir(name)):
             entries, report = commitlog.replay_salvage(path)
@@ -177,18 +192,38 @@ class Database:
                     path, report.truncated_at, report.reason,
                     report.entries, report.dropped_bytes,
                 )
-            windows: set[int] = set()
+            sids: list[bytes] = []
+            encs: list[bytes] = []
+            fields_list: list = []
+            t_list: list[int] = []
+            v_list: list[int] = []
+            tag_fields: dict[bytes, list | None] = {}  # decode once per blob
             for e in entries:
                 if cutoff is not None and e.time_ns < cutoff:
                     continue  # past retention: don't resurrect
-                try:
-                    shard = ns.shard_for(e.series_id)
-                except KeyError:
-                    continue  # shard no longer owned by this node
-                windows.add(ns.opts.retention.block_start(e.time_ns))
-                shard.write(e.series_id, e.time_ns, e.value_bits, e.encoded_tags)
-                if ns.index is not None and e.encoded_tags:
-                    ns.index.insert(e.series_id, decode_tags(e.encoded_tags), e.time_ns)
+                sids.append(e.series_id)
+                encs.append(e.encoded_tags)
+                t_list.append(e.time_ns)
+                v_list.append(e.value_bits)
+                if e.encoded_tags:
+                    fields = tag_fields.get(e.encoded_tags)
+                    if fields is None:
+                        fields = tag_fields[e.encoded_tags] = \
+                            decode_tags(e.encoded_tags)
+                    fields_list.append(fields)
+                else:
+                    fields_list.append(None)  # untagged: skip the index
+            windows: set[int] = set()
+            if sids:
+                times = np.array(t_list, np.int64)
+                vbits = np.array(v_list, np.uint64)
+                errors = ns.write_many(sids, times, vbits, encs, fields_list)
+                ok = np.array([err is None for err in errors], bool)
+                if ok.any():  # unowned-shard rows don't pin their windows
+                    t_ok = times[ok]
+                    for w in np.unique(
+                            t_ok - (t_ok % r.block_size_ns)).tolist():
+                        windows.add(int(w))
             retired.append((path, windows, now_ns if now_ns is not None else 0))
 
     def _cleanup_retired_logs(self, name: str, ns: Namespace, now_ns: int) -> None:
@@ -438,6 +473,7 @@ class Database:
                 results, n_ok = self._write_batch_traced(namespace, entries)
         finally:
             _observe_write_batch(time.perf_counter() - t0)
+            _observe_write_batch_size(float(len(entries)))
         _scope.counter("write_batch_points", n_ok)
         return results
 
@@ -738,18 +774,42 @@ class Database:
             )
             values = windowed_agg.extract(agg_type, stats, vq, offsets)
             tgt = self.namespaces[target_ns]
-            for g in range(len(ge)):
-                sid, tags_blob = tags_by_idx[int(ge[g])]
-                tile_start = int(gw[g]) * tile_ns
-                # through Database.write so tiles hit the commitlog like
-                # every other write into the target namespace
-                self.write(target_ns, sid, tile_start, float(values[g]),
-                           tags_blob)
-                if tgt.index is not None and tags_blob:
-                    from m3_tpu.utils.ident import decode_tags
+            # tiles land as ONE columnar batch per source shard (the
+            # write_batch shape: one commitlog append, one buffer lock per
+            # (target shard, window) group, one index insert_many pass)
+            # instead of a per-tile Database.write loop
+            from m3_tpu.utils.ident import decode_tags
 
-                    tgt.index.insert(sid, decode_tags(tags_blob), tile_start)
-                written += 1
+            n_tiles = len(ge)
+            if n_tiles == 0:
+                continue
+            sids: list[bytes] = [b""] * n_tiles
+            encs: list[bytes] = [b""] * n_tiles
+            fields_list: list = [None] * n_tiles
+            fields_of: dict[bytes, list] = {}  # decode once per tag blob
+            t_arr = np.asarray(gw, np.int64) * tile_ns
+            v_arr = np.asarray(values, np.float64).view(np.uint64)
+            for g in range(n_tiles):
+                sid, tags_blob = tags_by_idx[int(ge[g])]
+                sids[g] = sid
+                encs[g] = tags_blob
+                if tags_blob:
+                    fields = fields_of.get(tags_blob)
+                    if fields is None:
+                        fields = fields_of[tags_blob] = decode_tags(tags_blob)
+                    fields_list[g] = fields
+            clog = self._commitlogs.get(target_ns)
+            if clog is not None:
+                # tiles hit the commitlog like every other write into the
+                # target namespace, one append for the whole shard's batch
+                clog.write_many(sids, encs, t_arr, v_arr,
+                                int(tgt.opts.write_time_unit))
+                windows = self._log_windows[target_ns]
+                bs = tgt.opts.retention.block_size_ns
+                for win in np.unique(t_arr - (t_arr % bs)).tolist():
+                    windows.add(int(win))
+            errors = tgt.write_many(sids, t_arr, v_arr, encs, fields_list)
+            written += sum(1 for err in errors if err is None)
         return written
 
     def flush_all(self, now_ns: int | None = None) -> int:

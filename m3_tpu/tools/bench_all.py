@@ -487,12 +487,15 @@ def config6_read_many():
 
 
 def config7_tracing_overhead():
-    """Observability-overhead guard on the write hot path (PR-4): the
-    SHIPPED path (tracer enabled at sample_every=1, per-write latency
-    histogram) vs the seed-equivalent path (tracer disabled, histogram
-    observe no-oped). The disabled-path cost must stay within noise of
-    seed: vs_baseline is shipped/seed throughput and the run flags
-    anything below 0.85 (beyond run-to-run noise on shared hosts)."""
+    """Observability-overhead guard on the write hot path (PR-4, widened
+    in PR-6): the SHIPPED path (tracer enabled at sample_every=1,
+    per-write latency histogram WITH exemplar capture, and a live
+    telemetry-exporter drainer shipping the registry+span ring to a file
+    sink every 0.5s) vs the seed-equivalent path (tracer disabled,
+    histogram observe no-oped, no exporter). The disabled-path cost must
+    stay within noise of seed: vs_baseline is shipped/seed throughput and
+    the run flags anything below 0.85 (beyond run-to-run noise on shared
+    hosts)."""
     import tempfile
 
     from m3_tpu.storage import database as database_mod
@@ -537,7 +540,11 @@ def config7_tracing_overhead():
 
     # paired interleaved runs, median of the per-pair ratios: host drift
     # on shared CPUs exceeds the effect size, and back-to-back pairing +
-    # median is the standard way to cancel it
+    # median is the standard way to cancel it. The shipped side runs
+    # under a LIVE exporter drainer (file sink, 0.5s interval) so the
+    # guard covers the full PR-6 observability stack.
+    from m3_tpu.utils.export import FileSink, TelemetryExporter
+
     ratios: list[float] = []
     rate_on = rate_off = 0.0
     try:
@@ -545,7 +552,15 @@ def config7_tracing_overhead():
         run_once()  # warm the code paths once, outside any pair
         for _ in range(5):
             seed_equivalent(True)
-            on = run_once()
+            with tempfile.TemporaryDirectory() as sink_dir:
+                exporter = TelemetryExporter(
+                    "bench", FileSink(f"{sink_dir}/telemetry.jsonl"),
+                    interval_s=0.5)
+                exporter.start()
+                try:
+                    on = run_once()
+                finally:
+                    exporter.close()
             seed_equivalent(False)
             off = run_once()
             ratios.append(on / off)

@@ -71,3 +71,65 @@ def record(op: str, device: bool) -> None:
 
 def next_pow2(n: int) -> int:
     return 1 << max(n - 1, 1).bit_length() if n > 2 else max(n, 1)
+
+
+# -- jit/plan-cache telemetry ------------------------------------------------
+#
+# Every XLA entry point on the serving paths is a jax.jit'd function keyed
+# on static args (shape bucket, unit, impl). Whether a call HIT that plan
+# cache or paid a trace+compile is the number the whole-query-compilation
+# work (ROADMAP #2) will be judged against — so the dispatch layer records
+# it: jit_tracker() wraps a call site, diffs the jitted function's cache
+# size across the call, and lands hit/miss counters plus a compile-time
+# histogram in the metrics registry (visible on /metrics, the self-scrape
+# and the exporter).
+
+_jit_scopes: dict = {}
+
+
+def _jit_scope(op: str, result: str):
+    key = (op, result)
+    sc = _jit_scopes.get(key)
+    if sc is None:
+        from m3_tpu.utils.instrument import default_registry
+
+        sc = default_registry().root_scope("compute").subscope(
+            "jit", op=op, result=result)
+        _jit_scopes[key] = sc
+    return sc
+
+
+class jit_tracker:
+    """`with jit_tracker("m3tsz_decode", jitted_fn): jitted_fn(...)` —
+    records compute.jit_calls{op,result=hit|miss} and, on a miss, the
+    trace+compile wall time into compute.jit_compile_seconds{op}. The
+    jitted function's private plan cache (`_cache_size`) is the ground
+    truth; a jax build without it records every call as a hit with no
+    compile histogram (counters stay meaningful, never wrong)."""
+
+    def __init__(self, op: str, jitted_fn):
+        self.op = op
+        self._size_fn = getattr(jitted_fn, "_cache_size", None)
+
+    def __enter__(self):
+        import time
+
+        self._before = self._size_fn() if self._size_fn is not None else None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        import time
+
+        dt = time.perf_counter() - self._t0
+        miss = self._before is not None and \
+            self._size_fn() > self._before
+        result = "miss" if miss else "hit"
+        counters[f"jit_{self.op}[{result}]"] += 1
+        sc = _jit_scope(self.op, result)
+        sc.counter("calls")
+        if miss:
+            # the whole call IS the compile on a miss (execution time is
+            # noise next to trace+lower+compile)
+            sc.observe("compile_seconds", dt)
+        return False

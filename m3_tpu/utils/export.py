@@ -1,0 +1,229 @@
+"""OTLP-style telemetry export: drain span rings + metric registries to a
+collector endpoint.
+
+The reference deployment sidecars an OpenTelemetry collector next to every
+process; this module is the in-house equivalent for all four services
+(coordinator, dbnode, aggregator, kvd): a background drainer periodically
+snapshots the process tracer's NEW spans (`Tracer.export_since` cursor —
+each span ships at most once) and the metrics registry, wraps them in an
+OTLP-shaped envelope (`resource` / `scopeSpans` / `scopeMetrics`), and
+ships them to a pluggable sink:
+
+- ``HTTPSink`` POSTs JSON to a collector endpoint (`M3_TPU_EXPORT_ENDPOINT`
+  or the service config's ``export.endpoint``);
+- ``FileSink`` appends JSON lines (`M3_TPU_EXPORT_FILE` / ``export.file``)
+  — the test backend and a poor-man's collector for `em` dtests.
+
+Backpressure contract: the hot path NEVER blocks on export. Recording
+stays exactly as cheap as without an exporter (the drainer pulls on its
+own thread); payloads queue in a BOUNDED deque and a sink outage drops the
+oldest payload per overflow, counted on ``exporter_dropped_payloads`` /
+``exporter_dropped_spans`` — so a dead collector costs bounded memory and
+visible counters, nothing else. With no endpoint/file configured,
+``exporter_from_config`` returns None and the services skip the thread
+entirely.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from m3_tpu.utils.instrument import MetricsRegistry, default_registry
+
+
+class FileSink:
+    """JSON-lines file backend (tests, dtests)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def ship(self, payload: dict) -> None:
+        line = json.dumps(payload, default=str)
+        with open(self.path, "a") as f:
+            f.write(line + "\n")
+
+
+class HTTPSink:
+    """POST each payload as JSON to a collector endpoint."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 5.0):
+        self.endpoint = endpoint
+        self.timeout_s = timeout_s
+
+    def ship(self, payload: dict) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.endpoint, data=json.dumps(payload, default=str).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            r.read()
+
+
+class TelemetryExporter:
+    """Bounded-queue drainer: collect -> enqueue -> ship, on a daemon
+    thread (or driven manually via `tick()` in tests/service loops)."""
+
+    def __init__(self, service: str, sink, interval_s: float = 10.0,
+                 queue_max: int = 64, registry: MetricsRegistry | None = None,
+                 tracer=None):
+        from m3_tpu.utils import trace
+
+        self.service = service
+        self.sink = sink
+        self.interval_s = interval_s
+        self.registry = registry or default_registry()
+        self.tracer = tracer or trace.default_tracer()
+        self._queue: deque[dict] = deque()
+        self.queue_max = queue_max
+        self._cursor = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # the exporter's own health rides the same registry it exports
+        self._scope = self.registry.root_scope("exporter") \
+            .subscope("svc", service=service)
+
+    # -- collection --
+
+    def collect_once(self, now_ns: int | None = None) -> dict | None:
+        """One export payload: spans recorded since the last collect plus
+        a full metrics snapshot. None when there is nothing new to say
+        (no new spans AND no metrics — a fresh idle process)."""
+        now_ns = now_ns if now_ns is not None else time.time_ns()
+        spans, self._cursor = self.tracer.export_since(self._cursor)
+        counters, gauges, timers, hists = self.registry.snapshot()
+        if not spans and not counters and not gauges and not timers \
+                and not hists:
+            return None
+        metrics = []
+        for (name, tags), v in counters.items():
+            metrics.append({"name": name, "type": "counter",
+                            "attributes": dict(tags), "value": v})
+        for (name, tags), v in gauges.items():
+            metrics.append({"name": name, "type": "gauge",
+                            "attributes": dict(tags), "value": v})
+        for (name, tags), (count, total_s, max_s) in timers.items():
+            metrics.append({"name": name, "type": "timer",
+                            "attributes": dict(tags), "count": count,
+                            "sum": total_s, "max": max_s})
+        for (name, tags), (bounds, counts, hsum, hcount) in hists.items():
+            metrics.append({"name": name, "type": "histogram",
+                            "attributes": dict(tags),
+                            "bounds": list(bounds), "counts": list(counts),
+                            "sum": hsum, "count": hcount})
+        return {
+            "resource": {"service.name": self.service,
+                         "process.pid": os.getpid()},
+            "time_unix_ns": now_ns,
+            "scopeSpans": spans,
+            "scopeMetrics": metrics,
+        }
+
+    # -- queue + ship --
+
+    def _enqueue(self, payload: dict) -> None:
+        with self._lock:
+            while len(self._queue) >= self.queue_max:
+                dropped = self._queue.popleft()
+                self._scope.counter("dropped_payloads")
+                self._scope.counter("dropped_spans",
+                                    len(dropped.get("scopeSpans", ())))
+            self._queue.append(payload)
+            self._scope.gauge("queue_depth", len(self._queue))
+
+    def _drain(self) -> int:
+        """Ship queued payloads oldest-first; stop at the first sink
+        failure (the rest retry next tick, bounded by the queue)."""
+        shipped = 0
+        while True:
+            with self._lock:
+                if not self._queue:
+                    break
+                payload = self._queue[0]
+            try:
+                self.sink.ship(payload)
+            except Exception:  # noqa: BLE001 - sink outage: keep queued
+                self._scope.counter("ship_errors")
+                break
+            with self._lock:
+                # ships run on one drainer thread; the head is still ours
+                if self._queue and self._queue[0] is payload:
+                    self._queue.popleft()
+            shipped += 1
+            self._scope.counter("shipped_payloads")
+            self._scope.counter("shipped_spans",
+                                len(payload.get("scopeSpans", ())))
+        with self._lock:
+            self._scope.gauge("queue_depth", len(self._queue))
+        return shipped
+
+    def tick(self, now_ns: int | None = None) -> int:
+        """One collect+enqueue+drain pass; returns payloads shipped."""
+        payload = self.collect_once(now_ns)
+        if payload is not None:
+            self._enqueue(payload)
+        return self._drain()
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 - the drainer must
+                    pass           # outlive any transient sink weirdness
+
+        self._thread = threading.Thread(
+            target=loop, name=f"telemetry-export-{self.service}", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        """Stop the drainer and attempt one final collect+ship."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval_s)
+            self._thread = None
+        try:
+            self.tick()
+        except Exception:  # noqa: BLE001 - best-effort final flush
+            pass
+
+
+def exporter_from_config(config: dict | None, service: str,
+                         registry: MetricsRegistry | None = None
+                         ) -> TelemetryExporter | None:
+    """Build the service's exporter from its config's ``export:`` section
+    (file / endpoint / interval_s / queue_max), with
+    ``M3_TPU_EXPORT_FILE`` / ``M3_TPU_EXPORT_ENDPOINT`` env overrides so
+    processes without config files (kvd, dtest children) still export.
+    Returns None when neither a file nor an endpoint is configured — the
+    caller skips the drainer thread entirely."""
+    cfg = dict((config or {}).get("export", {}) or {})
+    file_path = os.environ.get("M3_TPU_EXPORT_FILE") or cfg.get("file")
+    endpoint = os.environ.get("M3_TPU_EXPORT_ENDPOINT") or cfg.get("endpoint")
+    if file_path:
+        sink = FileSink(str(file_path))
+    elif endpoint:
+        sink = HTTPSink(str(endpoint))
+    else:
+        return None
+    return TelemetryExporter(
+        service, sink,
+        interval_s=float(cfg.get("interval_s", 10.0)),
+        queue_max=int(cfg.get("queue_max", 64)),
+        registry=registry,
+    )

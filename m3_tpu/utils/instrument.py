@@ -8,6 +8,17 @@ NaN/Inf), plus a minimal structured logger. The platform monitors itself
 with the same metric model it stores: the coordinator's self-scrape loop
 (utils/selfscrape.py) ingests this registry into the `_m3_system`
 namespace so p99s over these histograms are one PromQL query away.
+
+Exemplars: every histogram observation made inside a SAMPLED trace pins a
+``(trace_id, value, timestamp)`` exemplar to the bucket it landed in —
+last observation wins per bucket, so each bucket of a latency histogram
+always points at a recent representative trace. The OpenMetrics-style render
+(``render_openmetrics``, served on ``/metrics?format=openmetrics`` —
+explicit opt-in only) emits them as
+``# {trace_id="..."} value ts`` suffixes on `_bucket` lines, so a p99
+bucket is one /debug/traces lookup away from its stitched trace. The
+plain Prometheus render is byte-compatible with PR 4 (no exemplars —
+that format has no syntax for them).
 """
 
 from __future__ import annotations
@@ -43,6 +54,32 @@ class _Timer:
 # buckets per 1000x decade, enough that p99 interpolation error stays
 # under ~2x anywhere in the range while one histogram costs ~30 ints
 DEFAULT_BUCKETS: tuple = tuple(2.0 ** e for e in range(-20, 7))
+# bounds for COUNT-shaped distributions (batch sizes, fan-out widths):
+# powers of two from 1 to ~1M
+COUNT_BUCKETS: tuple = tuple(float(2 ** e) for e in range(0, 21))
+
+
+# bound lazily (first traced observation), then a straight thread-local
+# read per observation: an in-function `import` here costs ~2us per call,
+# which at per-datapoint seam frequency is the difference between
+# exemplars being free and blowing the bench-#7 overhead guard
+_tracer_tl = None
+
+
+def _active_exemplar_trace() -> str | None:
+    """The trace id an observation should pin as its exemplar: the
+    thread's active SAMPLED span context, or None outside a recorded
+    trace (one thread-local read — the histogram hot paths call this per
+    observation)."""
+    global _tracer_tl
+    if _tracer_tl is None:
+        from m3_tpu.utils import trace
+
+        _tracer_tl = trace.default_tracer()._tl
+    ctx = getattr(_tracer_tl, "ctx", None)
+    if ctx is None or not ctx.sampled or not ctx.span_id:
+        return None
+    return ctx.trace_id
 
 
 @dataclass
@@ -51,12 +88,22 @@ class _Histogram:
     counts: list = field(default_factory=lambda: [0] * (len(DEFAULT_BUCKETS) + 1))
     sum: float = 0.0
     count: int = 0
+    # per-bucket (trace_id, value, unix_seconds) exemplar, last-wins;
+    # allocated on the first traced observation so untraced histograms
+    # stay three scalars + a counts list
+    exemplars: list | None = None
 
-    def observe_locked(self, value: float) -> None:
+    def observe_locked(self, value: float,
+                       exemplar_trace: str | None = None) -> None:
         """Record one observation; caller holds the registry lock."""
-        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        i = bisect.bisect_left(self.bounds, value)
+        self.counts[i] += 1
         self.sum += value
         self.count += 1
+        if exemplar_trace is not None:
+            if self.exemplars is None:
+                self.exemplars = [None] * len(self.counts)
+            self.exemplars[i] = (exemplar_trace, value, time.time())
 
     def cumulative(self) -> list[tuple[float, int]]:
         """[(upper_bound, cumulative_count)] incl. the +Inf bucket."""
@@ -129,13 +176,30 @@ class Scope:
 
         return _Ctx()
 
-    def observe(self, name: str, value: float) -> None:
-        """One histogram observation (seconds for latency seams). Unlike a
+    def _histogram_locked(self, name: str, bounds: tuple | None):
+        """Get-or-create under the registry lock; `bounds` only applies on
+        creation (first binding wins, like Prometheus client libs)."""
+        reg = self._registry
+        key = (self._name(name), self._tags)
+        h = reg.histograms.get(key)
+        if h is None:
+            h = _Histogram(bounds=tuple(bounds)) if bounds else _Histogram()
+            if bounds:
+                h.counts = [0] * (len(h.bounds) + 1)
+            reg.histograms[key] = h
+        return h
+
+    def observe(self, name: str, value: float,
+                bounds: tuple | None = None) -> None:
+        """One histogram observation (seconds for latency seams; pass
+        COUNT_BUCKETS bounds for size-shaped distributions). Unlike a
         timer, the distribution survives: p50/p99 are derivable from the
-        `_bucket` exposition instead of only count/total/max."""
+        `_bucket` exposition instead of only count/total/max. Observed
+        inside a sampled trace, the bucket pins a (trace_id, value)
+        exemplar."""
+        ex = _active_exemplar_trace()
         with self._registry._lock:
-            self._registry.histograms[(self._name(name), self._tags)] \
-                .observe_locked(value)
+            self._histogram_locked(name, bounds).observe_locked(value, ex)
 
     def histogram(self, name: str):
         """Context manager observing a duration into the histogram."""
@@ -151,28 +215,42 @@ class Scope:
 
         return _Ctx()
 
-    def histogram_handle(self, name: str):
+    def histogram_handle(self, name: str, bounds: tuple | None = None):
         """Pre-resolved observe(value) callable for HOT paths: the metric
         key is built once here and the closure binds everything it touches,
         so each observation is a bisect (outside the lock — bounds are
         immutable) plus three adds under a bare acquire/release. Scope
         .observe rebuilds the key string and enters a context manager per
-        call — measurably slower on per-datapoint seams."""
+        call — measurably slower on per-datapoint seams. Exemplar-capable
+        like observe: a sampled trace context pins its trace_id to the
+        bucket (one thread-local read when no trace is active)."""
+        from m3_tpu.utils import trace
+
         reg = self._registry
         with reg._lock:
-            h = reg.histograms[(self._name(name), self._tags)]
+            h = self._histogram_locked(name, bounds)
         acquire = reg._lock.acquire
         release = reg._lock.release
-        bounds = h.bounds
+        h_bounds = h.bounds
         counts = h.counts
         _bisect = bisect.bisect_left
+        # the tracer's raw thread-local, read inline (no function call):
+        # per-datapoint seams pay one getattr for exemplar capability
+        tracer_tl = trace.default_tracer()._tl
+        _getattr = getattr
+        _now = time.time
 
         def observe(value: float) -> None:
-            i = _bisect(bounds, value)
+            i = _bisect(h_bounds, value)
+            ctx = _getattr(tracer_tl, "ctx", None)
             acquire()
             counts[i] += 1
             h.sum += value
             h.count += 1
+            if ctx is not None and ctx.sampled and ctx.span_id:
+                if h.exemplars is None:
+                    h.exemplars = [None] * len(counts)
+                h.exemplars[i] = (ctx.trace_id, value, _now())
             release()
 
         return observe
@@ -277,6 +355,55 @@ class MetricsRegistry:
                 tags += (("path", path.rstrip("]")),)
             fmt("m3_dispatch_ops_total", tags, v, "counter")
         return ("\n".join(out) + "\n").encode()
+
+    def render_openmetrics(self) -> bytes:
+        """OpenMetrics-style text exposition: the Prometheus render plus
+        histogram-bucket EXEMPLARS (`# {trace_id="..."} value ts` suffix
+        per the OpenMetrics exemplar syntax) and the `# EOF` terminator.
+        Served only on explicit opt-in (`/metrics?format=openmetrics`):
+        family names match the Prometheus render exactly (counters keep
+        their PR-4 names rather than gaining the `_total` suffix strict
+        OpenMetrics mandates) so dashboards and the `_m3_system`
+        self-scrape series line up across both formats — which is also
+        why this render must never be Accept-negotiated to a stock
+        scraper expecting spec-strict OpenMetrics."""
+        # exemplars are not in snapshot() (its consumers - selfscrape,
+        # the prometheus render - have no use for them), so take one
+        # dedicated locked pass here, capturing bounds alongside
+        with self._lock:
+            exemplars = {}
+            bounds_of = {}
+            for k, h in self.histograms.items():
+                if h.exemplars:
+                    exemplars[k] = list(h.exemplars)
+                    bounds_of[k] = h.bounds
+        # per rendered-line prefix (`name_bucket{tags,le="..."` — the exact
+        # string render_prometheus emits before the space): the exemplar
+        # pinned to that bucket. Tags participate in the key, so two
+        # histograms sharing a family name cannot cross-pollinate.
+        by_prefix: dict[str, tuple] = {}
+        for (name, tags), ex in exemplars.items():
+            bounds = bounds_of[(name, tags)]
+            tag_str = ",".join(f'{k}="{_escape_label(v)}"' for k, v in tags)
+            for slot, pinned in enumerate(ex):
+                if pinned is None:
+                    continue
+                le = "+Inf" if slot >= len(bounds) \
+                    else _fmt_number(bounds[slot])
+                labels = (tag_str + "," if tag_str else "") + f'le="{le}"'
+                by_prefix[f"{_prom_name(name)}_bucket{{{labels}}}"] = pinned
+        base = self.render_prometheus().decode()
+        out: list[str] = []
+        for line in base.splitlines():
+            brace = line.find("{")
+            pinned = by_prefix.get(line[: line.rfind(" ")]) \
+                if brace > 0 else None
+            if pinned is not None:
+                trace_id, value, ts = pinned
+                line = (f'{line} # {{trace_id="{_escape_label(trace_id)}"}} '
+                        f"{_fmt_number(value)} {ts:.3f}")
+            out.append(line)
+        return ("\n".join(out) + "\n# EOF\n").encode()
 
 
 _default_registry = MetricsRegistry()

@@ -13,11 +13,15 @@ threading a parameter through a dozen signatures:
 - the block cache records hits/misses, the decode ladder records which
   rung served each (shard, block, volume) group and the bytes decoded.
 
-Finished records land in a bounded ring served at /debug/slow_queries;
-`M3_TPU_SLOW_QUERY_MS` sets the admission threshold (default 0: every
-query is kept — the ring IS the query log until an operator raises the
-bar). The HTTP layer embeds the record in the response envelope under
-`stats`.
+Finished records land in a bounded ring served at /debug/slow_queries.
+Admission is PERCENTILE-BASED when a request-latency histogram source is
+registered (the coordinator registers its `request_seconds` histogram):
+a query is slow when it exceeds the live p99 of that histogram —
+`M3_TPU_SLOW_QUERY_MS` is the FLOOR under the adaptive bar, and the
+fallback threshold while the histogram is still too thin to trust
+(default 0: every query is kept — the ring IS the query log until the
+p99 bar arms or an operator raises the floor). The HTTP layer embeds
+the record in the response envelope under `stats`.
 
 In cluster mode the storage/decode counters accrue on the STORAGE node
 processes (their own /metrics histograms cover them); the coordinator's
@@ -51,10 +55,14 @@ class QueryStats:
     decode_rungs: dict = field(default_factory=dict)
     # stage name -> seconds (query_ids, read_many, eval)
     stages: dict = field(default_factory=dict)
+    # remote leg -> (calls, seconds, rows): one entry per storage node /
+    # fanout zone this query touched (the cross-node half of EXPLAIN
+    # ANALYZE — the coordinator's plan tree shows each node's share)
+    node_legs: dict = field(default_factory=dict)
     duration_s: float = 0.0
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "query": self.query,
             "namespace": self.namespace,
             "start_unix_ns": self.start_unix_ns,
@@ -68,6 +76,12 @@ class QueryStats:
             "decode_rungs": dict(self.decode_rungs),
             "stages_ms": {k: round(v * 1e3, 3) for k, v in self.stages.items()},
         }
+        if self.node_legs:
+            out["node_legs"] = {
+                host: {"calls": c, "ms": round(s * 1e3, 3), "rows": r}
+                for host, (c, s, r) in self.node_legs.items()
+            }
+        return out
 
 
 _tls = threading.local()
@@ -83,40 +97,87 @@ def _env_threshold_s() -> float:
 
 
 _threshold_s = _env_threshold_s()
+# adaptive slow-query bar: (histogram_source_fn, quantile, min_count).
+# The coordinator registers its request-latency histogram; while the
+# histogram holds fewer than min_count observations the env/floor
+# threshold governs alone (a 3-sample p99 is noise, not a bar).
+_adaptive: tuple | None = None
 
 
 def set_threshold_ms(ms: float) -> None:
-    """Admission threshold for the slow-query ring (0 keeps everything)."""
+    """FLOOR threshold for the slow-query ring (0 keeps everything until
+    the adaptive p99 bar arms)."""
     global _threshold_s
     _threshold_s = max(0.0, float(ms)) / 1e3
+
+
+def set_adaptive_source(source, quantile: float = 0.99,
+                        min_count: int = 64) -> None:
+    """Register (or clear, with None) the live histogram the slow-query
+    bar derives from: `source()` returns an object with `.count` and
+    `.quantile(q)` (utils/instrument._Histogram). Admission threshold
+    becomes max(floor, histogram p99) once the histogram holds min_count
+    observations."""
+    global _adaptive
+    _adaptive = None if source is None else (source, quantile, min_count)
+
+
+def clear_adaptive_source(source) -> None:
+    """Clear the adaptive bar ONLY if `source` is the currently
+    registered one — a shutting-down CoordinatorAPI must not disarm a
+    sibling instance's registration (the bar is process-global)."""
+    global _adaptive
+    if _adaptive is not None and _adaptive[0] is source:
+        _adaptive = None
+
+
+def threshold_s() -> float:
+    """The CURRENT admission bar: the env/operator floor, raised to the
+    registered histogram's live quantile once it has enough samples."""
+    thr = _threshold_s
+    if _adaptive is not None:
+        source, q, min_count = _adaptive
+        try:
+            h = source()
+            if h is not None and h.count >= min_count:
+                p = h.quantile(q)
+                if p == p:  # not NaN
+                    thr = max(thr, p)
+        except Exception:  # noqa: BLE001 - a broken source must never
+            pass           # make finish() raise
+    return thr
 
 
 def current() -> QueryStats | None:
     return getattr(_tls, "current", None)
 
 
-def start(query: str = "", namespace: str = "") -> QueryStats:
+def start(query: str = "", namespace: str = "",
+          clock=None) -> QueryStats:
     """Open a record for this thread's query. Nested engines (subqueries,
     front-ends compiling through the same engine) keep the OUTER record:
     the inner call gets the same object back with a depth mark, and only
-    the matching outermost `finish` closes it."""
+    the matching outermost `finish` closes it. `clock` (seconds, default
+    perf_counter) is injectable so admission tests run on virtual time."""
     cur = getattr(_tls, "current", None)
     if cur is not None:
         cur._depth = getattr(cur, "_depth", 0) + 1  # type: ignore[attr-defined]
         return cur
     st = QueryStats(query=query, namespace=namespace,
                     start_unix_ns=time.time_ns())
-    st._t0 = time.perf_counter()  # type: ignore[attr-defined]
+    st._clock = clock or time.perf_counter  # type: ignore[attr-defined]
+    st._t0 = st._clock()  # type: ignore[attr-defined]
     st._depth = 0  # type: ignore[attr-defined]
     _tls.current = st
     return st
 
 
 def finish(st: QueryStats) -> None:
-    """Close the record, stamp duration, admit to the ring. A nested
-    finish (depth > 0) only pops one level — the outer query keeps
-    accruing; object identity alone can't tell owner from nested caller
-    since start() hands the same record back."""
+    """Close the record, stamp duration, admit to the ring when it clears
+    the threshold bar (env floor raised to the live p99 once the adaptive
+    source arms). A nested finish (depth > 0) only pops one level — the
+    outer query keeps accruing; object identity alone can't tell owner
+    from nested caller since start() hands the same record back."""
     if getattr(_tls, "current", None) is not st:
         return
     depth = getattr(st, "_depth", 0)
@@ -124,8 +185,9 @@ def finish(st: QueryStats) -> None:
         st._depth = depth - 1  # type: ignore[attr-defined]
         return
     _tls.current = None
-    st.duration_s = time.perf_counter() - getattr(st, "_t0", time.perf_counter())
-    if st.duration_s >= _threshold_s:
+    clock = getattr(st, "_clock", time.perf_counter)
+    st.duration_s = clock() - getattr(st, "_t0", clock())
+    if st.duration_s >= threshold_s():
         with _ring_lock:
             _ring.append(st)
 
@@ -144,6 +206,17 @@ def record(series_matched: int = 0, blocks_read: int = 0,
     st.cache_misses += cache_misses
     if decode_rung is not None:
         st.decode_rungs[decode_rung] = st.decode_rungs.get(decode_rung, 0) + 1
+
+
+def record_node_leg(leg: str, seconds: float, rows: int = 0) -> None:
+    """Accrue one remote leg (storage-node RPC, fanout zone) onto the
+    active query's record: EXPLAIN ANALYZE shows each node's share of a
+    fan-out stage. No-op outside a query."""
+    st = getattr(_tls, "current", None)
+    if st is None:
+        return
+    calls, total_s, total_rows = st.node_legs.get(leg, (0, 0.0, 0))
+    st.node_legs[leg] = (calls + 1, total_s + seconds, total_rows + rows)
 
 
 @contextmanager

@@ -29,6 +29,65 @@ from m3_tpu.utils.instrument import (
 
 SELF_NAMESPACE = "_m3_system"
 
+_PAGE_SIZE = 4096
+try:
+    import os as _os
+
+    _PAGE_SIZE = _os.sysconf("SC_PAGE_SIZE")
+except (ValueError, OSError, AttributeError):
+    pass
+
+
+def record_process_gauges(registry: MetricsRegistry | None = None) -> None:
+    """Compute-plane health gauges, refreshed each self-scrape tick:
+    process RSS (from /proc/self/statm, getrusage fallback) and per-device
+    accelerator memory in use (jax memory_stats — only when a backend is
+    ALREADY initialized, same no-init rule as utils/dispatch: a scrape
+    must never be the thing that pays, or wedges on, PJRT init). CPU
+    backends report no memory_stats and are skipped."""
+    registry = registry or default_registry()
+    scope = registry.root_scope("process")
+    rss = 0
+    try:
+        with open("/proc/self/statm") as f:
+            rss = int(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+            import sys as _sys
+
+            peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            # ru_maxrss is KILOBYTES on linux but BYTES on darwin — and
+            # darwin is exactly where the /proc path above fails. (Peak
+            # rss, not current: the best this fallback can do.)
+            rss = peak if _sys.platform == "darwin" else peak * 1024
+        except Exception:  # noqa: BLE001 - no rss source on this platform
+            pass
+    if rss:
+        scope.gauge("rss_bytes", float(rss))
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge._backends:  # not initialized: do not trigger it
+            return
+        dev_scope = registry.root_scope("device")
+        for d in jax.devices():
+            stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+            if not stats:
+                continue  # CPU devices report none
+            in_use = stats.get("bytes_in_use")
+            if in_use is not None:
+                dev_scope.subscope("mem", device=str(d.id),
+                                   platform=d.platform) \
+                    .gauge("bytes_in_use", float(in_use))
+    except Exception:  # noqa: BLE001 - never break the scrape over a
+        pass           # backend quirk
+
 
 def ensure_namespace(db, namespace: str = SELF_NAMESPACE) -> bool:
     """Create the self-monitoring namespace on the LOCAL storage under
@@ -67,6 +126,9 @@ def scrape_once(db, registry: MetricsRegistry | None = None,
     raises like any bad write."""
     registry = registry or default_registry()
     now_ns = now_ns if now_ns is not None else time.time_ns()
+    # refresh compute-plane gauges (RSS, device memory) so the tick's
+    # snapshot carries them alongside the seam histograms
+    record_process_gauges(registry)
     counters, gauges, timers, hists = registry.snapshot()
     entries: list = []
     for (name, tags), v in counters.items():

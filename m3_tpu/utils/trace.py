@@ -119,6 +119,9 @@ class Span:
     trace_id: str = ""
     span_id: str = ""
     parent_span_id: str | None = None
+    # ring admission order, monotonic per process — the exporter's drain
+    # cursor (utils/export.py) ships each recorded span exactly once
+    seq: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -152,6 +155,8 @@ class Tracer:
         # lock-free sampler: next() on itertools.count is atomic in
         # CPython (a single C call), unlike the old racy `_counter += 1`
         self._count = itertools.count()
+        # ring admission counter (under _lock): export_since cursors
+        self._last_seq = 0
         self.enabled = True
 
     def _stack(self) -> list:
@@ -256,6 +261,8 @@ class Tracer:
             stack.pop()
             tl.ctx = prev_ctx
             with self._lock:
+                self._last_seq += 1
+                sp.seq = self._last_seq
                 self._spans.append(sp)
 
     # -- ring access --
@@ -270,6 +277,17 @@ class Tracer:
         with self._lock:
             spans = [s for s in self._spans if s.trace_id == trace_id]
         return [s.to_dict() for s in spans]
+
+    def export_since(self, cursor: int) -> tuple[list[dict], int]:
+        """Spans recorded after `cursor` (a prior call's returned cursor;
+        0 = everything still in the ring) plus the new cursor. The
+        exporter's drain surface: spans evicted from the bounded ring
+        between drains are simply gone — the ring never grows to wait for
+        a slow exporter (export must not backpressure recording)."""
+        with self._lock:
+            spans = [s for s in self._spans if s.seq > cursor]
+            last = self._last_seq
+        return [s.to_dict() for s in spans], last
 
     def clear(self) -> None:
         with self._lock:

@@ -221,7 +221,7 @@ class TestBatchLoopParity:
                        write_consistency=ConsistencyLevel.ONE)
         ents = [(b"s-%d" % i, [(b"k", b"v")], START + i * SEC, float(i))
                 for i in range(32)]
-        assert sess.write_many("default", ents) == 32
+        assert sess.write_many("default", ents) == [None] * 32
         assert called == [32]
         db.close()
 

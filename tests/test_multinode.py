@@ -261,7 +261,7 @@ class TestBatchedWrites:
         sess = make_session(kv)
         entries = [(b"bm", [(b"i", str(i).encode())], START + i * 10**9, float(i))
                    for i in range(30)]
-        assert sess.write_many("default", entries) == 30
+        assert sess.write_many("default", entries) == [None] * 30
         for svc in nodes.values():
             ids = set()
             for ns in svc.db.namespaces.values():
@@ -269,13 +269,19 @@ class TestBatchedWrites:
             assert len(ids) == 30  # RF=3: every node holds every series
 
     def test_write_many_consistency_failure(self, cluster):
+        """A sub-consistency entry degrades ITS OWN result slot (naming
+        the ack shortfall) instead of raising on the whole batch; the
+        all-or-raise surface lives in ClusterDatabase.write_tagged_batch."""
         kv, nodes = cluster
         nodes["node1"].api.shutdown()
         nodes["node2"].api.shutdown()
         sess = make_session(kv, write_cl=ConsistencyLevel.MAJORITY)
+        [res] = sess.write_many("default", [(b"x", [(b"k", b"v")],
+                                             START + 10**9, 1.0)])
+        assert res is not None and "acks" in res
         with pytest.raises(ConsistencyError):
-            sess.write_many("default", [(b"x", [(b"k", b"v")],
-                                         START + 10**9, 1.0)])
+            ClusterDatabase(sess).write_tagged_batch(
+                "default", [(b"x", [(b"k", b"v")], START + 10**9, 1.0)])
 
     def test_remote_write_uses_batch_path(self, cluster):
         """Prometheus remote write over the cluster goes through the

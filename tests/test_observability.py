@@ -223,6 +223,12 @@ class TestQueryStatsAndSlowLog:
         querystats.clear()
         db, api = _local_api(tmp_path)
         port = api.serve(port=0)
+        # this test pins the FLOOR admission path; serve() armed the
+        # adaptive p99 bar against the suite-global request histogram,
+        # which other tests may already have filled past min_count —
+        # disarm it here (the adaptive path has its own virtual-clock
+        # test below)
+        querystats.set_adaptive_source(None)
         try:
             for j in range(20):
                 db.write_tagged("default", b"m", [(b"k", b"v")],
@@ -483,3 +489,407 @@ class TestConsensusSeamHistogram:
         assert types.get("consensus_commit_seconds") == "histogram"
         assert any(k[0] == "consensus_commit_seconds_bucket"
                    for k in samples)
+
+
+# ---------------------------------------------------------------------------
+# PR-6 introspection plane: exemplars, EXPLAIN/ANALYZE, exporter, p99 bar
+# ---------------------------------------------------------------------------
+
+
+def _strip_exemplars(text: str) -> tuple[str, dict]:
+    """Split OpenMetrics text into (plain exposition, exemplars keyed by
+    the full sample-line prefix). Drops the # EOF terminator."""
+    plain: list[str] = []
+    exemplars: dict[str, tuple[str, float]] = {}
+    for line in text.splitlines():
+        if line == "# EOF":
+            continue
+        if " # {" in line:
+            base, _, ex = line.partition(" # ")
+            m = re.match(r'\{trace_id="([^"]+)"\} ([^ ]+) ', ex + " ")
+            assert m, f"malformed exemplar: {line!r}"
+            exemplars[base[: base.rfind(" ")]] = (m.group(1),
+                                                 float(m.group(2)))
+            plain.append(base)
+        else:
+            plain.append(line)
+    return "\n".join(plain) + "\n", exemplars
+
+
+class TestExemplars:
+    def test_openmetrics_exemplar_round_trip(self):
+        reg = MetricsRegistry()
+        s = reg.root_scope("seam")
+        handle = s.histogram_handle("hot_seconds")
+        trace.default_tracer().clear()
+        with trace.span("req") as sp:
+            s.observe("lat_seconds", 0.3)      # Scope.observe path
+            handle(0.0021)                     # hot-path closure path
+        s.observe("lat_seconds", 0.4)          # OUTSIDE a trace: no exemplar
+        text = reg.render_openmetrics().decode()
+        assert text.endswith("# EOF\n")
+        plain, exemplars = _strip_exemplars(text)
+        # base exposition (exemplars stripped) still parses strictly and
+        # matches the Prometheus render byte-for-byte
+        types, samples = parse_exposition(plain)
+        assert types["seam_lat_seconds"] == "histogram"
+        assert plain == reg.render_prometheus().decode()
+        # both entry points pinned this trace's id to the bucket they hit
+        by_metric = {}
+        for prefix, (tid, val) in exemplars.items():
+            by_metric.setdefault(prefix.split("{")[0], []).append((tid, val))
+        assert any(tid == sp.trace_id and val == 0.3
+                   for tid, val in by_metric["seam_lat_seconds_bucket"])
+        assert any(tid == sp.trace_id and val == 0.0021
+                   for tid, val in by_metric["seam_hot_seconds_bucket"])
+        # the 0.4 observation landed in a different bucket than 0.3 and
+        # carried no trace: its bucket must have NO exemplar
+        import bisect as _bisect
+
+        from m3_tpu.utils.instrument import DEFAULT_BUCKETS
+        b_03 = _bisect.bisect_left(DEFAULT_BUCKETS, 0.3)
+        b_04 = _bisect.bisect_left(DEFAULT_BUCKETS, 0.4)
+        if b_03 != b_04:  # (they do differ: 0.3 <= 2^-2 < 0.4 <= 2^-1)
+            vals = [v for _t, v in by_metric["seam_lat_seconds_bucket"]]
+            assert 0.4 not in vals
+
+    def test_unsampled_trace_pins_no_exemplar(self):
+        from m3_tpu.utils.trace import SpanContext
+
+        reg = MetricsRegistry()
+        s = reg.root_scope("seam")
+        tr = trace.default_tracer()
+        with tr.activate(SpanContext("ab" * 16, "cd" * 8, False)):
+            s.observe("lat_seconds", 0.1)
+        assert b"# {" not in reg.render_openmetrics()
+
+
+class TestExplain:
+    def test_plan_mode_local(self, tmp_path):
+        from m3_tpu.query import explain as explain_mod
+
+        explain_mod.clear()
+        db, api = _local_api(tmp_path)
+        port = api.serve(port=0)
+        try:
+            for j in range(10):
+                db.write_tagged("default", b"pm", [(b"k", b"v")],
+                                START + j * NS, float(j))
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/query_range"
+                f"?query=sum(rate(pm[1m]))&start={START // NS}"
+                f"&end={START // NS + 60}&step=15&explain=plan",
+                timeout=10).read())
+            plan = doc["explain"]
+            assert plan["mode"] == "plan"
+            [root] = plan["tree"]
+            assert root["node"] == "aggregate" and root["detail"] == "sum"
+            [rate] = root["children"]
+            assert rate["node"] == "range_fn" and rate["detail"] == "rate()"
+            [sel] = rate["children"]
+            assert sel["node"] == "selector"
+            assert "pm" in sel["detail"] and "[60s]" in sel["detail"]
+            # plan mode carries structure only, no timings
+            assert "duration_ms" not in root
+            # the record also landed in the /debug/explain ring
+            ring = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/explain",
+                timeout=10).read())
+            assert any(p.get("query") == "sum(rate(pm[1m]))"
+                       for p in ring["plans"])
+        finally:
+            api.shutdown()
+            db.close()
+
+    def test_bad_explain_mode_is_an_error(self, tmp_path):
+        db, api = _local_api(tmp_path)
+        try:
+            status, _ctype, payload, _h = api.handle(
+                "GET", "/api/v1/query_range",
+                {"query": ["x"], "start": ["0"], "end": ["60"],
+                 "step": ["15"], "explain": ["bogus"]}, b"")
+            assert status == 400
+            assert b"explain" in payload
+        finally:
+            api.shutdown()
+            db.close()
+
+
+class TestExplainAnalyzeFanout(TestTwoNodeFanoutTrace):
+    """EXPLAIN ANALYZE over the 2-node fan-out topology: ONE stitched
+    plan tree whose per-stage timings, dispatch rungs, and per-node legs
+    line up with the envelope stats — and whose exemplars link back to
+    the stitched trace (the acceptance-criteria path)."""
+
+    def test_stitched_plan_tree_parity(self, cluster):
+        nodes, cdb, api, port = cluster
+        trace.default_tracer().clear()
+        for i in range(32):
+            cdb.write_tagged("default", b"m", [(b"i", b"%02d" % i)],
+                             START + NS, float(i))
+        for svc in nodes.values():
+            svc.db.flush_all()
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/v1/query_range"
+            f"?query=sum(rate(m[2m]))&start={START // NS}"
+            f"&end={START // NS + 60}&step=15&explain=analyze",
+            timeout=10)
+        doc = json.loads(resp.read())
+        trace_id = resp.headers["M3-Trace-Id"]
+        stats = doc["stats"]
+        plan = doc["explain"]
+        assert plan["mode"] == "analyze"
+        assert plan["trace_id"] == trace_id == stats["trace_id"]
+        # ONE stitched tree: sum -> rate -> selector -> one rpc leg/node
+        [root] = plan["tree"]
+        assert root["node"] == "aggregate"
+        [rate] = root["children"]
+        assert rate["node"] == "range_fn"
+        [sel] = rate["children"]
+        assert sel["node"] == "selector"
+        legs = [c for c in sel["children"] if c["node"] == "rpc"]
+        assert {leg["detail"] for leg in legs} == {"node0", "node1"}
+        # per-stage timings nest: child wall time within parent's, every
+        # stage within the envelope total
+        for node, child in ((root, rate), (rate, sel)):
+            assert child["duration_ms"] <= node["duration_ms"] + 0.5
+        assert root["duration_ms"] <= stats["duration_ms"] + 0.5
+        assert sum(leg["duration_ms"] for leg in legs) \
+            <= sel["duration_ms"] + 0.5
+        assert sum(leg.get("rows", 0) for leg in legs) == 32
+        # dispatch-rung attribution: the selector stage carries exactly
+        # the rungs the envelope reports (decode happened ON THE NODES;
+        # the counters rode the /read_batch stats envelope back)
+        assert sel["rungs"] == stats["decode_rungs"]
+        assert sum(sel["rungs"].values()) >= 2  # both nodes decoded
+        assert sel["series"] == stats["series_matched"] == 32
+        assert sel["bytes"] == stats["bytes_decoded"] > 0
+        # /debug/explain?trace_id= finds the same plan
+        ring = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/explain?trace_id={trace_id}",
+            timeout=10).read())
+        assert len(ring["plans"]) == 1
+
+    def test_exemplar_links_to_stitched_trace(self, cluster):
+        nodes, cdb, api, port = cluster
+        trace.default_tracer().clear()
+        for i in range(8):
+            cdb.write_tagged("default", b"ex", [(b"i", b"%02d" % i)],
+                             START + NS, float(i))
+        for svc in nodes.values():
+            svc.db.flush_all()
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/v1/query_range?query=ex"
+            f"&start={START // NS}&end={START // NS + 60}&step=15",
+            timeout=10)
+        resp.read()
+        trace_id = resp.headers["M3-Trace-Id"]
+        # the coordinator's request histogram pinned this trace as the
+        # exemplar of the bucket the query's latency landed in
+        om = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics?format=openmetrics",
+            timeout=10).read().decode()
+        _plain, exemplars = _strip_exemplars(om)
+        req_ex = {tid for prefix, (tid, _v) in exemplars.items()
+                  if prefix.startswith("coordinator_request_seconds_bucket")}
+        assert trace_id in req_ex
+        # the decode seam ON THE STORAGE NODES pinned the same trace
+        # (propagated traceparent), so a node's p99 decode bucket links
+        # to the same stitched tree
+        node_ex = set()
+        for svc in nodes.values():
+            _status, payload, ctype = svc.api.handle(
+                "GET", "/metrics", {"format": ["openmetrics"]}, b"")
+            assert ctype.startswith("application/openmetrics-text")
+            _p, node_exemplars = _strip_exemplars(payload.decode())
+            node_ex |= {tid for prefix, (tid, _v) in node_exemplars.items()
+                        if prefix.startswith("decode_batch_seconds_bucket")}
+        assert trace_id in node_ex
+        # ...and that trace id resolves via /debug/traces to the stitched
+        # cross-process tree for THIS query
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces?trace_id={trace_id}",
+            timeout=10).read())
+        assert doc["count"] > 0
+        names = [s["name"] for s in doc["spans"]]
+        assert trace.API_REQUEST in names and trace.DECODE_BATCH in names
+        assert len(doc["tree"]) == 1
+
+
+class TestTelemetryExporter:
+    def _tracer_with_spans(self, n):
+        from m3_tpu.utils.trace import Tracer
+
+        tr = Tracer()
+        for i in range(n):
+            with tr.span(f"s{i}"):
+                pass
+        return tr
+
+    def test_file_sink_drain_and_cursor(self, tmp_path):
+        from m3_tpu.utils.export import FileSink, TelemetryExporter
+
+        reg = MetricsRegistry()
+        reg.root_scope("svc").counter("boot")
+        tr = self._tracer_with_spans(3)
+        path = str(tmp_path / "out.jsonl")
+        exp = TelemetryExporter("dbnode", FileSink(path), registry=reg,
+                                tracer=tr)
+        assert exp.tick() == 1
+        with tr.span("later"):
+            pass
+        assert exp.tick() == 1
+        lines = [json.loads(l) for l in open(path).read().splitlines()]
+        assert len(lines) == 2
+        assert lines[0]["resource"]["service.name"] == "dbnode"
+        # cursor semantics: each span ships exactly once
+        assert [s["name"] for s in lines[0]["scopeSpans"]] == \
+            ["s0", "s1", "s2"]
+        assert [s["name"] for s in lines[1]["scopeSpans"]] == ["later"]
+        assert any(m["name"] == "svc.boot"
+                   for m in lines[0]["scopeMetrics"])
+        # histograms ship with bounds+counts (the collector can rebuild
+        # quantiles)
+        reg.root_scope("svc").observe("lat_seconds", 0.2)
+        exp.tick()
+        last = json.loads(open(path).read().splitlines()[-1])
+        [h] = [m for m in last["scopeMetrics"]
+               if m["name"] == "svc.lat_seconds"]
+        assert h["type"] == "histogram" and h["count"] == 1
+
+    def test_drop_counter_under_full_queue(self, tmp_path):
+        from m3_tpu.utils.export import FileSink, TelemetryExporter
+
+        class DeadSink:
+            def ship(self, payload):
+                raise OSError("collector down")
+
+        reg = MetricsRegistry()
+        tr = self._tracer_with_spans(1)
+        exp = TelemetryExporter("agg", DeadSink(), registry=reg, tracer=tr,
+                                queue_max=2)
+        for i in range(5):
+            with tr.span(f"tick{i}"):
+                pass
+            exp.tick()
+        counters, gauges, _t, _h = reg.snapshot()
+        c = {k[0]: v for (k, v) in counters.items()}
+        # queue bounded at 2: 5 payloads enqueued, 3 dropped oldest-first,
+        # every failed ship counted — the hot path never blocked
+        assert c["exporter.svc.dropped_payloads"] == 3
+        assert c["exporter.svc.ship_errors"] == 5
+        assert c["exporter.svc.dropped_spans"] >= 3
+        assert exp.queue_depth == 2
+        assert gauges[("exporter.svc.queue_depth",
+                       (("service", "agg"),))] == 2
+        # collector recovers: the surviving queue drains in order
+        path = str(tmp_path / "out.jsonl")
+        exp.sink = FileSink(path)
+        assert exp.tick() >= 2
+        assert exp.queue_depth == 0
+
+    def test_exporter_from_config(self, tmp_path, monkeypatch):
+        from m3_tpu.utils.export import (
+            FileSink,
+            HTTPSink,
+            exporter_from_config,
+        )
+
+        assert exporter_from_config({}, "kvd") is None
+        exp = exporter_from_config(
+            {"export": {"file": str(tmp_path / "f"), "interval_s": 1.5,
+                        "queue_max": 7}}, "coordinator")
+        assert isinstance(exp.sink, FileSink)
+        assert exp.interval_s == 1.5 and exp.queue_max == 7
+        exp = exporter_from_config(
+            {"export": {"endpoint": "http://127.0.0.1:9/v1"}}, "dbnode")
+        assert isinstance(exp.sink, HTTPSink)
+        # env overrides config, and arms config-less processes (kvd)
+        monkeypatch.setenv("M3_TPU_EXPORT_FILE", str(tmp_path / "env"))
+        exp = exporter_from_config(None, "kvd")
+        assert isinstance(exp.sink, FileSink)
+
+    def test_dbnode_service_registers_exporter(self, tmp_path, monkeypatch):
+        from m3_tpu.services.dbnode import DBNodeService
+
+        out = tmp_path / "tel.jsonl"
+        monkeypatch.setenv("M3_TPU_EXPORT_FILE", str(out))
+        svc = DBNodeService({"db": {"path": str(tmp_path / "db"),
+                                    "n_shards": 2}})
+        try:
+            assert svc.exporter is not None
+            svc.db.open(START)
+            svc.db.write_tagged("default", b"m", [(b"k", b"v")],
+                                START + NS, 1.0)
+            svc.exporter.tick()
+            lines = out.read_text().splitlines()
+            assert lines
+            doc = json.loads(lines[0])
+            assert doc["resource"]["service.name"] == "dbnode"
+            assert any(m["name"] == "db.write_seconds"
+                       for m in doc["scopeMetrics"])
+        finally:
+            svc.shutdown()
+
+
+class TestAdaptiveSlowQueryBar:
+    def test_p99_admission_with_virtual_clock(self):
+        querystats.clear()
+        reg = MetricsRegistry()
+        s = reg.root_scope("coordinator")
+        # 50/50 split at 0.01s and 1.0s: interpolated p99 lands just
+        # under 1.0s in the (0.5, 1.0] bucket
+        for _ in range(50):
+            s.observe("request_seconds", 0.01)
+        for _ in range(50):
+            s.observe("request_seconds", 1.0)
+        querystats.set_adaptive_source(
+            lambda: reg.histograms.get(("coordinator.request_seconds", ())))
+        try:
+            bar = querystats.threshold_s()
+            assert 0.5 <= bar <= 1.0
+            clock = [0.0]
+
+            def run(query: str, duration_s: float):
+                st = querystats.start(query=query, clock=lambda: clock[0])
+                clock[0] += duration_s
+                querystats.finish(st)
+
+            run("below-bar", 0.05)   # would have been kept at floor=0
+            run("above-bar", 5.0)
+            kept = {q["query"] for q in querystats.slow_queries()}
+            assert "above-bar" in kept and "below-bar" not in kept
+            # duration stamped from the virtual clock, not wall time
+            [rec] = [q for q in querystats.slow_queries()
+                     if q["query"] == "above-bar"]
+            assert rec["duration_ms"] == pytest.approx(5000.0)
+        finally:
+            querystats.set_adaptive_source(None)
+            querystats.clear()
+
+    def test_floor_and_thin_histogram_fallback(self):
+        querystats.clear()
+        reg = MetricsRegistry()
+        s = reg.root_scope("coordinator")
+        for _ in range(3):  # far below min_count: p99 not armed yet
+            s.observe("request_seconds", 0.001)
+        querystats.set_adaptive_source(
+            lambda: reg.histograms.get(("coordinator.request_seconds", ())))
+        try:
+            # fallback: the env floor (0) governs alone -> everything kept
+            assert querystats.threshold_s() == 0.0
+            clock = [0.0]
+            st = querystats.start(query="thin", clock=lambda: clock[0])
+            clock[0] += 0.002
+            querystats.finish(st)
+            assert any(q["query"] == "thin"
+                       for q in querystats.slow_queries())
+            # the floor RAISES the armed bar, never lowers it
+            for _ in range(100):
+                s.observe("request_seconds", 0.001)
+            querystats.set_threshold_ms(50.0)
+            assert querystats.threshold_s() == pytest.approx(0.05)
+        finally:
+            querystats.set_threshold_ms(0.0)
+            querystats.set_adaptive_source(None)
+            querystats.clear()

@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Static observability pass (wired into run_tests.sh).
 
-Two invariants, both cheap enough to run before every test lane:
+Four invariants, all cheap enough to run before every test lane:
 
 1. Tracepoint constants in m3_tpu/utils/trace.py are UNIQUE — two
    tracepoints sharing a name would silently merge in every trace tree
@@ -12,6 +12,19 @@ Two invariants, both cheap enough to run before every test lane:
    a module that also instruments that seam — a metrics scope
    (instrument histogram/counter/timer) or a trace span. A fault point
    without observability is a seam we can break but not see.
+
+3. Every fault-catalog histogram seam is EXEMPLAR-CAPABLE: the three
+   histogram entry points in utils/instrument (Scope.observe,
+   Scope.histogram via observe, Scope.histogram_handle's closure) must
+   each route through the exemplar-capture helper — the seams all
+   observe through the Scope API, so capability is proven at the source.
+   A seam histogram that can't pin a trace_id breaks the p99-bucket →
+   stitched-trace link the OpenMetrics exposition promises.
+
+4. Every service entrypoint (coordinator, dbnode, aggregator, kvd)
+   registers the telemetry-exporter drainer (utils/export
+   `exporter_from_config`) — a process outside the export plane is a
+   blind spot the collector can't see.
 
 Exit code 0 = clean; 1 = violations (each printed with file:line).
 """
@@ -76,6 +89,77 @@ class _Scanner(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# service entrypoints that must register the exporter drainer: one per
+# long-running process the platform ships
+SERVICE_ENTRYPOINTS = (
+    os.path.join("services", "coordinator.py"),
+    os.path.join("services", "dbnode.py"),
+    os.path.join("services", "aggregator.py"),
+    os.path.join("cluster", "kvd.py"),
+)
+
+
+def _function_references(tree: ast.AST, func_name: str,
+                         needle: str) -> bool:
+    """Does the (possibly nested) function/closure named `func_name`
+    reference `needle` anywhere in its body?"""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == func_name:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and sub.id == needle:
+                    return True
+                if isinstance(sub, ast.Attribute) and sub.attr == needle:
+                    return True
+    return False
+
+
+def check_exemplar_capable(failures: list[str]) -> None:
+    """Invariant 3: the Scope histogram entry points all capture
+    exemplars, so every seam histogram (they all go through Scope) can
+    pin a trace_id to its bucket."""
+    path = os.path.join(PKG, "utils", "instrument.py")
+    tree = ast.parse(open(path).read())
+    # Scope.observe and the histogram_handle closure must consult the
+    # exemplar trace source; _Histogram.observe_locked must accept and
+    # store it. (Scope.histogram delegates to observe, so it inherits.)
+    if not _function_references(tree, "observe", "_active_exemplar_trace") \
+            and not _function_references(tree, "observe", "_exemplar"):
+        failures.append(
+            f"{path}: Scope.observe does not capture exemplars — seam "
+            f"histograms lose the p99-bucket -> trace link")
+    # the hot-path closure may inline the thread-local read instead of
+    # calling the helper; either way it must write exemplar storage
+    if not (_function_references(tree, "histogram_handle",
+                                 "_active_exemplar_trace")
+            or _function_references(tree, "histogram_handle", "exemplars")):
+        failures.append(
+            f"{path}: histogram_handle's hot-path closure does not capture "
+            f"exemplars")
+    if not _function_references(tree, "observe_locked", "exemplars"):
+        failures.append(
+            f"{path}: _Histogram.observe_locked has no exemplar storage")
+
+
+def check_exporter_registered(failures: list[str]) -> None:
+    """Invariant 4: every service entrypoint builds its exporter via
+    utils/export.exporter_from_config."""
+    for rel in SERVICE_ENTRYPOINTS:
+        path = os.path.join(PKG, rel)
+        try:
+            tree = ast.parse(open(path).read())
+        except (OSError, SyntaxError) as e:
+            failures.append(f"{path}: unreadable/unparseable: {e}")
+            continue
+        found = any(
+            isinstance(node, ast.Name) and node.id == "exporter_from_config"
+            for node in ast.walk(tree)
+        )
+        if not found:
+            failures.append(
+                f"{path}: service entrypoint does not register the "
+                f"telemetry exporter (exporter_from_config)")
+
+
 def main() -> int:
     failures: list[str] = []
 
@@ -116,13 +200,19 @@ def main() -> int:
                     f"{path}: declares fault point(s) [{pts}] but has no "
                     f"metric scope or trace span at the seam")
 
+    # 3 + 4: exemplar-capable seam histograms; exporter in every service
+    check_exemplar_capable(failures)
+    check_exporter_registered(failures)
+
     if failures:
         print("check_observability: FAILED", file=sys.stderr)
         for f in failures:
             print(f"  {f}", file=sys.stderr)
         return 1
     print(f"check_observability: OK — {len(seen)} tracepoints unique, "
-          f"{len(catalog)} fault points instrumented at their seams")
+          f"{len(catalog)} fault points instrumented at their seams, "
+          f"exemplar capture verified, exporter registered in "
+          f"{len(SERVICE_ENTRYPOINTS)} service entrypoints")
     return 0
 
 
