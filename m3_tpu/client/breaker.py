@@ -29,6 +29,20 @@ class BreakerOpen(Exception):
     """Request rejected locally: the host's circuit is open."""
 
 
+class Backpressure(Exception):
+    """The server answered 429: it is HEALTHY and explicitly asked this
+    caller to slow down (per-tenant admission control shedding load).
+    Carries the server's Retry-After hint. HostPolicy treats this as
+    backpressure — honored wait + jittered retry, never a breaker
+    failure: counting sheds as failures would convert per-tenant
+    throttling into node-level circuit-opening, the exact cross-tenant
+    blast radius admission control exists to prevent."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.05):
+        super().__init__(msg)
+        self.retry_after_s = max(0.001, float(retry_after_s))
+
+
 @dataclass(frozen=True)
 class BreakerConfig:
     failure_threshold: int = 5      # consecutive failures that open the circuit
@@ -39,6 +53,11 @@ class BreakerConfig:
     # multiplicative backoff jitter in [0, frac): many callers retrying a
     # recovered host must not stampede it in lockstep (0 = deterministic)
     retry_jitter_frac: float = 0.0
+    # 429 backpressure handling: cap on how long one Retry-After hint may
+    # stall a caller, and jitter applied ON TOP of the honored wait so
+    # shed tenants don't re-arrive in lockstep when the window reopens
+    backpressure_cap_s: float = 2.0
+    backpressure_jitter_frac: float = 0.25
 
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
@@ -139,6 +158,24 @@ class HostPolicy:
             try:
                 out = fn(*args, **kwargs)
             except Exception as e:  # noqa: BLE001 - every failure counts
+                if getattr(e, "retry_after_s", None) is not None:
+                    # 429 backpressure: the host answered, so the breaker
+                    # records a SUCCESS (a shed tenant must never open
+                    # the node's circuit), and the caller waits out the
+                    # server's Retry-After hint (capped, jittered) before
+                    # retrying within the normal attempts budget
+                    self.breaker.on_success()
+                    last_err = e
+                    if attempt + 1 < self.config.retry_attempts:
+                        delay = min(float(e.retry_after_s),
+                                    self.config.backpressure_cap_s)
+                        if self.config.backpressure_jitter_frac:
+                            delay *= 1.0 + \
+                                self.config.backpressure_jitter_frac \
+                                * self._rng.random()
+                        self._sleep(delay)
+                        continue
+                    raise
                 if self._no_count and isinstance(e, self._no_count):
                     # the host ANSWERED (deterministic request error): for
                     # the breaker that is a healthy response — record

@@ -22,6 +22,16 @@ class NodeUnavailableError(ConnectionError):
     pass
 
 
+def _retry_after_s(raw: str | None) -> float:
+    """Retry-After header -> seconds (integer-seconds form; a missing or
+    malformed value falls back to a short default so backpressure still
+    backs off)."""
+    try:
+        return max(0.001, float(raw))
+    except (TypeError, ValueError):
+        return 0.05
+
+
 def parse_endpoint(endpoint: str) -> tuple[str, int]:
     """(host, port) of a node endpoint; single source of the scheme guard
     and default port for connections AND topology-change detection."""
@@ -61,6 +71,17 @@ class HTTPNodeConnection:
                 c.request(method, path, body=body, headers=headers)
                 r = c.getresponse()
                 payload = r.read()
+                if r.status == 429:
+                    # per-tenant admission shed: backpressure, not a node
+                    # failure — the breaker layer honors Retry-After
+                    # instead of counting this against the host's circuit
+                    from m3_tpu.client.breaker import Backpressure
+
+                    raise Backpressure(
+                        f"{self.host}:{self.port}{path} -> 429 "
+                        f"{payload[:200]!r}",
+                        retry_after_s=_retry_after_s(r.getheader("Retry-After")),
+                    )
                 if r.status >= 400:
                     raise NodeUnavailableError(
                         f"{self.host}:{self.port}{path} -> {r.status} "
@@ -70,6 +91,8 @@ class HTTPNodeConnection:
             except NodeUnavailableError:
                 raise
             except Exception as e:  # noqa: BLE001 - socket-level failure
+                if getattr(e, "retry_after_s", None) is not None:
+                    raise  # Backpressure: the connection is healthy
                 last_err = e
                 self._tl.conn = None
                 with self._all_lock:

@@ -930,8 +930,10 @@ class _RaftDriver:
         while not self._closed.is_set():
             try:
                 self._queue(self._node.tick())
-            except Exception:  # noqa: BLE001 - injected persist fault etc.
-                pass
+            except Exception as e:  # noqa: BLE001 - injected persist fault
+                # etc.; an ARMED SimulatedCrash (chaos rig) kills the
+                # replica process here instead of being swallowed
+                faults.escalate(e)
             self._wake.wait(self.TICK_S)
             self._wake.clear()
 

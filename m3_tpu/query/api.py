@@ -22,7 +22,8 @@ import numpy as np
 from m3_tpu.index.query import Matcher, MatchType, matchers_to_query
 from m3_tpu.query.engine import Engine, QueryLimitError, Scalar, Vector
 from m3_tpu.query.windows import NS
-from m3_tpu.utils import protowire, snappy
+from m3_tpu.utils import faults, protowire, snappy
+from m3_tpu.utils.tenantlimits import TenantShedError
 
 _MATCH_TYPE_BY_PROM = {
     0: MatchType.EQUAL,
@@ -197,6 +198,12 @@ class CoordinatorAPI:
         self.writer = None
         # optional AdminAPI (namespace/placement/topic CRUD; query/admin.py)
         self.admin = None
+        # optional per-tenant admission controller (utils/tenantlimits,
+        # coordinator service wiring): None = no quotas, zero overhead
+        self.admission = None
+        # per-tenant request-latency observer handles, keyed by BOUNDED
+        # label (configured tenants + the default namespace + "other")
+        self._tenant_observers: dict[str, object] = {}
         # per-namespace engine cache for ?namespace= query routing (the
         # self-monitoring loop's _m3_system namespace is queried this way)
         self._engines: dict[str, Engine] = {namespace: self.engine}
@@ -250,12 +257,16 @@ class CoordinatorAPI:
         nodes — follows it, and the response echoes the trace id in an
         `M3-Trace-Id` header so a slow query is one /debug/traces lookup
         away."""
+        import math
+        import time as _time
+
         from m3_tpu.utils import trace
 
         # one resource budget per request, enforced in the storage read
         # path (covers PromQL, Graphite render, and remote read alike)
         limits = getattr(self.db, "limits", None)
         ctx = trace.start_request(headers)
+        t0 = _time.perf_counter()
         try:
             if limits is not None:
                 limits.start_query()
@@ -265,6 +276,22 @@ class CoordinatorAPI:
                 res = self._route(method, path, query, body, headers)
             status, ctype, payload, hdrs = res if len(res) == 4 \
                 else (*res, {})
+        except TenantShedError as e:
+            # per-tenant admission shed: 429 + Retry-After, the
+            # degrade-THIS-tenant contract (clients treat it as
+            # backpressure, never as a node failure)
+            status, ctype, payload, hdrs = 429, "application/json", json.dumps(
+                {"status": "error", "errorType": "tenant_limit",
+                 "tenant": e.namespace, "kind": e.kind,
+                 "retry_after_s": round(e.retry_after_s, 3),
+                 "error": str(e)}
+            ).encode(), {"Retry-After": str(max(1, math.ceil(e.retry_after_s)))}
+        except faults.SimulatedCrash:
+            # crash semantics match the node API: never served as an
+            # error envelope — the request thread dies (and with
+            # M3_TPU_FAULTS_EXIT=1 armed, the whole process does)
+            faults.escalate()
+            raise
         except QueryLimitError as e:
             status, ctype, payload, hdrs = 422, "application/json", json.dumps(
                 {"status": "error", "errorType": "query_limit", "error": str(e)}
@@ -276,9 +303,57 @@ class CoordinatorAPI:
         finally:
             if limits is not None:
                 limits.end_query()
+        if self.admission is not None and (
+                path.startswith("/api/v1/") or path == "/render"):
+            # only tenant-billable routes feed the per-tenant latency
+            # histogram: /metrics scrapes, health polls and /debug would
+            # dilute the p99 the isolation SLO is asserted against
+            self._observe_tenant(query, _time.perf_counter() - t0)
         if trace.default_tracer().enabled:
             hdrs = {**hdrs, "M3-Trace-Id": ctx.trace_id}
         return status, ctype, payload, hdrs
+
+    # -- per-tenant admission plumbing --
+
+    def _tenant_of(self, q) -> str:
+        """The tenant (== namespace) a request bills to: ?namespace= on
+        query routes, the configured ingest namespace otherwise."""
+        return (q.get("namespace", [self.namespace])[0] if q
+                else self.namespace)
+
+    def _observe_tenant(self, q, seconds: float) -> None:
+        """Per-tenant request-latency histogram (the PR-4 family,
+        namespace-labelled): the substrate for isolation SLOs — tenant
+        B's p99 must hold while tenant A is being shed. Cardinality is
+        bounded: only configured tenants and the default namespace get
+        their own label, everything else shares "other"."""
+        ns = self._tenant_of(q)
+        if ns != self.namespace and not self.admission.is_configured(ns):
+            ns = "other"
+        obs = self._tenant_observers.get(ns)
+        if obs is None:
+            obs = self._scope.subscope("tenant", namespace=ns) \
+                .histogram_handle("request_seconds")
+            self._tenant_observers[ns] = obs
+        obs(seconds)
+
+    def _admit_write(self, datapoints: int) -> None:
+        """Ingest gate: raises TenantShedError (-> 429) when the tenant
+        is over its datapoints/sec rate or live-cardinality ceiling."""
+        if self.admission is not None and datapoints:
+            self.admission.admit_write(self.namespace, datapoints)
+
+    def _admit_query(self, ns: str) -> None:
+        """Query gate: queries/sec bucket + post-paid cost budget."""
+        if self.admission is not None:
+            self.admission.admit_query(ns)
+
+    def _charge_query(self, ns: str, engine) -> None:
+        """Bill the finished query's QueryStats against the tenant's
+        cost budget (post-paid; never raises)."""
+        if self.admission is not None:
+            self.admission.charge_query_cost(
+                ns, getattr(engine, "last_stats", None))
 
     def _warning_headers(self, engine=None) -> dict:
         """PR-2 partial-result contract, threaded out to HTTP: one
@@ -447,6 +522,7 @@ class CoordinatorAPI:
     def _graphite_render(self, q):
         from m3_tpu.query.graphite import GraphiteEngine
 
+        self._admit_query(self.namespace)
         now = time.time_ns()
         start = _parse_graphite_time(q["from"][0], now) if "from" in q else now - 24 * 3600 * NS
         end = _parse_graphite_time(q["until"][0], now) if "until" in q else now
@@ -523,6 +599,7 @@ class CoordinatorAPI:
                     tags.append((k, v))
             for ts_ms, value in ts.samples:
                 entries.append((name, tags, ts_ms * 1_000_000, value))
+        self._admit_write(len(entries))
         batch = getattr(self.db, "write_batch", None)
         if self.writer is None and batch is not None:
             # no downsampler rules to run per-sample: one op-batched
@@ -555,6 +632,7 @@ class CoordinatorAPI:
             import time
 
             t_ns = time.time_ns()
+        self._admit_write(1)
         self._write(name, tags, t_ns, float(doc["value"]))
         return 200, "application/json", b'{"status":"success"}'
 
@@ -575,6 +653,10 @@ class CoordinatorAPI:
             ).encode()
         n = 0
         errors = 0
+        # parse the whole payload BEFORE writing: the admission gate needs
+        # the datapoint count, and a shed must reject the batch without
+        # having half-applied it
+        writes = []
         for line in body.splitlines():
             line = line.strip()
             if not line or line.startswith(b"#"):
@@ -591,8 +673,11 @@ class CoordinatorAPI:
                 t_ns *= mult
             for fname, fval in fields:
                 name = measurement + b"_" + fname if fname != b"value" else measurement
-                self._write(name, tags, t_ns, fval)
-                n += 1
+                writes.append((name, tags, t_ns, fval))
+        self._admit_write(len(writes))
+        for name, tags, t_ns, fval in writes:
+            self._write(name, tags, t_ns, fval)
+            n += 1
         if errors:
             # influx-style partial-write semantics: good points ARE
             # written; the client still learns something was dropped
@@ -606,6 +691,7 @@ class CoordinatorAPI:
     # -- read --
 
     def _remote_read(self, body: bytes):
+        self._admit_query(self.namespace)
         queries = protowire.decode_read_request(snappy.decompress(body))
         results = []
         for q in queries:
@@ -675,9 +761,11 @@ class CoordinatorAPI:
         start = _parse_time(q["start"][0])
         end = _parse_time(q["end"][0])
         step = _parse_step(q["step"][0])
+        self._admit_query(self._tenant_of(q))
         engine = self._query_engine(q)
         (result, eval_ts), plan = self._run_explained(
             q, engine, lambda: engine.query_range(expr, start, end, step))
+        self._charge_query(self._tenant_of(q), engine)
         return (200, "application/json",
                 self._render(result, eval_ts, matrix=True, engine=engine,
                              explain_doc=plan),
@@ -694,10 +782,12 @@ class CoordinatorAPI:
         start = _parse_time(q["start"][0])
         end = _parse_time(q["end"][0])
         step = _parse_step(q["step"][0])
+        self._admit_query(self._tenant_of(q))
         engine = self._query_engine(q)
         (result, eval_ts), plan = self._run_explained(
             q, engine, lambda: engine.query_range_expr(
                 expr, start, end, step, query_text=raw))
+        self._charge_query(self._tenant_of(q), engine)
         return (200, "application/json",
                 self._render(result, eval_ts, matrix=True, engine=engine,
                              explain_doc=plan),
@@ -710,9 +800,11 @@ class CoordinatorAPI:
             import time as _time
 
             t = _time.time_ns()
+        self._admit_query(self._tenant_of(q))
         engine = self._query_engine(q)
         (result, eval_ts), plan = self._run_explained(
             q, engine, lambda: engine.query_instant(expr, t))
+        self._charge_query(self._tenant_of(q), engine)
         return (200, "application/json",
                 self._render(result, eval_ts, matrix=False, engine=engine,
                              explain_doc=plan),

@@ -130,7 +130,20 @@ class AggregatorService:
                 self._stop.wait(flush_every)
                 if self._stop.is_set():
                     break
-                self.flush_once()
+                try:
+                    self.flush_once()
+                except Exception as e:  # noqa: BLE001 - one bad flush must
+                    # not kill the service loop. A SimulatedCrash is the
+                    # exception to that: armed (chaos rig,
+                    # M3_TPU_FAULTS_EXIT=1) the whole process dies here;
+                    # unarmed it propagates — no handler survives a
+                    # SIGKILL, in-process chaos tests included
+                    from m3_tpu.utils import faults
+
+                    if isinstance(e, faults.SimulatedCrash):
+                        faults.escalate(e)
+                        raise
+                    self.log.info("flush error; continuing", error=str(e))
         finally:
             self.shutdown()
 

@@ -32,6 +32,26 @@ def ruleset_from_config(doc: dict | None) -> RuleSet:
     return ruleset_from_doc(doc)
 
 
+_TIME_UNITS = {"s": "SECOND", "ms": "MILLISECOND", "us": "MICROSECOND",
+               "ns": "NANOSECOND", "m": "MINUTE", "h": "HOUR"}
+
+
+def parse_time_unit(name: str):
+    """Namespace time-unit config ("s", "ms", "us", "ns", ...) -> the
+    encoder TimeUnit. Sub-unit timestamp precision is TRUNCATED at
+    encode (reference-compatible lossiness), so namespaces ingesting
+    irregular/high-frequency timestamps must declare a fine unit or a
+    snapshot/flush-restore cycle silently collapses their datapoints —
+    the chaos rig's zero-acked-write-loss audit is what surfaced this."""
+    from m3_tpu.encoding.m3tsz.constants import TimeUnit
+
+    try:
+        return TimeUnit[_TIME_UNITS[str(name).strip().lower()]]
+    except KeyError:
+        raise ValueError(f"unknown time_unit {name!r} "
+                         f"(want one of {sorted(_TIME_UNITS)})") from None
+
+
 def namespace_options(doc: dict | None) -> NamespaceOptions:
     if not doc:
         return NamespaceOptions()
@@ -39,6 +59,10 @@ def namespace_options(doc: dict | None) -> NamespaceOptions:
 
     r = doc.get("retention", {}) or {}
     res = doc.get("resolution")  # set on downsampled (aggregated) tiers
+    tu = doc.get("time_unit")
+    kwargs = {}
+    if tu:
+        kwargs["write_time_unit"] = parse_time_unit(tu)
     return NamespaceOptions(
         retention=RetentionOptions(
             retention_ns=dur(r.get("period", "48h")),
@@ -48,6 +72,7 @@ def namespace_options(doc: dict | None) -> NamespaceOptions:
         ),
         int_optimized=bool(doc.get("int_optimized", False)),
         aggregated_resolution_ns=dur(res) if res else 0,
+        **kwargs,
     )
 
 
@@ -150,6 +175,23 @@ class CoordinatorService:
         self.api = CoordinatorAPI(self.db, db_cfg.get("namespace", "default"),
                                   limits=limits)
         self.api.writer = self.writer  # ingest fans out through downsampler
+        # per-tenant admission control (utils/tenantlimits): quotas from
+        # the config's `tenants:` section, cardinality ceilings read from
+        # the live storage, runtime-retunable through the m3_tpu.tenants
+        # KV key — a noisy tenant is throttled live, without a restart
+        from m3_tpu.storage import limits as storage_limits
+        from m3_tpu.utils import tenantlimits
+
+        self.admission = tenantlimits.from_config(
+            config.get("tenants"),
+            cardinality_source=lambda ns: storage_limits.live_series(
+                self.db, ns),
+        )
+        self.api.admission = self.admission
+        if self.admission is not None and self.kv is not None:
+            self.admission.watch_kv(self.kv)
+            self.log.info("tenant admission armed",
+                          tenants=self.admission.known_tenants())
         from m3_tpu.query.admin import AdminAPI
 
         self.api.admin = AdminAPI(
@@ -345,6 +387,10 @@ class CoordinatorService:
                             self.self_monitor.maybe_scrape()
                 except Exception as e:  # noqa: BLE001 - a transient KV/IO
                     # error must not kill the long-running coordinator
+                    # (but an armed SimulatedCrash must — the rig watches)
+                    from m3_tpu.utils import faults
+
+                    faults.escalate(e)
                     self.log.info("tick error; continuing", error=str(e))
         finally:
             self.shutdown()

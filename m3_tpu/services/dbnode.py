@@ -249,10 +249,12 @@ class NodeAPI:
             return 404, b'{"error":"unknown path"}'
         except faults.SimulatedCrash:
             # a simulated crash must NOT be served as an error response —
-            # no handler survives a SIGKILL. Propagate so the request
-            # thread dies mid-flight (the client sees a torn connection)
-            # and any partially-written durability state stays exactly as
-            # the kill left it.
+            # no handler survives a SIGKILL. With M3_TPU_FAULTS_EXIT=1
+            # (chaos rig) the WHOLE PROCESS dies here (_exit 137); else
+            # propagate so the request thread dies mid-flight (the client
+            # sees a torn connection) and any partially-written
+            # durability state stays exactly as the kill left it.
+            faults.escalate()
             raise
         except (faults.InjectedError, faults.InjectedTimeout) as e:
             return 503, json.dumps({"error": str(e)}).encode()
@@ -352,6 +354,12 @@ class DBNodeService:
             DatabaseOptions(
                 n_shards=db_cfg.get("n_shards", 8),
                 owned_shards=owned_arg,
+                # WAL flush threshold: how many acked bytes may sit in the
+                # user-space buffer (lost on SIGKILL before replication
+                # recovers them). 1 = flush every append — the chaos rig
+                # runs nodes this way so "acked" means "in the OS"
+                commitlog_flush_every_bytes=int(db_cfg.get(
+                    "commitlog_flush_every_bytes", 1 << 20)),
             ),
         )
         for ns in db_cfg.get("namespaces", [{"name": "default"}]) or []:
@@ -564,7 +572,9 @@ class DBNodeService:
                         stats = self.db.tick()
                     scope.counter("blocks_flushed", stats["flushed"])
                 except Exception as e:  # noqa: BLE001 - a transient KV/IO
-                    # error must not kill the long-running node
+                    # error must not kill the long-running node (but an
+                    # armed SimulatedCrash must — the rig is watching)
+                    faults.escalate(e)
                     self.log.info("tick error; continuing", error=str(e))
         finally:
             self.shutdown()

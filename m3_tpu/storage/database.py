@@ -106,8 +106,16 @@ class Database:
             shard.cache = self.block_cache
             shard.persist_limiter = self.persist_limiter
         self.namespaces[name] = ns
-        if ns.opts.writes_to_commitlog and self._open:
-            self._open_commitlog(name)
+        if self._open:
+            # a namespace created on a LIVE database (the registry-sync
+            # path every cluster node takes for dynamically-added tenant
+            # namespaces, and the admin API) must bootstrap its durable
+            # state exactly as open() would have — filesets, snapshots,
+            # then commitlog replay. Without this, a restarted node
+            # re-creates the namespace EMPTY and silently abandons its
+            # WAL: acked writes vanish once the other replicas restart
+            # too (found by the chaos rig's zero-acked-write-loss audit).
+            self._bootstrap_namespace(name, ns, time.time_ns())
         return ns
 
     def drop_namespace(self, name: str) -> None:
@@ -139,21 +147,28 @@ class Database:
         self._open = True
         now_ns = now_ns if now_ns is not None else time.time_ns()
         for name, ns in self.namespaces.items():
-            if ns.opts.bootstrap_enabled:
-                restored = set()
-                if ns.index is not None:
-                    from m3_tpu.index import persist as index_persist
+            self._bootstrap_namespace(name, ns, now_ns)
 
-                    r = ns.opts.retention
-                    restored = index_persist.load_index(
-                        ns.index, self.fs_root, name,
-                        cutoff_ns=r.block_start(now_ns - r.retention_ns),
-                    )
-                ns.bootstrap_from_fs(now_ns, skip_index_blocks=restored)
-                self._restore_snapshots(name, ns, now_ns)
-                self._replay_commitlogs(name, ns, now_ns)
-            if ns.opts.writes_to_commitlog:
-                self._open_commitlog(name)
+    def _bootstrap_namespace(self, name: str, ns: Namespace,
+                             now_ns: int) -> None:
+        """One namespace's boot sequence (shared by open() and live
+        create_namespace): index + fileset bootstrap, snapshot restore,
+        commitlog replay, then a fresh commitlog writer."""
+        if ns.opts.bootstrap_enabled:
+            restored = set()
+            if ns.index is not None:
+                from m3_tpu.index import persist as index_persist
+
+                r = ns.opts.retention
+                restored = index_persist.load_index(
+                    ns.index, self.fs_root, name,
+                    cutoff_ns=r.block_start(now_ns - r.retention_ns),
+                )
+            ns.bootstrap_from_fs(now_ns, skip_index_blocks=restored)
+            self._restore_snapshots(name, ns, now_ns)
+            self._replay_commitlogs(name, ns, now_ns)
+        if ns.opts.writes_to_commitlog:
+            self._open_commitlog(name)
 
     def _replay_commitlogs(self, name: str, ns: Namespace,
                            now_ns: int | None = None) -> None:

@@ -66,3 +66,18 @@ class QueryLimits:
             raise QueryLimitError(
                 f"query would read {total} datapoints, limit {self.max_datapoints}"
             )
+
+
+def live_series(db, namespace: str) -> int | None:
+    """Live (buffered) series count for one namespace — the storage-side
+    source behind the per-tenant cardinality ceiling
+    (utils/tenantlimits): the count is read where the series actually
+    live, so the ceiling tracks reality instead of an ingest-side
+    estimate. Returns None when the storage is remote (cluster facade:
+    the nodes own the buffers) — the ceiling is then not enforceable
+    from this process and the admission controller skips it."""
+    ns = getattr(db, "namespaces", {}).get(namespace)
+    shards = getattr(ns, "shards", None)
+    if shards is None:
+        return None
+    return sum(s.buffer.n_series for s in shards.values())

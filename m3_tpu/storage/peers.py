@@ -147,6 +147,18 @@ class HTTPPeer:
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
                     return json.loads(r.read())
             except urllib.error.HTTPError as e:
+                if e.code == 429:
+                    # admission-control shed: backpressure (honored
+                    # Retry-After + jittered retry in HostPolicy), NOT a
+                    # client error and NOT a breaker failure
+                    from m3_tpu.client.breaker import Backpressure
+                    from m3_tpu.client.http_conn import _retry_after_s
+
+                    raise Backpressure(
+                        f"429 from {self.base}{path}",
+                        retry_after_s=_retry_after_s(
+                            e.headers.get("Retry-After")),
+                    ) from e
                 if 400 <= e.code < 500:
                     raise PeerClientError(
                         f"{e.code} from {self.base}{path}") from e

@@ -248,6 +248,11 @@ class EmAgent:
         self._server.shutdown()
 
 
+class AgentError(RuntimeError):
+    """An agent request failed; the message carries the agent's error
+    document (and, for startup deaths, the child's log tail)."""
+
+
 class AgentClient:
     """Orchestrator-side handle to one agent."""
 
@@ -256,10 +261,18 @@ class AgentClient:
         self.timeout_s = timeout_s
 
     def _req(self, method: str, path: str, body: bytes = b"") -> dict:
+        import urllib.error
+
         req = urllib.request.Request(self.endpoint + path, data=body or None,
                                      method=method)
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-            return json.loads(r.read().decode())
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            # surface the agent's error doc instead of a bare HTTP status
+            detail = e.read().decode(errors="replace")[:4096]
+            raise AgentError(
+                f"agent {method} {path} -> {e.code}: {detail}") from None
 
     def health(self) -> dict:
         return self._req("GET", "/health")
@@ -270,9 +283,19 @@ class AgentClient:
         return self._req("PUT", f"/files/{name}", content)
 
     def start(self, service: str, module: str | None = None,
-              config: str | None = None, env: dict | None = None) -> dict:
+              config: str | None = None, env: dict | None = None,
+              grace_s: float = 1.0) -> dict:
         """Start (or restart) a service. All of module/config/env may be
-        omitted on restart — the agent reuses the service's placed state."""
+        omitted on restart — the agent reuses the service's placed state.
+
+        The startup grace window: after the agent acks the start, poll
+        the child for `grace_s`; a process that dies inside the window
+        (bad config, crashed import) raises AgentError carrying its exit
+        code AND a log tail — the alternative is the orchestrator's
+        wait_until looping to a timeout with no diagnostic. Raise the
+        window for services whose failure mode is post-import (config
+        parsing happens seconds into a JAX-importing boot); 0 skips the
+        check entirely."""
         doc = {}
         if module:
             doc["module"] = module
@@ -281,11 +304,33 @@ class AgentClient:
         if env:
             doc["env"] = env
         body = json.dumps(doc).encode()
-        return self._req("POST", f"/services/{service}/start", body)
+        out = self._req("POST", f"/services/{service}/start", body)
+        deadline = time.time() + grace_s
+        while time.time() < deadline:
+            st = self.status(service)
+            if not st["running"]:
+                tail = ""
+                try:
+                    tail = self.logs(service)[-4000:]
+                except Exception:  # noqa: BLE001 - diagnostics best-effort
+                    pass
+                raise AgentError(
+                    f"service {service} exited rc={st.get('returncode')} "
+                    f"within {grace_s:.1f}s of start\n"
+                    f"--- {service} log tail ---\n{tail}")
+            time.sleep(min(0.1, grace_s))
+        return out
 
     def stop(self, service: str, sig: str = "SIGTERM") -> dict:
         return self._req("POST", f"/services/{service}/stop",
                          json.dumps({"signal": sig}).encode())
+
+    def kill(self, service: str) -> dict:
+        """SIGKILL + reap: the chaos rig's kill-schedule primitive. The
+        agent's stop path waits on the child, so by return the process
+        is dead and its returncode recorded (no TERM grace, no cleanup —
+        exactly the failure a production node loss is)."""
+        return self.stop(service, sig="SIGKILL")
 
     def status(self, service: str) -> dict:
         return self._req("GET", f"/services/{service}/status")

@@ -231,6 +231,21 @@ def disable() -> None:
     _ACTIVE = None
 
 
+def escalate(exc: BaseException | None = None) -> None:
+    """Service-entrypoint catch hook: when a chaos rig armed
+    ``M3_TPU_FAULTS_EXIT=1`` in a SPAWNED service process, a
+    SimulatedCrash that reached a catch block becomes a REAL process
+    death (``os._exit(137)``, SIGKILL parity) instead of unwinding into
+    a 500 in a process that lives on. Call it with the caught exception
+    (no-op for non-crash exceptions) or bare from an
+    ``except SimulatedCrash`` block. Unarmed (the default, and every
+    in-process test), this is a no-op and the exception propagates."""
+    if exc is not None and not isinstance(exc, SimulatedCrash):
+        return
+    if os.environ.get("M3_TPU_FAULTS_EXIT") == "1":
+        os._exit(137)
+
+
 @contextlib.contextmanager
 def active(spec: str, seed: int = 0, clock=time.monotonic, sleep=time.sleep):
     """Scoped activation for tests: always disables on exit."""

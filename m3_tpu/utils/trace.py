@@ -58,6 +58,7 @@ MSG_RECV = "msg.consumer.handle"
 KVD_RPC = "kvd.client.rpc"
 KVD_HANDLE = "kvd.server.handle"
 PEER_HTTP = "storage.peer.http"
+TENANT_SHED = "tenant.admission.shed"
 
 _ZERO_SPAN_ID = "0" * 16
 # placeholder trace id carried by a negative head decision's context —

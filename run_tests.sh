@@ -14,6 +14,12 @@
 #                         number of partition/leader-kill/heal rounds
 #                         against the raft-lite metadata plane under a
 #                         virtual clock; never part of tier-1
+#   run_tests.sh rig    — opt-in PROCESS-LEVEL production rig: real
+#                         spawned dbnodes + 3-replica quorum kvd +
+#                         coordinator + aggregator under seeded
+#                         kill/partition chaos and live load
+#                         (M3_TPU_RIG_SECONDS schedule budget, ~60s wall
+#                         with spawn/verify overhead); never tier-1
 #   run_tests.sh [...]  — full suite (extra args pass through to pytest)
 # static observability pass: tracepoint names unique; every fault point
 # has a metric/span at its seam (tools/check_observability.py)
@@ -30,6 +36,12 @@ elif [ "${1:-}" = "chaos" ]; then
     python -m pytest tests/test_crash_recovery.py tests/test_fault_injection.py \
     tests/test_consensus.py \
     -q -m chaos "$@"
+elif [ "${1:-}" = "rig" ]; then
+  shift
+  exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    M3_TPU_RIG_SECONDS="${M3_TPU_RIG_SECONDS:-20}" \
+    python -m pytest tests/test_rig.py -q -m chaos "$@"
 fi
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \

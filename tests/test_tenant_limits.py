@@ -1,0 +1,537 @@
+"""Per-tenant admission control (utils/tenantlimits) + the client's
+429-as-backpressure contract.
+
+Everything time-dependent runs on a virtual clock: token-bucket
+refill/burst, the cardinality-cache TTL, cost-budget deficit windows and
+runtime KV updates are all asserted deterministically. The isolation
+test drives a real in-process CoordinatorAPI and asserts tenant B's p99
+from the PR-4 per-tenant request histograms while tenant A is shed."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from m3_tpu.utils import tenantlimits
+from m3_tpu.utils.tenantlimits import (
+    TenantAdmission,
+    TenantQuota,
+    TenantShedError,
+    TokenBucket,
+)
+
+
+class VClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# token bucket
+
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        clock = VClock()
+        b = TokenBucket(rate_per_s=10.0, burst=20.0, clock=clock)
+        # starts full: the whole burst is available immediately
+        assert b.try_take(20.0) == 0.0
+        wait = b.try_take(5.0)
+        assert wait == pytest.approx(0.5)  # 5 tokens at 10/s
+        clock.advance(0.5)
+        assert b.try_take(5.0) == 0.0
+
+    def test_refill_caps_at_burst(self):
+        clock = VClock()
+        b = TokenBucket(rate_per_s=100.0, burst=10.0, clock=clock)
+        assert b.try_take(10.0) == 0.0
+        clock.advance(1000.0)  # a long idle period refills to burst, not more
+        assert b.balance() == pytest.approx(10.0)
+
+    def test_post_paid_charge_goes_negative_and_recovers(self):
+        clock = VClock()
+        b = TokenBucket(rate_per_s=10.0, burst=10.0, clock=clock)
+        b.charge(30.0)  # one oversized query
+        assert b.balance() == pytest.approx(-20.0)
+        assert b.deficit_s() == pytest.approx(2.0)
+        clock.advance(2.0)
+        assert b.deficit_s() == 0.0
+
+    def test_debt_capped_at_ten_bursts(self):
+        clock = VClock()
+        b = TokenBucket(rate_per_s=1.0, burst=5.0, clock=clock)
+        b.charge(1e9)
+        assert b.balance() >= -50.0  # throttled, not banished
+
+    def test_oversized_request_granted_with_debt_not_livelocked(self):
+        """n > burst can never be satisfied by waiting (tokens cap at
+        burst): it is granted while solvent, and the debt throttles the
+        tenant's NEXT requests — never a Retry-After that lies."""
+        clock = VClock()
+        b = TokenBucket(rate_per_s=10.0, burst=20.0, clock=clock)
+        assert b.try_take(50.0) == 0.0  # oversized but solvent: granted
+        assert b.balance() < 0
+        wait = b.try_take(50.0)  # insolvent: wait out the DEBT only
+        assert 0 < wait < math.inf
+        clock.advance(wait)
+        assert b.try_take(50.0) == 0.0  # solvent again -> granted again
+        assert b.try_take(1.0) > 0  # normal requests throttled by the debt
+
+
+# ---------------------------------------------------------------------------
+# quota parsing (strict types, the KV payload discipline)
+
+
+class TestQuotaParsing:
+    def test_from_doc_strict_types(self):
+        q = TenantQuota.from_doc({"datapoints_per_sec": 100,
+                                  "max_series": 5, "unknown_key": 1})
+        assert q.datapoints_per_sec == 100.0 and q.max_series == 5
+        with pytest.raises(ValueError):
+            TenantQuota.from_doc({"queries_per_sec": "fast"})
+        with pytest.raises(ValueError):
+            TenantQuota.from_doc({"queries_per_sec": True})
+        with pytest.raises(ValueError):
+            TenantQuota.from_doc({"burst_s": 0})
+
+    def test_parse_quota_doc_shape(self):
+        quotas, default = tenantlimits.parse_quota_doc({
+            "default": {"queries_per_sec": 10},
+            "tenants": {"a": {"max_series": 3}},
+        })
+        assert default.queries_per_sec == 10.0
+        assert quotas["a"].max_series == 3
+        assert tenantlimits.from_config(None) is None
+        assert tenantlimits.from_config({}) is None
+
+
+# ---------------------------------------------------------------------------
+# admission decisions (virtual clock)
+
+
+class TestAdmissionDecisions:
+    def test_write_rate_shed_and_refill(self):
+        clock = VClock()
+        adm = TenantAdmission(
+            {"a": TenantQuota(datapoints_per_sec=100, burst_s=1.0)},
+            clock=clock)
+        adm.admit_write("a", 100)  # the full burst
+        with pytest.raises(TenantShedError) as ei:
+            adm.admit_write("a", 50)
+        assert ei.value.kind == "write"
+        assert ei.value.retry_after_s == pytest.approx(0.5)
+        clock.advance(0.5)
+        adm.admit_write("a", 50)  # refilled
+        # unconfigured tenants are unlimited (no default quota)
+        adm.admit_write("other", 10**9)
+
+    def test_query_rate_shed(self):
+        clock = VClock()
+        adm = TenantAdmission(
+            {"a": TenantQuota(queries_per_sec=2, burst_s=1.0)}, clock=clock)
+        adm.admit_query("a")
+        adm.admit_query("a")
+        with pytest.raises(TenantShedError) as ei:
+            adm.admit_query("a")
+        assert ei.value.kind == "query"
+        clock.advance(1.0)
+        adm.admit_query("a")
+
+    def test_cardinality_ceiling_with_ttl_cache(self):
+        clock = VClock()
+        live = {"n": 10}
+        adm = TenantAdmission(
+            {"a": TenantQuota(max_series=5)}, clock=clock,
+            cardinality_source=lambda ns: live["n"], cardinality_ttl_s=1.0)
+        with pytest.raises(TenantShedError) as ei:
+            adm.admit_write("a", 1)
+        assert ei.value.kind == "cardinality"
+        # the source dropping below the ceiling is only observed after
+        # the TTL — the hot path must not re-scan storage per write
+        live["n"] = 2
+        with pytest.raises(TenantShedError):
+            adm.admit_write("a", 1)
+        clock.advance(1.1)
+        adm.admit_write("a", 1)
+
+    def test_cardinality_unknown_source_skips(self):
+        adm = TenantAdmission(
+            {"a": TenantQuota(max_series=1)}, clock=VClock(),
+            cardinality_source=lambda ns: None)
+        adm.admit_write("a", 1)  # remote storage: ceiling unenforceable
+
+    def test_cost_budget_post_paid(self):
+        clock = VClock()
+        adm = TenantAdmission(
+            {"a": TenantQuota(query_cost_per_sec=10, burst_s=1.0)},
+            clock=clock)
+
+        class Stats:
+            series_matched = 20
+            blocks_read = 10
+            bytes_decoded = 10 * 1024
+
+        adm.admit_query("a")  # solvent
+        adm.charge_query_cost("a", Stats())  # cost 40 against capacity 10
+        with pytest.raises(TenantShedError) as ei:
+            adm.admit_query("a")
+        assert ei.value.kind == "cost"
+        assert ei.value.retry_after_s == pytest.approx(3.0)  # 30 deficit @10/s
+        clock.advance(3.0)
+        adm.admit_query("a")
+
+    def test_default_quota_applies_to_unconfigured(self):
+        clock = VClock()
+        adm = TenantAdmission(
+            {}, default=TenantQuota(queries_per_sec=1, burst_s=1.0),
+            clock=clock)
+        adm.admit_query("anyone")
+        with pytest.raises(TenantShedError):
+            adm.admit_query("anyone")
+
+    def test_shed_counters_and_tracepoint(self):
+        from m3_tpu.utils.instrument import default_registry
+
+        clock = VClock()
+        adm = TenantAdmission(
+            {"ctr_t": TenantQuota(queries_per_sec=1, burst_s=1.0)},
+            clock=clock)
+        adm.admit_query("ctr_t")
+        with pytest.raises(TenantShedError):
+            adm.admit_query("ctr_t")
+        reg = default_registry()
+        tags_allow = (("kind", "query"), ("namespace", "ctr_t"))
+        assert reg.counters[("tenant.admission.allowed", tags_allow)].value == 1
+        assert reg.counters[("tenant.admission.shed", tags_allow)].value == 1
+
+    def test_default_quota_tenants_share_the_other_label(self):
+        """Client-supplied namespaces admitted via the default quota must
+        not mint per-namespace metric labels: a scanner cycling random
+        ?namespace= values would grow /metrics without bound."""
+        from m3_tpu.utils.instrument import default_registry
+
+        clock = VClock()
+        adm = TenantAdmission(
+            {}, default=TenantQuota(queries_per_sec=100), clock=clock)
+        reg = default_registry()
+        tags = (("kind", "query"), ("namespace", "other"))
+        before = reg.counters[("tenant.admission.allowed", tags)].value
+        for i in range(5):
+            adm.admit_query(f"scanner_ns_{i}")
+        assert reg.counters[
+            ("tenant.admission.allowed", tags)].value == before + 5
+        assert not any(
+            t == ("namespace", "scanner_ns_0")
+            for (_n, tag_tuple) in reg.counters for t in tag_tuple)
+
+
+# ---------------------------------------------------------------------------
+# runtime updates via the KV watch
+
+
+class TestRuntimeQuotaUpdates:
+    def test_kv_watch_applies_and_ignores_malformed(self):
+        from m3_tpu.cluster.kv import KVStore
+
+        clock = VClock()
+        kv = KVStore()
+        adm = TenantAdmission(
+            {"a": TenantQuota(queries_per_sec=100, burst_s=1.0)},
+            clock=clock)
+        adm.watch_kv(kv)
+        adm.admit_query("a")  # plenty of headroom
+
+        kv.set(tenantlimits.TENANTS_KEY, json.dumps(
+            {"tenants": {"a": {"queries_per_sec": 1, "burst_s": 1.0}}}
+        ).encode())
+        adm.admit_query("a")  # the ONE token of the new burst
+        with pytest.raises(TenantShedError):
+            adm.admit_query("a")
+
+        # malformed payloads keep the last applied quotas
+        kv.set(tenantlimits.TENANTS_KEY, b"{not json")
+        with pytest.raises(TenantShedError):
+            adm.admit_query("a")
+        kv.set(tenantlimits.TENANTS_KEY, json.dumps(
+            {"tenants": {"a": {"queries_per_sec": "fast"}}}).encode())
+        with pytest.raises(TenantShedError):
+            adm.admit_query("a")
+
+    def test_set_quotas_keeps_state_for_unchanged_tenants(self):
+        clock = VClock()
+        q = TenantQuota(queries_per_sec=1, burst_s=1.0)
+        adm = TenantAdmission({"a": q}, clock=clock)
+        adm.admit_query("a")  # drain the burst
+        # same quota for a, new tenant b: a's drained bucket must SURVIVE
+        adm.set_quotas({"a": TenantQuota(queries_per_sec=1, burst_s=1.0),
+                        "b": TenantQuota(queries_per_sec=5)})
+        with pytest.raises(TenantShedError):
+            adm.admit_query("a")
+        # a CHANGED quota rebuilds the bucket (fresh burst)
+        adm.set_quotas({"a": TenantQuota(queries_per_sec=2, burst_s=1.0)})
+        adm.admit_query("a")
+
+
+# ---------------------------------------------------------------------------
+# HTTP mapping + per-tenant isolation on a real in-process coordinator
+
+
+@pytest.fixture
+def iso_api(tmp_path):
+    from m3_tpu.query.api import CoordinatorAPI
+    from m3_tpu.storage import limits as storage_limits
+    from m3_tpu.storage.database import Database
+    from m3_tpu.storage.options import DatabaseOptions
+
+    db = Database(str(tmp_path / "data"), DatabaseOptions(n_shards=2))
+    db.create_namespace("isoA")
+    db.create_namespace("isoB")
+    db.open()
+    api = CoordinatorAPI(db, "isoA")
+    api.admission = TenantAdmission(
+        {"isoA": TenantQuota(queries_per_sec=2, burst_s=1.0),
+         "isoB": TenantQuota(queries_per_sec=10_000)},
+        cardinality_source=lambda ns: storage_limits.live_series(db, ns))
+    yield api, db
+    db.close()
+
+
+def _query(api, ns: str, expr: str = "iso_metric"):
+    return api.handle("GET", "/api/v1/query_range", {
+        "query": [expr], "start": ["0"], "end": ["60"], "step": ["10"],
+        "namespace": [ns]}, b"")
+
+
+class TestCoordinatorIntegration:
+    def test_429_with_retry_after(self, iso_api):
+        api, _db = iso_api
+        for _ in range(2):
+            status, _ct, _p, _h = _query(api, "isoA")
+            assert status == 200
+        status, _ct, payload, headers = _query(api, "isoA")
+        assert status == 429
+        assert int(headers["Retry-After"]) >= 1
+        doc = json.loads(payload)
+        assert doc["errorType"] == "tenant_limit"
+        assert doc["tenant"] == "isoA" and doc["kind"] == "query"
+        assert doc["retry_after_s"] > 0
+
+    def test_write_shed_maps_to_429(self, tmp_path):
+        from m3_tpu.query.api import CoordinatorAPI
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.options import DatabaseOptions
+
+        db = Database(str(tmp_path / "wdata"), DatabaseOptions(n_shards=2))
+        db.create_namespace("wts")
+        db.open()
+        try:
+            api = CoordinatorAPI(db, "wts")
+            api.admission = TenantAdmission(
+                {"wts": TenantQuota(datapoints_per_sec=2, burst_s=1.0)})
+            body = json.dumps({"metric": "m", "tags": {"k": "v"},
+                               "timestamp": 1.0, "value": 1.0}).encode()
+            for _ in range(2):
+                status, _ct, _p, _h = api.handle(
+                    "POST", "/api/v1/json/write", {}, body)
+                assert status == 200
+            status, _ct, _p, headers = api.handle(
+                "POST", "/api/v1/json/write", {}, body)
+            assert status == 429 and "Retry-After" in headers
+        finally:
+            db.close()
+
+    def test_isolation_tenant_b_p99_from_histograms(self, iso_api):
+        """Tenant A saturated (mostly 429s), tenant B unaffected: B's
+        p99 comes from the per-tenant request histogram the coordinator
+        feeds (the PR-4 family), not from client-side timing."""
+        from m3_tpu.utils.instrument import default_registry
+
+        api, _db = iso_api
+        reg = default_registry()
+        key_b = ("coordinator.tenant.request_seconds",
+                 (("namespace", "isoB"),))
+        before = reg.histograms[key_b].count \
+            if key_b in reg.histograms else 0
+        sheds = 0
+        for i in range(40):
+            status, *_rest = _query(api, "isoA")
+            if status == 429:
+                sheds += 1
+            status_b, *_rest = _query(api, "isoB", f"iso_metric_{i % 4}")
+            assert status_b == 200  # B is NEVER shed
+        assert sheds >= 35  # A is being shed hard
+        hist = reg.histograms[key_b]
+        assert hist.count - before == 40
+        assert hist.quantile(0.99) < 1.0  # B p99 stays in-process-fast
+        shed_ctr = reg.counters[("tenant.admission.shed",
+                                 (("kind", "query"), ("namespace", "isoA")))]
+        assert shed_ctr.value >= sheds
+
+
+# ---------------------------------------------------------------------------
+# client backpressure: 429 is NOT a breaker failure
+
+
+class TestClientBackpressure:
+    def test_hostpolicy_honors_retry_after_without_breaker_failure(self):
+        from m3_tpu.client.breaker import (
+            Backpressure,
+            BreakerConfig,
+            HostPolicy,
+        )
+
+        sleeps: list[float] = []
+        pol = HostPolicy(
+            "h", BreakerConfig(failure_threshold=2, retry_attempts=3,
+                               backpressure_jitter_frac=0.0),
+            sleep=sleeps.append)
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise Backpressure("429", retry_after_s=0.5)
+            return "ok"
+
+        assert pol.call(fn) == "ok"
+        assert pol.breaker.state == "closed"
+        assert sleeps == [0.5, 0.5]  # Retry-After honored, not backoff
+
+    def test_backpressure_capped_and_jittered(self):
+        from m3_tpu.client.breaker import (
+            Backpressure,
+            BreakerConfig,
+            HostPolicy,
+        )
+
+        sleeps: list[float] = []
+        pol = HostPolicy(
+            "h", BreakerConfig(retry_attempts=2, backpressure_cap_s=1.0,
+                               backpressure_jitter_frac=0.25),
+            sleep=sleeps.append)
+
+        def fn():
+            raise Backpressure("429", retry_after_s=60.0)
+
+        with pytest.raises(Backpressure):
+            pol.call(fn)
+        assert len(sleeps) == 1
+        assert 1.0 <= sleeps[0] <= 1.25  # capped, jitter in [0, 25%)
+
+    def test_sustained_429s_never_open_the_circuit(self):
+        from m3_tpu.client.breaker import (
+            Backpressure,
+            BreakerConfig,
+            HostPolicy,
+        )
+
+        pol = HostPolicy(
+            "h", BreakerConfig(failure_threshold=2, retry_attempts=1,
+                               backpressure_jitter_frac=0.0),
+            sleep=lambda s: None)
+
+        def fn():
+            raise Backpressure("429", retry_after_s=0.01)
+
+        for _ in range(20):
+            with pytest.raises(Backpressure):
+                pol.call(fn)
+        # 20 sheds > threshold 2, yet the circuit NEVER opened: tenant
+        # throttling must not become node-level shedding
+        assert pol.breaker.state == "closed"
+
+    def test_http_conn_raises_backpressure_with_retry_after(self):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from m3_tpu.client.breaker import Backpressure
+        from m3_tpu.client.http_conn import HTTPNodeConnection
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                body = b'{"error":"tenant over budget"}'
+                self.send_response(429)
+                self.send_header("Retry-After", "3")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            conn = HTTPNodeConnection(
+                f"127.0.0.1:{srv.server_address[1]}", timeout_s=5.0)
+            with pytest.raises(Backpressure) as ei:
+                conn.read("default", b"sid", 0, 1)
+            assert ei.value.retry_after_s == pytest.approx(3.0)
+        finally:
+            srv.shutdown()
+
+    def test_session_write_slot_degrades_not_breaker(self):
+        """A connection answering 429s degrades that entry's slot; the
+        host's circuit stays closed so the next batch is still tried."""
+        from m3_tpu.client.breaker import Backpressure, BreakerConfig
+        from m3_tpu.client.session import Session
+        from m3_tpu.cluster import placement as pl
+        from m3_tpu.cluster.placement import Instance
+        from m3_tpu.cluster.topology import ConsistencyLevel, TopologyMap
+
+        class ShedConn:
+            def write_tagged(self, ns, name, tags, t, v):
+                raise Backpressure("429 shed", retry_after_s=0.001)
+
+        conns = {"n0": ShedConn()}
+        p = pl.initial_placement([Instance("n0")], n_shards=2,
+                                 replica_factor=1)
+        sess = Session(TopologyMap(p), conns,
+                       write_consistency=ConsistencyLevel.ONE,
+                       breaker_config=BreakerConfig(
+                           failure_threshold=2, retry_attempts=1,
+                           retry_backoff_s=0.0))
+        for _ in range(5):
+            out = sess.write_many("default",
+                                  [(b"m", [(b"k", b"v")], 10**9, 1.0)])
+            assert out[0] is not None and "429" in out[0]
+        assert sess.host_policy("n0").breaker.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# crash escalation (the M3_TPU_FAULTS_EXIT satellite, in-process)
+
+
+class TestCrashEscalation:
+    def test_escalate_armed_exits_137(self, monkeypatch):
+        from m3_tpu.utils import faults
+
+        codes = []
+        monkeypatch.setenv("M3_TPU_FAULTS_EXIT", "1")
+        monkeypatch.setattr(faults.os, "_exit", codes.append)
+        faults.escalate(faults.SimulatedCrash("boom"))
+        assert codes == [137]
+        # bare form (from an `except SimulatedCrash` block)
+        faults.escalate()
+        assert codes == [137, 137]
+        # non-crash exceptions never escalate
+        faults.escalate(ValueError("x"))
+        assert codes == [137, 137]
+
+    def test_escalate_unarmed_is_noop(self, monkeypatch):
+        from m3_tpu.utils import faults
+
+        monkeypatch.delenv("M3_TPU_FAULTS_EXIT", raising=False)
+        monkeypatch.setattr(
+            faults.os, "_exit",
+            lambda code: (_ for _ in ()).throw(AssertionError("exited")))
+        faults.escalate(faults.SimulatedCrash("boom"))
+        faults.escalate()

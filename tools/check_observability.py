@@ -26,6 +26,12 @@ Four invariants, all cheap enough to run before every test lane:
    `exporter_from_config`) — a process outside the export plane is a
    blind spot the collector can't see.
 
+5. Every per-tenant admission-control decision point
+   (utils/tenantlimits: admit_write / admit_query) emits a counter
+   (shed/allow per tenant), and the shed path carries the
+   `tenant.admission.shed` tracepoint — a quota that can shed traffic
+   invisibly is an outage an operator cannot attribute.
+
 Exit code 0 = clean; 1 = violations (each printed with file:line).
 """
 
@@ -160,6 +166,35 @@ def check_exporter_registered(failures: list[str]) -> None:
                 f"telemetry exporter (exporter_from_config)")
 
 
+def check_admission_observability(failures: list[str]) -> None:
+    """Invariant 5: the tenant admission controller's decision points
+    count every verdict, and sheds are trace-visible."""
+    path = os.path.join(PKG, "utils", "tenantlimits.py")
+    try:
+        tree = ast.parse(open(path).read())
+    except (OSError, SyntaxError) as e:
+        failures.append(f"{path}: unreadable/unparseable: {e}")
+        return
+    # each decision point must route its verdict through the counting
+    # helpers (which emit the per-tenant counters)
+    for fn in ("admit_write", "admit_query"):
+        counted = (_function_references(tree, fn, "_allow")
+                   and _function_references(tree, fn, "_shed")) \
+            or _function_references(tree, fn, "counter")
+        if not counted:
+            failures.append(
+                f"{path}: decision point {fn} does not emit per-tenant "
+                f"allow/shed counters")
+    if not _function_references(tree, "_shed", "counter"):
+        failures.append(
+            f"{path}: the shed path does not emit a per-tenant counter")
+    if not (_function_references(tree, "_shed", "span")
+            and _function_references(tree, "_shed", "TENANT_SHED")):
+        failures.append(
+            f"{path}: the shed path does not carry the TENANT_SHED "
+            f"tracepoint")
+
+
 def main() -> int:
     failures: list[str] = []
 
@@ -204,6 +239,9 @@ def main() -> int:
     check_exemplar_capable(failures)
     check_exporter_registered(failures)
 
+    # 5: admission-control decisions are counted and sheds traced
+    check_admission_observability(failures)
+
     if failures:
         print("check_observability: FAILED", file=sys.stderr)
         for f in failures:
@@ -212,7 +250,8 @@ def main() -> int:
     print(f"check_observability: OK — {len(seen)} tracepoints unique, "
           f"{len(catalog)} fault points instrumented at their seams, "
           f"exemplar capture verified, exporter registered in "
-          f"{len(SERVICE_ENTRYPOINTS)} service entrypoints")
+          f"{len(SERVICE_ENTRYPOINTS)} service entrypoints, admission "
+          f"decision points counted + shed path traced")
     return 0
 
 
