@@ -9,3 +9,17 @@ parallel (mesh/sharding), models (service assemblies), utils.
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+if _os.environ.get("M3_TPU_LOCK_CHECK"):
+    # shadow-lock checker: every threading.Lock/RLock created after this
+    # point records cross-thread acquisition order; ordering cycles are
+    # reported as potential deadlocks (utils/lockcheck). Installed at
+    # package import so module- and __init__-constructed locks are all
+    # shadowed. Zero overhead when the env var is unset/disabled
+    # (=0/false/off also mean off — env_enabled).
+    from m3_tpu.utils import lockcheck as _lockcheck
+
+    if _lockcheck.env_enabled(_os.environ["M3_TPU_LOCK_CHECK"]):
+        _lockcheck.install()

@@ -1091,6 +1091,10 @@ class KvdClient(KVStore):
                     resp = _dec_resp(self._stub(name)(
                         req, timeout=self.timeout_s,
                         metadata=trace.grpc_metadata()))
+            except faults.SimulatedCrash:
+                # a crash injected at kvd.rpc must take the process down
+                # (M3_TPU_FAULTS_EXIT semantics), not feed the retry loop
+                raise
             except Exception as e:  # noqa: BLE001 - grpc transport error
                 last_exc = e
                 self._rotate()
@@ -1115,6 +1119,24 @@ class KvdClient(KVStore):
             raise KeyNotFound(key)
         return VersionedValue(version, data)
 
+    def _write_kv(self, key: str, data: bytes, lease: int,
+                  expect_version: int | None = None) -> tuple[int, str]:
+        """One Set (or Cas, when expect_version is given) RPC carrying an
+        explicit lease attachment.  The single decode path for the write
+        error vocabulary: conflicts raise VersionMismatch here; "nolease"
+        is returned for the caller's retry policy."""
+        if expect_version is None:
+            version, _d, err, _l, _k = self._call(
+                "Set", _enc_req(key=key, data=data, lease_id=lease))
+        else:
+            version, _d, err, _l, _k = self._call(
+                "Cas", _enc_req(key=key, data=data,
+                                expect_version=expect_version,
+                                lease_id=lease))
+        if err.startswith("conflict"):
+            raise VersionMismatch(err.partition(":")[2] or key)
+        return version, err
+
     def set(self, key: str, data: bytes, ephemeral: bool = False) -> int:
         """ephemeral=True attaches the key to this client's session lease
         (vanishes if the process dies). Plain sets are PERSISTENT — and
@@ -1122,8 +1144,7 @@ class KvdClient(KVStore):
         (round-4 advisor finding: the lease must not ride every write)."""
         for _attempt in range(2):
             lease = self._session_lease() if ephemeral else 0
-            version, _d, err, _l, _k = self._call(
-                "Set", _enc_req(key=key, data=data, lease_id=lease))
+            version, err = self._write_kv(key, data, lease)
             if err == "nolease":
                 # the session lease expired in flight (server restart or a
                 # stalled keepalive): replace it exactly once (racing the
@@ -1143,15 +1164,10 @@ class KvdClient(KVStore):
                       ephemeral: bool = False) -> int:
         for _attempt in range(2):
             lease = self._session_lease() if ephemeral else 0
-            version, _d, err, _l, _k = self._call(
-                "Cas", _enc_req(key=key, data=data,
-                                expect_version=expect_version,
-                                lease_id=lease))
+            version, err = self._write_kv(key, data, lease, expect_version)
             if err == "nolease":
                 self._ensure_fresh_lease(lease)  # expired in flight: retry
                 continue
-            if err.startswith("conflict"):
-                raise VersionMismatch(err.partition(":")[2] or key)
             self._track_ephemeral(key, data if ephemeral else None)
             return version
         raise KVError(f"session lease unrecoverable writing {key!r}")
@@ -1275,7 +1291,16 @@ class KvdClient(KVStore):
         loses the race adopts the winner's lease instead of granting a
         second one."""
         with self._lease_lock:
-            if self._lease_id == stale_id or not self._lease_id:
+            # ONLY the exact stale id re-grants: a zero here means
+            # end_session() tore the session down between our caller
+            # reading the id and this lock — re-granting would resurrect
+            # the session being ended (callers that really want a new
+            # session go through start_session explicitly)
+            if self._lease_id == stale_id and stale_id:
+                # intentional RPC-under-lock: single-flight lease grant —
+                # the lock's whole job is to make the losers of the race
+                # WAIT for the winner's network round-trip
+                # m3lint: disable=lock-blocking-call
                 self._grant_locked(self._lease_ttl_ms or 5_000)
             return self._lease_id
 
@@ -1298,6 +1323,9 @@ class KvdClient(KVStore):
         the server's orphan grace expires — a live leader keeps its
         leadership across a kvd restart."""
         with self._lease_lock:
+            # intentional RPC-under-lock: same single-flight grant
+            # discipline as _ensure_fresh_lease
+            # m3lint: disable=lock-blocking-call
             lease_id = self._grant_locked(ttl_ms)
         interval = max(0.2, ttl_ms / 3e3)
         if self._lease_thread is not None:
@@ -1311,12 +1339,22 @@ class KvdClient(KVStore):
                 try:
                     _v2, _d2, err, _l2, _k2 = self._call(
                         "LeaseKeepAlive", _enc_req(lease_id=cur))
+                except faults.SimulatedCrash as e:
+                    # armed (chaos rig): the process dies HERE — the
+                    # broad retry catch below must never eat a crash
+                    # _call deliberately re-raised; unarmed, kill the
+                    # keepalive thread loudly instead of silently
+                    faults.escalate(e)
+                    raise
                 except Exception:  # noqa: BLE001 - retry next tick
                     continue
                 if err == "notfound" and self._lease_id \
                         and not self._closed.is_set():
                     try:
                         self._regrant(cur)
+                    except faults.SimulatedCrash as e:
+                        faults.escalate(e)
+                        raise
                     except Exception:  # noqa: BLE001 - retry next tick
                         pass
 
@@ -1325,36 +1363,66 @@ class KvdClient(KVStore):
         return lease_id
 
     def _regrant(self, stale_id: int) -> None:
-        """Fresh lease + re-assert owned ephemeral keys (server lost ours)."""
-        self._ensure_fresh_lease(stale_id)
+        """Fresh lease + re-assert owned ephemeral keys (server lost ours).
+
+        Every re-assert RPC carries the EXPLICIT lease this round granted
+        — routing through set()/_session_lease's ambient auto-grant would
+        resurrect a session end_session() tears down concurrently (it
+        sees _lease_id == 0 mid-loop and grants a brand-new lease)."""
+        fresh = self._ensure_fresh_lease(stale_id)
+        if not fresh:
+            # end_session() zeroed the id between the keepalive reading it
+            # and here: re-granting would resurrect the session being
+            # ended (callers that really want a new session go through
+            # start_session explicitly)
+            return
         with self._lock:
             owned = list(self._ephemeral.items())
         for key, data in owned:
-            try:
-                vv = self.get(key)
-            except KeyNotFound:
-                vv = None
-            try:
-                if vv is None:
-                    self.set_if_not_exists(key, data, ephemeral=True)
-                elif vv.data == data:
-                    # still ours: re-attach under the new lease
-                    self.set(key, data, ephemeral=True)
-                else:
-                    # someone else took it while our lease was dead
+            for _attempt in range(2):
+                if not fresh or self._lease_id != fresh:
+                    # torn down (or replaced) mid-loop: stop resurrecting
+                    return
+                try:
+                    vv = self.get(key)
+                except KeyNotFound:
+                    vv = None
+                try:
+                    if vv is not None and vv.data != data:
+                        # someone else took it while our lease was dead
+                        self._track_ephemeral(key, None)
+                        break
+                    _v, err = self._write_kv(
+                        key, data, fresh,
+                        expect_version=0 if vv is None else None)
+                    if err == "nolease":
+                        # the fresh lease died in flight: replace exactly
+                        # it (a teardown returns 0 and the loop-head
+                        # guard bails)
+                        fresh = self._ensure_fresh_lease(fresh)
+                        continue
+                    self._track_ephemeral(key, data)
+                except (VersionMismatch, KVError):
                     self._track_ephemeral(key, None)
-            except (VersionMismatch, KVError):
-                self._track_ephemeral(key, None)
+                break
 
     def end_session(self) -> None:
-        if self._lease_id:
+        # zero the id under the lease lock FIRST: the keepalive thread and
+        # a concurrent _ensure_fresh_lease() key off self._lease_id, and
+        # zeroing after the revoke leaves a window where either resurrects
+        # the session we are tearing down
+        with self._lease_lock:
+            lease_id, self._lease_id = self._lease_id, 0
+        if lease_id:
             try:
                 # through _call so a quorum plane re-routes the revoke to
                 # the leader (a follower would silently drop it otherwise)
-                self._call("LeaseRevoke", _enc_req(lease_id=self._lease_id))
+                self._call("LeaseRevoke", _enc_req(lease_id=lease_id))
+            except faults.SimulatedCrash as e:
+                faults.escalate(e)
+                raise
             except Exception:  # noqa: BLE001 - server may already be gone
                 pass
-            self._lease_id = 0
             with self._lock:
                 self._ephemeral.clear()
 

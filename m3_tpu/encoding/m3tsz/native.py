@@ -52,6 +52,10 @@ def load():
         src_mtime = os.path.getmtime(_SRC) if os.path.exists(_SRC) else 0
         if not _SO_OVERRIDE and (
                 not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime):
+            # intentional build-under-lock: single-flight one-time g++
+            # build — concurrent callers must block until the artifact
+            # exists (they would only dogpile the compiler otherwise)
+            # m3lint: disable=lock-blocking-call
             if not _build():
                 return None
         try:

@@ -135,6 +135,9 @@ class CommitLogWriter:
                 f"commitlog writer poisoned by earlier flush failure "
                 f"({self.path})"
             ) from self._failed
+        # same semantic seam as the per-point write() above — one name, one
+        # injection schedule, whichever path the caller took
+        # m3lint: disable=inv-fault-point-unique
         faults.check("commitlog.write", batch=len(series_ids))
         n = len(series_ids)
         if n == 0:
@@ -197,6 +200,9 @@ class CommitLogWriter:
             faults.torn_write(self._f, header + payload, "commitlog.flush")
             self._f.flush()
             if fsync:
+                # same fsync seam as the empty-buffer branch above: one
+                # name for "the WAL fsync", whichever branch ran
+                # m3lint: disable=inv-fault-point-unique
                 faults.check("commitlog.fsync")
                 _fsync_timed(self._f.fileno())
         except BaseException as e:

@@ -461,7 +461,10 @@ class Shard:
                 )
             except (FileNotFoundError, ValueError):
                 continue  # incomplete or corrupt volume: ignore
-            self._filesets[block_start] = reader
+            # same guard as flush/seal: re-bootstrap (live tenant
+            # namespace creation, PR 7) can race a maintenance pass
+            with self._maint_lock:
+                self._filesets[block_start] = reader
             n += 1
         return n
 

@@ -1,0 +1,371 @@
+"""Runtime shadow-lock checker: dynamic lock-order cycle detection.
+
+The static side (tools/m3lint ``lock-order``) proves intra-module
+discipline; this module covers the residue statics can't see — locks
+handed across modules, orders that only materialize on real thread
+interleavings.  Role parity with Go's ``go test -race`` lock-annotation
+half (SURVEY §5), same spirit as pytest running under a deadlock
+sentinel.
+
+``M3_TPU_LOCK_CHECK=1`` (read at ``m3_tpu`` import) swaps
+``threading.Lock``/``threading.RLock`` for instrumented wrappers that
+record, per thread, the set of shadow-locks held at every acquisition
+and feed the (held → acquiring) edges into one global order graph.  A
+new edge that closes a cycle is a potential deadlock: two threads
+driving the two ends of the cycle park forever, no timeout, no stack
+trace.  Reports carry both edges' acquisition sites (file:line of the
+lock's construction), so the fix is a grep away.
+
+Granularity is the lock's CONSTRUCTION SITE, not the instance — kernel
+lockdep's "lock class" semantics.  Every ``Shard._lock`` is one node no
+matter how many shards exist, so an order violated between two different
+shard instances is still a cycle.  Ordering WITHIN a class (two locks
+born on the same source line, e.g. a stripe array) cannot be graph-
+validated — nesting two non-reentrant same-class locks is therefore
+reported directly, once per class, instead of silently dropped.
+
+Modes:
+
+* ``M3_TPU_LOCK_CHECK=1``      record + report (stderr, once per cycle);
+                               ``reports()`` returns them for tests
+* ``M3_TPU_LOCK_CHECK=raise``  raise ``LockOrderError`` at the closing
+                               acquisition — for tests that PIN ordering
+
+Overhead when disabled: zero — ``install()`` is never called and the
+stdlib classes are untouched.  When enabled, each acquire/release pays
+one thread-local list op plus, on a NEW edge only, one graph probe under
+a private registry lock (steady state adds no registry contention).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition closed an ordering cycle (potential deadlock)."""
+
+
+def env_enabled(value: str | None) -> bool:
+    """Is this M3_TPU_LOCK_CHECK value an ENABLE?  '0'/'false'/'off'/'no'
+    and empty mean off — the repo's env-flag convention (M3_TPU_NATIVE_OPS=0
+    etc.), so an operator disabling the checker gets what they asked for."""
+    if value is None:
+        return False
+    return value.strip().lower() not in ("", "0", "false", "off", "no")
+
+
+def raise_mode() -> bool:
+    """Is M3_TPU_LOCK_CHECK currently asking for raise mode?  Normalized
+    the same way env_enabled is — 'RAISE' or ' raise ' must not install
+    the checker and then silently degrade to report-only."""
+    v = os.environ.get("M3_TPU_LOCK_CHECK")
+    return v is not None and v.strip().lower() == "raise"
+
+
+@dataclass
+class CycleReport:
+    cycle: tuple[str, ...]          # lock site names along the cycle
+    closing_edge: tuple[str, str]   # (held, acquiring) that closed it
+    thread: str
+
+    def render(self) -> str:
+        path = " -> ".join(self.cycle + (self.cycle[0],))
+        return (f"lockcheck: ordering cycle {path} closed by thread "
+                f"{self.thread} acquiring {self.closing_edge[1]} while "
+                f"holding {self.closing_edge[0]} — two threads entering "
+                f"from both ends deadlock")
+
+
+class _Registry:
+    """The global order graph: nodes are lock construction sites, edges
+    are observed (held -> acquiring) pairs across all threads."""
+
+    def __init__(self):
+        self._mu = _REAL_LOCK()
+        self._edges: dict[str, set[str]] = {}
+        self._seen_edges: set[tuple[str, str]] = set()
+        self._same_class_seen: set[str] = set()
+        self._reports: list[CycleReport] = []
+        self._tls = threading.local()
+
+    # -- per-thread held stack --
+    def _held(self) -> list:
+        st = getattr(self._tls, "held", None)
+        if st is None:
+            st = self._tls.held = []
+        return st
+
+    def note_acquire(self, lock: "_CheckedLockBase",
+                     blocking: bool = True, bounded: bool = False) -> None:
+        held = self._held()
+        if any(h is lock for h in held):
+            if not lock.reentrant and blocking and not bounded:
+                # UNBOUNDED same-thread re-acquire of a plain Lock: a
+                # GUARANTEED self-deadlock — report before we park
+                # forever (the static check only sees intra-module
+                # re-acquisition; this is the cross-module residue).
+                # Non-blocking probes are exempt: Condition._is_owned
+                # legitimately tests ownership via acquire(False), and
+                # flagging it would also recurse through _DummyThread
+                # creation inside current_thread().  Timeout-bounded
+                # acquires are exempt too — a bounded probe simply
+                # returns False; calling it a guaranteed deadlock (and
+                # raising in raise mode) would be a lie.
+                rep = CycleReport(
+                    cycle=(lock.site,), closing_edge=(lock.site, lock.site),
+                    thread=threading.current_thread().name)
+                with self._mu:
+                    self._reports.append(rep)
+                print(f"lockcheck: non-reentrant lock {lock.site} "
+                      f"re-acquired by thread {rep.thread} while already "
+                      f"held — self-deadlock", file=sys.stderr)
+                if raise_mode():
+                    raise LockOrderError(
+                        f"self-deadlock: non-reentrant {lock.site} "
+                        f"re-acquired while held")
+            # reentrant re-acquire: no new ordering information
+            held.append(lock)
+            return
+        # two DIFFERENT instances from the same class (same construction
+        # line — striped locks, comprehensions): ordering inside a class
+        # cannot be validated by the graph (the edge would be a self
+        # loop), so silently dropping it would leave a same-line ABBA
+        # deadlock invisible. Lockdep semantics: report the nesting
+        # itself, once per class. Report-only — a consistently-ordered
+        # stripe sweep is legitimate and indistinguishable without
+        # nesting annotations, so raise mode does not abort on it.
+        if blocking and not lock.reentrant:
+            for h in held:
+                if h.site == lock.site and not h.reentrant:
+                    rep = CycleReport(
+                        cycle=(lock.site,),
+                        closing_edge=(lock.site, lock.site),
+                        thread=threading.current_thread().name)
+                    with self._mu:
+                        if lock.site in self._same_class_seen:
+                            break
+                        self._same_class_seen.add(lock.site)
+                        self._reports.append(rep)
+                    print(f"lockcheck: nested acquisition of two locks "
+                          f"from the same class {lock.site} by thread "
+                          f"{rep.thread} — ordering within a lock class "
+                          f"is unverifiable; an inconsistently-ordered "
+                          f"pair deadlocks", file=sys.stderr)
+                    break
+        # trylocks contribute NO ordering edges (lockdep semantics): an
+        # acquire that cannot block cannot complete a deadlock, so a
+        # cycle through it is a false report
+        new_edges = [] if not blocking else \
+            [(h.site, lock.site) for h in held
+             if h.site != lock.site
+             and (h.site, lock.site) not in self._seen_edges]
+        if new_edges:
+            # probe BEFORE pushing onto the held stack: raise-mode must
+            # abort the acquisition with the stack still consistent, and
+            # a real deadlock must have printed its report before we park
+            with self._mu:
+                for edge in new_edges:
+                    if edge in self._seen_edges:
+                        continue
+                    self._seen_edges.add(edge)
+                    self._edges.setdefault(edge[0], set()).add(edge[1])
+                    cycle = self._find_cycle(edge[1], edge[0])
+                    if cycle is not None:
+                        rep = CycleReport(
+                            cycle=tuple(cycle), closing_edge=edge,
+                            thread=threading.current_thread().name)
+                        self._reports.append(rep)
+                        print(rep.render(), file=sys.stderr)
+                        if raise_mode():
+                            raise LockOrderError(rep.render())
+        held.append(lock)
+
+    def note_release(self, lock: "_CheckedLockBase") -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    def note_release_all(self, lock: "_CheckedLockBase") -> int:
+        """Drop EVERY held entry for `lock` (Condition._release_save on a
+        recursively-held RLock releases all levels at once)."""
+        held = self._held()
+        n = sum(1 for h in held if h is lock)
+        held[:] = [h for h in held if h is not lock]
+        return n
+
+    def note_restore(self, lock: "_CheckedLockBase", n: int) -> None:
+        """Re-push `n` levels after Condition._acquire_restore — a
+        restore of ordering already recorded, not a new edge."""
+        self._held().extend([lock] * n)
+
+    def _find_cycle(self, start: str, target: str) -> list[str] | None:
+        """Path start ⇝ target in the edge graph (the new edge
+        target → start then closes the cycle)."""
+        stack = [(start, [start])]
+        seen = set()
+        while stack:
+            node, path = stack.pop()
+            if node == target:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in self._edges.get(node, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def reports(self) -> list[CycleReport]:
+        with self._mu:
+            return list(self._reports)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self._seen_edges.clear()
+            self._same_class_seen.clear()
+            self._reports.clear()
+
+
+_registry = _Registry()
+
+
+def reports() -> list[CycleReport]:
+    """Cycle reports recorded so far (test hook)."""
+    return _registry.reports()
+
+
+def reset() -> None:
+    """Clear the order graph and reports (test isolation)."""
+    _registry.reset()
+
+
+def _caller_site() -> str:
+    """file:line of the lock's construction, skipping this module."""
+    f = sys._getframe(2)
+    while f is not None and f.f_globals.get("__name__") == __name__:
+        f = f.f_back
+    if f is None:  # pragma: no cover - interpreter teardown
+        return "<unknown>"
+    fname = os.path.basename(f.f_code.co_filename)
+    return f"{fname}:{f.f_lineno}"
+
+
+class _CheckedLockBase:
+    _factory = staticmethod(_REAL_LOCK)
+    reentrant = False
+
+    def __init__(self, name: str | None = None):
+        self._inner = self._factory()
+        self.site = name or _caller_site()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        # record BEFORE blocking: if this edge deadlocks for real, the
+        # report has already been printed when the process wedges
+        _registry.note_acquire(self, blocking=blocking,
+                               bounded=timeout != -1)
+        try:
+            ok = self._inner.acquire(blocking, timeout)
+        except BaseException:
+            # interrupted mid-acquire (e.g. KeyboardInterrupt): the lock
+            # was never taken — a phantom held entry would turn every
+            # later acquisition into false reports
+            _registry.note_release(self)
+            raise
+        if not ok:
+            _registry.note_release(self)
+        return ok
+
+    def release(self):
+        self._inner.release()
+        _registry.note_release(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _at_fork_reinit(self):
+        # threading._after_fork calls this on every lock the module knows
+        # about; without it the child hits AttributeError and a lock held
+        # at fork time stays wedged forever. The child has exactly one
+        # thread, so also drop any held-stack entries the forking thread
+        # carried across — the inner lock is unlocked now, and stale
+        # entries would manufacture false ordering edges.
+        self._inner._at_fork_reinit()
+        _registry.note_release_all(self)
+
+
+class CheckedLock(_CheckedLockBase):
+    _factory = staticmethod(_REAL_LOCK)
+
+
+class CheckedRLock(_CheckedLockBase):
+    _factory = staticmethod(_REAL_RLOCK)
+    reentrant = True
+
+    # Condition support: without these, threading.Condition falls back to
+    # one plain release(), which only drops ONE recursion level of a
+    # recursively-held RLock — cond.wait() would then park still holding
+    # the lock and the checker itself would manufacture a deadlock
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        return (state, _registry.note_release_all(self))
+
+    def _acquire_restore(self, saved):
+        state, n = saved
+        self._inner._acquire_restore(state)
+        _registry.note_restore(self, n)
+
+
+def _checked_lock_factory():
+    return CheckedLock()
+
+
+def _checked_rlock_factory():
+    return CheckedRLock()
+
+
+_installed = False
+
+
+def install() -> None:
+    """Swap threading.Lock/RLock for the instrumented wrappers.
+
+    Locks created BEFORE install() stay plain — call it as early as
+    possible (m3_tpu/__init__ does, under M3_TPU_LOCK_CHECK).  Condition
+    and the other threading synchronizers build on the factories, so
+    they inherit shadow locks transparently."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    threading.Lock = _checked_lock_factory
+    threading.RLock = _checked_rlock_factory
+
+
+def uninstall() -> None:
+    """Restore the stdlib factories (test isolation)."""
+    global _installed
+    if not _installed:
+        return
+    _installed = False
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK

@@ -1,0 +1,45 @@
+"""The histogram/timer name catalog — the exposition contract.
+
+Every literal name passed to ``Scope.observe`` / ``Scope.histogram`` /
+``Scope.histogram_handle`` / ``Scope.timer`` in m3_tpu must be listed
+here (m3lint rule ``inv-histogram-catalog``).  The catalog is what
+dashboards, the self-scrape (`_m3_system`) queries, and the OpenMetrics
+exemplar links are written against: a histogram that exists only at its
+call site is a metric nobody can alert on, and a renamed one silently
+breaks every recorded query.
+
+Names are the LEAF names (the scope prefix supplies the subsystem, e.g.
+``storage.db`` + ``write_batch_seconds``).  Keep the set literal — the
+lint parses it with ``ast.literal_eval`` and never imports this module.
+"""
+
+from __future__ import annotations
+
+HISTOGRAMS = {
+    # storage / durability plane
+    "write_seconds",            # storage.db per-point write
+    "write_batch_seconds",      # storage.db fused batch write
+    "write_batch_size",         # storage.db entries per batch
+    "read_many_seconds",        # storage.ns fused batch read
+    "shard_flush_seconds",      # shard warm flush
+    "commitlog_fsync_seconds",  # WAL fsync wall time
+    "persist_seconds",          # fileset/index/kv persist (per-scope)
+    # compute plane
+    "seconds",                  # decode/encode + rpc legs (per-scope)
+    "batch_size",               # decode.batch per-rung batch size
+    "compile_seconds",          # compute.jit trace+compile on cache miss
+    # cluster / messaging plane
+    "append_seconds",           # consensus append-entries
+    "commit_seconds",           # consensus majority commit
+    "send_seconds",             # msg producer
+    "recv_seconds",             # msg consumer
+    "http_seconds",             # storage peers HTTP
+    # client / query plane
+    "fetch_many_seconds",       # session batched fetch
+    "request_seconds",          # coordinator request + per-tenant SLO
+    "flush_seconds",            # aggregator flush
+}
+
+TIMERS = {
+    "tick",                     # coordinator/dbnode tick loops
+}

@@ -20,10 +20,32 @@
 #                         kill/partition chaos and live load
 #                         (M3_TPU_RIG_SECONDS schedule budget, ~60s wall
 #                         with spawn/verify overhead); never tier-1
+#   run_tests.sh tsan   — opt-in ThreadSanitizer stage for the native
+#                         layer: (1) pytest tests/test_race_native.py
+#                         (uninstrumented pytest; its tests spawn their
+#                         own libtsan-preloaded children — planted-race
+#                         sensitivity + race_check's threaded workloads),
+#                         then (2) tools/tsan_native.py re-runs the
+#                         test_native*/test_native_hostops parity battery
+#                         in a preloaded child with M3TSZ_SO/M3HOSTOPS_SO
+#                         swapped to the native/tsan builds. pytest itself
+#                         cannot run under the preload in this image (its
+#                         capture layer deadlocks against the TSan
+#                         runtime), which is why the lane splits this way;
+#                         never tier-1
 #   run_tests.sh [...]  — full suite (extra args pass through to pytest)
-# static observability pass: tracepoint names unique; every fault point
-# has a metric/span at its seam (tools/check_observability.py)
-python tools/check_observability.py || exit 1
+#
+# Static analysis gate (every lane): tools/m3lint — lock discipline
+# (order inversions, blocking calls under locks, unguarded mutation of
+# guarded attrs), jax jit-purity/recompile hazards, and the project
+# invariants (tracepoints, fault seams, exemplars, exporter, admission,
+# histogram catalog, crash-swallowing excepts). Zero unwaived findings
+# or the lane does not run. Budget ~10s; see README "Static analysis &
+# concurrency checking".
+cd "$(dirname "$0")" || exit 1
+# same env guard as the lanes below: a set-but-dead PALLAS_AXON_POOL_IPS
+# hangs ANY python at interpreter startup, lint gate included
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m tools.m3lint || exit 1
 ARGS=("$@")
 if [ "${1:-}" = "fast" ]; then
   shift
@@ -42,6 +64,13 @@ elif [ "${1:-}" = "rig" ]; then
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     M3_TPU_RIG_SECONDS="${M3_TPU_RIG_SECONDS:-20}" \
     python -m pytest tests/test_rig.py -q -m chaos "$@"
+elif [ "${1:-}" = "tsan" ]; then
+  shift
+  env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_race_native.py -q "$@" || exit 1
+  exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python tools/tsan_native.py
 fi
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \

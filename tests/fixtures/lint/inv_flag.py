@@ -1,0 +1,34 @@
+"""MUST-FLAG: the inv-* family — duplicated fault-point names, crash-
+swallowing excepts, and off-catalog histogram names."""
+
+from m3_tpu.utils import faults
+from m3_tpu.utils.instrument import default_registry
+
+_scope = default_registry().root_scope("fixture")
+
+
+def write_path(f, data):
+    faults.check("fixture.seam")
+    f.write(data)
+
+
+def batch_path(f, rows):
+    # inv-fault-point-unique: same name as write_path's seam, no waiver
+    faults.check("fixture.seam")
+    for row in rows:
+        f.write(row)
+
+
+def guarded_flush(f, data):
+    try:
+        faults.check("fixture.flush")
+        f.write(data)
+    except Exception:
+        # inv-crash-swallow: SimulatedCrash dies here, chaos runs lie
+        return False
+    return True
+
+
+def record_latency(dt):
+    # inv-histogram-catalog: name absent from utils/metric_catalog.py
+    _scope.observe("fixture_bogus_seconds", dt)

@@ -1,0 +1,47 @@
+"""MUST-PASS: the inv-* family — unique seam names, instrumented
+modules, crash-transparent error handling, cataloged metric names."""
+
+from m3_tpu.utils import faults
+from m3_tpu.utils.instrument import default_registry
+
+_scope = default_registry().root_scope("fixture")
+
+
+def write_path(f, data):
+    faults.check("fixture_ok.write")
+    f.write(data)
+    _scope.counter("writes")
+
+
+def guarded_flush(f, data):
+    try:
+        faults.check("fixture_ok.flush")
+        f.write(data)
+    except faults.SimulatedCrash:
+        raise  # crashes stay crashes
+    except Exception:
+        return False
+    return True
+
+
+def escalating_flush(f, data):
+    try:
+        faults.check("fixture_ok.flush2")
+        f.write(data)
+    except Exception as e:
+        faults.escalate(e)  # escalate() re-raises crash semantics
+        return False
+    return True
+
+
+def reraising_flush(f, data):
+    try:
+        faults.check("fixture_ok.flush3")
+        f.write(data)
+    except Exception:
+        f.close()
+        raise
+
+
+def record_latency(dt):
+    _scope.observe("write_seconds", dt)  # cataloged name

@@ -1,0 +1,53 @@
+"""MUST-FLAG: the jax-* family — impurity, host materialization and
+recompile storms inside (or around) jit-traced code."""
+
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_STATS = {"calls": 0}
+
+
+@jax.jit
+def noisy_kernel(x):
+    # jax-impure-call: evaluated ONCE at trace time, constant thereafter
+    jitter = random.random()
+    stamp = time.time()
+    # jax-global-mutation: trace-time side effect, absent from cached runs
+    _STATS.update(calls=1)
+    # jax-host-materialize: numpy call on a traced parameter
+    base = np.asarray(x)
+    return x + jitter + stamp + base.sum()
+
+
+def helper_reached_from_jit(x):
+    # in the traced set via noisy_dispatch below: same purity rules apply
+    seed = random.random()
+    return x * seed
+
+
+@jax.jit
+def noisy_dispatch(x):
+    return helper_reached_from_jit(x)
+
+
+def rebuild_every_call(x):
+    # jax-jit-per-call: a fresh traced callable (and compile) per call
+    f = jax.jit(lambda v: v * 2.0)
+    return f(x)
+
+
+@jax.jit
+def stepped(x):
+    return jnp.cumsum(x)
+
+
+def ragged_scan(rows):
+    out = []
+    for i in range(len(rows)):
+        # jax-varying-static: every slice length is a new shape bucket
+        out.append(stepped(rows[:i]))
+    return out

@@ -1,0 +1,52 @@
+"""MUST-PASS: the jax-* family — the blessed idioms: pure kernels,
+statics declared, factories cached, shapes bucketed."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("unit", "width"))
+def pure_kernel(x, unit: int, width: int):
+    # unit/width are static: Python arithmetic and numpy on them is fine
+    scale = np.float64(unit * width)
+    return jnp.cumsum(x) * scale
+
+
+@functools.lru_cache(maxsize=None)
+def _kernel_factory():
+    """jit built lazily, ONCE — the lru_cache factory idiom."""
+
+    @jax.jit
+    def kernel(x):
+        return jnp.sort(x)
+
+    return kernel
+
+
+_PLAN_CACHE = {}
+
+
+def plan_for(shape_bucket):
+    """jit stored into a keyed cache — one compile per bucket."""
+    fn = _PLAN_CACHE.get(shape_bucket)
+    if fn is None:
+        fn = _PLAN_CACHE[shape_bucket] = jax.jit(jnp.cumsum)
+    return fn
+
+
+# module-level construction: traced once at import
+doubler = jax.jit(lambda v: v * 2.0)
+
+
+def bucketed_scan(rows, bucket: int):
+    """Padding to a fixed bucket before the jitted call: one shape, one
+    compile, loop-invariant."""
+    out = []
+    for row in rows:
+        padded = np.zeros(bucket)
+        padded[: len(row)] = row
+        out.append(doubler(padded))
+    return out

@@ -1,0 +1,37 @@
+"""MUST-FLAG: lock-blocking-call — I/O inside critical sections, both
+direct and through a helper the analyzer must chase transitively."""
+
+import os
+import subprocess
+import threading
+import time
+
+
+class WalWriter:
+    def __init__(self, f, sock):
+        self._lock = threading.Lock()
+        self._f = f
+        self._sock = sock
+
+    def flush_direct(self):
+        with self._lock:
+            os.fsync(self._f.fileno())  # fsync while every writer waits
+
+    def flush_via_helper(self):
+        with self._lock:
+            self._fsync_helper()
+
+    def _fsync_helper(self):
+        os.fsync(self._f.fileno())
+
+    def ship(self, payload):
+        with self._lock:
+            self._sock.sendall(payload)  # network under the writer lock
+
+    def rebuild(self):
+        with self._lock:
+            subprocess.run(["true"], check=True)
+
+    def backoff(self):
+        with self._lock:
+            time.sleep(0.5)

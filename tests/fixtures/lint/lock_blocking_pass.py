@@ -1,0 +1,32 @@
+"""MUST-PASS: lock-blocking-call — the I/O happens OUTSIDE the critical
+section; the lock only guards the in-memory handoff."""
+
+import os
+import subprocess
+import threading
+import time
+
+
+class WalWriter:
+    def __init__(self, f, sock):
+        self._lock = threading.Lock()
+        self._f = f
+        self._sock = sock
+        self._buf = []
+
+    def flush(self):
+        with self._lock:
+            payload = b"".join(self._buf)
+            self._buf.clear()
+        # lock released: slow I/O runs with writers unblocked
+        self._f.write(payload)
+        os.fsync(self._f.fileno())
+
+    def ship(self):
+        with self._lock:
+            payload = b"".join(self._buf)
+        self._sock.sendall(payload)
+
+    def rebuild(self):
+        subprocess.run(["true"], check=True)
+        time.sleep(0.01)

@@ -1,0 +1,22 @@
+"""MUST-FLAG: lock-guarded-mutation — `_count` and `_entries` are
+mutated under the lock on the write path but bare on another public
+path, so the guard is decoration, not discipline."""
+
+import threading
+
+
+class Buffer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._count = 0
+
+    def write(self, key, value):
+        with self._lock:
+            self._entries[key] = value
+            self._count += 1
+
+    def evict_all(self):
+        # no lock: races write() on both fields
+        self._entries = {}
+        self._count = 0

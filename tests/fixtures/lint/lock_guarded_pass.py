@@ -1,0 +1,37 @@
+"""MUST-PASS: lock-guarded-mutation — every mutation path holds the
+lock: directly, through a `_locked` helper whose callers all hold it, or
+before concurrency exists (__init__-only helpers)."""
+
+import threading
+
+
+class Buffer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._count = 0
+        self._warm_start()
+
+    def _warm_start(self):
+        # called from __init__ only: pre-concurrency, no guard needed
+        self._entries = {}
+        self._count = 0
+
+    def write(self, key, value):
+        with self._lock:
+            self._insert_locked(key, value)
+
+    def write_many(self, items):
+        with self._lock:
+            for key, value in items:
+                self._insert_locked(key, value)
+
+    def _insert_locked(self, key, value):
+        # every caller holds self._lock
+        self._entries[key] = value
+        self._count += 1
+
+    def evict_all(self):
+        with self._lock:
+            self._entries = {}
+            self._count = 0

@@ -1,0 +1,42 @@
+"""MUST-FLAG: lock-order — the seeded two-lock inversion.
+
+Thread 1 runs transfer_ab (A then B); thread 2 runs transfer_ba (B then
+A).  Two threads entering from both ends deadlock.  This fixture is the
+acceptance sentinel: re-introducing this shape anywhere in m3_tpu makes
+``python -m tools.m3lint`` exit non-zero.
+"""
+
+import threading
+
+
+class Accounts:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self.a = 0
+        self.b = 0
+
+    def transfer_ab(self, amount):
+        with self._lock_a:
+            with self._lock_b:
+                self.a -= amount
+                self.b += amount
+
+    def transfer_ba(self, amount):
+        with self._lock_b:
+            with self._lock_a:
+                self.b -= amount
+                self.a += amount
+
+
+class SelfDeadlock:
+    def __init__(self):
+        self._lock = threading.Lock()  # NOT an RLock
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:  # re-acquired while outer holds it
+            pass

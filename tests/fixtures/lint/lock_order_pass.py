@@ -1,0 +1,52 @@
+"""MUST-PASS: lock-order — consistent ordering, reentrancy, condvars."""
+
+import threading
+
+
+class Consistent:
+    """Both paths take A before B: a total order, no cycle."""
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self.x = 0
+
+    def path_one(self):
+        with self._lock_a:
+            with self._lock_b:
+                self.x += 1
+
+    def path_two(self):
+        with self._lock_a:
+            with self._lock_b:
+                self.x -= 1
+
+
+class Reentrant:
+    """RLock re-acquisition through a helper is legal."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.n = 0
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            self.n += 1
+
+
+class CondVar:
+    """`with cond: cond.wait()` releases the lock — the classic idiom."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.ready = False
+
+    def consume(self):
+        with self._cv:
+            while not self.ready:
+                self._cv.wait(0.1)
+            self.ready = False

@@ -1,0 +1,21 @@
+"""MUST-PASS: waiver mechanics — a real finding suppressed by an
+explicit in-code waiver (inline and comment-above forms)."""
+
+import os
+import threading
+
+
+class Writer:
+    def __init__(self, f):
+        self._lock = threading.Lock()
+        self._f = f
+
+    def flush_inline(self):
+        with self._lock:
+            os.fsync(self._f.fileno())  # m3lint: disable=lock-blocking-call
+
+    def flush_above(self):
+        with self._lock:
+            # single-flight flush: callers must block until durable
+            # m3lint: disable=lock-blocking-call
+            os.fsync(self._f.fileno())

@@ -1,0 +1,10 @@
+"""MUST-FLAG: lint-unused-waiver — a waiver with nothing to suppress is
+itself a finding (the baseline may only be relaxed visibly)."""
+
+import os
+
+
+def plain_write(f, data):
+    # m3lint: disable=lock-blocking-call
+    f.write(data)
+    os.replace("a", "b")

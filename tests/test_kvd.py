@@ -231,6 +231,93 @@ class TestKvdLeases:
         finally:
             holder.close()
 
+    # the loud thread death IS the assertion: unarmed, the crash
+    # re-raises out of the keepalive thread instead of being swallowed
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_keepalive_crash_escalates_not_swallowed(self, server,
+                                                     monkeypatch):
+        """A SimulatedCrash _call re-raises (chaos at the kvd.rpc seam)
+        must reach faults.escalate and terminate the keepalive loop —
+        the broad transport-retry except must not eat it, or an armed
+        chaos run observes no process death."""
+        from m3_tpu.utils import faults
+
+        a = KvdClient(f"127.0.0.1:{server.port}")
+        try:
+            a.start_session(ttl_ms=400)
+            escalated = threading.Event()
+            orig_escalate = faults.escalate
+
+            def recording_escalate(exc=None):
+                escalated.set()
+                orig_escalate(exc)  # unarmed: no-op, crash then re-raises
+
+            monkeypatch.setattr(faults, "escalate", recording_escalate)
+            orig_call = a._call
+
+            def crashing(name, req):
+                if name == "LeaseKeepAlive":
+                    raise faults.SimulatedCrash("kvd.rpc")
+                return orig_call(name, req)
+
+            monkeypatch.setattr(a, "_call", crashing)
+            assert escalated.wait(5), \
+                "keepalive swallowed the SimulatedCrash"
+            a._lease_thread.join(5)
+            assert not a._lease_thread.is_alive(), \
+                "crash did not terminate the keepalive loop"
+        finally:
+            a._closed.set()
+            a.close()
+
+    def test_regrant_mid_loop_teardown_grants_no_new_lease(self, server,
+                                                           monkeypatch):
+        """end_session racing INTO _regrant's re-assert loop: once the
+        lease id is zeroed the loop must stop, and critically must not
+        auto-grant a fresh lease via set()/_session_lease (which would
+        leave a ghost session alive for a full TTL)."""
+        a = KvdClient(f"127.0.0.1:{server.port}")
+        try:
+            lease = a.start_session(ttl_ms=60_000)
+            a.set("mid-loop", b"A", ephemeral=True)
+            orig_get = a.get
+
+            def get_then_teardown(key):
+                vv = orig_get(key)
+                with a._lease_lock:  # end_session wins mid-loop
+                    a._lease_id = 0
+                return vv
+
+            monkeypatch.setattr(a, "get", get_then_teardown)
+            a._regrant(lease)
+            assert a._lease_id == 0, \
+                "regrant granted a new lease for a session being ended"
+        finally:
+            a._closed.set()
+            a.close()
+
+    def test_regrant_refuses_after_end_session(self, server):
+        """The keepalive's re-grant path must not resurrect a session
+        end_session() is tearing down: if the stale id it observed has
+        been zeroed, _regrant bails instead of re-asserting ephemeral
+        keys (which would grant a brand-new lease via _session_lease)."""
+        a = KvdClient(f"127.0.0.1:{server.port}")
+        try:
+            lease = a.start_session(ttl_ms=60_000)
+            a.set("regrant-guard", b"A", ephemeral=True)
+            # freeze end_session mid-flight: id zeroed under the lock,
+            # revoke not yet landed, _ephemeral not yet cleared — the
+            # exact window a keepalive's "notfound" answer races into
+            with a._lease_lock:
+                a._lease_id = 0
+            a._regrant(lease)
+            assert a._lease_id == 0, \
+                "regrant resurrected a session being ended"
+        finally:
+            a._closed.set()
+            a.close()
+
 
 KILLABLE_LEADER = r"""
 import sys, time
@@ -563,10 +650,17 @@ class TestKvdQuorum:
         try:
             lead = next(nid for nid, s in servers.items() if s.is_leader)
             follower = next(s for nid, s in servers.items() if nid != lead)
-            err = _dec_resp(follower._set(
-                _enc_req(key="k", data=b"v"), None))[2]
-            assert err.startswith("notleader:")
-            assert err.partition(":")[2] == peers[lead]
+
+            # the follower learns the leader from the first heartbeat;
+            # _quorum_plane only waits for the leader itself, so wait for
+            # the hint rather than racing the heartbeat
+            def rejected_with_hint():
+                err = _dec_resp(follower._set(
+                    _enc_req(key="k", data=b"v"), None))[2]
+                return err.startswith("notleader:") \
+                    and err.partition(":")[2] == peers[lead]
+
+            wait_for(rejected_with_hint, desc="follower knows the leader")
             # reads are leader-only too (linearizable by construction)
             err = _dec_resp(follower._get(_enc_req(key="k"), None))[2]
             assert err.startswith("notleader:")

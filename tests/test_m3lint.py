@@ -1,0 +1,501 @@
+"""m3lint engine + rule-family tests (fixture snippets under
+tests/fixtures/lint/) and the runtime shadow-lock checker.
+
+The fixture pairs pin both directions of every rule family: the
+must-flag file produces the expected rule ids, the must-pass file
+produces ZERO findings for that family — an analyzer that goes blind
+(or noisy) fails here before it ever gates a test lane.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+if REPO not in sys.path:  # `import tools.m3lint` from the repo root
+    sys.path.insert(0, REPO)
+
+from tools.m3lint.engine import all_rules, lint_paths  # noqa: E402
+
+
+def run_lint(fname: str, select: tuple[str, ...] = ()):
+    return lint_paths([os.path.join(FIXTURES, fname)], select=select)
+
+
+def rules_of(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# rule families: must-flag / must-pass fixture pairs
+# ---------------------------------------------------------------------------
+
+class TestConcurrencyRules:
+    def test_lock_order_inversion_flags(self):
+        fs = run_lint("lock_order_flag.py", select=("lock-",))
+        assert rules_of(fs) == {"lock-order"}
+        msgs = "\n".join(f.message for f in fs)
+        # both directions of the inversion are reported, plus the
+        # non-reentrant re-acquisition
+        assert "Accounts._lock_b while holding Accounts._lock_a" in msgs
+        assert "Accounts._lock_a while holding Accounts._lock_b" in msgs
+        assert "self-deadlock" in msgs
+        assert len(fs) == 3
+
+    def test_lock_order_clean_idioms_pass(self):
+        # consistent ordering, RLock reentrancy, condvar wait
+        assert run_lint("lock_order_pass.py", select=("lock-",)) == []
+
+    def test_blocking_call_flags(self):
+        fs = run_lint("lock_blocking_flag.py", select=("lock-",))
+        assert rules_of(fs) == {"lock-blocking-call"}
+        msgs = "\n".join(f.message for f in fs)
+        assert "os.fsync" in msgs
+        assert "sendall" in msgs
+        assert "subprocess.run" in msgs
+        assert "time.sleep" in msgs
+        # the transitive hop through _fsync_helper is chased
+        assert "_fsync_helper" in msgs
+        assert len(fs) == 5
+
+    def test_blocking_call_outside_lock_passes(self):
+        assert run_lint("lock_blocking_pass.py", select=("lock-",)) == []
+
+    def test_guarded_mutation_flags(self):
+        fs = run_lint("lock_guarded_flag.py", select=("lock-",))
+        assert rules_of(fs) == {"lock-guarded-mutation"}
+        attrs = {m for f in fs for m in ("_entries", "_count")
+                 if f"self.{m}" in f.message}
+        assert attrs == {"_entries", "_count"}
+
+    def test_guarded_mutation_locked_helpers_pass(self):
+        # _locked helper convention + __init__-only helpers
+        assert run_lint("lock_guarded_pass.py", select=("lock-",)) == []
+
+
+class TestJaxRules:
+    def test_all_jax_hazards_flag(self):
+        fs = run_lint("jax_flag.py", select=("jax-",))
+        assert rules_of(fs) == {
+            "jax-impure-call", "jax-global-mutation",
+            "jax-host-materialize", "jax-jit-per-call",
+            "jax-varying-static",
+        }
+        msgs = "\n".join(f.message for f in fs)
+        # reachability: the helper called FROM a jitted root is traced too
+        assert "helper_reached_from_jit" in msgs
+
+    def test_blessed_jax_idioms_pass(self):
+        # static_argnames, lru_cache factory, keyed plan cache,
+        # module-level jit, bucketed shapes
+        assert run_lint("jax_pass.py", select=("jax-",)) == []
+
+
+class TestInvariantRules:
+    def test_invariant_violations_flag(self):
+        fs = run_lint("inv_flag.py", select=("inv-",))
+        assert rules_of(fs) == {
+            "inv-fault-point-unique", "inv-crash-swallow",
+            "inv-histogram-catalog",
+        }
+
+    def test_invariant_idioms_pass(self):
+        # unique names, SimulatedCrash re-raise / escalate / bare raise,
+        # cataloged histogram names
+        assert run_lint("inv_pass.py", select=("inv-",)) == []
+
+
+class TestWaivers:
+    def test_waived_finding_is_suppressed(self):
+        # inline and comment-above waiver forms both land
+        assert run_lint("waiver_pass.py") == []
+
+    def test_unused_waiver_is_a_finding(self):
+        fs = run_lint("waiver_unused_flag.py")
+        assert rules_of(fs) == {"lint-unused-waiver"}
+
+    def test_deleting_a_waiver_resurfaces_the_finding(self, tmp_path):
+        src = open(os.path.join(FIXTURES, "waiver_pass.py")).read()
+        # neuter the waiver text but keep the code lines intact
+        stripped = src.replace("# m3lint: disable=lock-blocking-call", "#")
+        p = tmp_path / "waiver_deleted.py"
+        p.write_text(stripped)
+        fs = lint_paths([str(p)])
+        assert {f.rule for f in fs} == {"lock-blocking-call"}
+        assert len(fs) == 2  # one per previously-waived site
+
+    def test_waiver_text_in_docstring_is_not_a_waiver(self, tmp_path):
+        """Documentation QUOTING the waiver syntax must neither suppress
+        findings nor register as an unused waiver."""
+        p = tmp_path / "documented.py"
+        p.write_text(
+            '"""Docs: suppress with  # m3lint: disable=lock-order  '
+            'comments."""\n\n'
+            "s = '# m3lint: disable=lock-blocking-call'\n")
+        assert lint_paths([str(p)]) == []
+
+    def test_multi_item_with_blocking_item_is_flagged(self, tmp_path):
+        """`with self._lock, blocking():` — the later context manager
+        evaluates with the earlier locks already held."""
+        p = tmp_path / "multi_with.py"
+        p.write_text(
+            "import threading\n\n\n"
+            "class C:\n"
+            "    def __init__(self, sock):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._sock = sock\n\n"
+            "    def ship(self):\n"
+            "        with self._lock, self._sock.makefile() as f:\n"
+            "            f.write(b'x')\n")
+        fs = lint_paths([str(p)], select=("lock-",))
+        assert {f.rule for f in fs} == {"lock-blocking-call"}
+
+    def test_deleting_a_real_tree_waiver_fails(self, tmp_path):
+        """The acceptance sentinel on production code: strip the
+        commitlog shared-seam waivers and the findings come back."""
+        src = open(os.path.join(
+            REPO, "m3_tpu", "storage", "commitlog.py")).read()
+        assert "m3lint: disable=inv-fault-point-unique" in src
+        stripped = "\n".join(
+            line for line in src.splitlines()
+            if "m3lint: disable" not in line)
+        p = tmp_path / "commitlog_stripped.py"
+        p.write_text(stripped)
+        fs = lint_paths([str(p)], select=("inv-fault-point-unique",))
+        assert len(fs) == 2  # commitlog.write + commitlog.fsync dups
+
+
+class TestWholeTree:
+    def test_repo_lints_clean(self):
+        """`python -m tools.m3lint` exits 0 on the merged tree, inside
+        the lane's time budget."""
+        t0 = time.perf_counter()
+        r = subprocess.run([sys.executable, "-m", "tools.m3lint"],
+                           cwd=REPO, capture_output=True, text=True,
+                           timeout=120)
+        dt = time.perf_counter() - t0
+        assert r.returncode == 0, r.stderr[-3000:]
+        assert "OK" in r.stdout
+        # the ~10s lane budget, with slack for a loaded CI host
+        assert dt < 30, f"m3lint took {dt:.1f}s — too slow to gate lanes"
+
+    def test_seeded_inversion_fails_the_tree(self, tmp_path):
+        """Re-introducing the seeded lock-order fixture shape makes the
+        lint exit non-zero."""
+        fs = lint_paths([os.path.join(FIXTURES, "lock_order_flag.py")])
+        assert any(f.rule == "lock-order" for f in fs)
+
+    def test_list_rules(self):
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.m3lint", "--list-rules"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0
+        for rule in ("lock-order", "lock-blocking-call",
+                     "lock-guarded-mutation", "jax-impure-call",
+                     "jax-jit-per-call", "inv-fault-point-unique",
+                     "inv-crash-swallow", "inv-histogram-catalog",
+                     "lint-unused-waiver"):
+            assert rule in r.stdout
+
+    def test_rule_registry_complete(self):
+        rules = all_rules()
+        assert len(rules) >= 15
+        assert all(isinstance(v, str) and v for v in rules.values())
+
+
+# ---------------------------------------------------------------------------
+# runtime shadow-lock checker
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def lockcheck():
+    from m3_tpu.utils import lockcheck as lc
+
+    lc.reset()
+    lc.install()
+    try:
+        yield lc
+    finally:
+        lc.uninstall()
+        lc.reset()
+
+
+class TestLockCheck:
+    def test_two_lock_cycle_across_threads_detected(self, lockcheck):
+        """The satellite contract: provoke a 2-lock ordering cycle on
+        two threads (serialized, so the test never actually deadlocks)
+        and the checker reports it."""
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def forward():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def backward():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        t1 = threading.Thread(target=forward, name="fwd")
+        t1.start(); t1.join()
+        assert lockcheck.reports() == []  # one direction alone is fine
+        t2 = threading.Thread(target=backward, name="bwd")
+        t2.start(); t2.join()
+        reps = lockcheck.reports()
+        assert len(reps) == 1
+        assert "deadlock" in reps[0].render()
+        assert reps[0].thread == "bwd"
+
+    def test_consistent_order_is_silent(self, lockcheck):
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        for _ in range(3):
+            t = threading.Thread(target=lambda: None)
+            with lock_a:
+                with lock_b:
+                    pass
+            t.start(); t.join()
+        assert lockcheck.reports() == []
+
+    def test_rlock_reentrancy_is_silent(self, lockcheck):
+        r = threading.RLock()
+        with r:
+            with r:
+                pass
+        assert lockcheck.reports() == []
+
+    def test_condition_wait_releases_its_lock(self, lockcheck):
+        """Condition.wait goes through release/acquire on the wrapped
+        lock, so the held-stack stays truthful across a wait."""
+        cv = threading.Condition()
+        other = threading.Lock()
+        done = []
+
+        def waiter():
+            with cv:
+                cv.wait(0.05)
+            # after the wait returns, cv is held again and released at
+            # exit; taking another lock now must not inherit stale state
+            with other:
+                done.append(True)
+
+        t = threading.Thread(target=waiter)
+        t.start(); t.join()
+        assert done == [True]
+        assert lockcheck.reports() == []
+
+    def test_raise_mode(self, lockcheck, monkeypatch):
+        monkeypatch.setenv("M3_TPU_LOCK_CHECK", "raise")
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        with lock_a:
+            with lock_b:
+                pass
+        with pytest.raises(lockcheck.LockOrderError):
+            with lock_b:
+                with lock_a:
+                    pass
+
+    def test_condition_wait_on_recursively_held_rlock(self, lockcheck):
+        """Condition._release_save must drop ALL recursion levels of a
+        CheckedRLock: otherwise the waiter parks still holding the lock
+        and the CHECKER manufactures a deadlock production doesn't have."""
+        rlock = threading.RLock()
+        cv = threading.Condition(rlock)
+        notified = []
+
+        def waiter():
+            with rlock:
+                with rlock:  # depth 2
+                    cv.wait(timeout=5.0)
+                    notified.append("woke")
+
+        def notifier():
+            with rlock:  # must be acquirable while waiter waits
+                with cv:
+                    notified.append("notifying")
+                    cv.notify_all()
+
+        t1 = threading.Thread(target=waiter)
+        t1.start()
+        time.sleep(0.2)  # let the waiter reach cv.wait
+        t2 = threading.Thread(target=notifier)
+        t2.start()
+        t2.join(timeout=5.0)
+        t1.join(timeout=5.0)
+        assert not t1.is_alive() and not t2.is_alive(), \
+            "checker-induced deadlock: _release_save not forwarded"
+        assert notified == ["notifying", "woke"]
+        assert lockcheck.reports() == []
+
+    def test_trylock_contributes_no_order_edges(self, lockcheck):
+        """A non-blocking acquire cannot deadlock — lockdep semantics:
+        it must not create edges that later read as a cycle."""
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def opportunistic():
+            with lock_a:
+                if lock_b.acquire(blocking=False):
+                    lock_b.release()
+
+        def strict():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        t1 = threading.Thread(target=opportunistic)
+        t1.start(); t1.join()
+        t2 = threading.Thread(target=strict)
+        t2.start(); t2.join()
+        assert lockcheck.reports() == []
+
+    def test_env_gate_value_awareness(self, monkeypatch):
+        from m3_tpu.utils.lockcheck import env_enabled, raise_mode
+
+        assert env_enabled("1") and env_enabled("raise")
+        for off in (None, "", "0", "false", "off", "no", " 0 "):
+            assert not env_enabled(off), off
+        # raise-mode uses the SAME normalization: any spelling that
+        # installs the checker as raise must actually raise, not
+        # silently degrade to report-only
+        for val in ("raise", "RAISE", " raise "):
+            monkeypatch.setenv("M3_TPU_LOCK_CHECK", val)
+            assert env_enabled(val) and raise_mode(), val
+        monkeypatch.setenv("M3_TPU_LOCK_CHECK", "1")
+        assert not raise_mode()
+
+    def test_same_class_nested_acquisition_is_reported(self, lockcheck):
+        """Striped locks born on one source line are ONE lock class;
+        the order graph cannot validate ordering inside a class (the
+        edge is a self-loop), so the nesting itself is reported — a
+        same-line ABBA deadlock must not be silently invisible."""
+        stripes = [threading.Lock() for _ in range(2)]
+        with stripes[0]:
+            with stripes[1]:
+                pass
+        reps = lockcheck.reports()
+        assert len(reps) == 1
+        # deduped: the class reports once, not once per pair/order
+        with stripes[1]:
+            with stripes[0]:
+                pass
+        assert len(lockcheck.reports()) == 1
+        # trylock nesting inside a class stays exempt (cannot deadlock)
+        lockcheck.reset()
+        with stripes[0]:
+            assert stripes[1].acquire(blocking=False)
+            stripes[1].release()
+        assert lockcheck.reports() == []
+
+    def test_timed_acquire_is_not_a_self_deadlock(self, lockcheck):
+        """A timeout-bounded re-acquire is a probe that returns False,
+        not a guaranteed deadlock — it must not pollute reports() (or
+        raise in raise mode)."""
+        lock = threading.Lock()
+        with lock:
+            assert not lock.acquire(True, 0.05)
+        assert lockcheck.reports() == []
+        with lock:  # held stack stayed consistent
+            pass
+        assert lockcheck.reports() == []
+
+    def test_exception_during_acquire_leaves_no_phantom(self, lockcheck):
+        """An inner acquire that exits via exception never took the
+        lock; the held-stack entry must be rolled back or every later
+        acquisition reports a false self-deadlock."""
+        class Boom(Exception):
+            pass
+
+        class Exploding:
+            def acquire(self, *a):
+                raise Boom
+
+        lock = threading.Lock()
+        inner = lock._inner
+        lock._inner = Exploding()
+        with pytest.raises(Boom):
+            lock.acquire()
+        lock._inner = inner
+        with lock:  # no phantom: acquiring again is clean
+            pass
+        assert lockcheck.reports() == []
+
+    def test_at_fork_reinit_forwarded(self, lockcheck):
+        """threading._after_fork calls _at_fork_reinit on the locks the
+        module tracks; the wrappers must forward it to the inner lock
+        (or every fork under the checker prints AttributeError and
+        leaves held locks wedged in the child) and drop the forking
+        thread's stale held-stack entries (which would otherwise
+        manufacture false ordering edges)."""
+        lock = threading.Lock()
+        lock.acquire()
+        lock._at_fork_reinit()
+        assert not lock.locked()
+        with lock:  # stale held entry dropped: no self-deadlock report
+            pass
+        rl = threading.RLock()
+        rl.acquire(); rl.acquire()
+        rl._at_fork_reinit()
+        rl.acquire(); rl.release()  # fully usable again
+        assert lockcheck.reports() == []
+
+    @pytest.mark.skipif(not hasattr(os, "fork"), reason="no os.fork")
+    def test_fork_with_live_thread_is_clean(self):
+        """Real fork with a live Thread (its internal Event/Condition
+        locks are checked locks): the child's threading._after_fork must
+        run without 'Exception ignored' noise and leave the lock
+        machinery usable. Runs in a fresh env-gated subprocess — forking
+        the JAX-threaded pytest process itself is the documented hazard
+        this test must not recreate."""
+        driver = (
+            "import os, sys, threading\n"
+            "from m3_tpu.utils import lockcheck\n"
+            "assert isinstance(threading.Lock(), lockcheck.CheckedLock)\n"
+            "release = threading.Event()\n"
+            "t = threading.Thread(target=release.wait)\n"
+            "t.start()\n"
+            "pid = os.fork()\n"
+            "if pid == 0:\n"
+            "    try:\n"
+            "        with threading.Lock():\n"
+            "            pass\n"
+            "        c = threading.Thread(target=lambda: None)\n"
+            "        c.start(); c.join()\n"
+            "        os._exit(0)\n"
+            "    except BaseException:\n"
+            "        os._exit(1)\n"
+            "_, status = os.waitpid(pid, 0)\n"
+            "release.set(); t.join()\n"
+            "sys.exit(os.WEXITSTATUS(status))\n"
+        )
+        env = dict(os.environ, M3_TPU_LOCK_CHECK="1",
+                   PYTHONPATH=str(REPO))
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import m3_tpu\n" + driver],
+            capture_output=True, text=True, env=env, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert "AttributeError" not in proc.stderr, proc.stderr
+        assert "Exception ignored" not in proc.stderr, proc.stderr
+
+    def test_nonreentrant_self_reacquire_reports(self, lockcheck,
+                                                 monkeypatch):
+        """Re-acquiring a plain Lock on the same thread is a guaranteed
+        self-deadlock: raise mode must abort BEFORE parking forever."""
+        monkeypatch.setenv("M3_TPU_LOCK_CHECK", "raise")
+        lock = threading.Lock()
+        with pytest.raises(lockcheck.LockOrderError, match="self-deadlock"):
+            with lock:
+                lock.acquire()
+        assert len(lockcheck.reports()) == 1

@@ -10,8 +10,11 @@ import math
 import struct
 
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+
+pytest.importorskip("hypothesis")  # property tier needs hypothesis; the
+# rest of the suite must not fail collection on images without it
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from m3_tpu.encoding.proto import custom_marshal
 from m3_tpu.encoding.proto.codec import decode, encode_messages

@@ -1,0 +1,2 @@
+# repo-local tooling package (makes `python -m tools.m3lint` work from
+# the repo root without installing anything)

@@ -1,259 +1,28 @@
 #!/usr/bin/env python3
-"""Static observability pass (wired into run_tests.sh).
+"""DEPRECATED shim — the observability invariants moved into m3lint.
 
-Four invariants, all cheap enough to run before every test lane:
+The five checks this script used to run (tracepoint uniqueness, fault
+seams instrumented, exemplar capture, exporter registration, admission
+counters) are now m3lint's ``inv-*`` rule family
+(tools/m3lint/rules_invariants.py), which run_tests.sh executes via
+``python -m tools.m3lint`` before every lane, alongside the lock-
+discipline and jax-purity families.
 
-1. Tracepoint constants in m3_tpu/utils/trace.py are UNIQUE — two
-   tracepoints sharing a name would silently merge in every trace tree
-   and /debug/traces filter.
-
-2. Every fault point declared via utils/faults (faults.check /
-   faults.torn_write / faults.wrap_io with a literal point name) lives in
-   a module that also instruments that seam — a metrics scope
-   (instrument histogram/counter/timer) or a trace span. A fault point
-   without observability is a seam we can break but not see.
-
-3. Every fault-catalog histogram seam is EXEMPLAR-CAPABLE: the three
-   histogram entry points in utils/instrument (Scope.observe,
-   Scope.histogram via observe, Scope.histogram_handle's closure) must
-   each route through the exemplar-capture helper — the seams all
-   observe through the Scope API, so capability is proven at the source.
-   A seam histogram that can't pin a trace_id breaks the p99-bucket →
-   stitched-trace link the OpenMetrics exposition promises.
-
-4. Every service entrypoint (coordinator, dbnode, aggregator, kvd)
-   registers the telemetry-exporter drainer (utils/export
-   `exporter_from_config`) — a process outside the export plane is a
-   blind spot the collector can't see.
-
-5. Every per-tenant admission-control decision point
-   (utils/tenantlimits: admit_write / admit_query) emits a counter
-   (shed/allow per tenant), and the shed path carries the
-   `tenant.admission.shed` tracepoint — a quota that can shed traffic
-   invisibly is an outage an operator cannot attribute.
-
-Exit code 0 = clean; 1 = violations (each printed with file:line).
+Kept as a working entry point so any script or muscle memory invoking
+``python tools/check_observability.py`` still enforces the same
+invariants (now the full m3lint set) with the same exit-code contract.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "m3_tpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# modules whose fault-point mentions are documentation or test scaffolding,
-# not production seams
-EXEMPT = {
-    os.path.join("utils", "faults.py"),      # the registry itself (docs)
-    os.path.join("tools", "race_check.py"),  # stress harness
-}
-
-# call attributes that count as "instrumented" when referenced in a module
-_OBS_ATTRS = {"span", "histogram", "observe", "counter", "timer", "gauge",
-              "subscope", "root_scope"}
-
-
-def _tracepoint_constants(path: str) -> list[tuple[str, str]]:
-    tree = ast.parse(open(path).read())
-    out = []
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name) \
-                and node.targets[0].id.isupper() \
-                and isinstance(node.value, ast.Constant) \
-                and isinstance(node.value.value, str):
-            name = node.targets[0].id
-            if name.startswith("_"):
-                continue
-            out.append((name, node.value.value))
-    return out
-
-
-class _Scanner(ast.NodeVisitor):
-    def __init__(self):
-        self.fault_points: list[tuple[str, int]] = []  # (point, lineno)
-        self.instrumented = False
-
-    def visit_Call(self, node: ast.Call):
-        fn = node.func
-        attr = fn.attr if isinstance(fn, ast.Attribute) else (
-            fn.id if isinstance(fn, ast.Name) else None)
-        if attr in ("check", "torn_write", "wrap_io"):
-            owner = getattr(fn, "value", None)
-            owner_name = owner.id if isinstance(owner, ast.Name) else None
-            if owner_name in ("faults", None) or attr == "check":
-                for arg in node.args:
-                    if isinstance(arg, ast.Constant) and \
-                            isinstance(arg.value, str) and "." in arg.value:
-                        self.fault_points.append((arg.value, node.lineno))
-                        break
-        if attr in _OBS_ATTRS:
-            self.instrumented = True
-        self.generic_visit(node)
-
-
-# service entrypoints that must register the exporter drainer: one per
-# long-running process the platform ships
-SERVICE_ENTRYPOINTS = (
-    os.path.join("services", "coordinator.py"),
-    os.path.join("services", "dbnode.py"),
-    os.path.join("services", "aggregator.py"),
-    os.path.join("cluster", "kvd.py"),
-)
-
-
-def _function_references(tree: ast.AST, func_name: str,
-                         needle: str) -> bool:
-    """Does the (possibly nested) function/closure named `func_name`
-    reference `needle` anywhere in its body?"""
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and node.name == func_name:
-            for sub in ast.walk(node):
-                if isinstance(sub, ast.Name) and sub.id == needle:
-                    return True
-                if isinstance(sub, ast.Attribute) and sub.attr == needle:
-                    return True
-    return False
-
-
-def check_exemplar_capable(failures: list[str]) -> None:
-    """Invariant 3: the Scope histogram entry points all capture
-    exemplars, so every seam histogram (they all go through Scope) can
-    pin a trace_id to its bucket."""
-    path = os.path.join(PKG, "utils", "instrument.py")
-    tree = ast.parse(open(path).read())
-    # Scope.observe and the histogram_handle closure must consult the
-    # exemplar trace source; _Histogram.observe_locked must accept and
-    # store it. (Scope.histogram delegates to observe, so it inherits.)
-    if not _function_references(tree, "observe", "_active_exemplar_trace") \
-            and not _function_references(tree, "observe", "_exemplar"):
-        failures.append(
-            f"{path}: Scope.observe does not capture exemplars — seam "
-            f"histograms lose the p99-bucket -> trace link")
-    # the hot-path closure may inline the thread-local read instead of
-    # calling the helper; either way it must write exemplar storage
-    if not (_function_references(tree, "histogram_handle",
-                                 "_active_exemplar_trace")
-            or _function_references(tree, "histogram_handle", "exemplars")):
-        failures.append(
-            f"{path}: histogram_handle's hot-path closure does not capture "
-            f"exemplars")
-    if not _function_references(tree, "observe_locked", "exemplars"):
-        failures.append(
-            f"{path}: _Histogram.observe_locked has no exemplar storage")
-
-
-def check_exporter_registered(failures: list[str]) -> None:
-    """Invariant 4: every service entrypoint builds its exporter via
-    utils/export.exporter_from_config."""
-    for rel in SERVICE_ENTRYPOINTS:
-        path = os.path.join(PKG, rel)
-        try:
-            tree = ast.parse(open(path).read())
-        except (OSError, SyntaxError) as e:
-            failures.append(f"{path}: unreadable/unparseable: {e}")
-            continue
-        found = any(
-            isinstance(node, ast.Name) and node.id == "exporter_from_config"
-            for node in ast.walk(tree)
-        )
-        if not found:
-            failures.append(
-                f"{path}: service entrypoint does not register the "
-                f"telemetry exporter (exporter_from_config)")
-
-
-def check_admission_observability(failures: list[str]) -> None:
-    """Invariant 5: the tenant admission controller's decision points
-    count every verdict, and sheds are trace-visible."""
-    path = os.path.join(PKG, "utils", "tenantlimits.py")
-    try:
-        tree = ast.parse(open(path).read())
-    except (OSError, SyntaxError) as e:
-        failures.append(f"{path}: unreadable/unparseable: {e}")
-        return
-    # each decision point must route its verdict through the counting
-    # helpers (which emit the per-tenant counters)
-    for fn in ("admit_write", "admit_query"):
-        counted = (_function_references(tree, fn, "_allow")
-                   and _function_references(tree, fn, "_shed")) \
-            or _function_references(tree, fn, "counter")
-        if not counted:
-            failures.append(
-                f"{path}: decision point {fn} does not emit per-tenant "
-                f"allow/shed counters")
-    if not _function_references(tree, "_shed", "counter"):
-        failures.append(
-            f"{path}: the shed path does not emit a per-tenant counter")
-    if not (_function_references(tree, "_shed", "span")
-            and _function_references(tree, "_shed", "TENANT_SHED")):
-        failures.append(
-            f"{path}: the shed path does not carry the TENANT_SHED "
-            f"tracepoint")
-
-
-def main() -> int:
-    failures: list[str] = []
-
-    # 1. tracepoint uniqueness
-    tp_path = os.path.join(PKG, "utils", "trace.py")
-    seen: dict[str, str] = {}
-    for name, value in _tracepoint_constants(tp_path):
-        if value in seen:
-            failures.append(
-                f"{tp_path}: tracepoint {name} duplicates {seen[value]} "
-                f"(both {value!r})")
-        seen[value] = name
-
-    # 2. fault points have observability at their seam
-    catalog: dict[str, list[str]] = {}
-    for dirpath, _dirs, files in os.walk(PKG):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, PKG)
-            if rel in EXEMPT:
-                continue
-            try:
-                tree = ast.parse(open(path).read())
-            except SyntaxError as e:
-                failures.append(f"{path}: unparseable: {e}")
-                continue
-            sc = _Scanner()
-            sc.visit(tree)
-            if not sc.fault_points:
-                continue
-            for point, lineno in sc.fault_points:
-                catalog.setdefault(point, []).append(f"{rel}:{lineno}")
-            if not sc.instrumented:
-                pts = ", ".join(p for p, _ in sc.fault_points)
-                failures.append(
-                    f"{path}: declares fault point(s) [{pts}] but has no "
-                    f"metric scope or trace span at the seam")
-
-    # 3 + 4: exemplar-capable seam histograms; exporter in every service
-    check_exemplar_capable(failures)
-    check_exporter_registered(failures)
-
-    # 5: admission-control decisions are counted and sheds traced
-    check_admission_observability(failures)
-
-    if failures:
-        print("check_observability: FAILED", file=sys.stderr)
-        for f in failures:
-            print(f"  {f}", file=sys.stderr)
-        return 1
-    print(f"check_observability: OK — {len(seen)} tracepoints unique, "
-          f"{len(catalog)} fault points instrumented at their seams, "
-          f"exemplar capture verified, exporter registered in "
-          f"{len(SERVICE_ENTRYPOINTS)} service entrypoints, admission "
-          f"decision points counted + shed path traced")
-    return 0
-
+from tools.m3lint.engine import main  # noqa: E402
 
 if __name__ == "__main__":
+    print("check_observability: absorbed into m3lint — running "
+          "`python -m tools.m3lint`", file=sys.stderr)
     sys.exit(main())

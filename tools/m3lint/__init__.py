@@ -1,0 +1,40 @@
+"""m3lint — project-wide static analysis for the m3_tpu codebase.
+
+Three rule families, all pure-AST (no m3_tpu import, no jax init, fast
+enough to run before every test lane):
+
+* ``lock-*``      concurrency discipline: per-module lock-acquisition
+                  graphs, lock-order inversions, blocking calls made
+                  while holding a lock, unguarded mutation of
+                  lock-guarded attributes.
+* ``jax-*``       jit-purity and recompile hazards inside functions
+                  reachable from ``jax.jit``/``vmap`` call sites.
+* ``inv-*``       project invariants (absorbs tools/check_observability):
+                  tracepoint uniqueness, fault-seam observability,
+                  exemplar capture, exporter registration, admission
+                  counters — plus fault-point uniqueness, the histogram
+                  catalog, and SimulatedCrash-swallowing excepts.
+
+Findings are ``path:line: rule-id message``.  Suppressions are explicit
+in-code waivers::
+
+    something_flagged()  # m3lint: disable=lock-blocking-call
+
+or, on their own line, applying to the next line::
+
+    # m3lint: disable=lock-order
+    with self._lock_b:
+
+Every waiver must suppress a live finding — stale waivers are themselves
+findings (``lint-unused-waiver``), so the enforced baseline can only be
+relaxed visibly, in code, under review.
+"""
+
+from tools.m3lint.engine import (  # noqa: F401
+    Finding,
+    Module,
+    Project,
+    lint_paths,
+    lint_project,
+    main,
+)

@@ -1,0 +1,499 @@
+"""Concurrency-discipline rules (rule family ``lock-*``).
+
+Per module, the checker builds a lock-acquisition model:
+
+* lock identities — ``self.X = threading.Lock()/RLock()/Condition()``
+  assigned in a class body/method gives lock ``Class.X``; module-level
+  ``X = threading.Lock()`` gives ``module.X``.  A ``with`` on an
+  attribute whose name *looks* like a lock (``_lock``, ``_mu`` …) but has
+  no local definition is still tracked (conservatively, reentrancy
+  unknown) so cross-class handles don't go invisible.
+* per-function summaries — which locks a function (transitively, through
+  intra-module calls) acquires, and which blocking primitives it
+  (transitively) reaches.  Computed to a fixpoint so helper indirection
+  doesn't hide an edge.
+* an order graph — edge A→B each time B is acquired (directly or through
+  a call) while A is held.  A→B with B⇝A reachable is a lock-order
+  inversion: two threads entering from the two ends deadlock.
+
+Three rules:
+
+``lock-order``            inversion edges (incl. re-acquiring a known
+                          non-reentrant lock while already held)
+``lock-blocking-call``    socket I/O, fsync, subprocess, HTTP, sleeps and
+                          thread joins executed while holding a lock
+``lock-guarded-mutation`` an attribute mutated under a class's lock in
+                          one method but mutated with no lock held in
+                          another — the guard is decoration, not
+                          discipline
+
+The model is intra-module and intra-class by design: cross-module lock
+graphs would need whole-program aliasing and drown the signal in noise.
+The runtime shadow-lock checker (utils/lockcheck, ``M3_TPU_LOCK_CHECK=1``)
+covers the dynamic, cross-module residue.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+from tools.m3lint.engine import Finding, Module, Project
+from tools.m3lint.engine import attr_chain as _attr_chain
+
+RULES = {
+    "lock-order": "lock-order inversion (potential deadlock)",
+    "lock-blocking-call": "blocking call while holding a lock",
+    "lock-guarded-mutation": "lock-guarded attribute mutated without the lock",
+}
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_REENTRANT_CTORS = {"RLock", "Condition"}
+_LOCKISH_NAME = re.compile(r"(^|_)(lock|mu|mutex)$")
+
+# blocking primitives: (owner constraint, attr/name). owner None = any.
+_BLOCKING_ATTRS = {
+    # sockets / network
+    "connect": None, "accept": None, "recv": None, "recvfrom": None,
+    "recv_into": None, "sendall": None, "create_connection": "socket",
+    "getaddrinfo": None, "makefile": None,
+    # HTTP
+    "urlopen": None, "getresponse": None,
+    # subprocess
+    "run": "subprocess", "Popen": "subprocess", "check_call": "subprocess",
+    "check_output": "subprocess", "call": "subprocess", "communicate": None,
+    # durability / scheduling
+    "fsync": None, "sleep": None, "wait": None,
+}
+# `.join` blocks only on threads/processes; str.join is everywhere, so the
+# owner name must look thread-like before it counts
+_JOINISH_OWNER = re.compile(r"(thread|worker|proc|child)", re.IGNORECASE)
+
+
+@dataclass
+class LockDef:
+    lock_id: str      # "Class.attr" or "module.name"
+    reentrant: bool
+    line: int
+
+
+@dataclass
+class FuncInfo:
+    qualname: str                 # "Class.method" or "func"
+    node: ast.FunctionDef
+    cls: str | None
+    # transitive summaries (fixpoint-computed)
+    acquires: set = field(default_factory=set)
+    blocking: dict = field(default_factory=dict)   # prim -> via-chain str
+
+
+class _ModuleModel:
+    """Locks, functions and the intra-module call graph of one file."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.locks: dict[str, LockDef] = {}
+        self.funcs: dict[str, FuncInfo] = {}
+        self.mod_name = os.path.splitext(os.path.basename(mod.path))[0]
+        self.module_level_names: set[str] = set()
+        # Condition(self._lock) wraps a lock: cond.wait() RELEASES it, so
+        # the classic `with self._lock: ... self._cond.wait()` idiom is
+        # not blocking-while-holding
+        self.cond_of: dict[str, str] = {}
+        self._collect()
+
+    # -- collection --------------------------------------------------------
+    def _collect(self) -> None:
+        tree = self.mod.tree
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_level_names.add(t.id)
+                        ctor = self._lock_ctor(node.value)
+                        if ctor:
+                            self.locks[f"{self.mod_name}.{t.id}"] = LockDef(
+                                f"{self.mod_name}.{t.id}",
+                                ctor in _REENTRANT_CTORS, node.lineno)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_level_names.add(node.name)
+                self.funcs[node.name] = FuncInfo(node.name, node, None)
+            elif isinstance(node, ast.ClassDef):
+                self.module_level_names.add(node.name)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        q = f"{node.name}.{item.name}"
+                        self.funcs[q] = FuncInfo(q, item, node.name)
+                # self.X = Lock() anywhere in the class's methods
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        ctor = self._lock_ctor(sub.value)
+                        if not ctor:
+                            continue
+                        for t in sub.targets:
+                            chain = _attr_chain(t)
+                            if chain and chain.startswith("self."):
+                                attr = chain[len("self."):]
+                                lid = f"{node.name}.{attr}"
+                                self.locks[lid] = LockDef(
+                                    lid, ctor in _REENTRANT_CTORS,
+                                    sub.lineno)
+                                if ctor == "Condition" and \
+                                        isinstance(sub.value, ast.Call) and \
+                                        sub.value.args:
+                                    wrapped = _attr_chain(sub.value.args[0])
+                                    if wrapped and wrapped.startswith("self."):
+                                        self.cond_of[lid] = (
+                                            f"{node.name}."
+                                            f"{wrapped[len('self.'):]}")
+
+    @staticmethod
+    def _lock_ctor(value: ast.AST) -> str | None:
+        if isinstance(value, ast.Call):
+            chain = _attr_chain(value.func)
+            if chain:
+                leaf = chain.rsplit(".", 1)[-1]
+                if leaf in _LOCK_CTORS:
+                    return leaf
+        return None
+
+    # -- lock identity for a `with` item ----------------------------------
+    def lock_id_for(self, expr: ast.AST, cls: str | None) -> str | None:
+        chain = _attr_chain(expr)
+        if chain is None:
+            return None
+        if chain.startswith("self.") and cls is not None:
+            attr = chain[len("self."):]
+            if "." in attr:
+                return None  # self.foo.lock — foreign object, skip
+            lid = f"{cls}.{attr}"
+            if lid in self.locks or _LOCKISH_NAME.search(attr):
+                return lid
+            return None
+        if "." not in chain:
+            lid = f"{self.mod_name}.{chain}"
+            if lid in self.locks:
+                return lid
+            if _LOCKISH_NAME.search(chain):
+                return lid
+        return None
+
+    def is_reentrant(self, lock_id: str) -> bool | None:
+        d = self.locks.get(lock_id)
+        return d.reentrant if d is not None else None
+
+    # -- call resolution ---------------------------------------------------
+    def resolve_call(self, call: ast.Call, cls: str | None) -> str | None:
+        chain = _attr_chain(call.func)
+        if chain is None:
+            return None
+        if chain.startswith("self.") and cls is not None:
+            name = chain[len("self."):]
+            if "." not in name and f"{cls}.{name}" in self.funcs:
+                return f"{cls}.{name}"
+            return None
+        if "." not in chain and chain in self.funcs:
+            return chain
+        return None
+
+
+def _blocking_prim(call: ast.Call) -> str | None:
+    """Name of the blocking primitive this call is, or None."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        attr = fn.attr
+        owner = _attr_chain(fn.value)
+        if attr == "join":
+            if owner and _JOINISH_OWNER.search(owner):
+                return f"{owner}.join"
+            return None
+        if attr in _BLOCKING_ATTRS:
+            need_owner = _BLOCKING_ATTRS[attr]
+            if need_owner is None or (owner or "").split(".")[-1] == need_owner \
+                    or (owner or "") == need_owner:
+                return f"{owner}.{attr}" if owner else attr
+        if owner == "requests" and attr in ("get", "post", "put", "delete",
+                                            "head", "request"):
+            return f"requests.{attr}"
+    elif isinstance(fn, ast.Name):
+        if fn.id in ("urlopen", "fsync", "create_connection", "getaddrinfo"):
+            return fn.id
+    return None
+
+
+class _FuncWalker:
+    """Walks one function body tracking the held-lock stack; records
+    acquisition edges, blocking hits, attr mutations and call sites."""
+
+    def __init__(self, model: _ModuleModel, info: FuncInfo):
+        self.model = model
+        self.info = info
+        self.edges: list[tuple[str, str, int, str]] = []  # (A, B, line, via)
+        self.direct_acquires: set[str] = set()
+        self.direct_blocking: list[tuple[str, int, bool]] = []  # (prim, line, held)
+        self.calls: list[tuple[str, int, tuple[str, ...]]] = []  # (callee, line, held-stack)
+        self.mutations: list[tuple[str, int, bool]] = []  # (attr, line, held)
+        self.self_acquire_lines: dict[str, int] = {}
+
+    def walk(self) -> None:
+        for stmt in self.info.node.body:
+            self._visit(stmt, held=())
+
+    def _visit(self, node: ast.AST, held: tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested callables run later, not at this program point
+        if isinstance(node, ast.With):
+            new_held = held
+            for item in node.items:
+                lid = self.model.lock_id_for(item.context_expr,
+                                             self.info.cls)
+                if lid is not None:
+                    for h in new_held:
+                        self.edges.append((h, lid, node.lineno, ""))
+                    self.direct_acquires.add(lid)
+                    self.self_acquire_lines.setdefault(lid, node.lineno)
+                    new_held = new_held + (lid,)
+                else:
+                    # later items in `with self._lock, expr():` evaluate
+                    # AFTER the earlier locks are taken — visit with the
+                    # accumulated held set, not the entry set
+                    self._visit(item.context_expr, new_held)
+            for stmt in node.body:
+                self._visit(stmt, new_held)
+            return
+        if isinstance(node, ast.Call):
+            prim = _blocking_prim(node)
+            if prim is not None:
+                flag_held = bool(held)
+                if flag_held and prim.endswith(".wait"):
+                    # Condition.wait RELEASES its own lock: `with c: c.wait()`
+                    # (or `with lock: cond.wait()` where cond wraps lock) is
+                    # the condvar idiom, not blocking-while-holding — unless
+                    # OTHER locks are also held, which stay held while asleep
+                    owner = node.func.value if isinstance(
+                        node.func, ast.Attribute) else None
+                    olid = self.model.lock_id_for(owner, self.info.cls) \
+                        if owner is not None else None
+                    released = {olid, self.model.cond_of.get(olid)} - {None}
+                    if released and all(h in released for h in held):
+                        flag_held = False
+                self.direct_blocking.append((prim, node.lineno, flag_held))
+            callee = self.model.resolve_call(node, self.info.cls)
+            if callee is not None:
+                self.calls.append((callee, node.lineno, held))
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                self._record_mutation(t, node.lineno, bool(held))
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._record_mutation(t, node.lineno, bool(held))
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    def _record_mutation(self, target: ast.AST, line: int,
+                         held: bool) -> None:
+        # self.attr = / self.attr[k] = / del self.attr
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        chain = _attr_chain(target)
+        if chain and chain.startswith("self.") and self.info.cls:
+            attr = chain[len("self."):]
+            if "." not in attr:
+                self.mutations.append((attr, line, held))
+
+
+def check(proj: Project):
+    for mod in proj.modules:
+        yield from _check_module(mod)
+
+
+def _check_module(mod: Module):
+    model = _ModuleModel(mod)
+    walkers: dict[str, _FuncWalker] = {}
+    for q, info in model.funcs.items():
+        w = _FuncWalker(model, info)
+        w.walk()
+        walkers[q] = w
+
+    # ---- fixpoint: transitive acquires + blocking through calls ----------
+    for q, info in model.funcs.items():
+        info.acquires = set(walkers[q].direct_acquires)
+        info.blocking = {p: p for p, _l, _h in walkers[q].direct_blocking}
+    changed = True
+    while changed:
+        changed = False
+        for q, info in model.funcs.items():
+            for callee, _line, _held in walkers[q].calls:
+                ci = model.funcs[callee]
+                if not ci.acquires <= info.acquires:
+                    info.acquires |= ci.acquires
+                    changed = True
+                for prim, via in ci.blocking.items():
+                    if prim not in info.blocking:
+                        info.blocking[prim] = f"{callee} -> {via}"
+                        changed = True
+
+    # ---- order graph: direct with-nesting edges + edges through calls ----
+    # edge key (A, B) -> list of (line, via)
+    edges: dict[tuple[str, str], list[tuple[int, str]]] = {}
+    for q, w in walkers.items():
+        for a, b, line, via in w.edges:
+            edges.setdefault((a, b), []).append((line, via))
+        for callee, line, held in w.calls:
+            if not held:
+                continue
+            ci = model.funcs[callee]
+            for b in ci.acquires:
+                for a in held:
+                    edges.setdefault((a, b), []).append((line, f"via {callee}()"))
+
+    # reachability for inversion detection
+    adj: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+
+    def reaches(src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(adj.get(n, ()))
+        return False
+
+    reported: set[tuple[str, str, int]] = set()
+    for (a, b), sites in sorted(edges.items()):
+        if a == b:
+            # re-acquiring a lock already held: deadlock unless reentrant
+            if model.is_reentrant(a) is False:
+                for line, via in sites:
+                    key = (a, b, line)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    suffix = f" ({via})" if via else ""
+                    yield Finding(
+                        "lock-order", mod.path, line,
+                        f"non-reentrant lock {a} re-acquired while already "
+                        f"held{suffix} — self-deadlock")
+            continue
+        if reaches(b, a):
+            for line, via in sites:
+                key = (a, b, line)
+                if key in reported:
+                    continue
+                reported.add(key)
+                suffix = f" ({via})" if via else ""
+                yield Finding(
+                    "lock-order", mod.path, line,
+                    f"acquires {b} while holding {a}{suffix}, but the "
+                    f"reverse order {b} -> {a} also exists in this module "
+                    f"— two threads entering from both ends deadlock")
+
+    # ---- blocking calls under a held lock --------------------------------
+    for q, w in walkers.items():
+        for prim, line, held in w.direct_blocking:
+            if held:
+                yield Finding(
+                    "lock-blocking-call", mod.path, line,
+                    f"{q} calls blocking {prim}() while holding a lock — "
+                    f"every other thread needing that lock stalls on the "
+                    f"I/O; move it outside the critical section")
+        for callee, line, held in w.calls:
+            if not held:
+                continue
+            ci = model.funcs[callee]
+            for prim, via in sorted(ci.blocking.items()):
+                yield Finding(
+                    "lock-blocking-call", mod.path, line,
+                    f"{q} calls {callee}() under a lock, which reaches "
+                    f"blocking {prim}() ({via})")
+
+    # ---- guarded-attribute discipline ------------------------------------
+    yield from _check_guarded_attrs(mod, model, walkers)
+
+
+def _check_guarded_attrs(mod: Module, model: _ModuleModel,
+                         walkers: dict[str, _FuncWalker]):
+    # methods whose EVERY intra-class call site runs with a lock held are
+    # themselves lock-held context (the `_foo_locked` helper convention);
+    # computed to a fixpoint since such helpers call further helpers
+    by_class: dict[str, list[str]] = {}
+    for q, info in model.funcs.items():
+        if info.cls is not None:
+            by_class.setdefault(info.cls, []).append(q)
+
+    lock_attrs = {lid.split(".", 1)[1] for lid in model.locks
+                  if not lid.startswith(model.mod_name + ".")}
+
+    for cls, methods in by_class.items():
+        held_context: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for q in methods:
+                if q in held_context:
+                    continue
+                callers = []
+                for cq in methods:
+                    for callee, _line, held in walkers[cq].calls:
+                        if callee == q:
+                            callers.append(bool(held) or cq in held_context)
+                if callers and all(callers):
+                    held_context.add(q)
+                    changed = True
+
+        # private helpers reachable ONLY from __init__ run before the
+        # object is shared between threads — their writes are
+        # pre-concurrency, like __init__'s own
+        init_q = f"{cls}.__init__"
+        init_only: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for q in methods:
+                meth = q.split(".", 1)[1]
+                if q in init_only or not meth.startswith("_") \
+                        or meth == "__init__":
+                    continue
+                callers = [cq for cq in methods
+                           for callee, _l, _h in walkers[cq].calls
+                           if callee == q]
+                if callers and all(
+                        cq == init_q or cq in init_only for cq in callers):
+                    init_only.add(q)
+                    changed = True
+
+        guarded: dict[str, list[tuple[str, int]]] = {}
+        unguarded: dict[str, list[tuple[str, int]]] = {}
+        for q in methods:
+            info = model.funcs[q]
+            meth_name = q.split(".", 1)[1]
+            in_held_ctx = q in held_context
+            for attr, line, held in walkers[q].mutations:
+                if attr in lock_attrs or _LOCKISH_NAME.search(attr):
+                    continue
+                if held or in_held_ctx:
+                    guarded.setdefault(attr, []).append((q, line))
+                elif meth_name != "__init__" and q not in init_only:
+                    unguarded.setdefault(attr, []).append((q, line))
+        for attr, sites in sorted(unguarded.items()):
+            g = guarded.get(attr)
+            if not g:
+                continue
+            gq, gline = g[0]
+            for q, line in sites:
+                yield Finding(
+                    "lock-guarded-mutation", mod.path, line,
+                    f"{q} mutates self.{attr} with no lock held, but "
+                    f"{gq} (line {gline}) mutates it under a lock — either "
+                    f"the guard is required (race) or it isn't (waive)")

@@ -1,0 +1,482 @@
+"""JAX jit-purity and recompile-hazard rules (rule family ``jax-*``).
+
+The checker finds the module's *traced set*: functions that are
+``jax.jit``/``jax.vmap`` roots (decorated, wrapped in
+``functools.partial(jax.jit, ...)``, or assigned ``f = jax.jit(g)``) plus
+everything they reach through intra-module calls.  Code in the traced set
+runs under a tracer: Python-side effects execute ONCE at trace time and
+are then baked into (or silently absent from) every cached executable —
+the class of bug whole-query compilation (ROADMAP #2) multiplies.
+
+``jax-impure-call``       randomness / wall-clock / uuid / env reads
+                          inside traced code: trace-time constants
+                          masquerading as per-call values
+``jax-global-mutation``   ``global`` writes or mutation of module-level
+                          containers inside traced code: runs once at
+                          trace time, never again
+``jax-host-materialize``  ``np.*(param)`` / ``float(param)`` /
+                          ``param.item()`` on a *non-static* parameter of
+                          a traced function: forces device→host sync or
+                          a ConcretizationTypeError under jit
+``jax-jit-per-call``      ``jax.jit``/``vmap`` constructed inside a
+                          plain function body with no cache around it: a
+                          fresh traced callable (and XLA compile) per
+                          invocation — the recompile storm PR 6's
+                          jit_tracker can only observe after the fact
+``jax-varying-static``    calling a jitted function in a loop with an
+                          argument sliced by the loop variable (or a
+                          per-iteration ``len()``): every iteration is a
+                          new shape/static bucket, every bucket a compile
+
+Recognized caching idioms that do NOT flag a jit construction: enclosing
+function decorated ``functools.lru_cache``/``cache``; result stored into
+a subscript (``_CACHE[key] = jax.jit(...)``) or via ``.setdefault``;
+construction at module scope; construction inside the traced set itself
+(tracing a vmap during a trace is one program, not one per call).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.m3lint.engine import attr_chain as _attr_chain
+from tools.m3lint.engine import Finding, Module, Project
+
+RULES = {
+    "jax-impure-call": "impure host call inside jit-traced code",
+    "jax-global-mutation": "global/module state mutated inside jit-traced code",
+    "jax-host-materialize": "numpy/host materialization of a traced value",
+    "jax-jit-per-call": "jit/vmap constructed per call (recompile storm)",
+    "jax-varying-static": "jitted call with per-iteration shape/static args",
+}
+
+_IMPURE_CALLS = {
+    "time.time", "time.time_ns", "time.perf_counter", "time.monotonic",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "os.urandom", "uuid.uuid4", "os.environ.get", "os.getenv",
+}
+_IMPURE_OWNERS = ("random", "np.random", "numpy.random")
+_MUTATORS = {"append", "add", "update", "pop", "clear", "extend", "insert",
+             "setdefault", "remove", "discard", "popitem", "appendleft"}
+
+
+def _is_jit_name(chain: str | None) -> bool:
+    return chain in ("jit", "jax.jit")
+
+
+def _is_vmap_name(chain: str | None) -> bool:
+    return chain in ("vmap", "jax.vmap", "pmap", "jax.pmap")
+
+
+def _static_params(fn: ast.FunctionDef) -> set[str]:
+    """Parameter names declared static via partial(jax.jit,
+    static_argnames=...) / static_argnums=... decorators."""
+    statics: set[str] = set()
+    args = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        inner = dec.args[0] if dec.args else None
+        inner_chain = _attr_chain(inner) if inner is not None else None
+        if not (_is_jit_name(_attr_chain(dec.func)) or
+                (_attr_chain(dec.func) or "").endswith("partial")
+                and _is_jit_name(inner_chain)):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        statics.add(el.value)
+            elif kw.arg == "static_argnums":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, int) and \
+                            el.value < len(args):
+                        statics.add(args[el.value])
+    return statics
+
+
+@dataclass
+class _FnRec:
+    node: ast.FunctionDef
+    qual: str
+    is_root: bool = False
+    statics: set = field(default_factory=set)
+    calls: set = field(default_factory=set)     # resolved local callee quals
+    parent: str | None = None                   # enclosing function qual
+
+
+class _DefCollector(ast.NodeVisitor):
+    """Pass 1: every function (incl. nested), decorator jit roots, and
+    module-level names.  Two passes so forward references resolve — a
+    jitted dispatcher happily calls helpers defined below it."""
+
+    def __init__(self):
+        self.fns: dict[str, _FnRec] = {}
+        self.jitted_names: set[str] = set()   # names bound to jitted callables
+        self._stack: list[str] = []
+        self.module_names: set[str] = set()
+
+    def _qual(self, name: str) -> str:
+        return ".".join(self._stack + [name]) if self._stack else name
+
+    def visit_Module(self, node):
+        for child in node.body:
+            if isinstance(child, ast.Assign):
+                for t in child.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_names.add(t.id)
+            elif isinstance(child, (ast.FunctionDef, ast.ClassDef)):
+                self.module_names.add(child.name)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _visit_fn(self, node):
+        qual = self._qual(node.name)
+        rec = _FnRec(node=node, qual=qual,
+                     parent=self._stack[-1] if self._stack else None)
+        rec.statics = _static_params(node)
+        for dec in node.decorator_list:
+            chain = _attr_chain(dec)
+            if _is_jit_name(chain) or _is_vmap_name(chain):
+                rec.is_root = True
+            elif isinstance(dec, ast.Call):
+                dchain = _attr_chain(dec.func)
+                if _is_jit_name(dchain) or _is_vmap_name(dchain):
+                    rec.is_root = True
+                elif (dchain or "").endswith("partial") and dec.args and \
+                        (_is_jit_name(_attr_chain(dec.args[0])) or
+                         _is_vmap_name(_attr_chain(dec.args[0]))):
+                    rec.is_root = True
+        self.fns[qual] = rec
+        if rec.is_root:
+            self.jitted_names.add(node.name)
+        self._stack.append(qual)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+
+class _CallCollector(ast.NodeVisitor):
+    """Pass 2: the intra-module call graph plus jit(f)/vmap(f) roots,
+    resolved against the COMPLETE function table from pass 1."""
+
+    def __init__(self, defs: _DefCollector):
+        self.d = defs
+        self._stack: list[str] = []
+
+    def _qual(self, name: str) -> str:
+        return ".".join(self._stack + [name]) if self._stack else name
+
+    def visit_ClassDef(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _visit_fn(self, node):
+        self._stack.append(self._qual(node.name))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Assign(self, node):
+        # g = jax.jit(f) / g = jax.vmap(f): f joins the traced set, g
+        # becomes a known jitted callable name
+        if isinstance(node.value, ast.Call):
+            chain = _attr_chain(node.value.func)
+            if _is_jit_name(chain) or _is_vmap_name(chain):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.d.jitted_names.add(t.id)
+                for a in node.value.args:
+                    inner = _attr_chain(a)
+                    if inner:
+                        self._mark_root(inner)
+        self.generic_visit(node)
+
+    def _mark_root(self, name: str) -> None:
+        for qual in (self._qual(name), name):
+            rec = self.d.fns.get(qual)
+            if rec is not None:
+                rec.is_root = True
+                return
+        for qual, rec in self.d.fns.items():
+            if qual.endswith("." + name):
+                rec.is_root = True
+                return
+
+    def visit_Call(self, node):
+        chain = _attr_chain(node.func)
+        if chain and self._stack:
+            cur = self.d.fns.get(self._stack[-1])
+            if cur is not None:
+                # resolve bare names and self.X to local functions
+                cands = [self._qual(chain), chain]
+                if chain.startswith("self.") and "." not in chain[5:]:
+                    # method on the enclosing class, if any
+                    parts = self._stack[-1].split(".")
+                    if len(parts) >= 2:
+                        cands.append(".".join(parts[:-1] + [chain[5:]]))
+                    cands.append(chain[5:])
+                for c in cands:
+                    if c in self.d.fns:
+                        cur.calls.add(c)
+                        break
+        # jit(f) / vmap(f) with a local function argument marks it traced
+        if chain and (_is_jit_name(chain) or _is_vmap_name(chain)):
+            for a in node.args:
+                inner = _attr_chain(a)
+                if inner:
+                    self._mark_root(inner)
+        self.generic_visit(node)
+
+
+def _collect(mod: Module) -> _DefCollector:
+    col = _DefCollector()
+    col.visit(mod.tree)
+    _CallCollector(col).visit(mod.tree)
+    return col
+
+
+def _traced_set(col: _DefCollector) -> set[str]:
+    traced = {q for q, r in col.fns.items() if r.is_root}
+    # nested defs inside a traced function body are traced too
+    changed = True
+    while changed:
+        changed = False
+        for q, r in col.fns.items():
+            if q in traced:
+                for callee in r.calls:
+                    if callee not in traced:
+                        traced.add(callee)
+                        changed = True
+            elif r.parent in traced:
+                traced.add(q)
+                changed = True
+    return traced
+
+
+def check(proj: Project):
+    for mod in proj.modules:
+        yield from _check_module(mod)
+
+
+def _check_module(mod: Module):
+    col = _collect(mod)
+    traced = _traced_set(col)
+
+    for qual in sorted(traced):
+        rec = col.fns[qual]
+        yield from _check_traced_fn(mod, col, rec)
+
+    yield from _check_jit_per_call(mod, col, traced)
+    yield from _check_varying_static(mod, col)
+
+
+_PY_SCALAR_ANNOTATIONS = {"int", "float", "bool", "str", "bytes",
+                          "TimeUnit"}
+
+
+def _py_scalar_params(fn: ast.FunctionDef) -> set[str]:
+    """Params annotated as plain Python scalars are trace-time constants
+    (static-by-convention), not traced arrays."""
+    out: set[str] = set()
+    for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+        ann = a.annotation
+        if ann is None:
+            continue
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                continue
+        chain = _attr_chain(ann)
+        if chain and chain.rsplit(".", 1)[-1] in _PY_SCALAR_ANNOTATIONS:
+            out.add(a.arg)
+    return out
+
+
+def _check_traced_fn(mod: Module, col: _DefCollector, rec: _FnRec):
+    fn = rec.node
+    params = {a.arg for a in fn.args.posonlyargs + fn.args.args
+              + fn.args.kwonlyargs} - rec.statics - {"self", "cls"} \
+        - _py_scalar_params(fn)
+    own_defs = {f.name for f in ast.walk(fn)
+                if isinstance(f, ast.FunctionDef) and f is not fn}
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            yield Finding(
+                "jax-global-mutation", mod.path, node.lineno,
+                f"traced function {rec.qual} declares "
+                f"global {', '.join(node.names)} — the write happens once "
+                f"at trace time, then never again for cached executables")
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain is None:
+            continue
+        # impure host calls
+        leaf_owner = chain.rsplit(".", 1)[0] if "." in chain else ""
+        if chain in _IMPURE_CALLS or any(
+                leaf_owner == o or leaf_owner.startswith(o + ".")
+                for o in _IMPURE_OWNERS):
+            yield Finding(
+                "jax-impure-call", mod.path, node.lineno,
+                f"traced function {rec.qual} calls {chain}() — evaluated "
+                f"once at trace time and constant-folded into every cached "
+                f"executable")
+            continue
+        # module-level container mutation
+        if "." in chain:
+            owner, attr = chain.rsplit(".", 1)
+            if attr in _MUTATORS and owner in col.module_names and \
+                    owner not in params and owner not in own_defs:
+                yield Finding(
+                    "jax-global-mutation", mod.path, node.lineno,
+                    f"traced function {rec.qual} mutates module-level "
+                    f"{owner} via .{attr}() — trace-time side effect, "
+                    f"invisible to cached executables")
+        # host materialization of traced parameters
+        yield from _materialize_hits(mod, rec, node, chain, params)
+
+
+def _materialize_hits(mod: Module, rec: _FnRec, node: ast.Call,
+                      chain: str, params: set[str]):
+    def uses_param(expr: ast.AST) -> str | None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in params:
+                return sub.id
+        return None
+
+    owner = chain.split(".")[0]
+    if owner in ("np", "numpy") and not chain.startswith("np.random"):
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            p = uses_param(a)
+            if p is not None:
+                yield Finding(
+                    "jax-host-materialize", mod.path, node.lineno,
+                    f"traced function {rec.qual} passes traced parameter "
+                    f"'{p}' to {chain}() — numpy forces host "
+                    f"materialization (ConcretizationTypeError under jit)")
+                return
+    if chain in ("float", "int", "bool") and node.args:
+        p = uses_param(node.args[0])
+        if p is not None:
+            yield Finding(
+                "jax-host-materialize", mod.path, node.lineno,
+                f"traced function {rec.qual} calls {chain}() on traced "
+                f"parameter '{p}' — concretizes the tracer")
+    if chain.endswith(".item") and chain.split(".")[0] in params:
+        yield Finding(
+            "jax-host-materialize", mod.path, node.lineno,
+            f"traced function {rec.qual} calls .item() on traced "
+            f"parameter '{chain.split('.')[0]}'")
+
+
+def _enclosing_cached(rec: _FnRec, col: _DefCollector) -> bool:
+    for dec in rec.node.decorator_list:
+        chain = _attr_chain(dec) or (
+            _attr_chain(dec.func) if isinstance(dec, ast.Call) else None)
+        if chain and chain.rsplit(".", 1)[-1] in ("lru_cache", "cache",
+                                                  "cached_property"):
+            return True
+    return False
+
+
+def _check_jit_per_call(mod: Module, col: _DefCollector, traced: set[str]):
+    """jit/vmap constructed inside an uncached plain function."""
+    for qual, rec in col.fns.items():
+        if qual in traced or _enclosing_cached(rec, col):
+            continue
+        fn = rec.node
+        # find jit/vmap constructions in THIS function's direct body (not
+        # nested defs: those are charged to their own record)
+        nested = {id(n) for f in ast.walk(fn)
+                  if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and f is not fn for n in ast.walk(f)}
+        for node in ast.walk(fn):
+            if id(node) in nested or not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not (_is_jit_name(chain) or _is_vmap_name(chain)):
+                continue
+            if _is_cached_store(mod, node):
+                continue
+            yield Finding(
+                "jax-jit-per-call", mod.path, node.lineno,
+                f"{qual} constructs {chain}(...) per call with no cache — "
+                f"every invocation re-traces and re-compiles (wrap the "
+                f"factory in functools.lru_cache or store in a keyed cache)")
+
+
+def _is_cached_store(mod: Module, call: ast.Call) -> bool:
+    """True when the jit(...) result is stored into a subscripted cache or
+    passed to .setdefault(...) — the keyed-cache idioms."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and node.value is call:
+            return any(isinstance(t, ast.Subscript) for t in node.targets)
+        if isinstance(node, ast.Call) and call in node.args and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "setdefault":
+            return True
+    return False
+
+
+def _check_varying_static(mod: Module, col: _DefCollector):
+    """Jitted call sites inside loops whose args vary shape per iteration."""
+    jitted = col.jitted_names
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        loop_vars: set[str] = set()
+        if isinstance(node, ast.For):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    loop_vars.add(t.id)
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            chain = _attr_chain(sub.func)
+            if chain is None:
+                continue
+            leaf = chain.rsplit(".", 1)[-1]
+            if leaf not in jitted:
+                continue
+            for a in list(sub.args) + [kw.value for kw in sub.keywords]:
+                hit = _varying_shape_expr(a, loop_vars)
+                if hit:
+                    yield Finding(
+                        "jax-varying-static", mod.path, sub.lineno,
+                        f"jitted {leaf}() called in a loop with {hit} — "
+                        f"each iteration is a fresh shape/static bucket, "
+                        f"each bucket a recompile (bucket the shape first, "
+                        f"e.g. dispatch.next_pow2 padding)")
+                    break
+
+
+def _varying_shape_expr(expr: ast.AST, loop_vars: set[str]) -> str | None:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Subscript):
+            # x[i], x[i:j] with a loop variable in the index
+            for n in ast.walk(sub.slice):
+                if isinstance(n, ast.Name) and n.id in loop_vars:
+                    return f"an argument sliced by loop variable '{n.id}'"
+        if isinstance(sub, ast.Call):
+            chain = _attr_chain(sub.func)
+            if chain == "len":
+                for n in ast.walk(sub):
+                    if isinstance(n, ast.Name) and n.id in loop_vars:
+                        return "a per-iteration len()"
+    return None
