@@ -214,6 +214,20 @@ class HTTPNodeConnection:
             "GET", f"/debug/traces?trace_id={trace_id}") or {}
         return doc.get("spans", [])
 
+    def repair_enqueue(self, namespace: str, shard: int, start_ns: int,
+                       end_ns: int) -> bool:
+        """Hand the node's repair daemon an out-of-band divergence hint (a
+        quorum read saw replica checksums disagree for this shard range).
+        Best-effort by contract: callers drop failures — the daemon's own
+        digest sweep re-finds anything a lost hint would have flagged."""
+        doc = self._request("POST", "/repair/enqueue", json.dumps({
+            "namespace": namespace,
+            "shard": int(shard),
+            "start_ns": int(start_ns),
+            "end_ns": int(end_ns),
+        }).encode()) or {}
+        return bool(doc.get("queued"))
+
     def health(self) -> bool:
         try:
             return bool(self._request("GET", "/health"))

@@ -43,6 +43,80 @@ from m3_tpu.utils.warnings import ReadWarning
 _scope = default_registry().root_scope("session")
 
 
+def _result_checksum(t_arr, v_arr) -> int:
+    """One adler32 over a replica's (times, value bits) answer for one
+    series — the cheap inline divergence probe (two replicas holding the
+    same data return byte-identical arrays). Never 0 for non-empty data,
+    so 0 can mean "replica answered empty"."""
+    import zlib
+
+    return zlib.adler32(v_arr.tobytes(), zlib.adler32(t_arr.tobytes())) or 1
+
+
+class DivergenceReporter:
+    """Out-of-band half of read-path divergence detection: the session's
+    sink pushes (namespace, shard, range) hints onto a bounded queue and
+    a daemon thread forwards each to the repair daemons of the shard's
+    replicas (`POST /repair/enqueue` via NodeConnection.repair_enqueue).
+    Dropping is fine (bounded queue, best-effort posts): a lost hint is
+    re-found by the next full digest sweep; what must never happen is the
+    read path blocking on repair bookkeeping."""
+
+    def __init__(self, session: "Session", maxsize: int = 256):
+        import queue
+
+        self.session = session
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self.dropped = 0
+        self.posted = 0
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def submit(self, namespace: str, shard: int, start_ns: int,
+               end_ns: int) -> None:
+        import queue
+
+        with self._lock:
+            if self._closed:
+                return
+            if self._thread is None:  # lazily started on first divergence
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name="divergence-reporter")
+                self._thread.start()
+        try:
+            self._q.put_nowait((namespace, shard, start_ns, end_ns))
+        except queue.Full:
+            self.dropped += 1
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            namespace, shard, start_ns, end_ns = item
+            for host in self.session.topology.hosts_for_shard(shard):
+                conn = self.session.connections.get(host)
+                enqueue = getattr(conn, "repair_enqueue", None)
+                if enqueue is None:
+                    continue
+                try:
+                    enqueue(namespace, shard, start_ns, end_ns)
+                    self.posted += 1
+                except Exception:  # noqa: BLE001 - best-effort hint; the
+                    # node's own digest sweep is the backstop
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            thread = self._thread
+        if thread is not None:
+            self._q.put(None)
+            thread.join(2.0)
+
+
 class NodeConnection(Protocol):
     def write_tagged(self, namespace: str, metric_name: bytes, tags, t_ns: int,
                      value: float): ...
@@ -92,6 +166,13 @@ class Session:
         # are recorded here (reset per fetch/fetch_many call) and in the
         # caller-provided `warnings` out-param
         self.last_warnings: list[ReadWarning] = []
+        # read-path divergence detection (the anti-entropy plane's inline
+        # half): when >=2 replicas answer for a series and their result
+        # checksums disagree, the session counts it and hands the
+        # (namespace, shard, range) to this sink — detection is inline
+        # and cheap, REPAIR is out of band (DivergenceReporter forwards
+        # hints to the nodes' repair daemons). None = count only.
+        self.divergence_sink = None
 
     def host_policy(self, host: str):
         """The host's breaker+retry policy (created on first use); every
@@ -165,6 +246,12 @@ class Session:
                 self._host_call(host, conn.write_tagged, namespace,
                                 metric_name, list(tags), t_ns, value)
                 result.acks += 1
+            except faults.SimulatedCrash:
+                # injected at the session.host_call seam: THIS process
+                # dying, never a per-host failure (swallowing it would
+                # falsify every chaos assertion downstream)
+                faults.escalate()
+                raise
             except Exception as e:  # per-host failure feeds the accumulator
                 result.errors.append((host, e))
         need = required_acks(self.write_consistency, self.topology.replica_factor)
@@ -222,8 +309,14 @@ class Session:
                             self._host_call(host, conn.write_tagged,
                                             namespace, m, list(tags), t, v)
                             results.append(None)
+                        except faults.SimulatedCrash:
+                            faults.escalate()  # our own injected death
+                            raise
                         except Exception as e:  # noqa: BLE001
                             results.append(str(e))
+            except faults.SimulatedCrash:
+                faults.escalate()  # never "whole host failed"
+                raise
             except Exception as e:  # noqa: BLE001 - whole host failed
                 errors.append((host, e))
                 continue
@@ -263,6 +356,7 @@ class Session:
         parts_t, parts_v = [], []
         successes = 0
         errors = []
+        replica_sums: set[int] = set()
         for host in hosts:
             conn = self.connections.get(host)
             if conn is None:
@@ -271,26 +365,53 @@ class Session:
             try:
                 dps = self._host_call(host, conn.read, namespace, series_id,
                                       start_ns, end_ns)
+            except faults.SimulatedCrash:
+                faults.escalate()  # our own injected death, not a host error
+                raise
             except Exception as e:
                 errors.append((host, e))
                 continue
             successes += 1
             if dps:
-                parts_t.append(np.array([d.timestamp_ns for d in dps], np.int64))
-                parts_v.append(
-                    np.array([d.value for d in dps], np.float64).view(np.uint64)
-                )
+                t_arr = np.array([d.timestamp_ns for d in dps], np.int64)
+                v_arr = np.array([d.value for d in dps],
+                                 np.float64).view(np.uint64)
+                parts_t.append(t_arr)
+                parts_v.append(v_arr)
+                replica_sums.add(_result_checksum(t_arr, v_arr))
+            else:
+                replica_sums.add(0)
         if successes < need:
             raise ConsistencyError(
                 f"read got {successes}/{need} replicas "
                 f"(level={self.read_consistency.value}, errors={errors})"
             )
         self._record_warnings(errors, warnings)
+        if successes >= 2 and len(replica_sums) > 1:
+            self._note_divergence(namespace, {shard}, start_ns, end_ns, 1)
         if not parts_t:
             return []
         times, vbits = merge_dedup(np.concatenate(parts_t), np.concatenate(parts_v))
         values = vbits.view(np.float64)
         return list(zip(times.tolist(), values.tolist()))
+
+    def _note_divergence(self, namespace: str, shards: set[int],
+                         start_ns: int, end_ns: int, n_series: int) -> None:
+        """Replicas answered with DIFFERENT data for the same series: the
+        read already merged them (last-write-wins), so the caller got the
+        union — but the replicas need anti-entropy. Count it and hand the
+        shard ranges to the sink; both must stay cheap and must never
+        fail the read."""
+        _scope.counter("divergence", n_series)
+        sink = self.divergence_sink
+        if sink is None:
+            return
+        for shard in shards:
+            try:
+                sink(namespace, shard, start_ns, end_ns)
+            except Exception:  # noqa: BLE001 - a broken sink must never
+                # fail a read that met its consistency level
+                pass
 
     def _record_warnings(self, errors: list, warnings: list | None) -> None:
         """A read that met consistency despite per-host failures surfaces
@@ -330,6 +451,7 @@ class Session:
         shard_of = {sid: self._shard(sid) for sid in series_ids}
         successes = {sid: 0 for sid in series_ids}
         parts: dict[bytes, list] = {sid: [] for sid in series_ids}
+        replica_sums: dict[bytes, set[int]] = {}
         errors = []
         import time as _time
 
@@ -354,6 +476,9 @@ class Session:
                     rows = [self._host_call(host, conn.read, namespace, sid,
                                             start_ns, end_ns)
                             for sid in want]
+            except faults.SimulatedCrash:
+                faults.escalate()  # our own injected death, not a host error
+                raise
             except Exception as e:  # noqa: BLE001 - per-host failure
                 errors.append((host, e))
                 querystats.record_node_leg(
@@ -366,11 +491,14 @@ class Session:
             for sid, dps in zip(want, rows):
                 successes[sid] += 1
                 if dps:
-                    parts[sid].append((
-                        np.array([d.timestamp_ns for d in dps], np.int64),
-                        np.array([d.value for d in dps],
-                                 np.float64).view(np.uint64),
-                    ))
+                    t_arr = np.array([d.timestamp_ns for d in dps], np.int64)
+                    v_arr = np.array([d.value for d in dps],
+                                     np.float64).view(np.uint64)
+                    parts[sid].append((t_arr, v_arr))
+                    replica_sums.setdefault(sid, set()).add(
+                        _result_checksum(t_arr, v_arr))
+                else:
+                    replica_sums.setdefault(sid, set()).add(0)
         for sid in series_ids:
             if successes[sid] < need:
                 raise ConsistencyError(
@@ -382,6 +510,12 @@ class Session:
         # after every series cleared its consistency level (as fetch does),
         # so a raising call never pollutes the caller's warnings list
         self._record_warnings(errors, warnings)
+        divergent = [sid for sid, sums in replica_sums.items()
+                     if successes[sid] >= 2 and len(sums) > 1]
+        if divergent:
+            self._note_divergence(
+                namespace, {shard_of[sid] for sid in divergent},
+                start_ns, end_ns, len(divergent))
         out = []
         for sid in series_ids:
             if not parts[sid]:
@@ -428,6 +562,9 @@ class Session:
             try:
                 rows = self._host_call(host, conn.query_ids, namespace, doc,
                                        start_ns, end_ns, limit)
+            except faults.SimulatedCrash:
+                faults.escalate()  # our own injected death, not a host error
+                raise
             except Exception as e:  # noqa: BLE001 - per-host failure
                 errors.append((host, e))
                 continue
@@ -461,6 +598,9 @@ class Session:
             try:
                 out.update(self._host_call(host, getattr(conn, fn_name), *args))
                 covered |= shards
+            except faults.SimulatedCrash:
+                faults.escalate()  # our own injected death, not a host error
+                raise
             except Exception as e:  # noqa: BLE001
                 errors.append((host, e))
         missing = set(range(self.topology.n_shards)) - covered

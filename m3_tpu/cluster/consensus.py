@@ -790,6 +790,10 @@ class LocalRaftCluster:
                 continue
             try:
                 resp = self.nodes[dst].handle(rpc, req)
+            # the virtual cluster IS the chaos harness: a crash injected
+            # at a consensus seam models THAT node dropping the RPC, and
+            # the simulation must keep pumping the other nodes
+            # m3lint: disable=inv-crash-swallow
             except Exception:  # noqa: BLE001 - injected fault = dropped RPC
                 continue
             if src in self.down or not self._link_up(dst, src):
@@ -797,6 +801,7 @@ class LocalRaftCluster:
             try:
                 for out in self.nodes[src].on_response(dst, rpc, req, resp):
                     self.pending.append((src, *out))
+            # m3lint: disable=inv-crash-swallow  (same: simulated drop)
             except Exception:  # noqa: BLE001
                 continue
 

@@ -85,6 +85,7 @@ class CoordinatorService:
         self.kv = kv
         self._placement_version = -1
         self._registry_ns: set[str] = set()  # names synced from the registry
+        self._divergence_reporter = None  # set in cluster mode only
         if self.kv is None:
             from m3_tpu.cluster.kv import kv_from_config
 
@@ -276,6 +277,15 @@ class CoordinatorService:
             read_consistency=ConsistencyLevel(
                 cl_cfg.get("read_consistency", "one")),
         )
+        # read-path divergence detection closes its loop here: a quorum
+        # read whose replicas disagree hands the (namespace, shard, range)
+        # to this reporter, which forwards it to the replicas' repair
+        # daemons out of band (POST /repair/enqueue) — detection inline,
+        # repair never on the read path
+        from m3_tpu.client.session import DivergenceReporter
+
+        self._divergence_reporter = DivergenceReporter(session)
+        session.divergence_sink = self._divergence_reporter.submit
         return ClusterDatabase(session)
 
     def _sync_namespace_options(self) -> None:
@@ -404,6 +414,8 @@ class CoordinatorService:
             self.remote_server.close()
         if self.exporter is not None:
             self.exporter.close()  # final best-effort flush
+        if self._divergence_reporter is not None:
+            self._divergence_reporter.close()
         self.db.close()
         self.log.info("coordinator stopped")
 

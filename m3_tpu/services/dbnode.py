@@ -35,11 +35,15 @@ class NodeAPI:
         "/health", "/bootstrapped", "/metrics", "/debug/traces", "/write",
         "/write_batch", "/read_batch", "/read", "/query_ids",
         "/label_names", "/label_values", "/blocks/starts",
-        "/blocks/metadata", "/blocks/stream",
+        "/blocks/metadata", "/blocks/stream", "/blocks/rollup",
+        "/debug/repair", "/repair/enqueue", "/debug/flush",
     })
 
     def __init__(self, db: Database):
         self.db = db
+        # the node's RepairDaemon (set by DBNodeService; None standalone):
+        # /debug/repair and /repair/enqueue surface it
+        self.repair = None
         self._server: ThreadingHTTPServer | None = None
         scope = default_registry().root_scope("dbnode")
         # per-path latency histograms, pre-resolved (bounded set)
@@ -246,6 +250,45 @@ class NodeAPI:
                         ).decode(),
                     }
                 ).encode()
+            if path == "/blocks/rollup":
+                # the repair plane's digest exchange: the whole shard's
+                # per-block rollup table as ONE packed binary payload
+                # (peers.ROLLUP_DTYPE — in-sync blocks cost 20 bytes on
+                # the wire, not per-series JSON)
+                from m3_tpu.storage.peers import (
+                    local_rollup_digests,
+                    pack_rollup,
+                )
+
+                digests = local_rollup_digests(
+                    self.db, q["namespace"][0], int(q["shard"][0]))
+                return 200, json.dumps({
+                    "rollup_b64": base64.b64encode(
+                        pack_rollup(digests)).decode(),
+                }).encode()
+            if path == "/repair/enqueue" and method == "POST":
+                # out-of-band repair hint from a quorum read that saw
+                # replica checksums disagree (client/session.py)
+                if self.repair is None:
+                    return 200, b'{"ok":false,"queued":false}'
+                doc = json.loads(body)
+                queued = self.repair.enqueue_range(
+                    doc.get("namespace", "default"), int(doc["shard"]),
+                    int(doc["start_ns"]), int(doc["end_ns"]),
+                )
+                return 200, json.dumps(
+                    {"ok": True, "queued": queued}).encode()
+            if path == "/debug/repair":
+                if self.repair is None:
+                    return 200, b'{"enabled":false}'
+                return 200, json.dumps(self.repair.status()).encode()
+            if path == "/debug/flush" and method == "POST":
+                # ops/audit surface: persist every buffered block NOW so
+                # rollup digests cover current data (the rig's convergence
+                # audit flushes both replicas before comparing; blocks
+                # normally wait for their window to complete)
+                self.db.flush_all()
+                return 200, b'{"ok":true}'
             return 404, b'{"error":"unknown path"}'
         except faults.SimulatedCrash:
             # a simulated crash must NOT be served as an error response —
@@ -373,6 +416,26 @@ class DBNodeService:
         if self.kv is not None:
             self.runtime.watch_kv(self.kv)
         self.api = NodeAPI(self.db)
+        # the anti-entropy repair plane (storage/repair.py): peers come
+        # from the placement, tuning from the `repair:` config section
+        # and the m3_tpu.repair KV key. Built unconditionally — a
+        # standalone node has no peers and idles — so /debug/repair and
+        # the read path's /repair/enqueue hints always have a home.
+        from m3_tpu.storage.repair import RepairDaemon, RepairOptions
+
+        self.repair = RepairDaemon(
+            self.db, lambda: self.db.owned_shards,
+            self._repair_peers_for_shard,
+            opts=RepairOptions.from_config(config.get("repair")),
+            seed=self.instance_id or "standalone",
+        )
+        self.api.repair = self.repair
+        # placement snapshot for repair peer discovery, refreshed at most
+        # every TTL so a cycle over many shards is one KV load, not one
+        # per shard
+        self._repair_placement_ttl_s = 5.0
+        self._repair_placement: tuple[float, object] = (-1e18, None)
+        self._repair_placement_lock = threading.Lock()
         # OTLP-style telemetry export (config `export:` / M3_TPU_EXPORT_*
         # env): storage nodes ship their span rings + seam histograms to
         # the same collector as the coordinator, so exported traces stitch
@@ -419,6 +482,42 @@ class DBNodeService:
                     peers.append(HTTPPeer(inst.endpoint))
         return peers
 
+    def _repair_peers_for_shard(self, shard_id: int) -> list:
+        """Replica peers for the repair daemon, from a TTL-cached
+        placement snapshot (one KV load per cycle, not per shard) with
+        the runtime-tunable peer timeout applied."""
+        if self.kv is None:
+            return []
+        import time as _time
+
+        with self._repair_placement_lock:
+            ts, p = self._repair_placement
+            stale = _time.monotonic() - ts > self._repair_placement_ttl_s
+        if stale:
+            try:
+                p, _version = self._load_placement()
+            except Exception:  # noqa: BLE001 - KV hiccup: cache the miss
+                # for the TTL too, so a KV outage costs ONE failing load
+                # per cycle, not one per shard; a later cycle retries
+                p = None
+            with self._repair_placement_lock:
+                self._repair_placement = (_time.monotonic(), p)
+        if p is None:
+            return []
+        from m3_tpu.cluster.placement import ShardState
+        from m3_tpu.storage.peers import HTTPPeer
+
+        timeout_s = self.repair.opts.peer_timeout_s
+        peers = []
+        for iid, inst in p.instances.items():
+            if iid == self.instance_id or not inst.endpoint:
+                continue
+            sh = inst.shards.get(shard_id)
+            if sh is not None and sh.state in (ShardState.AVAILABLE,
+                                               ShardState.LEAVING):
+                peers.append(HTTPPeer(inst.endpoint, timeout_s=timeout_s))
+        return peers
+
     def sync_placement(self) -> None:
         """Reconcile shard ownership with the current placement; bootstrap
         and mark newly-assigned INITIALIZING shards AVAILABLE."""
@@ -463,6 +562,12 @@ class DBNodeService:
                     try:
                         starts.update(peer.block_starts(ns_name, sid))
                         reached += 1
+                    except faults.SimulatedCrash:
+                        # injected at the peer.http seam: THIS node dying
+                        # mid-probe, never "peer down" (swallowing it here
+                        # falsifies the rig's crash assertions)
+                        faults.escalate()
+                        raise
                     except Exception:  # noqa: BLE001 - peer down
                         continue
                 starts_by_ns[ns_name] = starts
@@ -552,6 +657,12 @@ class DBNodeService:
         port = self.api.serve(http_cfg.get("host", "0.0.0.0"),
                               http_cfg.get("port", 9000))
         self.log.info("node api listening", port=port)
+        # continuous anti-entropy: the daemon runs for the node's whole
+        # life (NOT test-invoked), paced + jittered, following the
+        # m3_tpu.repair KV key for live retuning
+        if self.kv is not None:
+            self.repair.watch_kv(self.kv)
+        self.repair.start()
         tick_every = float(self.config.get("tick_interval_s", 10.0))
         scope = default_registry().root_scope("dbnode")
         try:
@@ -581,6 +692,7 @@ class DBNodeService:
 
     def shutdown(self) -> None:
         self._stop.set()
+        self.repair.stop()
         self.api.shutdown()
         if self.exporter is not None:
             self.exporter.close()  # final best-effort flush

@@ -401,6 +401,44 @@ class FilesetReader:
             return None
         return self._parse_entry(off)[1]
 
+    # -- repair support: per-series checksums + rollup digest --
+
+    def series_checksums(self):
+        """uint64 adler32 of every series' stream, in index (sorted-id)
+        order — the per-series halves of the repair comparison. One pass
+        over the mapped index/data; cached, because a volume is immutable
+        once its checkpoint exists."""
+        import numpy as np
+
+        cached = getattr(self, "_series_checksums", None)
+        if cached is not None:
+            return cached
+        offs = self._entry_offsets()
+        out = np.empty(len(offs), np.uint64)
+        data = self._data
+        for i, o in enumerate(offs):
+            _sid, _tags, data_off, data_len, _ = self._parse_entry(int(o))
+            out[i] = zlib.adler32(data[data_off:data_off + data_len])
+        out.flags.writeable = False
+        self._series_checksums = out
+        return out
+
+    def rollup_digest(self) -> int:
+        """ONE aggregate checksum for the whole block volume: adler32 over
+        the vector of sorted per-series adler32s (little-endian u64) plus
+        the series count. Content-addressed — two replicas holding the
+        same series/streams produce the same digest regardless of volume
+        number — so an in-sync block costs O(1) on the repair wire instead
+        of one metadata row per series."""
+        cached = getattr(self, "_rollup_digest", None)
+        if cached is not None:
+            return cached
+        sums = self.series_checksums()
+        digest = zlib.adler32(sums.tobytes(),
+                              zlib.adler32(struct.pack("<Q", len(sums))))
+        self._rollup_digest = digest
+        return digest
+
     def close(self) -> None:
         for m in (self._index, self._data):
             if not isinstance(m, bytes):

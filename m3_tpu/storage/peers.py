@@ -37,6 +37,63 @@ class PeerSource(Protocol):
 
     def block_starts(self, namespace: str, shard: int) -> list[int]: ...
 
+    def rollup_digests(self, namespace: str, shard: int
+                       ) -> dict[int, tuple[int, int]]: ...
+
+
+# -- rollup digest wire format ---------------------------------------------
+#
+# The repair plane's steady-state traffic is "are we in sync?" — one row
+# per flushed block, exchanged every cycle by every replica pair. That
+# must not be per-series float64 JSON (ROADMAP #5(c), EQuARX discipline:
+# comparison traffic wants the leanest encoding that answers the
+# question), so the whole shard's digest table rides as ONE packed
+# little-endian array: (block_start i64, digest u64, n_series u32) per
+# block — 20 bytes per block vs ~60 bytes of JSON object keys alone.
+
+ROLLUP_DTYPE = np.dtype([("block_start", "<i8"), ("digest", "<u8"),
+                         ("n_series", "<u4")])
+
+
+def pack_rollup(digests: dict[int, tuple[int, int]]) -> bytes:
+    """{block_start: (digest, n_series)} -> packed ROLLUP_DTYPE bytes,
+    rows sorted by block_start (deterministic wire bytes)."""
+    arr = np.empty(len(digests), ROLLUP_DTYPE)
+    for i, bs in enumerate(sorted(digests)):
+        digest, n_series = digests[bs]
+        arr[i] = (bs, digest, n_series)
+    return arr.tobytes()
+
+
+def unpack_rollup(raw: bytes) -> dict[int, tuple[int, int]]:
+    if len(raw) % ROLLUP_DTYPE.itemsize:
+        raise ValueError(
+            f"rollup payload length {len(raw)} not a multiple of "
+            f"{ROLLUP_DTYPE.itemsize}")
+    arr = np.frombuffer(raw, ROLLUP_DTYPE)
+    return {int(r["block_start"]): (int(r["digest"]), int(r["n_series"]))
+            for r in arr}
+
+
+def local_rollup_digests(db, namespace: str, shard_id: int
+                         ) -> dict[int, tuple[int, int]]:
+    """{block_start: (rollup digest, n_series)} over this node's flushed
+    volumes for one shard. O(1) per block after the first computation —
+    digests cache on the immutable FilesetReader, so a repair cycle over
+    an in-sync shard costs a dict walk, not a data pass."""
+    ns = db.namespaces.get(namespace)
+    if ns is None or shard_id not in ns.shards:
+        return {}
+    out: dict[int, tuple[int, int]] = {}
+    for bs, reader in list(ns.shards[shard_id]._filesets.items()):
+        try:
+            out[bs] = (reader.rollup_digest(), reader.n_series)
+        except ValueError:
+            # captured reader closed by a concurrent flush swap + retire
+            # drain: skip; the next cycle sees the new volume
+            continue
+    return out
+
 
 class InProcessPeer:
     """Peer backed by a Database in the same process (integration/test)."""
@@ -71,6 +128,9 @@ class InProcessPeer:
         if reader is None:
             return b"", b""
         return reader.read(series_id) or b"", reader.tags_of(series_id) or b""
+
+    def rollup_digests(self, namespace, shard):
+        return local_rollup_digests(self.db, namespace, shard)
 
 
 class PeerClientError(Exception):
@@ -122,10 +182,16 @@ class HTTPPeer:
     per-peer error handling) instead of serializing 10s urlopen timeouts
     per block."""
 
-    def __init__(self, base_url: str, timeout_s: float = 10.0,
+    # process-wide default request timeout; dbnode config / the
+    # m3_tpu.repair KV key override it per-peer (repair.peer_timeout_s) so
+    # one slow replica cannot pin a 10s stall into every probe
+    DEFAULT_TIMEOUT_S = 10.0
+
+    def __init__(self, base_url: str, timeout_s: float | None = None,
                  policy: HostPolicy | None = None):
         self.base = base_url.rstrip("/")
-        self.timeout = timeout_s
+        self.timeout = (timeout_s if timeout_s is not None
+                        else self.DEFAULT_TIMEOUT_S)
         self.policy = policy if policy is not None else peer_policy(self.base)
 
     def _get(self, path: str):
@@ -194,6 +260,15 @@ class HTTPPeer:
         )
         return (base64.b64decode(doc["stream"]), base64.b64decode(doc["tags"]))
 
+    def rollup_digests(self, namespace, shard):
+        from urllib.parse import quote
+
+        doc = self._get(
+            f"/blocks/rollup?namespace={quote(namespace, safe='')}"
+            f"&shard={shard}"
+        )
+        return unpack_rollup(base64.b64decode(doc.get("rollup_b64", "")))
+
 
 def bootstrap_shard_from_peers(db, namespace: str, shard_id: int,
                                peers: list[PeerSource],
@@ -212,6 +287,13 @@ def bootstrap_shard_from_peers(db, namespace: str, shard_id: int,
         for p in peers:
             try:
                 all_starts.update(p.block_starts(namespace, shard_id))
+            except faults.SimulatedCrash:
+                # a crash injected at the peer.http seam is THIS process
+                # dying mid-probe, not the peer being down: it must never
+                # degrade into "peer adds no blocks" (that would falsify
+                # every chaos assertion downstream)
+                faults.escalate()
+                raise
             except Exception:  # noqa: BLE001 - unreachable peer adds none
                 pass
     written = 0
@@ -257,7 +339,10 @@ def _merged_block_from_peers(namespace, shard_id, bs, peers):
     for p in peers:
         try:
             metas.append(p.block_metadata(namespace, shard_id, bs))
-        except Exception:
+        except faults.SimulatedCrash:
+            faults.escalate()  # our own injected death, not a peer error
+            raise
+        except Exception:  # noqa: BLE001 - unreachable peer contributes none
             metas.append({})
     all_sids = set()
     for m in metas:
@@ -274,7 +359,10 @@ def _merged_block_from_peers(namespace, shard_id, bs, peers):
             if sid in m and (best is None or m[sid]["checksum"] == best):
                 try:
                     stream, tags = p.stream_block(namespace, shard_id, bs, sid)
-                except Exception:
+                except faults.SimulatedCrash:
+                    faults.escalate()
+                    raise
+                except Exception:  # noqa: BLE001 - try the next replica
                     continue
                 if stream:
                     out[sid] = (tags, stream)
@@ -290,12 +378,23 @@ class RepairResult:
 
 
 def repair_shard_block(db, namespace: str, shard_id: int, block_start: int,
-                       peers: list[PeerSource]) -> RepairResult:
+                       peers: list[PeerSource],
+                       pacer=None) -> RepairResult:
     """Compare this node's block against peers and merge differences.
 
     The reference compares sizes/checksums then streams + merges differing
     blocks; here divergent series are decoded from every replica, merged
     last-write-wins, re-encoded, and written as a higher volume.
+
+    Convergence: replica streams for one series merge in a DETERMINISTIC
+    order (sorted by stream checksum) so two replicas repairing against
+    each other resolve a same-timestamp value conflict to the SAME winner
+    — otherwise each side would adopt the other's value and oscillate
+    forever, and the rig's digest-equality audit could never settle.
+
+    `pacer` (optional, `.acquire(n_bytes)`) is the RepairDaemon's token
+    bucket: every stream pulled off a peer pays into the repair budget so
+    a post-outage repair storm cannot starve the serving path.
 
     Locking: the slow phase (peer RPCs, decode/merge/re-encode) runs
     OUTSIDE the shard maintenance lock so a repair over slow peers never
@@ -325,7 +424,10 @@ def repair_shard_block(db, namespace: str, shard_id: int, block_start: int,
     for p in peers:
         try:
             peer_metas.append(p.block_metadata(namespace, shard_id, block_start))
-        except Exception:
+        except faults.SimulatedCrash:
+            faults.escalate()  # our own injected death, not a peer error
+            raise
+        except Exception:  # noqa: BLE001 - unreachable peer contributes none
             peer_metas.append({})
     all_sids = set(local_meta)
     for m in peer_metas:
@@ -358,16 +460,38 @@ def repair_shard_block(db, namespace: str, shard_id: int, block_start: int,
             # now and let the next repair cycle re-compare
             result.repaired = 0
             return result
+        have = set()
         if own:
             streams.append(own)
-        for p in peers:
+            have.add(local_meta[sid])
+        for p, m in zip(peers, peer_metas):
+            if m:
+                pm = m.get(sid)
+                if pm is None:
+                    continue  # peer's own metadata says it lacks this series
+                if pm["checksum"] in have:
+                    # byte-identical to a stream already in hand (ours or a
+                    # previously fetched peer's): re-pulling it buys the
+                    # merge nothing and charges the repair rate budget —
+                    # under RF=3 that's roughly half the storm's wire cost
+                    continue
             try:
                 stream, ptags = p.stream_block(namespace, shard_id, block_start, sid)
-            except Exception:
+            except faults.SimulatedCrash:
+                faults.escalate()
+                raise
+            except Exception:  # noqa: BLE001 - peer unreachable mid-stream
                 continue
             if stream:
+                if pacer is not None:
+                    pacer.acquire(len(stream))
                 streams.append(stream)
                 tags = tags or ptags
+                have.add(zlib.adler32(stream))
+        # deterministic merge order: both sides of a replica pair must
+        # concatenate the same streams in the same order so last-write-wins
+        # picks the same value for a conflicting timestamp on both nodes
+        streams.sort(key=lambda s: (zlib.adler32(s), s))
         for stream in streams:
             dps = scalar_decode(stream, int_optimized=ns.opts.int_optimized,
                                 default_time_unit=unit)

@@ -1,0 +1,380 @@
+"""Continuous anti-entropy repair plane: the RepairDaemon.
+
+Role parity with the reference's background repairer
+(/root/reference/src/dbnode/storage/repair.go — shard repairers compare
+per-series block checksums across replicas on a schedule and stream +
+merge differing blocks). PR 2/this repo's `storage/peers.py` had the
+mechanism (`repair_shard_block`) but only tests ever invoked it, so a
+replica that slept through writes stayed divergent forever; this daemon
+makes RF=2 actually mean two copies.
+
+Design:
+
+- **Digest-first comparison.** Each cycle exchanges ONE packed rollup
+  digest table per (namespace, shard) with every replica peer
+  (`PeerSource.rollup_digests`, the lean inter-node wire format of
+  ROADMAP #5(c)): an in-sync block costs 20 bytes on the wire and an
+  O(1) cached-digest lookup locally. Only blocks whose digests differ —
+  or blocks the read path flagged (see `enqueue_range`) — fall through
+  to the per-series `block_metadata` + `repair_shard_block` merge.
+- **Pacing.** Streamed repair bytes pay into a token bucket
+  (`PersistRateLimiter` discipline, MiB/s) and every cycle honors a
+  deadline, so a repair storm after an outage trickles behind the
+  serving path (the T3 overlap discipline: repair hides behind serving
+  ticks instead of competing with them). Both knobs are runtime-tunable
+  via the ``m3_tpu.repair`` KV key.
+- **Shedding.** Peers are reached through the shared per-host breaker
+  (`peers.peer_policy`); a dead peer costs one BreakerOpen per cycle,
+  counted in `peer_shed`, never a 10s timeout per block.
+- **Jitter.** Cycle sleeps are jittered from a seeded RNG so a fleet
+  restarted together does not run repair in lockstep.
+
+The daemon is wired by `services/dbnode.py` (placement-driven peer
+discovery, config + KV tuning, /debug/repair status ring) and audited
+end to end by the rig's convergence phase (tools/rig.py).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, replace
+
+from m3_tpu.utils import faults, trace
+from m3_tpu.utils.instrument import Logger, default_registry
+
+# the kvconfig key operators write to retune a live cluster's repair
+# plane (same discipline as cluster/runtime.RUNTIME_KEY)
+REPAIR_KEY = "m3_tpu.repair"
+
+
+@dataclass(frozen=True)
+class RepairOptions:
+    enabled: bool = True
+    # seconds between cycle STARTS (a cycle that overruns re-arms from
+    # its own end); jitter_frac spreads replicas out
+    interval_s: float = 30.0
+    jitter_frac: float = 0.25
+    # streamed-byte budget in MiB/s (0 = unpaced)
+    rate_mbps: float = 8.0
+    # one peer RPC's timeout and the whole cycle's wall budget: one slow
+    # peer must not wedge a round (0 = no deadline)
+    peer_timeout_s: float = 5.0
+    cycle_deadline_s: float = 30.0
+
+    def to_json(self) -> bytes:
+        return json.dumps(asdict(self), sort_keys=True).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "RepairOptions":
+        """Strictly-typed parse (RuntimeOptions discipline): a mistyped
+        KV payload must fail HERE, not inside the watch listener where
+        errors are swallowed and the operator sees nothing applied."""
+        doc = json.loads(raw)
+        known = {}
+        for k in doc:
+            if k not in cls.__dataclass_fields__:
+                continue
+            v = doc[k]
+            default = cls.__dataclass_fields__[k].default
+            if isinstance(default, bool):
+                if not isinstance(v, bool):
+                    raise ValueError(f"{k} must be a boolean, got {v!r}")
+            elif isinstance(default, float):
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    raise ValueError(f"{k} must be a number, got {v!r}")
+                v = float(v)
+            known[k] = v
+        return cls(**known)
+
+    @classmethod
+    def from_config(cls, doc: dict | None) -> "RepairOptions":
+        """dbnode config `repair:` section -> options (strict)."""
+        return cls.from_json(json.dumps(doc or {}).encode())
+
+
+class RepairDaemon:
+    """Background anti-entropy loop over this node's owned shards.
+
+    Pluggable topology half: `shards_fn() -> iterable[int]` names the
+    owned shards and `peers_fn(shard_id) -> list[PeerSource]` the replica
+    peers that can serve them (services/dbnode.py passes placement-driven
+    implementations; tests pass closures over in-process Databases)."""
+
+    STATUS_RING = 32
+
+    def __init__(self, db, shards_fn, peers_fn,
+                 opts: RepairOptions | None = None, seed: int = 0,
+                 clock=time.monotonic):
+        from m3_tpu.cluster.runtime import PersistRateLimiter
+
+        self.db = db
+        self.shards_fn = shards_fn
+        self.peers_fn = peers_fn
+        self.log = Logger("repair")
+        self.clock = clock
+        self._opts = opts or RepairOptions()
+        self._opts_lock = threading.Lock()
+        self._pacer = PersistRateLimiter(self._opts.rate_mbps)
+        self._rng = random.Random(f"repair:{seed}")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._unwatch = None
+        # read-path divergence hints: (namespace, shard, start_ns, end_ns)
+        # ranges, deduped, expanded to flushed blocks at drain time (a
+        # hinted block may not have flushed yet when the hint arrives)
+        self._queue: deque = deque(maxlen=1024)
+        self._queued: set = set()
+        self._queue_lock = threading.Lock()
+        # last-cycles ring + lifetime totals for /debug/repair
+        self._ring: deque = deque(maxlen=self.STATUS_RING)
+        self._ring_lock = threading.Lock()
+        self.totals = {"cycles": 0, "blocks_checked": 0, "blocks_diverged": 0,
+                       "series_repaired": 0, "peer_shed": 0, "errors": 0}
+        self._scope = default_registry().root_scope("repair")
+
+    # -- options ------------------------------------------------------------
+
+    @property
+    def opts(self) -> RepairOptions:
+        with self._opts_lock:
+            return self._opts
+
+    def set_opts(self, opts: RepairOptions) -> None:
+        with self._opts_lock:
+            self._opts = opts
+        self._pacer.set_rate(opts.rate_mbps)
+
+    def update_opts(self, **fields) -> RepairOptions:
+        with self._opts_lock:
+            self._opts = replace(self._opts, **fields)
+            opts = self._opts
+        self._pacer.set_rate(opts.rate_mbps)
+        return opts
+
+    def watch_kv(self, kv, key: str = REPAIR_KEY):
+        """Follow the repair KV key: operators retune pacing/interval on
+        a live cluster without restarts. Returns an unwatch callable."""
+
+        def on_change(_key, vv):
+            if vv is None:
+                return  # deletion keeps the last applied options
+            try:
+                self.set_opts(RepairOptions.from_json(vv.data))
+            except (ValueError, TypeError):
+                pass  # malformed payloads must not kill the watch thread
+
+        self._unwatch = kv.watch(key, on_change)
+        return self._unwatch
+
+    # -- read-path divergence queue -----------------------------------------
+
+    def enqueue_range(self, namespace: str, shard_id: int,
+                      start_ns: int, end_ns: int) -> bool:
+        """Out-of-band repair hint from the read path (quorum fetch saw
+        replica checksums disagree). Cheap and lossy by design: bounded,
+        deduped, dropped-oldest — a hint lost here is found again by the
+        next full digest sweep."""
+        key = (namespace, int(shard_id), int(start_ns), int(end_ns))
+        with self._queue_lock:
+            if key in self._queued:
+                return False
+            if len(self._queue) == self._queue.maxlen:
+                old = self._queue.popleft()
+                self._queued.discard(old)
+            self._queue.append(key)
+            self._queued.add(key)
+        self._scope.counter("enqueued")
+        return True
+
+    def _drain_queue(self) -> dict[tuple, set[int]]:
+        """Hinted (namespace, shard) -> block starts, expanded against
+        the CURRENT flushed volumes."""
+        with self._queue_lock:
+            hints, self._queue = (list(self._queue),
+                                  deque(maxlen=self._queue.maxlen))
+            self._queued = set()
+        out: dict[tuple, set[int]] = {}
+        for namespace, shard_id, start_ns, end_ns in hints:
+            ns = self.db.namespaces.get(namespace)
+            if ns is None or shard_id not in ns.shards:
+                continue
+            size = ns.opts.retention.block_size_ns
+            for bs in ns.shards[shard_id].flushed_block_starts:
+                if bs + size > start_ns and bs < end_ns:
+                    out.setdefault((namespace, shard_id), set()).add(bs)
+        return out
+
+    # -- the cycle ----------------------------------------------------------
+
+    def run_cycle(self) -> dict:
+        """One full anti-entropy round. Digest-compare every owned
+        (namespace, shard) against its peers; repair diverging blocks,
+        hinted blocks first. Returns the cycle report (also pushed onto
+        the status ring)."""
+        opts = self.opts
+        t0 = self.clock()
+        report = {"started_monotonic": round(t0, 3), "blocks_checked": 0,
+                  "blocks_diverged": 0, "series_repaired": 0,
+                  "peer_shed": 0, "deadline_hit": False, "errors": 0,
+                  "queue_hints": 0, "shards": 0}
+        with trace.span(trace.REPAIR_CYCLE), \
+                self._scope.histogram("cycle_seconds"):
+            # the kill-mid-repair seam: the rig schedules crashes here so
+            # a daemon dying between compare and swap is a covered case
+            faults.check("repair.cycle")
+            hinted = self._drain_queue()
+            report["queue_hints"] = sum(len(v) for v in hinted.values())
+            deadline = (t0 + opts.cycle_deadline_s
+                        if opts.cycle_deadline_s > 0 else None)
+            for shard_id in sorted(self.shards_fn()):
+                for namespace in list(self.db.namespaces):
+                    if deadline is not None and self.clock() > deadline:
+                        report["deadline_hit"] = True
+                        break
+                    self._repair_shard(namespace, shard_id, hinted, report,
+                                       deadline)
+                else:
+                    report["shards"] += 1
+                    continue
+                break
+        report["duration_s"] = round(self.clock() - t0, 4)
+        with self._ring_lock:
+            self._ring.append(report)
+            self.totals["cycles"] += 1
+            for k in ("blocks_checked", "blocks_diverged", "series_repaired",
+                      "peer_shed", "errors"):
+                self.totals[k] += report[k]
+        return report
+
+    def _repair_shard(self, namespace: str, shard_id: int,
+                      hinted: dict[tuple, set[int]], report: dict,
+                      deadline: float | None) -> None:
+        from m3_tpu.client.breaker import BreakerOpen
+        from m3_tpu.storage.peers import (
+            local_rollup_digests,
+            repair_shard_block,
+        )
+
+        ns = self.db.namespaces.get(namespace)
+        if ns is None or shard_id not in ns.shards:
+            return
+        peers = self.peers_fn(shard_id)
+        if not peers:
+            return
+        local = local_rollup_digests(self.db, namespace, shard_id)
+        divergent: set[int] = set(hinted.get((namespace, shard_id), ()))
+        reachable = []
+        for peer in peers:
+            try:
+                remote = peer.rollup_digests(namespace, shard_id)
+            except faults.SimulatedCrash:
+                faults.escalate()  # our own injected death mid-cycle
+                raise
+            except BreakerOpen:
+                # dead peer shed by the shared circuit: one cheap local
+                # rejection, not a timeout per block
+                report["peer_shed"] += 1
+                self._scope.counter("peer_shed")
+                continue
+            except Exception as e:  # noqa: BLE001 - peer unreachable
+                report["errors"] += 1
+                self._scope.counter("peer_errors")
+                self.log.info("rollup exchange failed", peer=str(peer),
+                              error=str(e))
+                continue
+            reachable.append(peer)
+            # symmetric difference: blocks only one side has, or held
+            # with different content, fall through to per-series repair
+            for bs in set(local) | set(remote):
+                if local.get(bs) != remote.get(bs):
+                    divergent.add(bs)
+        checked = len(set(local) | divergent)
+        report["blocks_checked"] += checked
+        self._scope.counter("blocks_checked", checked)
+        if not reachable or not divergent:
+            return
+        for bs in sorted(divergent):
+            if deadline is not None and self.clock() > deadline:
+                report["deadline_hit"] = True
+                return
+            try:
+                res = repair_shard_block(self.db, namespace, shard_id, bs,
+                                         reachable, pacer=self._pacer)
+            except faults.SimulatedCrash:
+                faults.escalate()
+                raise
+            except Exception as e:  # noqa: BLE001 - one bad block must
+                # not end the cycle for every other block/shard
+                report["errors"] += 1
+                self._scope.counter("block_errors")
+                self.log.info("block repair failed", namespace=namespace,
+                              shard=shard_id, block_start=bs, error=str(e))
+                continue
+            if res.diverged:
+                report["blocks_diverged"] += 1
+                self._scope.counter("blocks_diverged")
+            report["series_repaired"] += res.repaired
+            if res.repaired:
+                self._scope.counter("series_repaired", res.repaired)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="repair-daemon")
+        self._thread.start()
+
+    def _sleep_s(self) -> float:
+        opts = self.opts
+        return opts.interval_s * (1.0 + opts.jitter_frac * self._rng.random())
+
+    def _run(self) -> None:
+        # jittered initial delay: a fleet booting together must not fire
+        # its first repair wave in lockstep on top of bootstrap traffic
+        self._stop.wait(self._sleep_s() * 0.5)
+        while not self._stop.is_set():
+            if self.opts.enabled:
+                try:
+                    self.run_cycle()
+                except faults.SimulatedCrash:
+                    # armed (rig): the whole process dies here, SIGKILL
+                    # parity; unarmed in-process: die loudly (daemon
+                    # thread death is the crash analogue)
+                    faults.escalate()
+                    raise
+                except Exception as e:  # noqa: BLE001 - a failed cycle
+                    # must not kill the long-running daemon
+                    with self._ring_lock:
+                        self.totals["errors"] += 1
+                    self.log.info("repair cycle error; continuing",
+                                  error=str(e))
+            self._stop.wait(self._sleep_s())
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._unwatch is not None:
+            try:
+                self._unwatch()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+            self._unwatch = None
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    # -- status (/debug/repair) ---------------------------------------------
+
+    def status(self) -> dict:
+        with self._ring_lock:
+            ring = list(self._ring)
+            totals = dict(self.totals)
+        with self._queue_lock:
+            depth = len(self._queue)
+        return {"options": asdict(self.opts), "totals": totals,
+                "queue_depth": depth, "last_cycles": ring}

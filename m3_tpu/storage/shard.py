@@ -326,7 +326,13 @@ class Shard:
 
     def _drain_retired(self) -> None:
         """Close readers retired at least RETIRE_GRACE_S ago; any read that
-        captured them in its snapshot has finished by now."""
+        captured them in its snapshot has finished by now. A drained
+        reader whose volume was SUPERSEDED (flush/cold-flush/repair wrote
+        a higher volume for the block) also has its files deleted here —
+        without this every repair cycle leaks a full volume on disk until
+        retention expiry (continuous repair would leak without bound).
+        Readers retired by expire() already had their files deleted; the
+        per-volume remove is a no-op for them."""
         import time
 
         now = time.monotonic()
@@ -338,6 +344,9 @@ class Shard:
             self._retired = keep
         for _, r in doomed:
             r.close()
+            cur = self._filesets.get(r.block_start)
+            if cur is not None and cur.volume > r.volume:
+                self._delete_volume_files(r.block_start, r.volume)
 
     def _flush_traced(self, block_start: int) -> bool:
         from m3_tpu.utils.instrument import default_registry
@@ -471,14 +480,24 @@ class Shard:
     # -- maintenance --
 
     def _delete_fileset_files(self, block_start: int) -> None:
+        # every volume of the block (retention expiry)
+        self._delete_matching(f"fileset-{block_start}-*.db")
+
+    def _delete_volume_files(self, block_start: int, volume: int) -> None:
+        """ONE superseded volume's files (repair/flush wrote a higher
+        volume; this one is no longer the bootstrap choice). Readers
+        still holding it keep reading through their open fds/mmaps."""
+        self._delete_matching(f"fileset-{block_start}-{volume}-*.db")
+
+    def _delete_matching(self, pattern: str) -> None:
         import glob
         import os
 
         d = os.path.join(self.fs_root, self.namespace, str(self.shard_id))
         # *.db.tmp: leftovers of a flush killed mid-write (atomic writers
         # never expose them under final names; reclaim them here)
-        pattern = os.path.join(d, f"fileset-{block_start}-*.db")
-        paths = glob.glob(pattern) + glob.glob(pattern + ".tmp")
+        full = os.path.join(d, pattern)
+        paths = glob.glob(full) + glob.glob(full + ".tmp")
         # checkpoint first so a crash mid-delete leaves an "incomplete"
         # (ignored) volume rather than a corrupt-looking one
         paths = sorted(paths, key=lambda p: "checkpoint" not in p)
@@ -507,9 +526,24 @@ class Shard:
                     del self._filesets[bs]
                     self._delete_fileset_files(bs)
                     dropped += 1
-            for bs, _vol in list_filesets(self.fs_root, self.namespace, self.shard_id):
+            with self._retired_lock:
+                in_grace = {(r.block_start, r.volume)
+                            for _ts, r in self._retired}
+            for bs, vol in list_filesets(self.fs_root, self.namespace,
+                                         self.shard_id, all_volumes=True):
                 if bs < cutoff and bs not in self._filesets:
                     self._delete_fileset_files(bs)
+                    continue
+                # superseded-volume sweep: a complete volume below the one
+                # currently serving the block is a crash leftover (killed
+                # between the swap and the retired-reader cleanup) — only
+                # the max volume is ever bootstrapped, so reclaim the rest.
+                # Volumes still inside the retire grace are skipped (their
+                # readers drain first; the next expire pass gets them).
+                cur = self._filesets.get(bs)
+                if cur is not None and vol < cur.volume \
+                        and (bs, vol) not in in_grace:
+                    self._delete_volume_files(bs, vol)
         self.buffer.expire_before(cutoff)
         return dropped
 

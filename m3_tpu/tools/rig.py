@@ -22,7 +22,12 @@ rig then proves the contracts the platform claims:
   fake a regression or mask one;
 - **tenant isolation**: the noisy-tenant phase saturates one tenant
   until admission control sheds it with 429s while a steady tenant's
-  p99 holds — proven WHILE nodes are being killed.
+  p99 holds — proven WHILE nodes are being killed;
+- **anti-entropy convergence**: after the schedule heals, the replica
+  that slept through its outage window converges via the nodes' OWN
+  repair daemons — every replica pair reaches per-(shard, block)
+  rollup-digest equality within the configured cycle budget
+  (``convergence_audit``; nothing in the rig invokes repair directly).
 
 Determinism: the traffic sequence (tenant choice, batch sizes, series,
 query shapes) and the chaos schedule derive from one seed — the same
@@ -636,6 +641,107 @@ def median_p99_ms(p99s: list) -> float | None:
 
 
 # ---------------------------------------------------------------------------
+# convergence audit: per-(shard, block) rollup digests across replicas
+
+
+def _http_post_ok(url: str, timeout_s: float = 30.0) -> None:
+    req = urllib.request.Request(url, data=b"{}", method="POST")
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        r.read()
+
+
+def node_rollup(port: int, namespace: str, shard: int,
+                timeout_s: float = 10.0) -> dict:
+    """{block_start: (digest, n_series)} from one node's /blocks/rollup
+    — the same packed wire format the repair daemons exchange."""
+    import base64 as _b64
+    from urllib.parse import urlencode
+
+    from m3_tpu.storage.peers import unpack_rollup
+
+    qs = urlencode({"namespace": namespace, "shard": shard})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/blocks/rollup?{qs}",
+            timeout=timeout_s) as r:
+        doc = json.loads(r.read().decode())
+    return unpack_rollup(_b64.b64decode(doc.get("rollup_b64", "")))
+
+
+def node_repair_cycles(port: int, timeout_s: float = 10.0) -> int:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/repair",
+            timeout=timeout_s) as r:
+        doc = json.loads(r.read().decode())
+    return int(doc.get("totals", {}).get("cycles", 0))
+
+
+def convergence_audit(cluster, namespaces, budget_cycles: int = 10,
+                      interval_s: float = 1.0, poll_s: float = 0.5) -> dict:
+    """The anti-entropy acceptance phase: after the chaos schedule heals,
+    every replica pair must reach per-(shard, block) rollup-digest
+    equality within `budget_cycles` repair cycles — the replica that
+    slept through a kill/partition window converges via the daemons, not
+    via test code invoking repair.
+
+    Both replicas are flushed first (digests cover persisted volumes;
+    the rig's short run otherwise leaves everything in the mutable
+    buffer, making equality vacuous), then the audit POLLS — repair runs
+    only inside the nodes."""
+    from m3_tpu.cluster.placement import ShardState
+
+    for port in cluster.node_ports.values():
+        _http_post_ok(f"http://127.0.0.1:{port}/debug/flush")
+    owners: dict[int, list[str]] = {}
+    for nid, inst in cluster.placement.instances.items():
+        for sh in inst.shards.values():
+            if sh.state in (ShardState.AVAILABLE, ShardState.LEAVING):
+                owners.setdefault(sh.id, []).append(nid)
+    pairs = {s: sorted(nids) for s, nids in owners.items() if len(nids) >= 2}
+    cycles0 = {nid: node_repair_cycles(port)
+               for nid, port in cluster.node_ports.items()}
+
+    def mismatches() -> list[dict]:
+        out = []
+        for shard, nids in sorted(pairs.items()):
+            for namespace in namespaces:
+                tables = {
+                    nid: node_rollup(cluster.node_ports[nid], namespace,
+                                     shard)
+                    for nid in nids
+                }
+                base = tables[nids[0]]
+                if any(tables[n] != base for n in nids[1:]):
+                    out.append({
+                        "namespace": namespace, "shard": shard,
+                        "tables": {n: {str(bs): d for bs, (d, _c)
+                                       in sorted(t.items())}
+                                   for n, t in tables.items()},
+                    })
+        return out
+
+    # budget in wall time: budget_cycles at the configured interval plus
+    # the daemon's jitter headroom and one deadline-length straggler
+    deadline = time.monotonic() + budget_cycles * interval_s * 1.5 + 5.0
+    remaining = mismatches()
+    initially_divergent = len(remaining)
+    while remaining and time.monotonic() < deadline:
+        time.sleep(poll_s)
+        remaining = mismatches()
+    cycles_used = max(
+        (node_repair_cycles(port) - cycles0[nid]
+         for nid, port in cluster.node_ports.items()), default=0)
+    return {
+        "converged": not remaining,
+        "initially_divergent": initially_divergent,
+        "replica_pairs": len(pairs),
+        "namespaces": list(namespaces),
+        "budget_cycles": budget_cycles,
+        "cycles_used": cycles_used,
+        "mismatches": remaining[:10],
+    }
+
+
+# ---------------------------------------------------------------------------
 # full production deployment (real processes) — shared by the CLI and the
 # chaos-lane pytest
 
@@ -658,6 +764,14 @@ http:
   host: 127.0.0.1
   port: {port}
 tick_interval_s: 0.5
+# continuous anti-entropy at rig tempo: production defaults are 30s
+# cycles, but the convergence audit needs several cycles inside its
+# budget, so the rig runs 1s cycles with the same pacing discipline
+repair:
+  interval_s: 1.0
+  jitter_frac: 0.25
+  cycle_deadline_s: 10.0
+  rate_mbps: 8.0
 """
 
 COORD_CFG = """\
@@ -956,6 +1070,14 @@ def run_production_rig(workdir: str, seconds: float = 20.0, seed: int = 7,
                               desc="tenant namespaces readable after chaos")
         report["verify"] = ledger.verify(session_fetch_fn(verify_session))
 
+        # ---- convergence audit: anti-entropy actually converged ----
+        # the replica that slept through its kill/partition window holds
+        # less data than its partner; the nodes' OWN repair daemons must
+        # reach per-(shard, block) rollup-digest equality within the
+        # cycle budget — nothing here invokes repair
+        report["convergence"] = convergence_audit(
+            cluster, tenants, budget_cycles=10, interval_s=1.0)
+
         # ---- phase 2: noisy-tenant isolation under a node kill ----
         # runtime quota push through the kvd metadata plane: noisy goes
         # from unlimited to 3 qps LIVE; steady keeps its headroom
@@ -1022,6 +1144,7 @@ def main(argv=None) -> int:
                                 args.slo_p99_ms)
     print(json.dumps(report, indent=2, default=str))
     ok = (not report.get("verify", {}).get("missing")
+          and report.get("convergence", {}).get("converged", False)
           and report.get("noisy_phase", {}).get("noisy_sheds", 0) > 0)
     return 0 if ok else 1
 

@@ -34,6 +34,7 @@ HISTOGRAMS = {
     "send_seconds",             # msg producer
     "recv_seconds",             # msg consumer
     "http_seconds",             # storage peers HTTP
+    "cycle_seconds",            # repair daemon anti-entropy cycle
     # client / query plane
     "fetch_many_seconds",       # session batched fetch
     "request_seconds",          # coordinator request + per-tenant SLO

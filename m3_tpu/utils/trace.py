@@ -59,6 +59,7 @@ KVD_RPC = "kvd.client.rpc"
 KVD_HANDLE = "kvd.server.handle"
 PEER_HTTP = "storage.peer.http"
 TENANT_SHED = "tenant.admission.shed"
+REPAIR_CYCLE = "storage.repair.cycle"
 
 _ZERO_SPAN_ID = "0" * 16
 # placeholder trace id carried by a negative head decision's context —

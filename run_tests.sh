@@ -19,7 +19,13 @@
 #                         coordinator + aggregator under seeded
 #                         kill/partition chaos and live load
 #                         (M3_TPU_RIG_SECONDS schedule budget, ~60s wall
-#                         with spawn/verify overhead); never tier-1
+#                         with spawn/verify overhead). Asserts zero
+#                         acked-write loss, the pair-median p99 SLO, AND
+#                         (PR 9) the anti-entropy convergence audit:
+#                         every replica pair reaches per-(shard, block)
+#                         rollup-digest equality within the repair-cycle
+#                         budget, driven by the nodes' own RepairDaemons;
+#                         never tier-1
 #   run_tests.sh tsan   — opt-in ThreadSanitizer stage for the native
 #                         layer: (1) pytest tests/test_race_native.py
 #                         (uninstrumented pytest; its tests spawn their

@@ -32,3 +32,23 @@ def guarded_flush(f, data):
 def record_latency(dt):
     # inv-histogram-catalog: name absent from utils/metric_catalog.py
     _scope.observe("fixture_bogus_seconds", dt)
+
+
+class Peer:
+    def rpc_probe(self, payload):
+        # the seam lives one call down from the swallowing except
+        faults.check("fixture.peer.rpc")
+        return payload
+
+
+def probe_all(peers, payload):
+    out = []
+    for p in peers:
+        try:
+            out.append(p.rpc_probe(payload))
+        except Exception:
+            # inv-crash-swallow (cross-function): rpc_probe reaches the
+            # seam, so SimulatedCrash dies here as "peer down" — the
+            # storage/peers.py bug class
+            continue
+    return out

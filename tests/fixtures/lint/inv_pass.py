@@ -45,3 +45,39 @@ def reraising_flush(f, data):
 
 def record_latency(dt):
     _scope.observe("write_seconds", dt)  # cataloged name
+
+
+class Peer:
+    def rpc_probe(self, payload):
+        faults.check("fixture_ok.peer.rpc")
+        return payload
+
+
+def probe_all(peers, payload):
+    # cross-function seam handled right: the crash escapes the per-peer
+    # degrade loop (peers.py post-fix shape)
+    out = []
+    for p in peers:
+        try:
+            out.append(p.rpc_probe(payload))
+        except faults.SimulatedCrash:
+            faults.escalate()
+            raise
+        except Exception:
+            continue
+    return out
+
+
+def probe_queue(q):
+    # `q.get()` must NOT chase a same-module seam-bearing `def get` —
+    # generic object-protocol names resolve to queues/events/channels,
+    # not to this module's RPC surface
+    try:
+        return q.get(timeout=0.5)
+    except Exception:
+        return None
+
+
+def get(key):
+    faults.check("fixture_ok.kv.get")
+    return key

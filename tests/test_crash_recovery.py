@@ -359,6 +359,139 @@ def _chaos_iteration_batched(root: str, seed: int) -> tuple[bool, int]:
     return crashed, len(acked)
 
 
+# the repair-plane spec: kills land at the cycle boundary (daemon dying
+# between compare and merge) AND inside the volume write (repair killed
+# mid-persist leaves .tmp leftovers / a torn volume the next cycle must
+# absorb); peer partitions are injected at the peer wrapper below
+REPAIR_CHAOS_SPEC = (
+    "repair.cycle=crash:p0.15;"
+    "fileset.persist=crash:p0.08;"
+    "fileset.write=torn:p0.05"
+)
+
+
+def _repair_chaos_iteration(root: str, seed: int) -> tuple[int, int]:
+    """One seeded anti-entropy storm (ISSUE 9): two divergent replicas
+    repair each other through flaky peers while kills land mid-cycle and
+    mid-volume-write and a reader thread hammers both sides across the
+    volume swaps. Invariants: reads NEVER error (a repair swap must be
+    invisible to serving), and once the faults heal, clean daemon cycles
+    reach rollup-digest equality with every written datapoint readable
+    on BOTH replicas. Returns (crashes_survived, clean_cycles_used)."""
+    import random
+    import threading
+
+    from m3_tpu.storage import peers as peers_mod
+    from m3_tpu.storage.repair import RepairDaemon
+
+    rng = random.Random(f"repair-chaos:{seed}")
+    a = make_db(os.path.join(root, "a"))
+    a.open(START)
+    b = make_db(os.path.join(root, "b"))
+    b.open(START)
+    expect: dict[bytes, dict[int, float]] = {}
+    for i in range(30):
+        sid = b"s-%d" % (i % 8)
+        t = START + i * 90 * SEC
+        v = float(seed * 1000 + i)
+        for db in ((a,), (b,), (a, b))[rng.randrange(3)]:  # divergence
+            db.write("default", sid, t, v)
+        expect.setdefault(sid, {})[t] = v
+    a.flush_all()
+    b.flush_all()
+
+    class FlakyPeer(peers_mod.InProcessPeer):
+        """Partition mid-stream: any RPC — including between the metadata
+        fetch and the stream — can drop with a seeded probability."""
+
+        def __init__(self, db, prng, p):
+            super().__init__(db)
+            self._prng, self._p = prng, p
+
+        def _maybe_drop(self):
+            if self._prng.random() < self._p["p"]:
+                raise ConnectionError("injected partition")
+
+        def rollup_digests(self, *args):
+            self._maybe_drop()
+            return super().rollup_digests(*args)
+
+        def block_metadata(self, *args):
+            self._maybe_drop()
+            return super().block_metadata(*args)
+
+        def stream_block(self, *args):
+            self._maybe_drop()
+            return super().stream_block(*args)
+
+    prng = random.Random(f"partition:{seed}")
+    drop = {"p": 0.25}  # healed to 0.0 after the storm
+    da = RepairDaemon(a, lambda: a.owned_shards,
+                      lambda s: [FlakyPeer(b, prng, drop)])
+    db_ = RepairDaemon(b, lambda: b.owned_shards,
+                       lambda s: [FlakyPeer(a, prng, drop)])
+
+    # the stale-reader swap race: reads race every repair volume swap;
+    # the retire grace keeps captured readers alive, so a reader must
+    # never observe an error (values may be pre- or post-repair)
+    stop = threading.Event()
+    read_errors: list[str] = []
+
+    def _hammer():
+        while not stop.is_set():
+            try:
+                for sid in list(expect):
+                    read_all(a, sid)
+                    read_all(b, sid)
+            except Exception as e:  # noqa: BLE001 - the assertion payload
+                read_errors.append(repr(e))
+                return
+
+    reader = threading.Thread(target=_hammer, name="swap-race-reader")
+    reader.start()
+
+    crashes = 0
+    faults.configure(REPAIR_CHAOS_SPEC, seed=seed)
+    try:
+        for _ in range(6):
+            for d in (da, db_):
+                try:
+                    d.run_cycle()
+                except faults.SimulatedCrash:
+                    crashes += 1  # the daemon died mid-repair; "restart"
+    finally:
+        faults.disable()
+
+    # healed: faults off AND partitions closed — clean cycles must
+    # converge the pair within a small budget
+    drop["p"] = 0.0
+    clean_cycles = 0
+    converged = False
+    while clean_cycles < 8 and not converged:
+        da.run_cycle()
+        db_.run_cycle()
+        clean_cycles += 1
+        converged = all(
+            peers_mod.local_rollup_digests(a, "default", s)
+            == peers_mod.local_rollup_digests(b, "default", s)
+            for s in a.owned_shards
+        )
+    stop.set()
+    reader.join(10.0)
+    assert not read_errors, \
+        f"seed={seed}: read failed during repair swaps: {read_errors[:3]}"
+    assert converged, f"seed={seed}: no convergence in {clean_cycles} cycles"
+    for name, db in (("a", a), ("b", b)):
+        for sid, tv in expect.items():
+            got = read_all(db, sid)
+            for t, v in tv.items():
+                assert got.get(t) == v, \
+                    f"seed={seed}: {name} lost {(sid, t, v)} after repair"
+    a.close()
+    b.close()
+    return crashes, clean_cycles
+
+
 class TestChaosQuick:
     def test_chaos_iterations_quick(self, tmp_path):
         """A handful of seeds in tier-1 so the harness itself never rots;
@@ -378,6 +511,17 @@ class TestChaosQuick:
                 str(tmp_path / str(seed)), seed)
             crashes += crashed
         assert crashes >= 1
+
+    def test_repair_chaos_iterations_quick(self, tmp_path):
+        """Anti-entropy storm, tier-1 sized (the sweep is the chaos
+        lane). The iteration arms its own spec AFTER seeding the
+        divergence — setup flushes must not eat the injected kills."""
+        crashes = 0
+        for seed in range(4):
+            c, _cycles = _repair_chaos_iteration(
+                str(tmp_path / str(seed)), seed)
+            crashes += c
+        assert crashes >= 1  # kills actually landed mid-repair
 
 
 @pytest.mark.chaos
@@ -409,3 +553,16 @@ class TestChaosFull:
             acked_total += n
         assert crashes >= iters // 10
         assert acked_total > 0
+
+    def test_chaos_repair_storm_always_converges(self, tmp_path):
+        """ISSUE 9's seeded daemon sweep: kill-mid-repair, peer
+        partition mid-stream, and the stale-reader swap race, across
+        M3_TPU_CHAOS_ITERS seeds — every storm ends with rollup-digest
+        equality and both replicas serving every written datapoint."""
+        iters = int(os.environ.get("M3_TPU_CHAOS_ITERS", "200")) // 4
+        crashes = 0
+        for seed in range(max(iters, 10)):
+            c, _cycles = _repair_chaos_iteration(
+                str(tmp_path / str(seed)), seed)
+            crashes += c
+        assert crashes >= max(iters, 10) // 10

@@ -106,6 +106,11 @@ class TestInvariantRules:
             "inv-fault-point-unique", "inv-crash-swallow",
             "inv-histogram-catalog",
         }
+        # both swallow shapes land: the seam directly inside the try
+        # (guarded_flush) AND one call down inside a same-module callee
+        # (probe_all -> Peer.rpc_probe, the storage/peers.py bug class)
+        swallows = [f for f in fs if f.rule == "inv-crash-swallow"]
+        assert len(swallows) == 2, swallows
 
     def test_invariant_idioms_pass(self):
         # unique names, SimulatedCrash re-raise / escalate / bare raise,

@@ -1,5 +1,8 @@
-"""Peers bootstrap, replica repair, and AggregateTiles tests
-(SURVEY.md §5 failure detection / §3.5)."""
+"""Peers bootstrap, replica repair, the anti-entropy RepairDaemon, and
+AggregateTiles tests (SURVEY.md §5 failure detection / §3.5)."""
+
+import random
+import zlib
 
 import numpy as np
 import pytest
@@ -11,6 +14,8 @@ from m3_tpu.storage.options import (
     NamespaceOptions,
     RetentionOptions,
 )
+from m3_tpu.storage.repair import RepairDaemon, RepairOptions
+from m3_tpu.utils import faults
 
 HOUR = 3600 * 10**9
 SEC = 10**9
@@ -213,6 +218,40 @@ class TestReviewRegressions:
         assert bs not in a.namespaces["default"].shards[shard_id]._filesets
         a.close()
 
+    def test_crash_at_peer_seam_escapes_repair_functions(self, tmp_path):
+        """The crash-swallow satellite: SimulatedCrash injected at the
+        peer.http seam is THIS process dying, and must escape every
+        broad per-peer except in bootstrap/metadata/stream loops instead
+        of degrading into 'peer down'."""
+        a = make_db(tmp_path, "a")
+        b = make_db(tmp_path, "b")
+        b.write_tagged("default", b"c", [], START + SEC, 1.0)
+        b.flush_all()
+
+        class CrashingPeer:
+            """Stands in for HTTPPeer with a crash rule armed at its
+            seam: every RPC dies the way faults.check('peer.http')
+            does."""
+
+            def block_starts(self, *a):
+                raise faults.SimulatedCrash("peer.http")
+
+            block_metadata = stream_block = rollup_digests = block_starts
+
+        shard_id = 0
+        bs = START
+        with pytest.raises(faults.SimulatedCrash):
+            peers_mod.bootstrap_shard_from_peers(
+                a, "default", shard_id, [CrashingPeer()])
+        with pytest.raises(faults.SimulatedCrash):
+            peers_mod.repair_shard_block(
+                a, "default", shard_id, bs, [CrashingPeer()])
+        with pytest.raises(faults.SimulatedCrash):
+            peers_mod._merged_block_from_peers(
+                "default", shard_id, bs, [CrashingPeer()])
+        for db in (a, b):
+            db.close()
+
     def test_repaired_peer_only_series_queryable(self, tmp_path):
         a = make_db(tmp_path, "a")
         b = make_db(tmp_path, "b")
@@ -233,4 +272,639 @@ class TestReviewRegressions:
                       START, START + HOUR)
         assert len(got) == 1 and got[0][2][0].value == 3.0
         a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# anti-entropy plane: rollup digests + the RepairDaemon (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+def _divergent_shards(a, b, namespace="default"):
+    return [
+        s for s in (0, 1)
+        if peers_mod.local_rollup_digests(a, namespace, s)
+        != peers_mod.local_rollup_digests(b, namespace, s)
+    ]
+
+
+def _daemon_pair(a, b, **opt_kw):
+    opts = RepairOptions(**opt_kw) if opt_kw else RepairOptions()
+    da = RepairDaemon(a, lambda: a.owned_shards,
+                      lambda s: [peers_mod.InProcessPeer(b)], opts=opts)
+    db_ = RepairDaemon(b, lambda: b.owned_shards,
+                       lambda s: [peers_mod.InProcessPeer(a)], opts=opts)
+    return da, db_
+
+
+class TestRollupDigest:
+    def test_pack_unpack_roundtrip(self):
+        rng = random.Random(7)
+        for _ in range(20):
+            digests = {
+                rng.randrange(-2**62, 2**62): (rng.randrange(2**64),
+                                               rng.randrange(2**32))
+                for _ in range(rng.randrange(0, 16))
+            }
+            raw = peers_mod.pack_rollup(digests)
+            assert len(raw) == len(digests) * peers_mod.ROLLUP_DTYPE.itemsize
+            assert peers_mod.unpack_rollup(raw) == digests
+
+    def test_pack_is_deterministic(self):
+        d = {200: (7, 1), -100: (9, 2), 0: (3, 3)}
+        assert peers_mod.pack_rollup(d) == peers_mod.pack_rollup(
+            dict(reversed(list(d.items()))))
+
+    def test_unpack_rejects_ragged_payload(self):
+        with pytest.raises(ValueError):
+            peers_mod.unpack_rollup(b"x" * 21)
+
+    def test_digest_is_digest_of_per_series_metadata(self, tmp_path):
+        """The documented contract: the rollup digest IS the adler32 of
+        the sorted-by-series per-series stream adler32s (+ count) — the
+        same checksums block_metadata serves per series. Recomputed here
+        independently from the metadata wire surface."""
+        import struct
+
+        a = make_db(tmp_path, "a")
+        for i in range(12):
+            a.write_tagged("default", b"m", [(b"i", str(i).encode())],
+                           START + (i + 1) * SEC, float(i))
+        a.flush_all()
+        peer = peers_mod.InProcessPeer(a)
+        for shard_id in (0, 1):
+            local = peers_mod.local_rollup_digests(a, "default", shard_id)
+            for bs, (digest, n_series) in local.items():
+                meta = peer.block_metadata("default", shard_id, bs)
+                assert n_series == len(meta)
+                sums = np.array([meta[sid]["checksum"]
+                                 for sid in sorted(meta)], np.uint64)
+                want = zlib.adler32(
+                    sums.astype("<u8").tobytes(),
+                    zlib.adler32(struct.pack("<Q", len(sums))))
+                assert digest == want
+        a.close()
+
+    def test_property_divergence_iff_rollup_mismatch(self, tmp_path):
+        """Seeded property sweep: for every (shard, block), the rollup
+        digests of two replicas are equal IFF their per-series metadata
+        (checksum maps) are equal — divergence ⇔ rollup mismatch, no
+        false negatives from the cheap comparison."""
+        rng = random.Random(20240803)
+        for case in range(10):
+            a = make_db(tmp_path, f"pa{case}")
+            b = make_db(tmp_path, f"pb{case}")
+            for i in range(rng.randrange(1, 14)):
+                t = START + (i + 1) * SEC
+                roll = rng.random()
+                if roll < 0.6:  # in sync
+                    for db in (a, b):
+                        db.write_tagged("default", b"pm",
+                                        [(b"i", str(i).encode())], t, roll)
+                elif roll < 0.8:  # one side only
+                    (a if rng.random() < 0.5 else b).write_tagged(
+                        "default", b"pm", [(b"i", str(i).encode())], t, roll)
+                else:  # same series, conflicting values
+                    a.write_tagged("default", b"pm",
+                                   [(b"i", str(i).encode())], t, roll)
+                    b.write_tagged("default", b"pm",
+                                   [(b"i", str(i).encode())], t, roll + 1.0)
+            a.flush_all()
+            b.flush_all()
+            pa, pb = peers_mod.InProcessPeer(a), peers_mod.InProcessPeer(b)
+            for shard_id in (0, 1):
+                da = peers_mod.local_rollup_digests(a, "default", shard_id)
+                db_ = peers_mod.local_rollup_digests(b, "default", shard_id)
+                for bs in set(da) | set(db_):
+                    meta_eq = (
+                        {s: m["checksum"] for s, m in pa.block_metadata(
+                            "default", shard_id, bs).items()}
+                        == {s: m["checksum"] for s, m in pb.block_metadata(
+                            "default", shard_id, bs).items()})
+                    roll_eq = da.get(bs) == db_.get(bs)
+                    assert meta_eq == roll_eq, (case, shard_id, bs)
+            a.close()
+            b.close()
+
+    def test_digest_content_addressed_across_volumes(self, tmp_path):
+        """Repair writes volume N+1 on the repaired node; the digest
+        depends on CONTENT only, so a repaired replica compares equal to
+        the peer that never re-flushed."""
+        a = make_db(tmp_path, "a")
+        b = make_db(tmp_path, "b")
+        for db in (a, b):
+            db.write_tagged("default", b"r", [], START + SEC, 1.0)
+        b.write_tagged("default", b"r", [], START + 2 * SEC, 2.0)
+        a.flush_all()
+        b.flush_all()
+        assert _divergent_shards(a, b)
+        from m3_tpu.utils.ident import tags_to_id
+
+        shard_id = a.namespaces["default"].shard_set.lookup(tags_to_id(b"r", []))
+        bs = a.namespaces["default"].opts.retention.block_start(START + SEC)
+        peers_mod.repair_shard_block(a, "default", shard_id, bs,
+                                     [peers_mod.InProcessPeer(b)])
+        # a now serves volume 1, b still volume 0 — digests must agree
+        assert a.namespaces["default"].shards[shard_id]._filesets[bs].volume == 1
+        assert not _divergent_shards(a, b)
+        for db in (a, b):
+            db.close()
+
+    def test_rollup_of_absent_shard_is_empty(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        assert peers_mod.local_rollup_digests(a, "nope", 0) == {}
+        assert peers_mod.local_rollup_digests(a, "default", 99) == {}
+        a.close()
+
+
+class TestRepairDaemon:
+    def test_two_replicas_converge(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        b = make_db(tmp_path, "b")
+        for i in range(16):
+            for db in (a, b):
+                db.write_tagged("default", b"cpu",
+                                [(b"h", str(i).encode())],
+                                START + (i + 1) * SEC, float(i))
+        for i in range(4):  # a-only series
+            a.write_tagged("default", b"cpu", [(b"only_a", str(i).encode())],
+                           START + (30 + i) * SEC, 1.0)
+        # same series, conflicting value: deterministic merge must settle
+        b.write_tagged("default", b"cpu", [(b"h", b"0")], START + 50 * SEC,
+                       99.0)
+        a.flush_all()
+        b.flush_all()
+        assert _divergent_shards(a, b)
+        da, db_ = _daemon_pair(a, b)
+        for _ in range(3):
+            da.run_cycle()
+            db_.run_cycle()
+        assert not _divergent_shards(a, b)
+        status = da.status()
+        assert status["totals"]["cycles"] == 3
+        assert status["totals"]["blocks_checked"] > 0
+        assert len(status["last_cycles"]) == 3
+        # convergent: the last cycle found nothing to repair
+        assert status["last_cycles"][-1]["blocks_diverged"] == 0
+        for db in (a, b):
+            db.close()
+
+    def test_in_sync_cycle_is_digest_only(self, tmp_path):
+        """An in-sync pair must never fall through to per-series
+        metadata/stream RPCs — the O(1) wire promise of the rollup."""
+        a = make_db(tmp_path, "a")
+        b = make_db(tmp_path, "b")
+        for db in (a, b):
+            db.write_tagged("default", b"s", [], START + SEC, 1.0)
+            db.flush_all()
+
+        calls = {"rollup": 0, "meta": 0, "stream": 0}
+
+        class CountingPeer(peers_mod.InProcessPeer):
+            def rollup_digests(self, *a):
+                calls["rollup"] += 1
+                return super().rollup_digests(*a)
+
+            def block_metadata(self, *a):
+                calls["meta"] += 1
+                return super().block_metadata(*a)
+
+            def stream_block(self, *a):
+                calls["stream"] += 1
+                return super().stream_block(*a)
+
+        daemon = RepairDaemon(a, lambda: a.owned_shards,
+                              lambda s: [CountingPeer(b)])
+        report = daemon.run_cycle()
+        assert report["blocks_checked"] >= 1
+        assert report["blocks_diverged"] == 0
+        assert calls["rollup"] >= 1
+        assert calls["meta"] == 0 and calls["stream"] == 0
+        for db in (a, b):
+            db.close()
+
+    def test_simulated_crash_escapes_cycle(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        daemon = RepairDaemon(a, lambda: a.owned_shards, lambda s: [])
+        try:
+            with faults.active("repair.cycle=crash:n1"):
+                with pytest.raises(faults.SimulatedCrash):
+                    daemon.run_cycle()
+        finally:
+            faults.disable()
+            a.close()
+
+    def test_deadline_bounds_cycle(self, tmp_path):
+        """One slow peer (or many shards) cannot wedge a round: the
+        cycle re-checks its deadline between shards and blocks."""
+        a = make_db(tmp_path, "a")
+        ticks = iter(range(0, 10_000, 6))  # 0, 6, 12, ... virtual seconds
+        daemon = RepairDaemon(a, lambda: a.owned_shards, lambda s: [],
+                              opts=RepairOptions(cycle_deadline_s=10.0),
+                              clock=lambda: float(next(ticks)))
+        report = daemon.run_cycle()
+        assert report["deadline_hit"] is True
+        assert report["shards"] < 2  # stopped before covering both shards
+        a.close()
+
+    def test_breaker_open_peer_is_shed(self, tmp_path):
+        from m3_tpu.client.breaker import BreakerOpen
+
+        a = make_db(tmp_path, "a")
+        a.write_tagged("default", b"s", [], START + SEC, 1.0)
+        a.flush_all()
+
+        class OpenPeer:
+            def rollup_digests(self, *args):
+                raise BreakerOpen("circuit open")
+
+        daemon = RepairDaemon(a, lambda: a.owned_shards,
+                              lambda s: [OpenPeer()])
+        report = daemon.run_cycle()
+        assert report["peer_shed"] >= 1
+        assert report["errors"] == 0  # shed is not an error
+        a.close()
+
+    def test_unreachable_peer_counted_not_fatal(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        a.write_tagged("default", b"s", [], START + SEC, 1.0)
+        a.flush_all()
+
+        class DeadPeer:
+            def rollup_digests(self, *args):
+                raise ConnectionError("down")
+
+        daemon = RepairDaemon(a, lambda: a.owned_shards,
+                              lambda s: [DeadPeer()])
+        report = daemon.run_cycle()  # must not raise
+        assert report["errors"] >= 1
+        a.close()
+
+    def test_enqueue_dedups_and_bounds(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        daemon = RepairDaemon(a, lambda: set(), lambda s: [])
+        assert daemon.enqueue_range("default", 0, START, START + HOUR)
+        assert not daemon.enqueue_range("default", 0, START, START + HOUR)
+        assert daemon.enqueue_range("default", 1, START, START + HOUR)
+        # bounded: the queue drops oldest instead of growing forever
+        for i in range(2000):
+            daemon.enqueue_range("default", 0, START + i, START + i + 1)
+        assert len(daemon._queue) <= 1024
+        a.close()
+
+    def test_hints_expand_to_flushed_blocks(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        a.write_tagged("default", b"s", [], START + SEC, 1.0)
+        a.flush_all()
+        shard_id = next(
+            s for s in (0, 1)
+            if a.namespaces["default"].shards[s].flushed_block_starts)
+        daemon = RepairDaemon(a, lambda: a.owned_shards, lambda s: [])
+        daemon.enqueue_range("default", shard_id, START, START + HOUR)
+        daemon.enqueue_range("nope", 0, START, START + HOUR)  # unknown ns
+        hinted = daemon._drain_queue()
+        assert hinted == {("default", shard_id): {START}}
+        assert daemon._drain_queue() == {}  # drained
+        # a hint for a never-flushed range expands to nothing
+        daemon.enqueue_range("default", shard_id, START + 10 * HOUR,
+                             START + 12 * HOUR)
+        assert daemon._drain_queue() == {}
+        a.close()
+
+    def test_hinted_blocks_enter_the_cycle(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        b = make_db(tmp_path, "b")
+        for db in (a, b):
+            db.write_tagged("default", b"s", [], START + SEC, 1.0)
+            db.flush_all()
+        daemon = RepairDaemon(a, lambda: a.owned_shards,
+                              lambda s: [peers_mod.InProcessPeer(b)])
+        shard_id = next(
+            s for s in (0, 1)
+            if a.namespaces["default"].shards[s].flushed_block_starts)
+        daemon.enqueue_range("default", shard_id, START, START + HOUR)
+        report = daemon.run_cycle()
+        assert report["queue_hints"] == 1
+        for db in (a, b):
+            db.close()
+
+    def test_kv_retune_live(self, tmp_path):
+        import json as _json
+
+        from m3_tpu.cluster.kv import KVStore
+        from m3_tpu.storage.repair import REPAIR_KEY
+
+        a = make_db(tmp_path, "a")
+        daemon = RepairDaemon(a, lambda: set(), lambda s: [],
+                              opts=RepairOptions(rate_mbps=8.0))
+        kv = KVStore()
+        daemon.watch_kv(kv)
+        kv.set(REPAIR_KEY, _json.dumps(
+            {"rate_mbps": 2.0, "interval_s": 5.0}).encode())
+        assert daemon.opts.rate_mbps == 2.0
+        assert daemon.opts.interval_s == 5.0
+        assert daemon.opts.cycle_deadline_s == 30.0  # untouched default
+        # malformed payloads never kill the watch or clobber live opts
+        kv.set(REPAIR_KEY, b'{"rate_mbps": "fast"}')
+        assert daemon.opts.rate_mbps == 2.0
+        kv.set(REPAIR_KEY, _json.dumps({"peer_timeout_s": 1.5}).encode())
+        assert daemon.opts.peer_timeout_s == 1.5
+        daemon.stop()
+        a.close()
+
+    def test_options_strict_parse(self):
+        with pytest.raises(ValueError):
+            RepairOptions.from_json(b'{"interval_s": "soon"}')
+        with pytest.raises(ValueError):
+            RepairOptions.from_json(b'{"enabled": 1}')
+        opts = RepairOptions.from_json(b'{"interval_s": 3, "unknown": 9}')
+        assert opts.interval_s == 3.0  # ints coerce, unknown keys ignored
+        assert RepairOptions.from_config(None) == RepairOptions()
+
+    def test_streamed_bytes_pay_the_pacer(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        b = make_db(tmp_path, "b")
+        b.write_tagged("default", b"only_b", [], START + SEC, 3.0)
+        b.flush_all()
+        a.flush_all()
+
+        paid = []
+
+        class Pacer:
+            def acquire(self, n_bytes):
+                paid.append(n_bytes)
+
+        shard_id = next(
+            s for s in (0, 1)
+            if b.namespaces["default"].shards[s].flushed_block_starts)
+        res = peers_mod.repair_shard_block(
+            a, "default", shard_id, START,
+            [peers_mod.InProcessPeer(b)], pacer=Pacer())
+        assert res.repaired == 1
+        assert paid and all(n > 0 for n in paid)
+        for db in (a, b):
+            db.close()
+
+
+class TestReadPathDivergence:
+    def _cluster(self, tmp_path):
+        from m3_tpu.client.session import Session
+        from m3_tpu.cluster import placement as pl
+        from m3_tpu.cluster.placement import Instance
+        from m3_tpu.cluster.topology import ConsistencyLevel, TopologyMap
+
+        insts = [Instance("node-0"), Instance("node-1")]
+        p = pl.initial_placement(insts, n_shards=2, replica_factor=2)
+        nodes = {}
+        for inst in insts:
+            db = Database(str(tmp_path / inst.id),
+                          DatabaseOptions(n_shards=2))
+            db.create_namespace("default", opts())
+            db.open(START)
+            nodes[inst.id] = db
+        sess = Session(TopologyMap(p), nodes,
+                       write_consistency=ConsistencyLevel.MAJORITY,
+                       read_consistency=ConsistencyLevel.ONE)
+        return sess, nodes
+
+    def test_fetch_detects_divergence_and_reports(self, tmp_path):
+        from m3_tpu.utils.ident import tags_to_id
+
+        sess, nodes = self._cluster(tmp_path)
+        sess.write_tagged("default", b"cpu", [(b"h", b"1")], START + SEC, 1.0)
+        # one replica quietly holds an extra point (missed-write residue)
+        nodes["node-1"].write_tagged("default", b"cpu", [(b"h", b"1")],
+                                     START + 2 * SEC, 2.0)
+        hints = []
+        sess.divergence_sink = lambda *args: hints.append(args)
+        sid = tags_to_id(b"cpu", [(b"h", b"1")])
+        got = sess.fetch("default", sid, START, START + HOUR)
+        # the caller still gets the UNION (last-write-wins merge)
+        assert got == [(START + SEC, 1.0), (START + 2 * SEC, 2.0)]
+        assert hints == [("default", sess._shard(sid), START, START + HOUR)]
+        for db in nodes.values():
+            db.close()
+
+    def test_fetch_in_sync_is_silent(self, tmp_path):
+        from m3_tpu.utils.ident import tags_to_id
+
+        sess, nodes = self._cluster(tmp_path)
+        sess.write_tagged("default", b"cpu", [], START + SEC, 1.0)
+        hints = []
+        sess.divergence_sink = lambda *args: hints.append(args)
+        sess.fetch("default", tags_to_id(b"cpu", []), START, START + HOUR)
+        assert hints == []
+        for db in nodes.values():
+            db.close()
+
+    def test_fetch_many_flags_divergent_series_only(self, tmp_path):
+        from m3_tpu.utils.ident import tags_to_id
+
+        sess, nodes = self._cluster(tmp_path)
+        sids = []
+        for i in range(4):
+            tags = [(b"i", str(i).encode())]
+            sess.write_tagged("default", b"m", tags, START + SEC, float(i))
+            sids.append(tags_to_id(b"m", tags))
+        # two series diverge on one replica
+        for i in (1, 3):
+            nodes["node-0"].write_tagged(
+                "default", b"m", [(b"i", str(i).encode())],
+                START + 2 * SEC, 9.0)
+        hints = []
+        sess.divergence_sink = lambda *args: hints.append(args)
+        out = sess.fetch_many("default", sids, START, START + HOUR)
+        assert len(out) == 4
+        want_shards = {sess._shard(sids[1]), sess._shard(sids[3])}
+        assert {h[1] for h in hints} == want_shards
+        for db in nodes.values():
+            db.close()
+
+    def test_broken_sink_never_fails_the_read(self, tmp_path):
+        from m3_tpu.utils.ident import tags_to_id
+
+        sess, nodes = self._cluster(tmp_path)
+        sess.write_tagged("default", b"cpu", [], START + SEC, 1.0)
+        nodes["node-0"].write_tagged("default", b"cpu", [],
+                                     START + 2 * SEC, 2.0)
+
+        def bad_sink(*args):
+            raise RuntimeError("sink exploded")
+
+        sess.divergence_sink = bad_sink
+        sid = tags_to_id(b"cpu", [])
+        got = sess.fetch("default", sid, START, START + HOUR)
+        assert len(got) == 2  # read served despite the broken sink
+        for db in nodes.values():
+            db.close()
+
+    def test_reporter_posts_to_shard_replicas(self, tmp_path):
+        from m3_tpu.client.session import DivergenceReporter
+
+        posted = []
+
+        class Conn:
+            def repair_enqueue(self, namespace, shard, start_ns, end_ns):
+                posted.append((namespace, shard, start_ns, end_ns))
+
+        class Topo:
+            def hosts_for_shard(self, shard):
+                return ["node-0", "node-1"]
+
+        class Sess:
+            topology = Topo()
+            connections = {"node-0": Conn(), "node-1": Conn()}
+
+        reporter = DivergenceReporter(Sess())
+        reporter.submit("default", 1, START, START + HOUR)
+        import time as _time
+
+        deadline = _time.monotonic() + 5.0
+        while len(posted) < 2 and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert posted == [("default", 1, START, START + HOUR)] * 2
+        assert reporter.posted == 2
+        reporter.close()
+        reporter.submit("default", 0, START, START + HOUR)  # post-close noop
+        assert reporter.posted == 2
+
+
+class TestRepairHTTPSurface:
+    def test_rollup_enqueue_status_flush_roundtrip(self, tmp_path):
+        from m3_tpu.client.http_conn import HTTPNodeConnection
+        from m3_tpu.services.dbnode import NodeAPI
+        from m3_tpu.storage.peers import HTTPPeer
+
+        a = make_db(tmp_path, "a")
+        a.write_tagged("default", b"h", [], START + SEC, 1.0)
+        a.flush_all()
+        # unflushed residue for /debug/flush to persist
+        a.write_tagged("default", b"h2", [], START + 2 * SEC, 2.0)
+        api = NodeAPI(a)
+        api.repair = RepairDaemon(a, lambda: a.owned_shards, lambda s: [])
+        port = api.serve(host="127.0.0.1", port=0)
+        try:
+            url = f"http://127.0.0.1:{port}"
+            peer = HTTPPeer(url)
+            for shard_id in (0, 1):
+                assert peer.rollup_digests("default", shard_id) == \
+                    peers_mod.local_rollup_digests(a, "default", shard_id)
+            conn = HTTPNodeConnection(url)
+            assert conn.repair_enqueue("default", 0, START, START + HOUR)
+            assert not conn.repair_enqueue("default", 0, START,
+                                           START + HOUR)  # deduped
+            import json as _json
+            import urllib.request as _rq
+
+            with _rq.urlopen(f"{url}/debug/repair", timeout=10) as r:
+                doc = _json.loads(r.read().decode())
+            assert doc["queue_depth"] == 1
+            assert doc["options"]["interval_s"] == 30.0
+            assert doc["totals"]["cycles"] == 0
+            # /debug/flush persists the mutable buffer into the digests
+            before = sum(
+                len(peers_mod.local_rollup_digests(a, "default", s))
+                for s in (0, 1))
+            req = _rq.Request(f"{url}/debug/flush", data=b"{}",
+                              method="POST")
+            with _rq.urlopen(req, timeout=30) as r:
+                assert _json.loads(r.read().decode())["ok"]
+            after = sum(
+                sum(n for _d, n in
+                    peers_mod.local_rollup_digests(a, "default", s).values())
+                for s in (0, 1))
+            assert after >= before + 1
+        finally:
+            api.shutdown()
+            a.close()
+
+    def test_http_peer_timeout_configurable(self):
+        from m3_tpu.storage.peers import HTTPPeer
+
+        assert HTTPPeer("http://127.0.0.1:1").timeout == 10.0
+        assert HTTPPeer("http://127.0.0.1:1", timeout_s=2.5).timeout == 2.5
+
+
+class TestVolumeLifecycle:
+    def _diverged_pair(self, tmp_path):
+        a = make_db(tmp_path, "a")
+        b = make_db(tmp_path, "b")
+        for db in (a, b):
+            db.write_tagged("default", b"r", [], START + SEC, 1.0)
+        b.write_tagged("default", b"r", [], START + 2 * SEC, 2.0)
+        a.flush_all()
+        b.flush_all()
+        from m3_tpu.utils.ident import tags_to_id
+
+        sid = tags_to_id(b"r", [])
+        shard_id = a.namespaces["default"].shard_set.lookup(sid)
+        bs = a.namespaces["default"].opts.retention.block_start(START + SEC)
+        return a, b, sid, shard_id, bs
+
+    @staticmethod
+    def _volumes_on_disk(db, shard_id):
+        import glob
+        import os
+
+        shard = db.namespaces["default"].shards[shard_id]
+        d = os.path.join(shard.fs_root, "default", str(shard_id))
+        vols = set()
+        for p in glob.glob(os.path.join(d, "fileset-*-*-*.db")):
+            vols.add(int(os.path.basename(p).split("-")[2]))
+        return vols
+
+    def test_superseded_volume_deleted_after_retire_grace(self, tmp_path):
+        """Continuous repair must not leak disk: once the retire grace
+        passes, the superseded volume's FILES go with the reader."""
+        a, b, sid, shard_id, bs = self._diverged_pair(tmp_path)
+        shard = a.namespaces["default"].shards[shard_id]
+        res = peers_mod.repair_shard_block(
+            a, "default", shard_id, bs, [peers_mod.InProcessPeer(b)])
+        assert res.repaired == 1
+        # both volumes on disk while the old reader drains its grace
+        assert self._volumes_on_disk(a, shard_id) == {0, 1}
+        shard.RETIRE_GRACE_S = 0.0  # instance attr shadows the class
+        shard._drain_retired()
+        assert self._volumes_on_disk(a, shard_id) == {1}
+        # the repaired data still serves
+        dps = a.read("default", sid, START, START + HOUR)
+        assert [d.value for d in dps] == [1.0, 2.0]
+        for db in (a, b):
+            db.close()
+
+    def test_repeated_repairs_do_not_accumulate_volumes(self, tmp_path):
+        a, b, sid, shard_id, bs = self._diverged_pair(tmp_path)
+        shard = a.namespaces["default"].shards[shard_id]
+        shard.RETIRE_GRACE_S = 0.0
+        for round_no in range(3):
+            # make b newer each round so every repair writes a volume
+            b.write_tagged("default", b"r", [],
+                           START + (10 + round_no) * SEC, float(round_no))
+            b.flush_all()
+            peers_mod.repair_shard_block(
+                a, "default", shard_id, bs, [peers_mod.InProcessPeer(b)])
+            shard._drain_retired()
+            assert len(self._volumes_on_disk(a, shard_id)) == 1
+        for db in (a, b):
+            db.close()
+
+    def test_crash_leftover_volume_swept_by_expire(self, tmp_path):
+        """A node killed between the volume swap and the retired-reader
+        drain leaves a complete lower volume on disk; after restart the
+        expire sweep reclaims it (only the max volume ever bootstraps)."""
+        a, b, sid, shard_id, bs = self._diverged_pair(tmp_path)
+        peers_mod.repair_shard_block(
+            a, "default", shard_id, bs, [peers_mod.InProcessPeer(b)])
+        assert self._volumes_on_disk(a, shard_id) == {0, 1}
+        a.close()  # grace never elapsed: vol 0 files survive ("crash")
+        a2 = make_db(tmp_path, "a")
+        a2.open(START)
+        assert self._volumes_on_disk(a2, shard_id) == {0, 1}
+        shard = a2.namespaces["default"].shards[shard_id]
+        assert shard._filesets[bs].volume == 1  # max volume bootstrapped
+        shard.expire(bs)  # cutoff at bs: block retained, leftovers swept
+        assert self._volumes_on_disk(a2, shard_id) == {1}
+        dps = a2.read("default", sid, START, START + HOUR)
+        assert [d.value for d in dps] == [1.0, 2.0]
+        a2.close()
         b.close()

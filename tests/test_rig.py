@@ -331,6 +331,15 @@ class TestProcessRig:
                        for t in report["phase1"]["tenants"].values())
         assert warnings >= 1, report["phase1"]
 
+        # anti-entropy convergence: every replica pair reached
+        # per-(shard, block) rollup-digest equality within the repair
+        # cycle budget — driven by the nodes' continuous daemons, not by
+        # the rig invoking repair
+        conv = report["convergence"]
+        assert conv["converged"], conv
+        assert conv["replica_pairs"] > 0, conv
+        assert conv["cycles_used"] <= conv["budget_cycles"] * 2, conv
+
         # noisy-tenant isolation under a node kill: quota pushed through
         # the kvd metadata plane mid-run started shedding the noisy
         # tenant; the steady tenant held its SLO (pair-median p99 from

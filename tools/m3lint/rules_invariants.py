@@ -23,7 +23,11 @@ invariants) as rules 1-5 and adds three new ones:
 ``inv-crash-swallow``       no bare/broad ``except`` around a fault seam
                             that would swallow ``SimulatedCrash`` without
                             re-raising or escalating: a swallowed crash
-                            turns every chaos assertion into a lie
+                            turns every chaos assertion into a lie. Seams
+                            are found transitively through same-module
+                            calls (the peers.py bug class: the broad
+                            except wraps an RPC helper whose
+                            ``faults.check`` lives one call down)
 
 The fixed-project-file rules (tracepoints, exemplars, exporter,
 admission) run in whole-tree mode only; the fault-seam, catalog, and
@@ -334,30 +338,90 @@ def _mentions_crash(node: ast.AST) -> bool:
     return False
 
 
-def _body_has_seam(stmts: list[ast.stmt]) -> bool:
+def _call_name(call: ast.Call) -> str | None:
+    fn = call.func
+    return fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None)
+
+
+def _is_direct_seam(call: ast.Call) -> bool:
+    attr = _call_name(call)
+    if attr not in ("check", "torn_write", "wrap_io"):
+        return False
+    owner = getattr(call.func, "value", None)
+    if isinstance(owner, ast.Name) and owner.id == "faults":
+        return True
+    return attr in ("torn_write", "wrap_io")
+
+
+# object-protocol names too generic to resolve by name: `q.get()`,
+# `event.set()`, `channel.close()` would otherwise match any same-module
+# seam-bearing `def get/set/close` (a queue is not a KV server). Calls to
+# these are never chased; the direct-seam check still covers their
+# bodies where it matters.
+_GENERIC_NAMES = frozenset({
+    "get", "set", "put", "pop", "close", "open", "read", "write", "flush",
+    "send", "recv", "start", "stop", "run", "join", "wait", "clear", "add",
+    "append", "update", "remove", "discard", "items", "keys", "values",
+    "copy", "encode", "decode", "acquire", "release", "submit", "result",
+    "cancel", "done", "next",
+})
+
+
+def _body_has_seam(stmts: list[ast.stmt],
+                   seam_names: frozenset[str] = frozenset()) -> bool:
+    """True when the statements reach a fault seam — directly
+    (``faults.check``/``torn_write``/``wrap_io``) or through a call to a
+    same-module callable whose body reaches one (``seam_names``, from
+    `_seam_bearing_names`)."""
     for stmt in stmts:
         for sub in ast.walk(stmt):
             if isinstance(sub, ast.Call):
-                fn = sub.func
-                attr = fn.attr if isinstance(fn, ast.Attribute) else (
-                    fn.id if isinstance(fn, ast.Name) else None)
-                if attr in ("check", "torn_write", "wrap_io"):
-                    owner = getattr(fn, "value", None)
-                    if isinstance(owner, ast.Name) and owner.id == "faults":
-                        return True
-                    if attr in ("torn_write", "wrap_io"):
-                        return True
+                if _is_direct_seam(sub):
+                    return True
+                if _call_name(sub) in seam_names:
+                    return True
     return False
+
+
+def _seam_bearing_names(mod: Module) -> frozenset[str]:
+    """Names of this module's functions/methods whose bodies reach a
+    fault seam, transitively through same-module calls (fixpoint — the
+    concurrency family's intra-module call chasing, applied to crash
+    propagation). Matching is by terminal name, so ``peer.block_starts()``
+    resolves to any same-module ``def block_starts`` — the cross-function
+    bug class where ``except Exception`` wraps an RPC helper whose seam
+    lives one call down (storage/peers.py's bootstrap/metadata/stream
+    loops around the ``peer.http`` seam)."""
+    defs: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name not in _GENERIC_NAMES:
+            defs.setdefault(node.name, []).append(node)
+    seam: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, fns in defs.items():
+            if name in seam:
+                continue
+            for fn in fns:
+                if _body_has_seam(fn.body, frozenset(seam)):
+                    seam.add(name)
+                    changed = True
+                    break
+    return frozenset(seam)
 
 
 def _check_crash_swallow(proj: Project):
     for mod in proj.modules:
         if mod.rel in EXEMPT:
             continue
+        seam_names = _seam_bearing_names(mod)
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Try):
                 continue
-            if not _body_has_seam(node.body):
+            if not _body_has_seam(node.body, seam_names):
                 continue
             crash_handled_earlier = False
             for h in node.handlers:
