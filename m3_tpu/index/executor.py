@@ -116,17 +116,52 @@ def search_segment(seg: Segment, query: Query) -> np.ndarray:
 
 def search(segments: list[Segment], query: Query, limit: int | None = None):
     """Execute over segments; yields (series_id, fields) deduped by series
-    (later segments win nothing — first hit is kept)."""
+    (later segments win nothing — first hit is kept).
+
+    Batched the way the data half of fetch_tagged is (read_many's
+    "cache hits never enter the batch" discipline): per segment, the
+    matched series ids come out of the id blob in bulk passes
+    (series_ids_at — no Document construction), cross-segment duplicates
+    are filtered on those cheap ids, and only the fresh winners pay
+    Document materialization (docs_at — the tag decode). A series
+    matched in B overlapping blocks costs one tag decode, not B. With a
+    limit, the id passes are chunked to a multiple of the remaining
+    budget so a limit-10 query over a million matches stays O(limit),
+    not O(matches), like the per-doc loop it replaced."""
     seen: set[bytes] = set()
-    out = []
+    out: list = []
     for seg in segments:
         ids = search_segment(seg, query)
-        for doc_id in ids:
-            doc = seg.docs[int(doc_id)]
-            if doc.series_id in seen:
+        ids_of = getattr(seg, "series_ids_at", None)
+        docs_of = getattr(seg, "docs_at", None)
+        pos = 0
+        while pos < len(ids):
+            if limit is None:
+                chunk = ids[pos:]
+            else:
+                chunk = ids[pos:pos + max(64, 4 * (limit - len(out)))]
+            pos += len(chunk)
+            if ids_of is None:  # minimal test doubles: per-doc path
+                docs = seg.docs
+                sids = [docs[int(i)].series_id for i in chunk]
+            else:
+                sids = ids_of(chunk)
+            fresh: list[int] = []
+            for i, sid in enumerate(sids):
+                if sid in seen:
+                    continue
+                seen.add(sid)
+                fresh.append(i)
+                if limit is not None and len(out) + len(fresh) >= limit:
+                    break
+            if not fresh:
                 continue
-            seen.add(doc.series_id)
-            out.append(doc)
+            take = chunk[np.asarray(fresh, np.intp)]
+            if docs_of is None:
+                docs = seg.docs
+                out.extend(docs[int(i)] for i in take)
+            else:
+                out.extend(docs_of(take))
             if limit is not None and len(out) >= limit:
                 return out
     return out

@@ -144,6 +144,28 @@ class PackedSegment:
         blob = self._sid_blob
         return [bytes(blob[off[i] : off[i + 1]]) for i in range(self.n_docs)]
 
+    def series_ids_at(self, doc_ids) -> list[bytes]:
+        """Series ids for many doc ids in one pass over the id blob — no
+        Document construction, no tag decode. The executor's batched
+        search dedups on these BEFORE paying any tag decode."""
+        off = self._sid_off
+        blob = self._sid_blob
+        return [bytes(blob[off[i]: off[i + 1]])
+                for i in np.asarray(doc_ids, np.int64).tolist()]
+
+    def docs_at(self, doc_ids) -> list[Document]:
+        """Documents for many doc ids in one pass (the batched twin of
+        the per-doc _LazyDocs facade: local offset/blob bindings, one tag
+        decode per requested doc)."""
+        sid_off, sid_blob = self._sid_off, self._sid_blob
+        tag_off, tag_blob = self._tag_off, self._tag_blob
+        out = []
+        for i in np.asarray(doc_ids, np.int64).tolist():
+            sid = bytes(sid_blob[sid_off[i]: sid_off[i + 1]])
+            tags = decode_tags(bytes(tag_blob[tag_off[i]: tag_off[i + 1]]))
+            out.append(Document(i, sid, tags))
+        return out
+
     @property
     def _vocab_clean(self) -> bool:
         """Vocab is regex-scannable iff no term contains a newline. Computed

@@ -82,6 +82,19 @@ class Segment:
         """Every doc's series id (membership-set building, no field walk)."""
         return [d.series_id for d in self.docs]
 
+    # batched doc surfaces (executor.search): docs here are materialized
+    # objects, so bulk access is plain indexing — the methods exist to
+    # share one contract with PackedSegment, whose lazy docs make the
+    # split (ids first, docs only for dedup winners) actually cheap
+
+    def series_ids_at(self, doc_ids) -> list[bytes]:
+        docs = self.docs
+        return [docs[int(i)].series_id for i in doc_ids]
+
+    def docs_at(self, doc_ids) -> list[Document]:
+        docs = self.docs
+        return [docs[int(i)] for i in doc_ids]
+
     def field_names(self) -> list[bytes]:
         return sorted(self._fields)
 

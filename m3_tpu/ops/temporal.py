@@ -10,6 +10,14 @@ All kernels take ragged sample arrays padded to a power of two (values
 pad 0.0, so prefix sums are unaffected; lo/hi indices never reach pads)
 and a [S, steps] lo/hi bound pair. ``query.windows`` dispatches here via
 ``utils.dispatch`` and keeps numpy as the flag-off fallback.
+
+The math bodies live in the module-level ``stage_*`` functions: PURE
+traced functions of jax arrays with no dispatch, padding or host logic.
+The per-op jitted wrappers below (``_kernels``) and the whole-query
+compiler (``query/compiler.py``, ROADMAP #2) compose the SAME stage
+functions — op-by-op dispatch and whole-plan fusion share one
+implementation, so a plan fused end-to-end cannot drift numerically from
+the per-op kernels it replaced.
 """
 
 from __future__ import annotations
@@ -36,79 +44,153 @@ def _pad_samples(values: np.ndarray, times: np.ndarray | None = None):
     return v, t
 
 
+# ---------------------------------------------------------------------------
+# pure traced stage kernels (composable: see module doc)
+# ---------------------------------------------------------------------------
+
+
+def stage_sum_avg_std(v, lo, hi):
+    """(count, s1, s2) per window via prefix sums (pads are 0.0, so the
+    cumsum tail never changes a window that ends before the pad)."""
+    import jax.numpy as jnp
+
+    csum = jnp.concatenate([jnp.zeros(1), jnp.cumsum(v)])
+    csq = jnp.concatenate([jnp.zeros(1), jnp.cumsum(v * v)])
+    count = (hi - lo).astype(jnp.float64)
+    return count, csum[hi] - csum[lo], csq[hi] - csq[lo]
+
+
+def stage_instant_values(v, lo, hi):
+    """Latest sample per (series, step) window, NaN when empty — the
+    PromQL lookback/staleness gather."""
+    import jax.numpy as jnp
+
+    has = hi > lo
+    idx = jnp.clip(hi - 1, 0, v.shape[0] - 1)
+    return jnp.where(has, v[idx], jnp.nan)
+
+
+def stage_over_time(fn: str, csum, lo, hi):
+    """sum/avg/count/present_over_time matrices with the NaN-when-empty
+    masking of windows.over_time — ``fn`` is a trace-time constant.
+
+    ``csum`` is the [n+1] sample prefix-sum array, computed on HOST like
+    the window bounds (np.cumsum — the exact array windows._window_sums
+    gathers from, so the fused path is bit-identical to the interpreter
+    here; XLA:CPU's own cumsum is also an order of magnitude slower than
+    numpy's, see the whole-query compiler's host-prep note)."""
+    import jax.numpy as jnp
+
+    count = (hi - lo).astype(jnp.float64)
+    empty = count == 0
+    if fn == "count":
+        return jnp.where(empty, jnp.nan, count)
+    if fn == "present":
+        return jnp.where(empty, jnp.nan, 1.0)
+    s1 = csum[hi] - csum[lo]
+    if fn == "sum":
+        return jnp.where(empty, jnp.nan, s1)
+    if fn == "avg":
+        return jnp.where(empty, jnp.nan, s1 / jnp.where(empty, 1, count))
+    raise ValueError(f"unknown composable over_time fn {fn}")
+
+
+def stage_extrapolated_rate(v, adj, t, lo, hi, eval_ts, range_ns,
+                            is_counter: bool, is_rate: bool):
+    """Mirrors upstream promql extrapolatedRate (windows.py host path).
+
+    Known deviation: XLA may reassociate (sampled/count)*1.1 when
+    computing the extrapolation threshold, so a window whose edge gap
+    EXACTLY equals the threshold (possible only with perfectly regular
+    sample spacing) can take the other extrapolation branch than the
+    numpy path. Both branches are valid upstream-Prometheus behavior;
+    off the knife edge the paths agree bit-for-bit on exact inputs."""
+    import jax.numpy as jnp
+
+    n = v.shape[0]
+    count = (hi - lo).astype(jnp.float64)
+    ok = count >= 2
+    safe_lo = jnp.clip(lo, 0, n - 1)
+    safe_hi = jnp.clip(hi - 1, 0, n - 1)
+    first_v = adj[safe_lo]
+    last_v = adj[safe_hi]
+    raw_first_v = v[safe_lo]
+    first_t = t[safe_lo].astype(jnp.float64)
+    last_t = t[safe_hi].astype(jnp.float64)
+    result = last_v - first_v
+
+    window_start = (eval_ts - range_ns).astype(jnp.float64)[None, :]
+    window_end = eval_ts.astype(jnp.float64)[None, :]
+    sampled = (last_t - first_t) / NS
+    dur_to_start = (first_t - window_start) / NS
+    dur_to_end = (window_end - last_t) / NS
+    avg_between = sampled / jnp.maximum(count - 1, 1)
+    threshold = avg_between * 1.1
+
+    if is_counter:
+        dur_to_zero = jnp.where(
+            result > 0, sampled * (raw_first_v / result), jnp.inf
+        )
+        dur_to_start = jnp.where(
+            (result > 0) & (raw_first_v >= 0) & (dur_to_zero < dur_to_start),
+            dur_to_zero,
+            dur_to_start,
+        )
+
+    dur_to_start = jnp.where(dur_to_start >= threshold, avg_between / 2,
+                             dur_to_start)
+    dur_to_end = jnp.where(dur_to_end >= threshold, avg_between / 2,
+                           dur_to_end)
+
+    extrap = sampled + dur_to_start + dur_to_end
+    factor = jnp.where(sampled > 0, extrap / sampled, jnp.nan)
+    out = result * factor
+    if is_rate:
+        out = out / (range_ns / NS)
+    return jnp.where(ok & (sampled > 0), out, jnp.nan)
+
+
+def stage_instant_delta(v, t, lo, hi, is_counter: bool, is_rate: bool):
+    """irate/idelta from the last two samples in each window
+    (windows.instant_delta host math)."""
+    import jax.numpy as jnp
+
+    n = v.shape[0]
+    ok = (hi - lo) >= 2
+    i_last = jnp.clip(hi - 1, 0, n - 1)
+    i_prev = jnp.clip(hi - 2, 0, n - 1)
+    v_last, v_prev = v[i_last], v[i_prev]
+    t_last = t[i_last].astype(jnp.float64)
+    t_prev = t[i_prev].astype(jnp.float64)
+    diff = v_last - v_prev
+    if is_counter:
+        diff = jnp.where(v_last < v_prev, v_last, diff)
+    out = diff
+    if is_rate:
+        dt = (t_last - t_prev) / NS
+        out = jnp.where(dt > 0, diff / dt, jnp.nan)
+    return jnp.where(ok, out, jnp.nan)
+
+
+def stage_reset_adjusted(v, is_first, row_start_index):
+    """Counter monotonization: v + cumulative in-row reset drops.
+    row_start_index[i] = index of sample i's row's first sample."""
+    import jax.numpy as jnp
+
+    prev = jnp.concatenate([jnp.zeros(1), v[:-1]])
+    drop = jnp.where((v < prev) & ~is_first, prev, 0.0)
+    cdrop = jnp.cumsum(drop)
+    cdrop0 = jnp.concatenate([jnp.zeros(1), cdrop])
+    row_base = cdrop0[row_start_index]
+    return v + (cdrop - row_base)
+
+
 @functools.lru_cache(maxsize=None)
 def _kernels():
     import jax
     import jax.numpy as jnp
 
     import m3_tpu.ops  # noqa: F401  (x64)
-
-    @jax.jit
-    def sum_avg_std(v, lo, hi):
-        """(count, s1, s2) per window in one fused program."""
-        csum = jnp.concatenate([jnp.zeros(1), jnp.cumsum(v)])
-        csq = jnp.concatenate([jnp.zeros(1), jnp.cumsum(v * v)])
-        count = (hi - lo).astype(jnp.float64)
-        return count, csum[hi] - csum[lo], csq[hi] - csq[lo]
-
-    @jax.jit
-    def instant_values(v, lo, hi):
-        has = hi > lo
-        idx = jnp.clip(hi - 1, 0, v.shape[0] - 1)
-        return jnp.where(has, v[idx], jnp.nan)
-
-    @functools.partial(jax.jit, static_argnames=("is_counter", "is_rate"))
-    def extrapolated_rate(v, adj, t, lo, hi, eval_ts, range_ns,
-                          is_counter, is_rate):
-        """Mirrors upstream promql extrapolatedRate (windows.py host path).
-
-        Known deviation: XLA may reassociate (sampled/count)*1.1 when
-        computing the extrapolation threshold, so a window whose edge gap
-        EXACTLY equals the threshold (possible only with perfectly regular
-        sample spacing) can take the other extrapolation branch than the
-        numpy path. Both branches are valid upstream-Prometheus behavior;
-        off the knife edge the paths agree bit-for-bit on exact inputs."""
-        n = v.shape[0]
-        count = (hi - lo).astype(jnp.float64)
-        ok = count >= 2
-        safe_lo = jnp.clip(lo, 0, n - 1)
-        safe_hi = jnp.clip(hi - 1, 0, n - 1)
-        first_v = adj[safe_lo]
-        last_v = adj[safe_hi]
-        raw_first_v = v[safe_lo]
-        first_t = t[safe_lo].astype(jnp.float64)
-        last_t = t[safe_hi].astype(jnp.float64)
-        result = last_v - first_v
-
-        window_start = (eval_ts - range_ns).astype(jnp.float64)[None, :]
-        window_end = eval_ts.astype(jnp.float64)[None, :]
-        sampled = (last_t - first_t) / NS
-        dur_to_start = (first_t - window_start) / NS
-        dur_to_end = (window_end - last_t) / NS
-        avg_between = sampled / jnp.maximum(count - 1, 1)
-        threshold = avg_between * 1.1
-
-        if is_counter:
-            dur_to_zero = jnp.where(
-                result > 0, sampled * (raw_first_v / result), jnp.inf
-            )
-            dur_to_start = jnp.where(
-                (result > 0) & (raw_first_v >= 0) & (dur_to_zero < dur_to_start),
-                dur_to_zero,
-                dur_to_start,
-            )
-
-        dur_to_start = jnp.where(dur_to_start >= threshold, avg_between / 2,
-                                 dur_to_start)
-        dur_to_end = jnp.where(dur_to_end >= threshold, avg_between / 2,
-                               dur_to_end)
-
-        extrap = sampled + dur_to_start + dur_to_end
-        factor = jnp.where(sampled > 0, extrap / sampled, jnp.nan)
-        out = result * factor
-        if is_rate:
-            out = out / (range_ns / NS)
-        return jnp.where(ok & (sampled > 0), out, jnp.nan)
 
     @functools.partial(jax.jit, static_argnames=("max_len",))
     def holt_winters(v, lo, hi, sf, tf, max_len):
@@ -148,23 +230,14 @@ def _kernels():
         _ff, fs, _p, curr, _tr, _i = jax.lax.fori_loop(0, max_len, body, init)
         return jnp.where(fs, curr, jnp.nan)
 
-    @jax.jit
-    def reset_adjusted(v, is_first, row_start_index):
-        """Counter monotonization: v + cumulative in-row reset drops.
-        row_start_index[i] = index of sample i's row's first sample."""
-        prev = jnp.concatenate([jnp.zeros(1), v[:-1]])
-        drop = jnp.where((v < prev) & ~is_first, prev, 0.0)
-        cdrop = jnp.cumsum(drop)
-        cdrop0 = jnp.concatenate([jnp.zeros(1), cdrop])
-        row_base = cdrop0[row_start_index]
-        return v + (cdrop - row_base)
-
     return {
-        "sum_avg_std": sum_avg_std,
-        "instant_values": instant_values,
-        "extrapolated_rate": extrapolated_rate,
+        "sum_avg_std": jax.jit(stage_sum_avg_std),
+        "instant_values": jax.jit(stage_instant_values),
+        "extrapolated_rate": jax.jit(
+            stage_extrapolated_rate,
+            static_argnames=("is_counter", "is_rate")),
         "holt_winters": holt_winters,
-        "reset_adjusted": reset_adjusted,
+        "reset_adjusted": jax.jit(stage_reset_adjusted),
     }
 
 
@@ -190,6 +263,19 @@ def _pad_eval_ts(eval_ts: np.ndarray) -> np.ndarray:
         return eval_ts
     fill = eval_ts[-1] if T else 0
     return np.concatenate([eval_ts, np.full(Tp - T, fill, np.int64)])
+
+
+def reset_adjust_inputs(offsets: np.ndarray, n: int, n_padded: int):
+    """(is_first, row_start_index) arrays for stage_reset_adjusted over a
+    CSR sample array padded from n to n_padded (pads form their own row)."""
+    is_first = np.zeros(n_padded, bool)
+    is_first[offsets[:-1][offsets[:-1] < n]] = True
+    row_id = np.repeat(np.arange(len(offsets) - 1), np.diff(offsets))  # [n]
+    row_start = np.full(n_padded, n, np.int64)
+    row_start[:n] = offsets[:-1][row_id]
+    if n_padded > n:
+        is_first[n] = True
+    return is_first, row_start
 
 
 def instant_values(values: np.ndarray, lo: np.ndarray, hi: np.ndarray):
@@ -247,13 +333,6 @@ def reset_adjusted(values: np.ndarray, offsets: np.ndarray) -> np.ndarray:
     if n == 0:
         return values
     v, _ = _pad_samples(values)
-    N = len(v)
-    is_first = np.zeros(N, bool)
-    is_first[offsets[:-1][offsets[:-1] < n]] = True
-    row_id = np.repeat(np.arange(len(offsets) - 1), np.diff(offsets))  # [n]
-    row_start = np.full(N, n, np.int64)  # pads form their own "row"
-    row_start[:n] = offsets[:-1][row_id]
-    if N > n:
-        is_first[n] = True
+    is_first, row_start = reset_adjust_inputs(offsets, n, len(v))
     out = _kernels()["reset_adjusted"](v, is_first, row_start)
     return np.asarray(out)[:n]

@@ -196,6 +196,82 @@ def _aggregate_groups_device(elem_ids, window_ids, values, order_seq, times):
     return es[group_start[:G]], ws[group_start[:G]], stats, vq[:n], offsets
 
 
+# ---------------------------------------------------------------------------
+# pure traced stage kernels over [S, T] value matrices
+# ---------------------------------------------------------------------------
+#
+# The whole-query compiler (query/compiler.py, ROADMAP #2) composes these
+# into its fused per-plan XLA program: PromQL `by`/`without` aggregations
+# over a [series, steps] matrix with the exact NaN semantics of
+# Engine._eval_aggregate (count counts non-NaN; empty groups are NaN).
+# ``seg`` maps each series row to its group id; ``num_groups`` is a
+# trace-time constant (the compiler's group-count bucket).
+
+
+def stage_grouped_reduce(op: str, vals, seg, num_groups: int):
+    """sum/avg/min/max/count over groups of rows; [num_groups, T] out."""
+    import jax
+    import jax.numpy as jnp
+
+    nan = jnp.isnan(vals)
+    count = jax.ops.segment_sum((~nan).astype(jnp.float64), seg,
+                                num_segments=num_groups)
+    any_present = count > 0
+    if op == "count":
+        out = count
+    elif op in ("sum", "avg"):
+        s1 = jax.ops.segment_sum(jnp.where(nan, 0.0, vals), seg,
+                                 num_segments=num_groups)
+        out = s1 if op == "sum" else s1 / jnp.where(any_present, count, 1)
+    elif op == "min":
+        out = jax.ops.segment_min(jnp.where(nan, jnp.inf, vals), seg,
+                                  num_segments=num_groups)
+    elif op == "max":
+        out = jax.ops.segment_max(jnp.where(nan, -jnp.inf, vals), seg,
+                                  num_segments=num_groups)
+    else:
+        raise ValueError(f"unknown grouped reduce op {op}")
+    return jnp.where(any_present, out, jnp.nan)
+
+
+def stage_grouped_quantile(vals, seg, num_groups: int, phi):
+    """Prometheus-interpolated quantile per (group, step), NaN-aware.
+
+    One grouped sort per step column (rows ordered (group, value), NaN
+    last within each group — the jnp sort order matches numpy's) and a
+    rank-interpolating gather, mirroring Engine._quantile_cols: empty
+    (group, step) -> NaN, phi < 0 -> -inf, phi > 1 -> +inf."""
+    import jax
+    import jax.numpy as jnp
+
+    S = vals.shape[0]
+    T = vals.shape[1]
+    sizes = jax.ops.segment_sum(jnp.ones(S), seg, num_segments=num_groups)
+    starts = jnp.concatenate(
+        [jnp.zeros(1), jnp.cumsum(sizes)])[:-1].astype(jnp.int64)  # [G]
+    # one 2-D lexsort down the columns: primary key seg, ties by value,
+    # NaN last within each group (jnp float sort order matches numpy's)
+    order = jnp.lexsort(
+        (vals, jnp.broadcast_to(seg[:, None], vals.shape)), axis=0)
+    sorted_cols = jnp.take_along_axis(vals, order, axis=0)
+    cnt = jax.ops.segment_sum((~jnp.isnan(vals)).astype(jnp.float64), seg,
+                              num_segments=num_groups)  # [G, T]
+    present = cnt > 0
+    rank = jnp.where(present, phi * (cnt - 1), 0.0)
+    rank_lo = jnp.floor(rank)
+    i_lo = jnp.clip(rank_lo.astype(jnp.int64), 0, S - 1)
+    i_hi = jnp.clip(jnp.minimum(i_lo + 1, cnt.astype(jnp.int64) - 1),
+                    0, S - 1)
+    cols = jnp.arange(T)[None, :]
+    base = starts[:, None]
+    v0 = sorted_cols[jnp.clip(base + i_lo, 0, S - 1), cols]
+    v1 = sorted_cols[jnp.clip(base + i_hi, 0, S - 1), cols]
+    out = v0 + (rank - rank_lo) * (v1 - v0)
+    out = jnp.where(phi < 0, -jnp.inf, out)
+    out = jnp.where(phi > 1, jnp.inf, out)
+    return jnp.where(present, out, jnp.nan)
+
+
 def group_quantiles(vq: np.ndarray, offsets: np.ndarray, q: float) -> np.ndarray:
     """Interpolated quantile per group from grouped-sorted values.
 

@@ -188,10 +188,16 @@ def _render_metrics(q, headers):
 class CoordinatorAPI:
     """HTTP facade over a Database + PromQL Engine."""
 
-    def __init__(self, db, namespace: str = "default", limits=None):
+    def __init__(self, db, namespace: str = "default", limits=None,
+                 query_compile: bool = False):
         self.db = db
         self.namespace = namespace
-        self.engine = Engine(db, namespace, limits=limits)
+        # whole-query compilation default for every engine this API
+        # builds (config `query: compile:`; M3_TPU_QUERY_COMPILE is the
+        # per-process escape hatch either way)
+        self.query_compile = bool(query_compile)
+        self.engine = Engine(db, namespace, limits=limits,
+                             query_compile=self.query_compile)
         self._server: ThreadingHTTPServer | None = None
         # optional DownsamplerAndWriter: ingest then fans out through the
         # embedded downsampler (coordinator service wiring)
@@ -234,7 +240,8 @@ class CoordinatorAPI:
                         if key != self.namespace:
                             del self._engines[key]
                             break
-                eng = self._engines[namespace] = Engine(self.db, namespace)
+                eng = self._engines[namespace] = Engine(
+                    self.db, namespace, query_compile=self.query_compile)
         return eng
 
     def _write(self, name: bytes, tags, t_ns: int, value: float):

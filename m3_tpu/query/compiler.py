@@ -1,0 +1,551 @@
+"""Whole-query compilation: fuse a resolved PromQL plan into ONE XLA
+program per plan shape (ROADMAP #2).
+
+The interpreter (`Engine._eval`) walks the expression tree op by op —
+decode, range function, aggregation and binary ops each pay their own
+dispatch ladder and materialize a host-side intermediate between stages.
+Following PAPERS.md "Automatic Full Compilation of Julia Programs and ML
+Models to Cloud TPUs" (compile the whole program, not the ops), this
+module lowers a covered plan — selector → range function → by/without
+aggregation → scalar binary ops — into a single traced/jit'd program
+composed from the SAME pure stage kernels the per-op device path uses
+(`ops/temporal.stage_*`, `ops/windowed_agg.stage_grouped_*`), so decoded
+columns stay on device across stages and the XLA/native/scalar dispatch
+decision moves from per-op to per-plan.
+
+Covered plan shapes (the high-traffic core; everything else falls back
+to the interpreter, counted, never an error):
+
+  base:   vector selector (instant lookback gather), or
+          rate/increase/delta/irate/idelta(sel[range]), or
+          avg/sum/count/present_over_time(sel[range])
+  over:   any chain of sum/avg/min/max/count/quantile `by`/`without`
+          aggregations (at most one) and scalar-literal binary
+          arithmetic (+ - * / % ^), in any order
+
+Plan-shape cache: compiled programs are cached per plan SIGNATURE (the
+op sequence) by an ``functools.lru_cache`` factory — the m3lint-blessed
+keyed-cache idiom, so ``jax.jit`` is constructed once per signature, not
+per call — and jax's own executable cache buckets the (series count,
+step count, group count) axes, which the host prep pads to half-octave
+buckets (`dispatch.next_bucket`: the smallest of {2^k, 3*2^(k-1)} that
+fits). Recompiles are therefore bounded by
+O(signatures x log S x log T x log G). An explicit bounded LRU
+(`_PLAN_CACHE`) tracks every (signature, bucket) key served; hit/miss is
+the jit tracker's executable-cache ground truth (not LRU membership) and
+feeds the per-plan-shape counters and the `?explain=analyze` surface.
+
+Numeric parity: stage math is shared with the per-op kernels and mirrors
+the interpreter formula-for-formula; results are element-identical up to
+XLA reassociation (prefix sums, segment-sum accumulation order — last-ulp
+differences) and the documented extrapolation-threshold knife edge in
+``stage_extrapolated_rate``. The seeded property sweep in
+tests/test_query_compile.py enforces this envelope.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import sys
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import numpy as np
+
+from m3_tpu.query.promql import (
+    AggregateExpr,
+    BinaryExpr,
+    Call,
+    Expr,
+    MatrixSelector,
+    NumberLiteral,
+    VectorSelector,
+)
+from m3_tpu.utils import dispatch
+
+# range-function bases: name -> (is_counter, is_rate)
+_EXTRAP = {"rate": (True, True), "increase": (True, False),
+           "delta": (False, False)}
+_INSTANT = {"irate": (True, True), "idelta": (False, False)}
+_OVER_TIME = {"avg_over_time": "avg", "sum_over_time": "sum",
+              "count_over_time": "count", "present_over_time": "present"}
+_AGG_OPS = {"sum", "avg", "min", "max", "count", "quantile"}
+_BIN_OPS = {"+", "-", "*", "/", "%", "^"}
+
+# bound on distinct (signature, bucket) keys tracked; jit programs are
+# cached per signature below (the buckets share one traced callable)
+_PLAN_CACHE_CAP = 128
+_PROGRAM_CACHE_CAP = 64
+
+
+@dataclass
+class PlanSpec:
+    """A matched, compilable plan."""
+
+    selector: VectorSelector
+    range_ns: int                 # 0 for an instant-selector base
+    base: str                     # "instant" | range-function name
+    stages: tuple                 # inner->outer ("bin", op, swapped, value)
+    #                             # | ("agg", op, grouping, without, phi)
+    nodes: tuple                  # AST nodes outer->inner for EXPLAIN
+
+    @property
+    def sig(self) -> tuple:
+        """Program signature: exactly what changes the traced callable
+        (ops + sides), never the data (scalars, phi, grouping labels)."""
+        return (self.base, tuple(
+            (st[0], st[1], st[2]) if st[0] == "bin" else (st[0], st[1])
+            for st in self.stages))
+
+    @property
+    def sig_str(self) -> str:
+        parts = [self.base]
+        for st in self.stages:
+            if st[0] == "bin":
+                parts.append(f"bin:{st[1]}:{'r' if st[2] else 'l'}")
+            else:
+                parts.append(f"agg:{st[1]}")
+        return "|".join(parts)
+
+
+def _scalar_literal(e: Expr) -> float | None:
+    """The float of a (possibly sign-wrapped) number literal, else None —
+    the parser spells -1.5 as UnaryExpr('-', NumberLiteral(1.5))."""
+    from m3_tpu.query.promql import UnaryExpr
+
+    if isinstance(e, NumberLiteral):
+        return float(e.value)
+    if isinstance(e, UnaryExpr) and isinstance(e.expr, NumberLiteral):
+        v = float(e.expr.value)
+        return -v if e.op == "-" else v
+    return None
+
+
+def match(expr: Expr) -> PlanSpec | None:
+    """PlanSpec when the expression is a covered chain, else None."""
+    outer = []   # outer->inner stage list
+    nodes = []
+    e = expr
+    while True:
+        if isinstance(e, BinaryExpr) and e.op in _BIN_OPS \
+                and not e.bool_mode:
+            lhs_lit = _scalar_literal(e.lhs)
+            rhs_lit = _scalar_literal(e.rhs)
+            if lhs_lit is not None:
+                swapped, scalar, inner = True, lhs_lit, e.rhs
+            elif rhs_lit is not None:
+                swapped, scalar, inner = False, rhs_lit, e.lhs
+            else:
+                return None
+            outer.append(("bin", e.op, swapped, scalar))
+            nodes.append(e)
+            e = inner
+            continue
+        if isinstance(e, AggregateExpr) and e.op in _AGG_OPS:
+            if any(st[0] == "agg" for st in outer):
+                return None  # one aggregation per compiled chain
+            phi = None
+            if e.op == "quantile":
+                phi = _scalar_literal(e.param)
+                if phi is None:
+                    return None
+            elif e.param is not None:
+                return None
+            outer.append(("agg", e.op, tuple(e.grouping), bool(e.without),
+                          phi))
+            nodes.append(e)
+            e = e.expr
+            continue
+        break
+    if isinstance(e, VectorSelector):
+        if getattr(e, "at_ns", None) in ("start", "end"):
+            return None  # unresolved sentinel: not a compilable instant
+        sel, range_ns, base = e, 0, "instant"
+        nodes.append(e)
+    elif isinstance(e, Call) and (
+            e.func in _EXTRAP or e.func in _INSTANT or e.func in _OVER_TIME) \
+            and len(e.args) == 1 and isinstance(e.args[0], MatrixSelector):
+        sel = e.args[0].selector
+        if getattr(sel, "at_ns", None) in ("start", "end"):
+            return None
+        range_ns, base = e.args[0].range_ns, e.func
+        nodes.append(e)
+        nodes.append(e.args[0])
+    else:
+        return None
+    # execution order is inner->outer
+    return PlanSpec(selector=sel, range_ns=range_ns, base=base,
+                    stages=tuple(reversed(outer)), nodes=tuple(nodes))
+
+
+# ---------------------------------------------------------------------------
+# program factory (the per-plan jit dispatcher)
+# ---------------------------------------------------------------------------
+
+
+def _apply_scalar_op(op: str, a, b):
+    """jnp twin of engine._apply_op restricted to arithmetic."""
+    import jax.numpy as jnp
+
+    if op == "+":
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    if op == "/":
+        return a / b
+    if op == "%":
+        return jnp.fmod(a, b)
+    if op == "^":
+        return jnp.power(a, b)
+    raise ValueError(f"unknown scalar op {op}")
+
+
+@functools.lru_cache(maxsize=_PROGRAM_CACHE_CAP)
+def _program(sig: tuple):
+    """ONE jit'd whole-plan callable per signature (the blessed lru_cache
+    factory idiom — see tools/m3lint rules_jax): shape buckets reuse it
+    through jax's own executable cache."""
+    import jax
+    import jax.numpy as jnp
+
+    from m3_tpu.ops import temporal, windowed_agg
+
+    base, stages = sig
+
+    def run(v, adj, t, csum, lo, hi, eval_ts, range_ns, seg,
+            phi, scalars, num_groups: int):
+        if base == "instant":
+            cur = temporal.stage_instant_values(v, lo, hi)
+        elif base in _EXTRAP:
+            is_counter, is_rate = _EXTRAP[base]
+            cur = temporal.stage_extrapolated_rate(
+                v, adj, t, lo, hi, eval_ts, range_ns, is_counter, is_rate)
+        elif base in _INSTANT:
+            is_counter, is_rate = _INSTANT[base]
+            cur = temporal.stage_instant_delta(v, t, lo, hi, is_counter,
+                                               is_rate)
+        else:
+            cur = temporal.stage_over_time(_OVER_TIME[base], csum, lo, hi)
+        si = 0
+        for st in stages:
+            if st[0] == "bin":
+                _, op, swapped = st
+                c = scalars[si]
+                si += 1
+                a, b = (c, cur) if swapped else (cur, c)
+                nxt = _apply_scalar_op(op, a, b)
+                if op == "^":
+                    # the interpreter _compacts (drops all-NaN rows)
+                    # between stages, and ^ is the one covered op whose
+                    # elementwise math can resurrect a dead row
+                    # (NaN ** 0 == 1 ** NaN == 1.0): a row dead before
+                    # the stage must stay dead, so the final _compact
+                    # drops exactly the rows the interpreter dropped
+                    dead = jnp.all(jnp.isnan(cur), axis=1, keepdims=True)
+                    nxt = jnp.where(dead, jnp.nan, nxt)
+                cur = nxt
+            else:
+                _, op = st
+                if op == "quantile":
+                    cur = windowed_agg.stage_grouped_quantile(
+                        cur, seg, num_groups, phi)
+                else:
+                    cur = windowed_agg.stage_grouped_reduce(
+                        op, cur, seg, num_groups)
+        return cur
+
+    return jax.jit(run, static_argnames=("num_groups",))
+
+
+# ---------------------------------------------------------------------------
+# plan-shape cache bookkeeping (telemetry + boundedness)
+# ---------------------------------------------------------------------------
+
+_plan_lock = threading.Lock()
+_plan_cache: OrderedDict = OrderedDict()  # key -> {"hits": n, "misses": n}
+
+# metric-label guard: registry counters persist forever, so the shape=
+# label set must be bounded even though the signature space is user-
+# controlled (ever-longer scalar chains mint fresh signatures — the PR 7
+# tenant-label cardinality class). First N distinct shapes get their own
+# label; the tail shares "other". ?explain= still carries the full key.
+_SHAPE_LABEL_CAP = 64
+_shape_labels_seen: set = set()
+
+
+def _shape_label(key_str: str) -> str:
+    with _plan_lock:
+        if key_str in _shape_labels_seen:
+            return key_str
+        if len(_shape_labels_seen) < _SHAPE_LABEL_CAP:
+            _shape_labels_seen.add(key_str)
+            return key_str
+        return "other"
+
+
+def _plan_cache_record(key: tuple, miss: bool) -> None:
+    """Record one use of a plan-shape key. ``miss`` is the GROUND-TRUTH
+    compile outcome from the jit tracker (did the executable cache grow),
+    not this LRU's own membership — so an eviction here can never relabel
+    a still-compiled plan as a miss, nor a real recompile after program-
+    factory eviction as a hit."""
+    with _plan_lock:
+        rec = _plan_cache.get(key)
+        if rec is None:
+            rec = _plan_cache[key] = {"hits": 0, "misses": 0}
+            while len(_plan_cache) > _PLAN_CACHE_CAP:
+                _plan_cache.popitem(last=False)
+        else:
+            _plan_cache.move_to_end(key)
+        rec["misses" if miss else "hits"] += 1
+
+
+def plan_cache_info() -> dict:
+    """Snapshot for tests and /debug surfaces."""
+    with _plan_lock:
+        return {"|".join(str(p) for p in k): dict(v)
+                for k, v in _plan_cache.items()}
+
+
+def clear_plan_cache() -> None:
+    with _plan_lock:
+        _plan_cache.clear()
+        _shape_labels_seen.clear()
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+
+def _jax_ready() -> bool:
+    """Compile only when jax is importable WITHOUT risking a wedge: jax
+    already imported (ingest/encode initialized it), or the operator
+    explicitly forced the path (M3_TPU_QUERY_COMPILE=1 accepts the
+    import). Mirrors dispatch._accelerator_present's tunnel caution."""
+    if "jax" in sys.modules:
+        return True
+    return os.environ.get("M3_TPU_QUERY_COMPILE") == "1"
+
+
+def _fallback(reason: str):
+    """Counted, traced, never an error."""
+    from m3_tpu.query import explain as explain_mod
+    from m3_tpu.utils import trace
+    from m3_tpu.utils.instrument import default_registry
+
+    dispatch.counters["query.compile[fallback]"] += 1
+    default_registry().root_scope("compute").subscope(
+        "query_plan").counter("fallback")
+    with trace.span(trace.QUERY_COMPILE_FALLBACK, reason=reason):
+        pass
+    col = explain_mod.current()
+    if col is not None:
+        col.set_compiled({"ran": False, "reason": reason})
+    return None
+
+
+def _host_prefers_interpreter(spec: PlanSpec) -> bool:
+    """The per-PLAN rung of the XLA/native/scalar dispatch ladder: on a
+    CPU-only backend, extrapolated-rate bases are served faster by the
+    interpreter's native columnar kernel (ops.native_hostops.rate_csr —
+    a pointer-walk the XLA lowering can't match on host; measured ~2.4x
+    in bench #9's development), so a config-enabled engine declines them
+    unless an accelerator is live. M3_TPU_QUERY_COMPILE=1 (the explicit
+    hatch) overrides — tests and accelerator-bound benches force the
+    fused program."""
+    if spec.base not in _EXTRAP:
+        return False
+    if dispatch._accelerator_present():
+        return False
+    if os.environ.get("M3_TPU_NATIVE_OPS") == "0":
+        return False
+    from m3_tpu.ops import native_hostops
+
+    return native_hostops.available()
+
+
+def _group_ids(labels: list, grouping: tuple, without: bool):
+    """(seg ids [S], output group labels) built from the engine's shared
+    ``grouping_keys`` helper — ONE definition of the by/without key
+    semantics, so the compiled path cannot drift from _eval_aggregate."""
+    from m3_tpu.query.engine import grouping_keys
+
+    keys, out_labels_for = grouping_keys(labels, grouping, without)
+    uniq = sorted(set(keys))
+    gid = {k: i for i, k in enumerate(uniq)}
+    seg = np.array([gid[k] for k in keys], np.int32) if keys \
+        else np.empty(0, np.int32)
+    return seg, [dict(out_labels_for[k]) for k in uniq]
+
+
+def try_execute(engine, expr: Expr, eval_ts: np.ndarray):
+    """Compile-and-run `expr` when covered; None means "interpreter's
+    turn" (uncovered shape or jax unavailable), with the fallback counted.
+
+    The decision is made BEFORE any storage work, so falling back never
+    double-fetches or double-accounts query limits; past this point the
+    compiled path either returns a result or raises like the interpreter
+    would (storage errors, limits)."""
+    spec = match(expr)
+    if spec is None:
+        return _fallback("uncovered_plan_shape")
+    if not _jax_ready():
+        return _fallback("jax_not_initialized")
+    if os.environ.get("M3_TPU_QUERY_COMPILE") != "1" \
+            and _host_prefers_interpreter(spec):
+        return _fallback("host_native_faster")
+    dispatch.counters["query.compile[compiled]"] += 1
+    from m3_tpu.query import explain as explain_mod
+
+    col = explain_mod.current()
+    with contextlib.ExitStack() as stack:
+        if col is not None:
+            for node in spec.nodes[:-1]:
+                stack.enter_context(col.node(node))
+        # innermost node wraps the fetch: selector-stage attribution
+        # lands exactly where the interpreter's plan tree puts it
+        with col.node(spec.nodes[-1]) if col is not None \
+                else contextlib.nullcontext():
+            labels, raws = engine._fetch(spec.selector, eval_ts,
+                                         spec.range_ns)
+        out = _execute(engine, spec, labels, raws, eval_ts, col)
+    return out
+
+
+def _pad_bounds(lo: np.ndarray, hi: np.ndarray, n_samples: int):
+    """Half-octave (next_bucket) padding of the [S, T] bound matrices:
+    the fused program pays for every padded cell, so the compiler uses
+    finer buckets than the per-op kernels' powers of two. Bounds are
+    global CSR sample indices in [0, n_samples]; they ship as int32 when
+    that fits — on the hot [S, T] axes that halves both the host->device
+    bytes and the gather-index reads — and int64 on a >2^31-sample fetch
+    (int32 would wrap negative and gather garbage silently)."""
+    S, T = lo.shape
+    Sp, Tp = dispatch.next_bucket(S), dispatch.next_bucket(T)
+    dt = np.int32 if n_samples < 2**31 else np.int64
+    lo_p = np.zeros((Sp, Tp), dt)
+    hi_p = np.zeros((Sp, Tp), dt)
+    lo_p[:S, :T] = lo
+    hi_p[:S, :T] = hi
+    return lo_p, hi_p
+
+
+def _pad_eval_ts(eval_ts: np.ndarray) -> np.ndarray:
+    T = len(eval_ts)
+    Tp = dispatch.next_bucket(T)
+    if Tp == T:
+        return eval_ts
+    fill = eval_ts[-1] if T else 0
+    return np.concatenate([eval_ts, np.full(Tp - T, fill, np.int64)])
+
+
+def _execute(engine, spec: PlanSpec, labels, raws, eval_ts, col):
+    from m3_tpu.ops import temporal
+    from m3_tpu.query import windows
+    from m3_tpu.query.engine import Vector, _compact
+    from m3_tpu.utils.instrument import default_registry
+
+    T = len(eval_ts)
+    S = raws.n_series
+    agg = next((st for st in spec.stages if st[0] == "agg"), None)
+    if S == 0:
+        # interpreter parity: an empty fetch compacts to an empty vector
+        # at the base stage, and every covered stage preserves emptiness
+        vec = Vector([], np.zeros((0, T)))
+        if col is not None:
+            col.set_compiled({"ran": True, "cache_key": "empty",
+                              "cache": "hit"})
+        return vec
+
+    shifted = engine._resolve_ts(spec.selector, eval_ts)
+    bounds_range = spec.range_ns if spec.base != "instant" \
+        else engine.lookback_ns
+    lo, hi = raws.window_bounds_batch(shifted, bounds_range)
+
+    # Host prep mirrors the bounds policy: per-SAMPLE sequential passes
+    # (prefix sums, counter monotonization) run as one numpy pass — the
+    # exact arrays the interpreter gathers from, and numpy's cumsum is an
+    # order of magnitude faster than XLA:CPU's — while every per-(series,
+    # step) stage fuses into the one traced program below.
+    n = len(raws.values)
+    v_pad, t_pad = temporal._pad_samples(raws.values, raws.times)
+    if spec.base in _EXTRAP and _EXTRAP[spec.base][0]:
+        adj = windows._reset_adjusted(raws)
+        adj_pad = np.concatenate([adj, np.zeros(len(v_pad) - n)])
+    else:  # unused by the program
+        adj_pad = v_pad
+    if spec.base in ("sum_over_time", "avg_over_time"):
+        csum = np.empty(len(v_pad) + 1)
+        csum[0] = 0.0
+        np.cumsum(raws.values, out=csum[1:n + 1])
+        csum[n + 1:] = csum[n]
+    else:
+        # unused by the traced program (count/present_over_time gather
+        # only window counts; the other bases never touch csum — the
+        # base is a trace-time constant) — ship one element, not
+        # O(samples) zeros, on the hot path
+        csum = np.zeros(1)
+    lo_p, hi_p = _pad_bounds(lo, hi, n)
+    eval_pad = _pad_eval_ts(shifted)
+    Sp, Tp = lo_p.shape
+
+    if agg is not None:
+        _, _aop, grouping, without, phi = agg
+        seg, group_labels = _group_ids(labels, grouping, without)
+        G = len(group_labels)
+        Gp = dispatch.next_bucket(G + 1)  # +1 reserves the pad-row group
+        seg_pad = np.full(Sp, Gp - 1, np.int32)
+        seg_pad[:S] = seg
+    else:
+        phi = None
+        G, Gp = 0, 1
+        seg_pad = np.zeros(Sp, np.int32)
+    scalars = np.array([st[3] for st in spec.stages if st[0] == "bin"],
+                       np.float64)
+
+    sig = spec.sig
+    key = (spec.sig_str, Sp, Tp, Gp)
+    key_str = f"{spec.sig_str}|S{Sp}|T{Tp}|G{Gp}"
+    program = _program(sig)
+    t0 = time.perf_counter()
+    tracker = dispatch.jit_tracker("query_plan", program)
+    with tracker:
+        out = program(v_pad, adj_pad, t_pad, csum, lo_p, hi_p,
+                      eval_pad, np.int64(spec.range_ns), seg_pad,
+                      np.float64(phi if phi is not None else 0.0),
+                      scalars, num_groups=Gp)
+    hit = not tracker.miss
+    _plan_cache_record(key, miss=tracker.miss)
+    sc = default_registry().root_scope("compute").subscope(
+        "plan_cache", shape=_shape_label(key_str))
+    sc.counter("hit" if hit else "miss")
+    if not hit:
+        # trace+lower+compile dominates the first call of a new shape
+        default_registry().root_scope("compute").subscope(
+            "query_plan").observe("plan_compile_seconds",
+                                  time.perf_counter() - t0)
+    out = np.asarray(out)
+
+    if agg is not None:
+        mat = out[:G, :T]
+        out_labels = group_labels
+    else:
+        mat = out[:S, :T]
+        drops_name = spec.base != "instant" or any(
+            st[0] == "bin" for st in spec.stages)
+        if drops_name:
+            out_labels = [{k: v for k, v in lb.items() if k != b"__name__"}
+                          for lb in labels]
+        else:
+            out_labels = [dict(lb) for lb in labels]
+    if col is not None:
+        col.set_compiled({"ran": True, "cache_key": key_str,
+                          "cache": "hit" if hit else "miss"})
+    return _compact(Vector(out_labels, mat))

@@ -100,12 +100,17 @@ class Engine:
                  limits: "QueryLimits | None" = None,
                  subquery_step_ns: int = 60 * NS,
                  resolve_tiers: bool = True,
-                 now_fn=None):
+                 now_fn=None,
+                 query_compile: bool = False):
         import time as _time
 
         self.db = db
         self.namespace = namespace
         self.lookback_ns = lookback_ns
+        # whole-query compilation (query/compiler.py, ROADMAP #2): fuse a
+        # covered plan into one jit'd XLA program. Config-driven default;
+        # M3_TPU_QUERY_COMPILE=1/0 is the runtime escape hatch either way
+        self.query_compile = bool(query_compile)
         # retention-tier read resolution (aggregated namespaces); now_fn is
         # injectable so tests can expire raw retention deterministically
         self.resolve_tiers = resolve_tiers
@@ -179,7 +184,10 @@ class Engine:
                 with querystats.stage("eval"):
                     _resolve_at_sentinels(expr, int(eval_ts[0]),
                                           int(eval_ts[-1]))
-                    return self._eval(expr, eval_ts), eval_ts
+                    out = self._maybe_compiled(expr, eval_ts)
+                    if out is None:
+                        out = self._eval(expr, eval_ts)
+                    return out, eval_ts
         finally:
             querystats.finish(st)
             self._warn_tls.last_stats = st
@@ -202,13 +210,40 @@ class Engine:
                 with querystats.stage("eval"):
                     expr = promql.parse(q)
                     _resolve_at_sentinels(expr, t_ns, t_ns)
-                    return self._eval(expr, eval_ts), eval_ts
+                    out = self._maybe_compiled(expr, eval_ts)
+                    if out is None:
+                        out = self._eval(expr, eval_ts)
+                    return out, eval_ts
         finally:
             querystats.finish(st)
             self._warn_tls.last_stats = st
             self._warn_tls.sink = None
             self._warn_tls.last = sink
             limits.end_query()
+
+    def _compile_enabled(self) -> bool:
+        """M3_TPU_QUERY_COMPILE overrides ('1' forces on, '0' forces
+        off); otherwise the engine's configured default. Read per query
+        so tests and operators can flip the hatch on a live process."""
+        import os
+
+        v = os.environ.get("M3_TPU_QUERY_COMPILE")
+        if v == "1":
+            return True
+        if v == "0":
+            return False
+        return self.query_compile
+
+    def _maybe_compiled(self, expr: Expr, eval_ts: np.ndarray):
+        """Whole-query compiled evaluation (query/compiler.py) when
+        enabled; None hands the query to the op-by-op interpreter —
+        uncovered plan shapes fall back transparently (counted, never an
+        error)."""
+        if not self._compile_enabled():
+            return None
+        from m3_tpu.query import compiler
+
+        return compiler.try_execute(self, expr, eval_ts)
 
     # -- fetch --
 
@@ -594,24 +629,7 @@ class Engine:
         if not isinstance(v, Vector):
             raise EvalError(f"{e.op} expects an instant vector")
         S, T = v.values.shape if len(v.labels) else (0, len(eval_ts))
-        # group keys
-        keys = []
-        out_labels_for = {}
-        for lb in v.labels:
-            if e.without:
-                kept = {
-                    k: val for k, val in lb.items()
-                    if k != b"__name__" and k.decode() not in e.grouping
-                }
-            elif e.grouping:
-                kept = {
-                    k: val for k, val in lb.items() if k.decode() in e.grouping
-                }
-            else:
-                kept = {}
-            key = tuple(sorted(kept.items()))
-            keys.append(key)
-            out_labels_for[key] = kept
+        keys, out_labels_for = grouping_keys(v.labels, e.grouping, e.without)
         uniq = sorted(set(keys))
         gid = {k: i for i, k in enumerate(uniq)}
         groups = np.array([gid[k] for k in keys], np.int64) if keys else np.empty(0, np.int64)
@@ -880,6 +898,30 @@ class Engine:
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
+
+
+def grouping_keys(labels, grouping, without: bool):
+    """Aggregation group keys: (per-series sorted-item key tuples, key ->
+    kept-label dict). ONE definition of the by/without key semantics —
+    the interpreter's _eval_aggregate and the whole-query compiler's
+    _group_ids both build their group ids from this, so the compiled
+    path cannot drift from the interpreter on grouping."""
+    keys = []
+    out_labels_for = {}
+    for lb in labels:
+        if without:
+            kept = {
+                k: val for k, val in lb.items()
+                if k != b"__name__" and k.decode() not in grouping
+            }
+        elif grouping:
+            kept = {k: val for k, val in lb.items() if k.decode() in grouping}
+        else:
+            kept = {}
+        key = tuple(sorted(kept.items()))
+        keys.append(key)
+        out_labels_for[key] = kept
+    return keys, out_labels_for
 
 
 def _apply_op(op: str, a, b):

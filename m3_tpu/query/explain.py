@@ -154,6 +154,10 @@ class Collector:
         self.entries: list[dict] = []
         self._stack: list[dict] = []
         self._n = 0
+        # whole-query compilation outcome (query/compiler.py): set once
+        # per query — {"ran": bool, "cache_key": ..., "cache": hit|miss}
+        # or {"ran": False, "reason": ...} on fallback
+        self.compiled: dict | None = None
         # legs already attributed to a (descendant) plan node: children
         # exit before parents, so a parent only claims what its subtree
         # hasn't — the selector gets the rpc legs, not every ancestor
@@ -239,9 +243,17 @@ class Collector:
     def tree(self) -> list[dict]:
         return trace.build_tree(self.entries)
 
+    def set_compiled(self, info: dict) -> None:
+        """Record whether the compiled path served this query (the plan-
+        cache key and hit/miss ride the ?explain= envelope and the ring)."""
+        self.compiled = info
+
     def to_dict(self) -> dict:
-        return {"mode": "analyze" if self.analyze else "plan",
-                "tree": self.tree()}
+        doc = {"mode": "analyze" if self.analyze else "plan",
+               "tree": self.tree()}
+        if self.compiled is not None:
+            doc["compiled"] = self.compiled
+        return doc
 
 
 def remember(record: dict) -> None:

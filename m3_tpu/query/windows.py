@@ -72,6 +72,62 @@ class RaggedSeries:
             hi[s] = a + np.searchsorted(row, eval_ts, side="right")
         return lo, hi
 
+    def window_bounds_batch(self, eval_ts: np.ndarray, range_ns: int):
+        """window_bounds without the per-series Python loop, for an
+        ASCENDING eval grid (what the engine always evaluates on).
+
+        Inverts the search: instead of S x T binary searches over sample
+        rows, every SAMPLE finds its first covering step in the tiny
+        eval grid (one N x log T searchsorted, cache-hot), and the per-
+        (series, step) counts come from a 2-D bincount + cumsum along
+        steps — hi[s, t] = offsets[s] + #{samples in row s with time <=
+        eval_ts[t]} by construction. The whole-query compiler's host
+        prep uses this; a 100k-series fetch costs two vectorized passes,
+        not 200k searchsorted calls. Falls back to the loop for
+        non-ascending grids."""
+        S = self.n_series
+        T = len(eval_ts)
+        n = len(self.times)
+        if S == 0 or n == 0 or T == 0:
+            z = np.zeros((S, T), np.int64)
+            return z, z.copy()
+        diffs = np.diff(eval_ts)
+        if not bool((diffs >= 0).all()) \
+                or S * (T + 1) > (1 << 26):  # bincount scratch cap ~0.5GB
+            return self.window_bounds(eval_ts, range_ns)
+        row_id = np.repeat(np.arange(S, dtype=np.int64),
+                           np.diff(self.offsets))
+
+        def counts(grid: np.ndarray) -> np.ndarray:
+            # first step whose grid value >= sample time: the sample is
+            # inside windows ending at that step and later (last slot =
+            # outside every window, dropped before the cumsum)
+            W = len(grid)
+            pos = np.searchsorted(grid, self.times, side="left")
+            hist = np.bincount(row_id * (W + 1) + pos,
+                               minlength=S * (W + 1))
+            return np.cumsum(hist.reshape(S, W + 1)[:, :W], axis=1)
+
+        base = self.offsets[:-1][:, None]
+        step = int(diffs[0]) if T > 1 else 0
+        if step > 0 and range_ns % step == 0 \
+                and bool((diffs == step).all()) \
+                and S * (T + range_ns // step + 1) <= (1 << 26):
+            # uniform grid, range a step multiple (every dashboard query):
+            # lo's grid is hi's shifted k steps, so ONE counts pass over
+            # the k-extended grid yields both bound matrices
+            k = range_ns // step
+            ext = np.concatenate([
+                eval_ts[0] - np.arange(k, 0, -1, dtype=np.int64) * step,
+                eval_ts])
+            c = counts(ext)
+            hi = base + c[:, k:]
+            lo = base + c[:, :T]
+        else:
+            hi = base + counts(eval_ts)
+            lo = base + counts(eval_ts - range_ns)
+        return lo.astype(np.int64), hi.astype(np.int64)
+
 
 def instant_values(raws: RaggedSeries, eval_ts: np.ndarray, lookback_ns: int):
     """Instant-vector matrix [S, n_steps]: latest sample in (t-lookback, t],

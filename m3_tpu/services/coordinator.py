@@ -173,8 +173,22 @@ class CoordinatorService:
             self.db.apply_runtime(self.runtime)
         if self.kv is not None:
             self.runtime.watch_kv(self.kv)
+        # whole-query compilation (ROADMAP #2): `query: compile: true`
+        # fuses covered PromQL plans into one XLA program per plan shape;
+        # M3_TPU_QUERY_COMPILE=1/0 overrides at runtime
+        query_cfg = config.get("query", {}) or {}
         self.api = CoordinatorAPI(self.db, db_cfg.get("namespace", "default"),
-                                  limits=limits)
+                                  limits=limits,
+                                  query_compile=bool(
+                                      query_cfg.get("compile", False)))
+        if self.api.query_compile:
+            # pay the jax import HERE, at service startup — the dispatch
+            # doctrine's blessed init point — never on a query thread
+            # (compiler._jax_ready refuses to be the first importer): a
+            # coordinator whose ingest path never touches jax would
+            # otherwise fall back forever on the feature the operator
+            # explicitly enabled
+            import jax  # noqa: F401
         self.api.writer = self.writer  # ingest fans out through downsampler
         # per-tenant admission control (utils/tenantlimits): quotas from
         # the config's `tenants:` section, cardinality ceilings read from

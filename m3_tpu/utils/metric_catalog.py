@@ -28,6 +28,8 @@ HISTOGRAMS = {
     "seconds",                  # decode/encode + rpc legs (per-scope)
     "batch_size",               # decode.batch per-rung batch size
     "compile_seconds",          # compute.jit trace+compile on cache miss
+    "plan_compile_seconds",     # compute.query_plan whole-plan compile
+    #                             on a plan-shape cache miss (ROADMAP #2)
     # cluster / messaging plane
     "append_seconds",           # consensus append-entries
     "commit_seconds",           # consensus majority commit

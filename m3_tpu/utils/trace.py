@@ -60,6 +60,7 @@ KVD_HANDLE = "kvd.server.handle"
 PEER_HTTP = "storage.peer.http"
 TENANT_SHED = "tenant.admission.shed"
 REPAIR_CYCLE = "storage.repair.cycle"
+QUERY_COMPILE_FALLBACK = "query.compile.fallback"
 
 _ZERO_SPAN_ID = "0" * 16
 # placeholder trace id carried by a negative head decision's context —

@@ -1,0 +1,34 @@
+"""MUST-FLAG: the naive per-plan jit dispatcher — what the whole-query
+compiler (query/compiler.py) must NOT look like. An engine that builds
+``jax.jit`` inside its eval path pays one trace+XLA-compile PER QUERY
+(the recompile storm the PR-6 jit telemetry can only observe after the
+fact), and feeding it exact per-query shapes makes every series count a
+fresh executable on top."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _rate_stage(v):
+    return jnp.cumsum(v)
+
+
+class NaiveEngine:
+    """Per-call jit construction in the dispatch path."""
+
+    def eval_plan(self, values):
+        # jax-jit-per-call: a fresh traced callable (and compile) every
+        # query — no lru_cache factory, no keyed plan cache around it
+        program = jax.jit(_rate_stage)
+        return program(values)
+
+    def eval_many(self, plans):
+        out = []
+        for i in range(len(plans)):
+            # jax-varying-static: per-iteration slice = a new shape
+            # bucket = a new compile per plan, unbounded
+            out.append(compiled_stage(plans[:i]))
+        return out
+
+
+compiled_stage = jax.jit(_rate_stage)

@@ -98,6 +98,21 @@ class TestJaxRules:
         # module-level jit, bucketed shapes
         assert run_lint("jax_pass.py", select=("jax-",)) == []
 
+    def test_naive_per_plan_dispatcher_flags(self):
+        """The whole-query-compilation hazard (ROADMAP #2): jit built
+        inside an engine's eval path, and exact per-plan shapes fed to a
+        jitted stage in a loop, must both fail the gate."""
+        fs = run_lint("jax_plan_flag.py", select=("jax-",))
+        assert rules_of(fs) == {"jax-jit-per-call", "jax-varying-static"}
+        msgs = "\n".join(f.message for f in fs)
+        assert "eval_plan" in msgs  # the per-call construction site
+        assert "compiled_stage" in msgs  # the per-iteration shape bucket
+
+    def test_blessed_per_plan_dispatcher_passes(self):
+        # the query/compiler.py shape: lru_cache program factory per plan
+        # signature + bounded keyed plan cache + pow2 shape buckets
+        assert run_lint("jax_plan_pass.py", select=("jax-",)) == []
+
 
 class TestInvariantRules:
     def test_invariant_violations_flag(self):

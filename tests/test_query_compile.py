@@ -1,0 +1,378 @@
+"""Whole-query compilation (query/compiler.py, ROADMAP #2).
+
+The contract under test: for every COVERED plan shape the compiled path
+returns element-identical results to the op-by-op interpreter (NaN masks
+exactly equal, values within the documented 1e-9 relative envelope for
+XLA reassociation — most shapes are bit-exact), uncovered shapes fall
+back transparently with a counted tracepoint, repeated identical-shape
+queries pay exactly ONE trace+compile, and the plan-shape cache stays
+bounded.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from m3_tpu.query import compiler, explain, promql
+from m3_tpu.query.engine import Engine, Vector
+from m3_tpu.query.windows import NS, RaggedSeries
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import DatabaseOptions
+from m3_tpu.utils import dispatch
+
+MIN = 60 * NS
+START = 1_599_998_400_000_000_000
+
+
+@pytest.fixture(scope="module")
+def db(tmp_path_factory):
+    db = Database(str(tmp_path_factory.mktemp("qc") / "db"),
+                  DatabaseOptions(n_shards=4))
+    db.create_namespace("default")
+    db.open(START)
+    rng = np.random.default_rng(42)
+    hosts = [b"h%02d" % i for i in range(7)]
+    jobs = [b"api", b"web", b"batch"]
+    for i in range(60):
+        tags = [(b"host", hosts[i % len(hosts)]), (b"job", jobs[i % len(jobs)])]
+        # irregular sample spacing + counter resets + a few gaps, so the
+        # sweep hits empty windows, reset adjustment and extrapolation
+        t = START
+        acc = float(rng.integers(0, 50))
+        for _ in range(40):
+            t += int(rng.integers(5, 40)) * NS
+            if rng.random() < 0.06:
+                acc = 0.0  # counter reset
+            acc += float(rng.integers(0, 9))
+            if rng.random() < 0.9:
+                db.write_tagged("default", b"reqs", tags, t, acc)
+    yield db
+    db.close()
+
+
+@pytest.fixture
+def engine(db):
+    return Engine(db, resolve_tiers=False)
+
+
+def run_both(engine, monkeypatch, q, start, end, step):
+    monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "0")
+    vi, _ = engine.query_range(q, start, end, step)
+    monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "1")
+    vc, _ = engine.query_range(q, start, end, step)
+    return vi, vc
+
+
+def assert_parity(vi: Vector, vc: Vector, q: str):
+    assert type(vi) is type(vc), q
+    assert vi.labels == vc.labels, q
+    assert vi.values.shape == vc.values.shape, q
+    assert np.array_equal(np.isnan(vi.values), np.isnan(vc.values)), q
+    assert np.allclose(vi.values, vc.values, rtol=1e-9, atol=0,
+                       equal_nan=True), q
+
+
+class TestCoverageMatrix:
+    COVERED = [
+        "reqs",
+        "rate(reqs[5m])",
+        "increase(reqs[3m])",
+        "delta(reqs[4m] offset 2m)",
+        "irate(reqs[5m])",
+        "idelta(reqs[5m])",
+        "avg_over_time(reqs[4m])",
+        "sum_over_time(reqs[2m])",
+        "count_over_time(reqs[3m])",
+        "present_over_time(reqs[3m])",
+        "sum by (host) (rate(reqs[5m]))",
+        "quantile by (job) (0.9, rate(reqs[5m]))",
+        "max without (host) (delta(reqs[5m]))",
+        "rate(reqs[5m]) * 8 / 1024",
+        "2 - sum(rate(reqs[5m]))",
+        "min by (job) (irate(reqs[5m]) ^ 2)",
+    ]
+    UNCOVERED = [
+        "topk(3, rate(reqs[5m]))",                    # uncovered aggregator
+        "stddev by (host) (rate(reqs[5m]))",          # uncovered aggregator
+        "rate(reqs[5m]) + rate(reqs[5m])",            # vector-vector binop
+        "rate(reqs[5m]) > 0.5",                       # comparison semantics
+        "max_over_time(reqs[5m])",                    # window min/max base
+        "holt_winters(reqs[5m], 0.5, 0.5)",           # uncovered function
+        "sum by (host) (sum by (job) (reqs))",        # two aggregations
+        "quantile by (job) (scalar(reqs), reqs)",     # non-literal phi
+        "avg_over_time(reqs[5m:1m])",                 # subquery range arg
+        "-rate(reqs[5m])",                            # unary
+        "abs(rate(reqs[5m]))",                        # math function
+    ]
+
+    def test_covered_shapes_match(self):
+        for q in self.COVERED:
+            assert compiler.match(promql.parse(q)) is not None, q
+
+    def test_uncovered_shapes_fall_back(self):
+        for q in self.UNCOVERED:
+            assert compiler.match(promql.parse(q)) is None, q
+
+    def test_signature_separates_program_from_data(self):
+        # scalars, grouping labels and phi are data, not program identity
+        a = compiler.match(promql.parse("sum by (host) (rate(reqs[5m]) * 8)"))
+        b = compiler.match(promql.parse("sum by (job) (rate(reqs[1m]) * 99)"))
+        assert a.sig == b.sig
+        c = compiler.match(promql.parse("avg by (host) (rate(reqs[5m]) * 8)"))
+        assert c.sig != a.sig
+
+
+class TestParitySweep:
+    """Seeded property sweep: random covered plans over the shared
+    fixture data must be element-identical (or within the documented
+    envelope) between the compiled program and the interpreter."""
+
+    BASES = ["rate(reqs[{r}]{o})", "increase(reqs[{r}]{o})",
+             "delta(reqs[{r}]{o})", "irate(reqs[{r}]{o})",
+             "idelta(reqs[{r}]{o})", "avg_over_time(reqs[{r}]{o})",
+             "sum_over_time(reqs[{r}]{o})", "count_over_time(reqs[{r}]{o})",
+             "present_over_time(reqs[{r}]{o})", "reqs{o_instant}"]
+    AGGS = ["sum", "avg", "min", "max", "count", "quantile"]
+    BIN_OPS = ["+", "-", "*", "/", "%", "^"]
+    SCALARS = [2, 0.5, 3.7, -1.5, 60]
+    PHIS = [0.5, 0.9, 0.99, 0.0, 1.0, -0.5, 1.5]
+
+    def random_plan(self, rng) -> str:
+        base = str(rng.choice(self.BASES))
+        off = " offset 1m" if rng.random() < 0.3 else ""
+        expr = base.format(r=f"{rng.integers(1, 7)}m", o=off,
+                           o_instant=off)
+        def add_bin(e):
+            op = str(rng.choice(self.BIN_OPS))
+            c = rng.choice(self.SCALARS)
+            return f"({e}) {op} {c}" if rng.random() < 0.5 \
+                else f"{c} {op} ({e})"
+        if rng.random() < 0.4:
+            expr = add_bin(expr)
+        if rng.random() < 0.75:
+            op = str(rng.choice(self.AGGS))
+            by = str(rng.choice(["by (host)", "by (job)",
+                                 "by (host, job)", "without (host)", ""]))
+            if op == "quantile":
+                phi = rng.choice(self.PHIS)
+                expr = f"quantile {by} ({phi}, {expr})"
+            else:
+                expr = f"{op} {by} ({expr})"
+        if rng.random() < 0.4:
+            expr = add_bin(expr)
+        return expr
+
+    def test_sweep(self, engine, monkeypatch):
+        rng = np.random.default_rng(1234)
+        compiled_runs = 0
+        for i in range(14):
+            q = self.random_plan(rng)
+            start = START + int(rng.integers(0, 5)) * MIN
+            step = int(rng.integers(1, 4)) * 30 * NS
+            end = START + int(rng.integers(10, 25)) * MIN
+            before = dispatch.counters["query.compile[compiled]"]
+            vi, vc = run_both(engine, monkeypatch, q, start, end, step)
+            assert dispatch.counters["query.compile[compiled]"] == \
+                before + 1, f"plan not compiled: {q}"
+            compiled_runs += 1
+            assert_parity(vi, vc, q)
+        assert compiled_runs == 14
+
+    def test_empty_match_parity(self, engine, monkeypatch):
+        for q in ("sum by (host) (rate(nope[5m]))", "rate(nope[5m])",
+                  "nope"):
+            vi, vc = run_both(engine, monkeypatch, q, START, START + 10 * MIN,
+                              MIN)
+            assert vi.labels == vc.labels == []
+            assert vi.values.shape == vc.values.shape
+
+    def test_power_cannot_resurrect_dead_series(self, tmp_path,
+                                                monkeypatch):
+        """The interpreter _compacts (drops all-NaN series) between
+        stages; elementwise NaN ** 0 == 1 ** NaN == 1.0 would resurrect
+        a dead row in the fused program, so the ^ stage masks rows that
+        were dead before it — parity holds on the series SET too."""
+        db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=1))
+        db.create_namespace("default")
+        db.open(START)
+        # host=a: one sample only -> irate is NaN at every step (dead)
+        db.write_tagged("default", b"m", [(b"host", b"a")],
+                        START + 30 * NS, 5.0)
+        for k in range(1, 30):
+            db.write_tagged("default", b"m", [(b"host", b"b")],
+                            START + k * 20 * NS, float(k))
+        eng = Engine(db, resolve_tiers=False)
+        try:
+            for q in ("irate(m[5m]) ^ 0",
+                      "1 ^ irate(m[5m])",
+                      "(irate(m[5m]) * 2) ^ 0",
+                      "sum by (host) (irate(m[5m]) ^ 0)"):
+                vi, vc = run_both(eng, monkeypatch, q, START,
+                                  START + 10 * MIN, MIN)
+                assert_parity(vi, vc, q)
+            monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "1")
+            vc, _ = eng.query_range("irate(m[5m]) ^ 0", START,
+                                    START + 10 * MIN, MIN)
+            assert [lb.get(b"host") for lb in vc.labels] == [b"b"]
+        finally:
+            db.close()
+
+    def test_instant_query_parity(self, engine, monkeypatch):
+        monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "0")
+        vi, _ = engine.query_instant("sum by (job) (rate(reqs[5m]))",
+                                     START + 10 * MIN)
+        monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "1")
+        vc, _ = engine.query_instant("sum by (job) (rate(reqs[5m]))",
+                                     START + 10 * MIN)
+        assert_parity(vi, vc, "instant")
+
+
+class TestFallbackAndPolicy:
+    def test_uncovered_falls_back_counted(self, engine, monkeypatch):
+        monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "1")
+        before = dispatch.counters["query.compile[fallback]"]
+        v, _ = engine.query_range("topk(2, rate(reqs[5m]))", START,
+                                  START + 10 * MIN, MIN)
+        assert dispatch.counters["query.compile[fallback]"] == before + 1
+        assert isinstance(v, Vector)  # interpreter served it, no error
+
+    def test_disabled_engine_never_counts(self, engine, monkeypatch):
+        monkeypatch.delenv("M3_TPU_QUERY_COMPILE", raising=False)
+        before_c = dispatch.counters["query.compile[compiled]"]
+        before_f = dispatch.counters["query.compile[fallback]"]
+        engine.query_range("rate(reqs[5m])", START, START + 10 * MIN, MIN)
+        assert dispatch.counters["query.compile[compiled]"] == before_c
+        assert dispatch.counters["query.compile[fallback]"] == before_f
+
+    def test_env_zero_overrides_configured_engine(self, db, monkeypatch):
+        eng = Engine(db, resolve_tiers=False, query_compile=True)
+        monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "0")
+        before = dispatch.counters["query.compile[compiled]"]
+        eng.query_range("rate(reqs[5m])", START, START + 10 * MIN, MIN)
+        assert dispatch.counters["query.compile[compiled]"] == before
+
+    def test_host_policy_prefers_native_rate(self, monkeypatch):
+        """Config-enabled (not forced) + CPU backend + native kernel
+        present => extrapolated-rate plans go to the interpreter; forced
+        env=1 compiles them; non-rate bases compile either way."""
+        from m3_tpu.ops import native_hostops
+
+        monkeypatch.setattr(native_hostops, "available", lambda: True)
+        monkeypatch.setattr(dispatch, "_accelerator_present", lambda: False)
+        monkeypatch.delenv("M3_TPU_NATIVE_OPS", raising=False)
+        rate_spec = compiler.match(promql.parse("sum(rate(reqs[5m]))"))
+        irate_spec = compiler.match(promql.parse("sum(irate(reqs[5m]))"))
+        assert compiler._host_prefers_interpreter(rate_spec)
+        assert not compiler._host_prefers_interpreter(irate_spec)
+        # an accelerator flips the decision for rate too
+        monkeypatch.setattr(dispatch, "_accelerator_present", lambda: True)
+        assert not compiler._host_prefers_interpreter(rate_spec)
+
+
+class TestPlanShapeCache:
+    def test_repeated_identical_shape_compiles_once(self, engine,
+                                                    monkeypatch):
+        monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "1")
+        compiler._program.cache_clear()
+        compiler.clear_plan_cache()
+        q = "count by (job) (count_over_time(reqs[3m]))"
+        miss0 = dispatch.counters["jit_query_plan[miss]"]
+        hit0 = dispatch.counters["jit_query_plan[hit]"]
+        for _ in range(4):
+            engine.query_range(q, START, START + 12 * MIN, MIN)
+        assert dispatch.counters["jit_query_plan[miss]"] == miss0 + 1
+        assert dispatch.counters["jit_query_plan[hit]"] == hit0 + 3
+        info = compiler.plan_cache_info()
+        key = next(k for k in info if k.startswith("count_over_time|agg:count"))
+        assert info[key] == {"hits": 3, "misses": 1}
+
+    def test_plan_cache_is_bounded(self):
+        compiler.clear_plan_cache()
+        for i in range(compiler._PLAN_CACHE_CAP + 40):
+            compiler._plan_cache_record(("sig", i, 1, 1), miss=True)
+        assert len(compiler.plan_cache_info()) == compiler._PLAN_CACHE_CAP
+        compiler.clear_plan_cache()
+
+    def test_metric_shape_labels_bounded(self):
+        """The shape= metric label set is capped (registry counters
+        persist forever and signatures are user-controlled — the PR 7
+        tenant-label cardinality class); the tail shares 'other'."""
+        compiler.clear_plan_cache()
+        labels = {compiler._shape_label(f"sig{i}|S1|T1|G1")
+                  for i in range(compiler._SHAPE_LABEL_CAP + 20)}
+        assert len(labels) == compiler._SHAPE_LABEL_CAP + 1
+        assert "other" in labels
+        # a capped shape keeps its own label on repeat queries
+        assert compiler._shape_label("sig0|S1|T1|G1") == "sig0|S1|T1|G1"
+        compiler.clear_plan_cache()
+
+    def test_shape_buckets_reuse_the_program(self, engine, monkeypatch):
+        """Different step counts inside one (S, T) bucket hit the same
+        compiled executable — the recompile-bounding contract."""
+        monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "1")
+        q = "sum by (host) (sum_over_time(reqs[2m]))"
+        engine.query_range(q, START, START + 20 * MIN, MIN)  # warm bucket
+        miss0 = dispatch.counters["jit_query_plan[miss]"]
+        engine.query_range(q, START, START + 19 * MIN, MIN)  # same bucket
+        assert dispatch.counters["jit_query_plan[miss]"] == miss0
+
+
+class TestExplainSurface:
+    def test_compiled_info_in_explain(self, engine, monkeypatch):
+        monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "1")
+        q = "sum by (host) (sum_over_time(reqs[2m]))"
+        engine.query_range(q, START, START + 10 * MIN, MIN)  # prime cache
+        with explain.collect(analyze=True) as col:
+            engine.query_range(q, START, START + 10 * MIN, MIN)
+        doc = col.to_dict()
+        assert doc["compiled"]["ran"] is True
+        assert doc["compiled"]["cache"] == "hit"
+        assert doc["compiled"]["cache_key"].startswith(
+            "sum_over_time|agg:sum|S")
+        # the plan tree still shows the resolved stages, selector innermost
+        root = doc["tree"][0]
+        assert root["node"] == "aggregate"
+        assert root["children"][0]["node"] == "range_fn"
+        assert root["children"][0]["children"][0]["node"] == "selector"
+
+    def test_fallback_reason_in_explain(self, engine, monkeypatch):
+        monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "1")
+        with explain.collect(analyze=True) as col:
+            engine.query_range("topk(2, rate(reqs[5m]))", START,
+                               START + 10 * MIN, MIN)
+        doc = col.to_dict()
+        assert doc["compiled"] == {"ran": False,
+                                   "reason": "uncovered_plan_shape"}
+
+
+class TestWindowBoundsBatch:
+    def test_randomized_parity_with_loop(self):
+        rng = np.random.default_rng(7)
+        for _ in range(40):
+            S = int(rng.integers(0, 30))
+            per = []
+            for _ in range(S):
+                n = int(rng.integers(0, 25))
+                t = np.sort(rng.integers(0, 10_000, n)).astype(np.int64)
+                per.append((t, rng.normal(size=n)))
+            raws = RaggedSeries.from_lists(per)
+            T = int(rng.integers(0, 16))
+            start = int(rng.integers(-2000, 2000))
+            step = int(rng.integers(1, 400))
+            eval_ts = (start + np.arange(T) * step).astype(np.int64)
+            # half the trials take the aligned single-pass branch
+            range_ns = step * int(rng.integers(0, 5)) if rng.random() < 0.5 \
+                else int(rng.integers(0, 2500))
+            lo1, hi1 = raws.window_bounds(eval_ts, range_ns)
+            lo2, hi2 = raws.window_bounds_batch(eval_ts, range_ns)
+            assert np.array_equal(lo1, lo2)
+            assert np.array_equal(hi1, hi2)
+
+    def test_non_ascending_grid_falls_back(self):
+        raws = RaggedSeries.from_lists(
+            [(np.array([5, 10], np.int64), np.array([1.0, 2.0]))])
+        eval_ts = np.array([20, 10], np.int64)  # descending: loop path
+        lo1, hi1 = raws.window_bounds(eval_ts, 4)
+        lo2, hi2 = raws.window_bounds_batch(eval_ts, 4)
+        assert np.array_equal(lo1, lo2) and np.array_equal(hi1, hi2)
