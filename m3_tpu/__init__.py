@@ -23,3 +23,16 @@ if _os.environ.get("M3_TPU_LOCK_CHECK"):
 
     if _lockcheck.env_enabled(_os.environ["M3_TPU_LOCK_CHECK"]):
         _lockcheck.install()
+
+if _os.environ.get("M3_TPU_LOCK_PROFILE"):
+    # lock-wait profiling: threading.Lock/RLock timed wrappers keyed by
+    # construction site, feeding the per-class acquire-wait histograms
+    # and the /debug/profile contended-lock table (utils/profiler).
+    # Installed AFTER the shadow-lock checker so the profiled wrapper
+    # wraps the checked lock — ordering edges keep recording when both
+    # are armed. Zero overhead when the env var is unset/disabled.
+    from m3_tpu.utils import lockcheck as _lockcheck2
+    from m3_tpu.utils import profiler as _profiler
+
+    if _lockcheck2.env_enabled(_os.environ["M3_TPU_LOCK_PROFILE"]):
+        _profiler.install_lock_profiling()

@@ -72,6 +72,12 @@ class DivergenceReporter:
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
         self._closed = False
+        # saturation plane: hint-queue depth/drops as registry gauges
+        from m3_tpu.utils.instrument import monitor_queue
+
+        self._unmonitor = monitor_queue(
+            "divergence_hints", self._q.qsize, maxsize,
+            drops_fn=lambda: self.dropped, owner=self)
 
     def submit(self, namespace: str, shard: int, start_ns: int,
                end_ns: int) -> None:
@@ -112,6 +118,7 @@ class DivergenceReporter:
         with self._lock:
             self._closed = True
             thread = self._thread
+        self._unmonitor()
         if thread is not None:
             self._q.put(None)
             thread.join(2.0)

@@ -232,7 +232,8 @@ class KvdServer:
                  peers: dict[str, str] | None = None,
                  orphan_grace_ms: int = 10_000,
                  election_timeout_s: tuple[float, float] = (1.0, 2.0),
-                 heartbeat_s: float = 0.25):
+                 heartbeat_s: float = 0.25,
+                 debug_port: int | None = None):
         import grpc
 
         self._replicated = bool(peers) and len(peers) > 1
@@ -343,6 +344,16 @@ class KvdServer:
         self._exporter = exporter_from_config(None, "kvd")
         if self._exporter is not None:
             self._exporter.start()
+        # always-on profiling plane; kvd speaks gRPC, so /debug/profile
+        # is served by the shared debug HTTP surface (`debug_port`
+        # config / M3_TPU_DEBUG_PORT env)
+        from m3_tpu.utils import profiler
+
+        profiler.arm_from_env("kvd")
+        if debug_port is not None:
+            self._debug_server = profiler.DebugServer(port=int(debug_port))
+        else:
+            self._debug_server = profiler.serve_debug_from_env()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
         if self._replicated:
@@ -885,6 +896,8 @@ class KvdServer:
             self._driver.poke()  # unblock sender/tick threads promptly
         if self._exporter is not None:
             self._exporter.close()  # final best-effort flush
+        if self._debug_server is not None:
+            self._debug_server.close()
         self._server.stop(grace=0.5).wait()
 
 
@@ -927,15 +940,29 @@ class _RaftDriver:
             self._cv.notify_all()
 
     def _tick_loop(self) -> None:
-        while not self._closed.is_set():
-            try:
-                self._queue(self._node.tick())
-            except Exception as e:  # noqa: BLE001 - injected persist fault
-                # etc.; an ARMED SimulatedCrash (chaos rig) kills the
-                # replica process here instead of being swallowed
-                faults.escalate(e)
-            self._wake.wait(self.TICK_S)
-            self._wake.clear()
+        from m3_tpu.utils import profiler
+
+        # the raft pump beats at 50 Hz; the heartbeat interval is padded
+        # way up so only a genuinely wedged pump (seconds of silence,
+        # i.e. elections stop advancing) flags, not GIL scheduling noise
+        hb = profiler.register_heartbeat("kvd.raft_tick",
+                                         max(0.5, self.TICK_S * 25))
+        try:
+            while not self._closed.is_set():
+                hb.beat()
+                try:
+                    # the tick-wedge seam (delay faults model a stuck
+                    # pump; the stall watchdog must catch it)
+                    faults.check("kvd.tick")
+                    self._queue(self._node.tick())
+                except Exception as e:  # noqa: BLE001 - injected persist
+                    # fault etc.; an ARMED SimulatedCrash (chaos rig)
+                    # kills the replica process instead of being swallowed
+                    faults.escalate(e)
+                self._wake.wait(self.TICK_S)
+                self._wake.clear()
+        finally:
+            hb.close()
 
     def _send_loop(self, peer: str) -> None:
         import grpc
@@ -1555,6 +1582,9 @@ def main(argv=None) -> None:
         journal = kvd_cfg.get("journal", journal)
         node_id = kvd_cfg.get("node_id", node_id)
         peers = kvd_cfg.get("peers", peers)
+        debug_port = kvd_cfg.get("debug_port")
+    else:
+        debug_port = None
     if args.no_journal:
         journal = ""
     peer_map = parse_peers(peers)
@@ -1563,7 +1593,8 @@ def main(argv=None) -> None:
         # other's journal
         journal = f"kvd.{node_id}.journal"
     server = KvdServer(listen, journal_path=journal or None,
-                       node_id=node_id or None, peers=peer_map or None)
+                       node_id=node_id or None, peers=peer_map or None,
+                       debug_port=int(debug_port) if debug_port else None)
     print(f"m3kvd listening on port {server.port}", flush=True)
     try:  # port discovery file for orchestrators spawning with port 0
         with open("kvd.port", "w") as f:

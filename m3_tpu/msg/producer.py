@@ -65,10 +65,17 @@ class Producer:
         self._next_id = 1
         self._closed = False
         self._sock: socket.socket | None = None
-        self._writer = threading.Thread(target=self._run_writer, daemon=True)
         self._acker: threading.Thread | None = None
-        self._writer.start()
         self.num_dropped = 0
+        # saturation plane: unacked backlog vs max_buffer, drop count
+        from m3_tpu.utils.instrument import monitor_queue
+
+        self._unmonitor = monitor_queue(
+            "msg_producer", lambda: len(self._pending), max_buffer,
+            drops_fn=lambda: self.num_dropped, owner=self,
+            endpoint=f"{endpoint[0]}:{endpoint[1]}")
+        self._writer = threading.Thread(target=self._run_writer, daemon=True)
+        self._writer.start()
 
     # -- publish --
 
@@ -109,6 +116,7 @@ class Producer:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
+        self._unmonitor()
         if self._sock:
             try:
                 self._sock.close()

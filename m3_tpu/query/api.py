@@ -397,6 +397,15 @@ class CoordinatorAPI:
             return _render_metrics(q, headers)
         if path == "/debug/dump":
             return self._debug_dump()
+        if path == "/debug/profile":
+            # the always-on profiling & saturation plane: sampling
+            # profiler top-N / collapsed stacks, contended-lock table,
+            # stall-watchdog status (utils/profiler; POST toggles live)
+            from m3_tpu.utils import profiler
+
+            status, payload, ctype = profiler.handle_debug_profile(
+                method, q, body)
+            return status, ctype, payload
         if path == "/debug/traces":
             return self._debug_traces(method, q, body)
         if path == "/debug/explain":

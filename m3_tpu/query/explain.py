@@ -44,6 +44,11 @@ _tls = threading.local()
 _ring_lock = threading.Lock()
 _ring: deque[dict] = deque(maxlen=64)
 
+# bounded plan ring on the saturation plane (m3lint inv-queue-gauge)
+from m3_tpu.utils import instrument as _instrument  # noqa: E402
+
+_instrument.monitor_queue("explain_ring", lambda: len(_ring), _ring.maxlen)
+
 
 def current() -> "Collector | None":
     """The thread's active plan collector (None outside EXPLAIN)."""

@@ -90,6 +90,17 @@ class AggregatorService:
         self.exporter = exporter_from_config(config, "aggregator")
         if self.exporter is not None:
             self.exporter.start()
+        # always-on profiling plane. The aggregator has no HTTP API of
+        # its own, so `debug_port:` (or M3_TPU_DEBUG_PORT) starts the
+        # shared debug surface serving /debug/profile + /metrics.
+        from m3_tpu.utils import profiler
+
+        profiler.arm_from_env("aggregator")
+        debug_port = config.get("debug_port")
+        if debug_port is not None:
+            self.debug_server = profiler.DebugServer(port=int(debug_port))
+        else:
+            self.debug_server = profiler.serve_debug_from_env()
 
     def _on_message(self, shard: int, payload: bytes) -> None:
         mt, sid, tags, t_ns, value = decode_metric(payload)
@@ -125,11 +136,15 @@ class AggregatorService:
         )
         self.log.info("ingest listening", port=self.consumer.port)
         flush_every = float(self.config.get("flush_interval_s", 5.0))
+        from m3_tpu.utils import profiler
+
+        hb = profiler.register_heartbeat("aggregator.flush", flush_every)
         try:
             while not self._stop.is_set():
                 self._stop.wait(flush_every)
                 if self._stop.is_set():
                     break
+                hb.beat()
                 try:
                     self.flush_once()
                 except Exception as e:  # noqa: BLE001 - one bad flush must
@@ -149,12 +164,17 @@ class AggregatorService:
 
     def shutdown(self) -> None:
         self._stop.set()
+        from m3_tpu.utils import profiler
+
+        profiler.default_watchdog().unregister("aggregator.flush")
         if self.consumer:
             self.consumer.close()
         if self.producer:
             self.producer.close()
         if self.exporter is not None:
             self.exporter.close()  # final best-effort flush
+        if self.debug_server is not None:
+            self.debug_server.close()
         self.election.resign()
         self.log.info("aggregator stopped")
 

@@ -242,6 +242,11 @@ class CoordinatorService:
             self.exporter.start()
             self.log.info("telemetry exporter started",
                           sink=type(self.exporter.sink).__name__)
+        # always-on profiling plane: M3_TPU_PROFILE arms the sampling
+        # profiler + stall watchdog (POST /debug/profile toggles live)
+        from m3_tpu.utils import profiler
+
+        profiler.arm_from_env("coordinator")
         self._stop = threading.Event()
 
     def _apply_ruleset(self, rs) -> None:
@@ -388,11 +393,15 @@ class CoordinatorService:
             self.log.info("carbon listening", port=self.carbon.port)
         tick_every = float(self.config.get("tick_interval_s", 10.0))
         scope = default_registry().root_scope("coordinator")
+        from m3_tpu.utils import profiler
+
+        hb = profiler.register_heartbeat("coordinator.tick", tick_every)
         try:
             while not self._stop.is_set():
                 self._stop.wait(tick_every)
                 if self._stop.is_set():
                     break
+                hb.beat()
                 try:
                     with scope.timer("tick"):
                         if self.kv is not None and hasattr(self.kv, "refresh"):
@@ -421,6 +430,11 @@ class CoordinatorService:
 
     def shutdown(self) -> None:
         self._stop.set()
+        from m3_tpu.utils import profiler
+
+        profiler.default_watchdog().unregister("coordinator.tick")
+        if self.self_monitor is not None:
+            self.self_monitor.close()
         self.api.shutdown()
         if self.carbon:
             self.carbon.close()

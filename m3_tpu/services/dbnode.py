@@ -37,6 +37,7 @@ class NodeAPI:
         "/label_names", "/label_values", "/blocks/starts",
         "/blocks/metadata", "/blocks/stream", "/blocks/rollup",
         "/debug/repair", "/repair/enqueue", "/debug/flush",
+        "/debug/profile",
     })
 
     def __init__(self, db: Database):
@@ -77,6 +78,16 @@ class NodeAPI:
                 # exempt from injection so orchestrators can still see the
                 # process is alive under a fault plan
                 return 200, json.dumps({"ok": True}).encode()
+            if path == "/debug/profile":
+                # also exempt: the saturation plane exists to observe a
+                # SICK node — a fault plan that error-injects the handler
+                # must not blind the stall/contention telemetry the rig's
+                # trajectory recorder scrapes mid-outage
+                from m3_tpu.utils import profiler
+
+                status, payload, ctype = profiler.handle_debug_profile(
+                    method, q, body)
+                return status, payload, ctype
             # node-level request faults: clients see a 5xx, driving their
             # breaker/consistency paths like a real sick node
             faults.check("dbnode.handle", path=path)
@@ -444,6 +455,12 @@ class DBNodeService:
         self.exporter = exporter_from_config(config, "dbnode")
         if self.exporter is not None:
             self.exporter.start()
+        # always-on profiling plane: M3_TPU_PROFILE arms the sampling
+        # profiler + stall-watchdog checker (POST /debug/profile toggles
+        # at runtime either way)
+        from m3_tpu.utils import profiler
+
+        profiler.arm_from_env("dbnode")
         self._stop = threading.Event()
 
     # -- placement plumbing --
@@ -665,12 +682,20 @@ class DBNodeService:
         self.repair.start()
         tick_every = float(self.config.get("tick_interval_s", 10.0))
         scope = default_registry().root_scope("dbnode")
+        from m3_tpu.utils import profiler
+
+        hb = profiler.register_heartbeat("dbnode.tick", tick_every)
         try:
             while not self._stop.is_set():
                 self._stop.wait(tick_every)
                 if self._stop.is_set():
                     break
+                hb.beat()
                 try:
+                    # the tick-wedge seam: a delay fault here models a
+                    # loop stuck mid-cycle (the rig's partition plans use
+                    # it to drill the stall watchdog on a live node)
+                    faults.check("dbnode.tick")
                     if self.kv is not None:
                         if hasattr(self.kv, "refresh"):
                             # cross-process KV: fire local watches (runtime
@@ -692,6 +717,9 @@ class DBNodeService:
 
     def shutdown(self) -> None:
         self._stop.set()
+        from m3_tpu.utils import profiler
+
+        profiler.default_watchdog().unregister("dbnode.tick")
         self.repair.stop()
         self.api.shutdown()
         if self.exporter is not None:

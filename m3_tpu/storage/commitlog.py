@@ -96,6 +96,14 @@ class CommitLogWriter:
         # would be a silent-loss lie. Callers that survive the error (a
         # request handler swallowing it) must rotate to a fresh log.
         self._failed: Exception | None = None
+        # saturation plane: acked bytes sitting in the user-space buffer
+        # (lost on SIGKILL until flushed) vs the flush threshold
+        from m3_tpu.utils.instrument import monitor_queue
+
+        self._unmonitor = monitor_queue(
+            "commitlog_flush_backlog", lambda: len(self._buf),
+            flush_every_bytes, owner=self,
+            log=os.path.basename(os.path.dirname(path)))
 
     def write(self, series_id: bytes, encoded_tags: bytes, time_ns: int,
               value_bits: int, unit: int) -> None:
@@ -210,6 +218,7 @@ class CommitLogWriter:
             raise
 
     def close(self) -> None:
+        self._unmonitor()
         if self._failed is None:
             self.flush(fsync=True)
         self._f.close()

@@ -129,6 +129,12 @@ class RepairDaemon:
         self._queue: deque = deque(maxlen=1024)
         self._queued: set = set()
         self._queue_lock = threading.Lock()
+        self.hint_drops = 0  # hints evicted by the bounded deque
+        from m3_tpu.utils.instrument import monitor_queue
+
+        self._unmonitor = monitor_queue(
+            "repair_hints", lambda: len(self._queue), self._queue.maxlen,
+            drops_fn=lambda: self.hint_drops, owner=self)
         # last-cycles ring + lifetime totals for /debug/repair
         self._ring: deque = deque(maxlen=self.STATUS_RING)
         self._ring_lock = threading.Lock()
@@ -185,6 +191,7 @@ class RepairDaemon:
             if len(self._queue) == self._queue.maxlen:
                 old = self._queue.popleft()
                 self._queued.discard(old)
+                self.hint_drops += 1
             self._queue.append(key)
             self._queued.add(key)
         self._scope.counter("enqueued")
@@ -335,10 +342,18 @@ class RepairDaemon:
         return opts.interval_s * (1.0 + opts.jitter_frac * self._rng.random())
 
     def _run(self) -> None:
+        from m3_tpu.utils import profiler
+
+        # stall watchdog: the repair loop beats once per cycle; a cycle
+        # wedged past the (retunable) interval is flagged with its stack
+        hb = profiler.register_heartbeat("repair.cycle", self.opts.interval_s)
         # jittered initial delay: a fleet booting together must not fire
         # its first repair wave in lockstep on top of bootstrap traffic
         self._stop.wait(self._sleep_s() * 0.5)
         while not self._stop.is_set():
+            hb.interval_s = max(self.opts.interval_s,
+                                self.opts.cycle_deadline_s)
+            hb.beat()
             if self.opts.enabled:
                 try:
                     self.run_cycle()
@@ -358,6 +373,10 @@ class RepairDaemon:
 
     def stop(self) -> None:
         self._stop.set()
+        self._unmonitor()
+        from m3_tpu.utils import profiler
+
+        profiler.default_watchdog().unregister("repair.cycle")
         if self._unwatch is not None:
             try:
                 self._unwatch()

@@ -1,8 +1,10 @@
 """BASELINE.md configs #1-#5 as one harness, plus #6 (the batched
 read_many path — config #3's fetch leg measured directly), #7 (the
 write-hot-path observability overhead guard), #8 (the batched
-write_batch ingest path vs the per-entry loop) and #9 (end-to-end
-query_range latency, whole-query-compiled vs interpreted).
+write_batch ingest path vs the per-entry loop), #9 (end-to-end
+query_range latency, whole-query-compiled vs interpreted) and #10 (the
+profiler-overhead guard: sampling profiler + lock-wait profiling +
+stall watchdog armed vs off, same pairing discipline as #7).
 
 Prints one JSON line per config (same shape as bench.py). Sizes are
 env-tunable; defaults are sized to finish on CPU in a few minutes —
@@ -784,10 +786,88 @@ def config9_query_compile():
                 os.environ["M3_TPU_QUERY_COMPILE"] = prev
 
 
+def config10_profiler_overhead():
+    """Profiler-overhead guard (the PR-11 twin of #7): the write hot
+    path with the WHOLE profiling & saturation plane armed — sampling
+    profiler at ~19 Hz, lock-wait profiling wrapping every
+    threading.Lock/RLock the timed code creates, stall-watchdog checker
+    running — vs the same path with all of it off. Same pairing
+    discipline as #7 (interleaved pairs, median ratio, 0.85 noise bar):
+    'always-on profiling' is only true if this number stays at 1.0-ish."""
+    import tempfile
+
+    from m3_tpu.storage.database import Database
+    from m3_tpu.storage.options import (
+        DatabaseOptions, IndexOptions, NamespaceOptions, RetentionOptions,
+    )
+    from m3_tpu.utils import profiler
+
+    NS = 10**9
+    START = 1_600_000_000 * NS
+    N = max(int(400_000 * _scale()), 40_000)
+
+    # pure CPU write path (no commitlog/index I/O), as in #7: the effect
+    # being guarded is per-write lock/sampling overhead, not disk jitter
+    def run_once() -> float:
+        with tempfile.TemporaryDirectory() as root:
+            db = Database(root, DatabaseOptions(n_shards=4))
+            db.create_namespace("default", NamespaceOptions(
+                retention=RetentionOptions(retention_ns=1000 * 3600 * NS,
+                                           block_size_ns=3600 * NS),
+                index=IndexOptions(enabled=False),
+                writes_to_commitlog=False, snapshot_enabled=False))
+            db.open(START)
+            names = [b"m%05d" % i for i in range(1000)]
+            tags = [(b"k", b"v")]
+            t0 = time.perf_counter()
+            for i in range(N):
+                db.write_tagged("default", names[i % 1000], tags,
+                                START + (i % 3600) * NS, float(i))
+            dt = time.perf_counter() - t0
+            db.close()
+        return N / dt
+
+    prof = profiler.default_profiler()
+    wd = profiler.default_watchdog()
+
+    def armed(on: bool):
+        # the timed Database is constructed AFTER the factory swap, so
+        # the armed side's storage locks are all profiled wrappers
+        if on:
+            profiler.install_lock_profiling()
+            prof.start(profiler.DEFAULT_HZ)
+            wd.start()
+        else:
+            prof.stop()
+            wd.stop()
+            profiler.uninstall_lock_profiling()
+
+    ratios: list[float] = []
+    rate_off = 0.0
+    try:
+        armed(True)
+        run_once()  # warm code paths once, outside any pair
+        for _ in range(5):
+            armed(True)
+            on_rate = run_once()
+            armed(False)
+            off_rate = run_once()
+            ratios.append(on_rate / off_rate)
+            rate_off = max(rate_off, off_rate)
+    finally:
+        armed(False)
+        profiler.reset_lock_stats()
+    ratios.sort()
+    ratio = ratios[len(ratios) // 2]
+    _emit("#10 write hot path w/ profiler+locks+watchdog armed vs off"
+          + ("" if ratio >= 0.85 else " (OVERHEAD EXCEEDED)"),
+          ratio * rate_off, rate_off)
+
+
 def main(argv=None) -> None:
     global _ACCEL
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10")
     ap.add_argument("--record", default=None,
                     help="also append the JSON lines to this file")
     args = ap.parse_args(argv)
@@ -814,7 +894,7 @@ def main(argv=None) -> None:
            "3": config3_promql_rate_sum, "4": config4_regex_postings,
            "5": config5_sharded_quantile, "6": config6_read_many,
            "7": config7_tracing_overhead, "8": config8_write_batch,
-           "9": config9_query_compile}
+           "9": config9_query_compile, "10": config10_profiler_overhead}
     for c in args.configs.split(","):
         c = c.strip()
         try:

@@ -234,12 +234,22 @@ class ChaosEvent:
 
 # per-service-kind partition rules: env-injected network faults that make
 # a live process drop most requests (the reachable-but-sick half of the
-# failure space SIGKILL doesn't cover)
+# failure space SIGKILL doesn't cover). Each plan also wedges the
+# service's periodic loop with a delay fault — a partitioned process is
+# typically also a STUCK process (blocked RPCs, wedged ticks), and the
+# stall watchdog must observe exactly that from inside
 PARTITION_SPECS = {
-    "dbnode": "dbnode.handle=error:p0.7",
-    "kvd": "consensus.append=error:p0.5;kvd.rpc=error:p0.3",
-    "aggregator": "msg.consumer.recv=error:p0.5",
+    "dbnode": "dbnode.handle=error:p0.7;dbnode.tick=delay:d2.0",
+    "kvd": "consensus.append=error:p0.5;kvd.rpc=error:p0.3;"
+           "kvd.tick=delay:d2.0",
+    "aggregator": "msg.consumer.recv=error:p0.5;"
+                  "aggregator.flush=delay:d4.0",
 }
+
+# the stall drill's fault plan: a live (serving) dbnode whose tick loop
+# is wedged hard — /debug/profile is fault-exempt, so the watchdog's
+# stall verdict is observable from outside WHILE the loop is stuck
+STALL_DRILL_SPEC = "dbnode.tick=delay:d6.0"
 
 
 class ChaosSchedule:
@@ -742,6 +752,188 @@ def convergence_audit(cluster, namespaces, budget_cycles: int = 10,
 
 
 # ---------------------------------------------------------------------------
+# soak trajectory: the first-class artifact of the profiling plane
+# (ROADMAP #6(a) first leg) — QPS, p99, RSS, top contended locks and
+# stall events over time, sampled off the live cluster's /metrics and
+# fault-exempt /debug/profile surfaces
+
+
+class TrajectoryRecorder:
+    """Samples the running deployment's saturation plane on a background
+    thread into one schema'd artifact. Every fetch is best-effort — a
+    killed node yields a gap in that service's row, never a rig crash."""
+
+    SCHEMA = "m3_tpu.trajectory.v1"
+
+    def __init__(self, coord_port: int, profile_ports: dict[str, int],
+                 rig: "Rig | None" = None, sample_s: float = 1.0):
+        self.coord_port = coord_port
+        # service name -> port serving /debug/profile (coordinator and
+        # dbnodes on their APIs, aggregator/kvd on the shared debug
+        # surface when armed)
+        self.profile_ports = dict(profile_ports)
+        self.rig = rig
+        self.sample_s = sample_s
+        self.samples: list[dict] = []
+        self._events: dict[tuple, dict] = {}        # dedup key -> event
+        self._locks: dict[tuple, dict] = {}         # (svc, site) -> doc
+        self._prev_hist = None
+        self._prev_writes = 0
+        self._prev_queries = 0
+        self._t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # fetchers are methods so tests can stub the transport
+    def _fetch_metrics(self) -> str:
+        return scrape_metrics(self.coord_port, timeout_s=3.0)
+
+    def _fetch_profile(self, port: int) -> dict:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/profile", timeout=3.0) as r:
+            return json.loads(r.read().decode())
+
+    def _rig_totals(self) -> tuple[int, int]:
+        if self.rig is None:
+            return 0, 0
+        with self.rig._lock:
+            writes = sum(st["writes_acked"]
+                         for st in self.rig.tenant_stats.values())
+            queries = sum(st["queries_ok"]
+                          for st in self.rig.tenant_stats.values())
+        return writes, queries
+
+    def sample_once(self) -> dict:
+        now_s = round(time.monotonic() - self._t0, 3)
+        row: dict = {"t_s": now_s, "p99_ms": None,
+                     "qps_writes": 0.0, "qps_queries": 0.0,
+                     "rss_bytes": {}, "stalls": {}}
+        writes, queries = self._rig_totals()
+        row["qps_writes"] = round((writes - self._prev_writes)
+                                  / max(self.sample_s, 1e-6), 1)
+        row["qps_queries"] = round((queries - self._prev_queries)
+                                   / max(self.sample_s, 1e-6), 1)
+        self._prev_writes, self._prev_queries = writes, queries
+        try:
+            text = self._fetch_metrics()
+            cur = parse_histogram(text, "coordinator_request_seconds")
+            if self._prev_hist is not None:
+                row["p99_ms"] = hist_p99_ms(hist_delta(self._prev_hist, cur))
+            self._prev_hist = cur
+        except Exception:  # noqa: BLE001 - coordinator briefly unreachable
+            pass
+        for svc, port in self.profile_ports.items():
+            try:
+                doc = self._fetch_profile(port)
+            except Exception:  # noqa: BLE001 - killed/partitioned process
+                continue
+            row["rss_bytes"][svc] = doc.get("rss_bytes", 0)
+            wd = doc.get("watchdog", {}) or {}
+            row["stalls"][svc] = sum(lp.get("stalls", 0)
+                                     for lp in wd.get("loops", ()))
+            for ev in wd.get("recent_events", ()):
+                if ev.get("kind") != "stall":
+                    continue
+                key = (svc, ev.get("loop"), ev.get("t_unix"))
+                self._events.setdefault(key, {**ev, "service": svc,
+                                               "rig_t_s": now_s})
+            for cls in (doc.get("locks", {}) or {}).get("classes", ()):
+                self._locks[(svc, cls.get("site"))] = {**cls, "service": svc}
+        self.samples.append(row)
+        return row
+
+    def artifact(self) -> dict:
+        events = sorted(self._events.values(),
+                        key=lambda e: e.get("t_unix", 0))
+        locks = sorted(self._locks.values(),
+                       key=lambda d: -d.get("wait_total_ms", 0.0))
+        return {
+            "schema": self.SCHEMA,
+            "sample_interval_s": self.sample_s,
+            "services": sorted(self.profile_ports),
+            "samples": self.samples,
+            "stall_events": events,
+            "contended_locks": locks[:32],
+        }
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.sample_s):
+                try:
+                    self.sample_once()
+                except Exception:  # noqa: BLE001 - the recorder must
+                    pass           # outlive anything it records
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="trajectory-recorder")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+
+def stall_drill(cluster, recorder: "TrajectoryRecorder | None",
+                timeout_s: float = 20.0) -> dict:
+    """Deterministic stall-watchdog proof on a LIVE node: restart one
+    dbnode under a tick-wedging fault plan, then poll its fault-exempt
+    /debug/profile until the node's OWN watchdog reports the stalled
+    tick loop (captured stack included); heal and hand the events to the
+    trajectory. The detection is entirely in-process on the node — the
+    drill only arranges the wedge and reads the verdict."""
+    agent_name, service, _kind = cluster.chaos_targets()[0]
+    agent = cluster.agents[agent_name]
+    port = cluster.node_ports[service]
+    base_env = cluster.base_service_env
+    t_start = time.time()
+    agent.stop(service)
+    agent.start(service, env={**base_env, "M3_TPU_FAULTS": STALL_DRILL_SPEC,
+                              "M3_TPU_FAULTS_SEED": "0"}, grace_s=0.5)
+    from m3_tpu.tools.em import ClusterEnv
+
+    ClusterEnv.wait_until(
+        lambda: _http_ok(f"http://127.0.0.1:{port}/health"),
+        timeout_s=60, desc="drill node serving")
+    events: list[dict] = []
+
+    def stalled():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/profile",
+                    timeout=3.0) as r:
+                doc = json.loads(r.read().decode())
+        except Exception:  # noqa: BLE001 - mid-restart
+            return False
+        evs = [e for e in doc.get("watchdog", {}).get("recent_events", ())
+               if e.get("kind") == "stall" and e.get("loop") == "dbnode.tick"]
+        if evs:
+            events.extend(evs)
+            return True
+        return False
+
+    try:
+        ClusterEnv.wait_until(stalled, timeout_s=timeout_s, every_s=0.5,
+                              desc="watchdog stall verdict")
+    except TimeoutError:
+        pass
+    finally:
+        agent.stop(service)
+        agent.start(service, env=base_env, grace_s=0.5)
+    if recorder is not None:
+        for ev in events:
+            key = (service, ev.get("loop"), ev.get("t_unix"))
+            recorder._events.setdefault(key, {**ev, "service": service})
+    return {"service": service, "window": [t_start, time.time()],
+            "fault_spec": STALL_DRILL_SPEC,
+            "events": events}
+
+
+# ---------------------------------------------------------------------------
 # full production deployment (real processes) — shared by the CLI and the
 # chaos-lane pytest
 
@@ -798,6 +990,9 @@ ingest:
   host: 127.0.0.1
   port: {port}
 flush_interval_s: 1.0
+# the aggregator has no HTTP API; the shared debug surface serves its
+# /debug/profile (profiler top-N, contended locks, stall watchdog)
+debug_port: {debug_port}
 """
 
 
@@ -836,6 +1031,12 @@ class RigCluster:
             "PALLAS_AXON_POOL_IPS": "",
             "PYTHONPATH": repo_root,
             "M3_TPU_FAULTS_EXIT": "1",  # crash rules kill the process
+            # the always-on profiling plane, armed fleet-wide: sampling
+            # profiler + stall watchdog (M3_TPU_PROFILE) and per-class
+            # lock-wait profiling (M3_TPU_LOCK_PROFILE) run for the
+            # whole schedule — the trajectory artifact reads them back
+            "M3_TPU_PROFILE": "1",
+            "M3_TPU_LOCK_PROFILE": "1",
         }
         agent_names = ([f"kv{i}" for i in range(kvd_replicas)]
                        + [f"h{i}" for i in range(n_dbnodes)] + ["hc"])
@@ -848,6 +1049,7 @@ class RigCluster:
         self.node_ports = {f"node{i}": free_port() for i in range(n_dbnodes)}
         self.coord_port = free_port()
         self.agg_port = free_port()
+        self.agg_debug_port = free_port()
         self.kvd_ports = {f"kv{i}": free_port() for i in range(kvd_replicas)}
         self.kv_addr = ""
         self.tenant_quotas = tenant_quotas or {}
@@ -922,8 +1124,9 @@ class RigCluster:
             port=self.coord_port, tenant_quota_yaml=quota_yaml))
         self.agents["hc"].start("coord", "m3_tpu.services.coordinator",
                                 "coord.yml", env=self.base_service_env)
-        self.agents["hc"].put_file("agg.yml",
-                                   AGG_CFG.format(port=self.agg_port))
+        self.agents["hc"].put_file(
+            "agg.yml", AGG_CFG.format(port=self.agg_port,
+                                      debug_port=self.agg_debug_port))
         self.agents["hc"].start("agg", "m3_tpu.services.aggregator",
                                 "agg.yml", env=self.base_service_env)
         ClusterEnv.wait_until(
@@ -952,6 +1155,14 @@ class RigCluster:
             breaker_config=BreakerConfig(open_timeout_s=1.0,
                                          retry_jitter_frac=0.25),
         )
+
+    def profile_ports(self) -> dict[str, int]:
+        """Every port serving /debug/profile: coordinator + dbnodes on
+        their APIs, the aggregator on its debug surface (kvd replicas
+        arm the profiler too; their surface is config-opt-in)."""
+        return {"coordinator": self.coord_port,
+                "aggregator": self.agg_debug_port,
+                **dict(self.node_ports)}
 
     def chaos_targets(self) -> list[tuple]:
         """Every killable process: dbnodes, one kvd replica, the
@@ -1035,6 +1246,12 @@ def run_production_rig(workdir: str, seconds: float = 20.0, seed: int = 7,
                         slo_p99_ms=slo_p99_ms)
         rig = Rig(cfg, session_write_fn(session),
                   http_query_fn(cluster.coord_port), ledger=ledger)
+        # the soak-trajectory recorder runs across EVERY phase: QPS,
+        # p99, RSS, contended locks and stall events over time — the
+        # first-class artifact of the profiling & saturation plane
+        recorder = TrajectoryRecorder(cluster.coord_port,
+                                      cluster.profile_ports(), rig=rig)
+        recorder.start()
         schedule = ChaosSchedule.generate(seed, chaos_s,
                                           cluster.chaos_targets())
         report["schedule"] = [e.to_doc() for e in schedule]
@@ -1077,6 +1294,13 @@ def run_production_rig(workdir: str, seconds: float = 20.0, seed: int = 7,
         # cycle budget — nothing here invokes repair
         report["convergence"] = convergence_audit(
             cluster, tenants, budget_cycles=10, interval_s=1.0)
+
+        # ---- stall drill: the watchdog proves itself on a live node ----
+        # one dbnode restarted under a tick-wedging fault plan; its OWN
+        # watchdog must flag the stalled loop (with the wedged thread's
+        # stack) on the fault-exempt /debug/profile before the heal
+        report["stall_drill"] = stall_drill(cluster, recorder)
+        cluster.wait_all_healthy()
 
         # ---- phase 2: noisy-tenant isolation under a node kill ----
         # runtime quota push through the kvd metadata plane: noisy goes
@@ -1124,6 +1348,20 @@ def run_production_rig(workdir: str, seconds: float = 20.0, seed: int = 7,
             "slo_p99_ms": slo_p99_ms,
         }
         cluster.wait_all_healthy()
+        recorder.stop()
+        try:
+            recorder.sample_once()  # one final post-heal row
+        except Exception:  # noqa: BLE001 - best-effort tail sample
+            pass
+        trajectory = recorder.artifact()
+        report["trajectory"] = trajectory
+        try:
+            import os as _os
+
+            with open(_os.path.join(workdir, "trajectory.json"), "w") as f:
+                json.dump(trajectory, f, indent=2, default=str)
+        except OSError:
+            pass
         report["final_heartbeats"] = {
             name: ("ok" if "services" in hb else hb.get("error", "?"))
             for name, hb in cluster.env.heartbeats().items()
@@ -1143,9 +1381,12 @@ def main(argv=None) -> int:
     report = run_production_rig(args.workdir, args.seconds, args.seed,
                                 args.slo_p99_ms)
     print(json.dumps(report, indent=2, default=str))
+    traj = report.get("trajectory", {})
     ok = (not report.get("verify", {}).get("missing")
           and report.get("convergence", {}).get("converged", False)
-          and report.get("noisy_phase", {}).get("noisy_sheds", 0) > 0)
+          and report.get("noisy_phase", {}).get("noisy_sheds", 0) > 0
+          and bool(traj.get("stall_events"))
+          and bool(traj.get("contended_locks")))
     return 0 if ok else 1
 
 

@@ -81,12 +81,22 @@ class TelemetryExporter:
         self._queue: deque[dict] = deque()
         self.queue_max = queue_max
         self._cursor = 0
+        self._profile_cursor = 0  # sampling-profiler snapshot cursor
+        self.dropped_payloads = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # the exporter's own health rides the same registry it exports
         self._scope = self.registry.root_scope("exporter") \
             .subscope("svc", service=service)
+        # saturation plane: the bounded payload queue's depth/drops are
+        # gauges refreshed at every registry snapshot
+        from m3_tpu.utils.instrument import monitor_queue
+
+        self._unmonitor = monitor_queue(
+            "exporter", lambda: len(self._queue), lambda: self.queue_max,
+            drops_fn=lambda: self.dropped_payloads, owner=self,
+            service=service)
 
     # -- collection --
 
@@ -94,11 +104,18 @@ class TelemetryExporter:
         """One export payload: spans recorded since the last collect plus
         a full metrics snapshot. None when there is nothing new to say
         (no new spans AND no metrics — a fresh idle process)."""
+        from m3_tpu.utils import profiler
+
         now_ns = now_ns if now_ns is not None else time.time_ns()
         spans, self._cursor = self.tracer.export_since(self._cursor)
+        # sampling-profiler snapshots ride the same cursor discipline as
+        # spans: a sampling epoch ships at most once, an idle profiler
+        # ships nothing
+        prof, self._profile_cursor = profiler.default_profiler() \
+            .export_since(self._profile_cursor)
         counters, gauges, timers, hists = self.registry.snapshot()
         if not spans and not counters and not gauges and not timers \
-                and not hists:
+                and not hists and prof is None:
             return None
         metrics = []
         for (name, tags), v in counters.items():
@@ -116,13 +133,16 @@ class TelemetryExporter:
                             "attributes": dict(tags),
                             "bounds": list(bounds), "counts": list(counts),
                             "sum": hsum, "count": hcount})
-        return {
+        payload = {
             "resource": {"service.name": self.service,
                          "process.pid": os.getpid()},
             "time_unix_ns": now_ns,
             "scopeSpans": spans,
             "scopeMetrics": metrics,
         }
+        if prof is not None:
+            payload["scopeProfile"] = prof
+        return payload
 
     # -- queue + ship --
 
@@ -130,6 +150,7 @@ class TelemetryExporter:
         with self._lock:
             while len(self._queue) >= self.queue_max:
                 dropped = self._queue.popleft()
+                self.dropped_payloads += 1
                 self._scope.counter("dropped_payloads")
                 self._scope.counter("dropped_spans",
                                     len(dropped.get("scopeSpans", ())))
@@ -181,11 +202,19 @@ class TelemetryExporter:
             return
 
         def loop():
-            while not self._stop.wait(self.interval_s):
-                try:
-                    self.tick()
-                except Exception:  # noqa: BLE001 - the drainer must
-                    pass           # outlive any transient sink weirdness
+            from m3_tpu.utils import profiler
+
+            hb = profiler.register_heartbeat(f"exporter.{self.service}",
+                                             self.interval_s)
+            try:
+                while not self._stop.wait(self.interval_s):
+                    hb.beat()
+                    try:
+                        self.tick()
+                    except Exception:  # noqa: BLE001 - the drainer must
+                        pass           # outlive transient sink weirdness
+            finally:
+                hb.close()
 
         self._thread = threading.Thread(
             target=loop, name=f"telemetry-export-{self.service}", daemon=True)
@@ -201,6 +230,7 @@ class TelemetryExporter:
             self.tick()
         except Exception:  # noqa: BLE001 - best-effort final flush
             pass
+        self._unmonitor()
 
 
 def exporter_from_config(config: dict | None, service: str,

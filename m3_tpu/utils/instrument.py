@@ -256,6 +256,159 @@ class Scope:
         return observe
 
 
+# ---------------------------------------------------------------------------
+# snapshot hooks + bounded-queue saturation monitors
+# ---------------------------------------------------------------------------
+
+# hooks run at the top of every MetricsRegistry.snapshot() — the one
+# choke point every consumer (the /metrics render, the telemetry
+# exporter, the _m3_system self-scrape) already goes through — so
+# pull-model telemetry (queue depths, lock-wait deltas) is always fresh
+# at read time without its own refresh loops. Guarded against
+# re-entrancy: a hook that snapshots a registry runs with hooks off.
+_hooks_lock = threading.Lock()
+_snapshot_hooks: list = []
+_hooks_tl = threading.local()
+
+
+def register_snapshot_hook(fn) -> None:
+    """Register fn(registry) to run before every registry snapshot."""
+    with _hooks_lock:
+        if fn not in _snapshot_hooks:
+            _snapshot_hooks.append(fn)
+
+
+def _run_snapshot_hooks(registry: "MetricsRegistry") -> None:
+    if getattr(_hooks_tl, "running", False):
+        return
+    _hooks_tl.running = True
+    try:
+        _refresh_queue_monitors(registry)
+        with _hooks_lock:
+            hooks = list(_snapshot_hooks)
+        for fn in hooks:
+            try:
+                fn(registry)
+            except Exception:  # noqa: BLE001 - telemetry hooks must never
+                pass           # break a scrape
+    finally:
+        _hooks_tl.running = False
+
+
+class _MonitorFns:
+    """The callables of one registration. With an `owner`, the STRONG
+    reference to this holder lives on the owner object itself and the
+    registry keeps only a weakref — the registered closures almost
+    always close over the owner, so holding them strongly here would pin
+    an abandoned owner (and its buffers/sockets) for process lifetime.
+    Owner + holder + closures form a cycle; the gc collects it whole,
+    the weakref dies, and the monitor prunes itself."""
+
+    __slots__ = ("depth_fn", "capacity", "drops_fn", "__weakref__")
+
+    def __init__(self, depth_fn, capacity, drops_fn):
+        self.depth_fn = depth_fn
+        self.capacity = capacity
+        self.drops_fn = drops_fn
+
+
+class _QueueMonitor:
+    __slots__ = ("name", "tags", "fns_ref", "registry")
+
+    def __init__(self, name, tags, fns_ref, registry):
+        self.name = name
+        self.tags = tags
+        self.fns_ref = fns_ref  # () -> _MonitorFns | None (None = dead)
+        self.registry = registry
+
+
+_monitors_lock = threading.Lock()
+_queue_monitors: list[_QueueMonitor] = []
+
+
+def monitor_queue(name: str, depth_fn, capacity=None, drops_fn=None,
+                  registry: "MetricsRegistry | None" = None, owner=None,
+                  **tags):
+    """Register a bounded queue/ring with the saturation plane: its
+    depth/capacity/drop gauges (``queue_depth{queue=...}`` etc.) refresh
+    at every registry snapshot, so /metrics, the exporter and the
+    ``_m3_system`` self-scrape all see saturation without the queue
+    owner pushing anything. `capacity` is an int or a callable;
+    `drops_fn` (optional) reads a monotonic dropped-items counter.
+    Passing `owner` ties the registration's lifetime to that object:
+    the callables are anchored ON the owner and the registry keeps only
+    a weakref, so an owner dropped without close() is still collectable
+    (closures over `self` would otherwise pin it here forever) and its
+    monitor prunes itself at the next refresh. Returns an unregister
+    callable. m3lint's ``inv-queue-gauge`` invariant holds every bounded
+    queue in the tree to this registration."""
+    import weakref
+
+    fns = _MonitorFns(depth_fn, capacity, drops_fn)
+    if owner is not None:
+        anchors = getattr(owner, "_m3_monitor_fns", None)
+        if anchors is None:
+            anchors = []
+            try:
+                owner._m3_monitor_fns = anchors
+            except AttributeError:  # __slots__ owner: fall back to a
+                anchors = None      # strong (immortal) registration
+        if anchors is not None:
+            anchors.append(fns)
+            fns_ref = weakref.ref(fns)
+        else:
+            fns_ref = (lambda f=fns: f)
+    else:
+        fns_ref = (lambda f=fns: f)
+    mon = _QueueMonitor(name, tuple(sorted(tags.items())), fns_ref, registry)
+    with _monitors_lock:
+        _queue_monitors.append(mon)
+
+    def unregister():
+        with _monitors_lock:
+            try:
+                _queue_monitors.remove(mon)
+            except ValueError:
+                pass
+
+    return unregister
+
+
+def _refresh_queue_monitors(registry: "MetricsRegistry") -> None:
+    dead: list[_QueueMonitor] = []
+    with _monitors_lock:
+        monitors = list(_queue_monitors)
+    for mon in monitors:
+        target = mon.registry if mon.registry is not None \
+            else _default_registry
+        if target is not registry:
+            continue
+        fns = mon.fns_ref()
+        if fns is None:  # owner (and its anchored callables) collected
+            dead.append(mon)
+            continue
+        try:
+            depth = float(fns.depth_fn())
+            cap = fns.capacity() if callable(fns.capacity) else fns.capacity
+            drops = float(fns.drops_fn()) if fns.drops_fn is not None else None
+        except Exception:  # noqa: BLE001 - a mid-teardown queue must not
+            continue       # break the scrape
+        scope = Scope(registry, "queue",
+                      tuple(sorted((("queue", mon.name), *mon.tags))))
+        scope.gauge("depth", depth)
+        if cap is not None:
+            scope.gauge("capacity", float(cap))
+        if drops is not None:
+            scope.gauge("dropped", drops)
+    if dead:
+        with _monitors_lock:
+            for mon in dead:
+                try:
+                    _queue_monitors.remove(mon)
+                except ValueError:
+                    pass
+
+
 def _prom_name(name: str) -> str:
     return name.replace(".", "_").replace("-", "_")
 
@@ -288,10 +441,32 @@ class MetricsRegistry:
     def root_scope(self, prefix: str = "") -> Scope:
         return Scope(self, prefix)
 
+    def merge_histogram(self, name: str, tags: tuple, bounds: tuple,
+                        counts_delta, sum_delta: float) -> None:
+        """Fold externally-accumulated histogram DELTAS into this
+        registry (the lock-wait profiler publishes through here: its hot
+        path must not touch the registry lock, so it accumulates raw and
+        merges at snapshot time). First merge binds the bounds."""
+        with self._lock:
+            key = (name, tags)
+            h = self.histograms.get(key)
+            if h is None:
+                h = _Histogram(bounds=tuple(bounds))
+                h.counts = [0] * (len(h.bounds) + 1)
+                self.histograms[key] = h
+            for i, c in enumerate(counts_delta):
+                if i < len(h.counts):
+                    h.counts[i] += c
+            h.sum += sum_delta
+            h.count += sum(counts_delta)
+
     def snapshot(self):
         """Point-in-time copy of every metric, one lock acquisition:
         (counters, gauges, timers, histograms) dicts keyed (name, tags).
-        Histogram entries are (bounds, counts, sum, count) tuples."""
+        Histogram entries are (bounds, counts, sum, count) tuples.
+        Registered snapshot hooks (queue-saturation gauges, lock-wait
+        publishing) run first, so every consumer reads fresh values."""
+        _run_snapshot_hooks(self)
         with self._lock:
             counters = {k: c.value for k, c in self.counters.items()}
             gauges = {k: g.value for k, g in self.gauges.items()}

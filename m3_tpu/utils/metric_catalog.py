@@ -41,8 +41,23 @@ HISTOGRAMS = {
     "fetch_many_seconds",       # session batched fetch
     "request_seconds",          # coordinator request + per-tenant SLO
     "flush_seconds",            # aggregator flush
+    # profiling & saturation plane (utils/profiler)
+    "sample_seconds",           # profiler per-pass sampling wall time
+    "wait_seconds",             # lock.wait_seconds{cls=site}: per-class
+    #                             acquire-wait (published via
+    #                             merge_histogram at snapshot time)
 }
 
 TIMERS = {
     "tick",                     # coordinator/dbnode tick loops
 }
+
+# Non-histogram families the profiling & saturation plane exports —
+# documented here so dashboards have one contract file to read (the
+# lint only enforces the histogram/timer sets above):
+#   queue_depth / queue_capacity / queue_dropped {queue=...}  gauges
+#       refreshed at every registry snapshot (instrument.monitor_queue)
+#   lock_acquisitions / lock_contended {cls=...}              counters
+#   watchdog_loop_stalls {loop=...}                           counter
+#   profiler_samples / profiler_evicted_samples               (status
+#       JSON on /debug/profile; not registry families)

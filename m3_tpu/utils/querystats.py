@@ -88,6 +88,13 @@ _tls = threading.local()
 _ring_lock = threading.Lock()
 _ring: deque[QueryStats] = deque(maxlen=256)
 
+# the slow-query ring is a bounded buffer: its fill level rides the
+# saturation plane (instrument.monitor_queue; m3lint inv-queue-gauge)
+from m3_tpu.utils import instrument as _instrument  # noqa: E402
+
+_instrument.monitor_queue("slow_query_ring", lambda: len(_ring),
+                          _ring.maxlen)
+
 
 def _env_threshold_s() -> float:
     try:

@@ -38,19 +38,14 @@ except (ValueError, OSError, AttributeError):
     pass
 
 
-def record_process_gauges(registry: MetricsRegistry | None = None) -> None:
-    """Compute-plane health gauges, refreshed each self-scrape tick:
-    process RSS (from /proc/self/statm, getrusage fallback) and per-device
-    accelerator memory in use (jax memory_stats — only when a backend is
-    ALREADY initialized, same no-init rule as utils/dispatch: a scrape
-    must never be the thing that pays, or wedges on, PJRT init). CPU
-    backends report no memory_stats and are skipped."""
-    registry = registry or default_registry()
-    scope = registry.root_scope("process")
-    rss = 0
+def rss_bytes() -> int:
+    """Process resident-set size in bytes (0 when unreadable): the one
+    RSS reader both observability surfaces share — the `_m3_system`
+    process_rss_bytes gauge here and /debug/profile + the rig
+    trajectory (utils/profiler) must never disagree about RSS."""
     try:
         with open("/proc/self/statm") as f:
-            rss = int(f.read().split()[1]) * _PAGE_SIZE
+            return int(f.read().split()[1]) * _PAGE_SIZE
     except (OSError, ValueError, IndexError):
         try:
             import resource
@@ -60,9 +55,21 @@ def record_process_gauges(registry: MetricsRegistry | None = None) -> None:
             # ru_maxrss is KILOBYTES on linux but BYTES on darwin — and
             # darwin is exactly where the /proc path above fails. (Peak
             # rss, not current: the best this fallback can do.)
-            rss = peak if _sys.platform == "darwin" else peak * 1024
+            return peak if _sys.platform == "darwin" else peak * 1024
         except Exception:  # noqa: BLE001 - no rss source on this platform
-            pass
+            return 0
+
+
+def record_process_gauges(registry: MetricsRegistry | None = None) -> None:
+    """Compute-plane health gauges, refreshed each self-scrape tick:
+    process RSS (from /proc/self/statm, getrusage fallback) and per-device
+    accelerator memory in use (jax memory_stats — only when a backend is
+    ALREADY initialized, same no-init rule as utils/dispatch: a scrape
+    must never be the thing that pays, or wedges on, PJRT init). CPU
+    backends report no memory_stats and are skipped."""
+    registry = registry or default_registry()
+    scope = registry.root_scope("process")
+    rss = rss_bytes()
     if rss:
         scope.gauge("rss_bytes", float(rss))
     import sys
@@ -188,16 +195,45 @@ class SelfMonitor:
         self.registry = registry or default_registry()
         self._clock = clock
         self._last = 0.0
+        # anchor for cadence inference: time from construction to the
+        # first maybe_scrape approximates the driver's tick interval
+        self._last_call = clock()
         self.samples_written = 0
         self.enabled = ensure_namespace(db, namespace)
+        self._hb = None
 
     def maybe_scrape(self, now_ns: int | None = None) -> int:
         if not self.enabled:
             return 0
         now = self._clock()
+        # stall watchdog: a wedged self-scrape means the platform has
+        # silently gone blind to itself. Registered LAZILY on the first
+        # call and beaten per CALL, with the interval self-tuned to the
+        # observed driving cadence — this monitor is ticked by the
+        # coordinator loop, which may run slower than interval_s; a 1s
+        # scrape interval under a 10s tick (or the construction-to-first-
+        # tick gap) must never read as a stall, while a driver that
+        # stops calling entirely still flags
+        gap = now - self._last_call
+        self._last_call = now
+        if self._hb is None:
+            from m3_tpu.utils import profiler
+
+            self._hb = profiler.register_heartbeat(
+                "selfscrape", max(self.interval_s, gap))
+        else:
+            self._hb.interval_s = max(self.interval_s, gap)
+        self._hb.beat()
         if now - self._last < self.interval_s:
             return 0
         self._last = now
         n = scrape_once(self.db, self.registry, self.namespace, now_ns)
         self.samples_written += n
         return n
+
+    def close(self) -> None:
+        """Unregister the watchdog heartbeat (service shutdown) — a
+        registered loop that will never beat again is a false stall."""
+        if self._hb is not None:
+            self._hb.close()
+            self._hb = None

@@ -61,6 +61,7 @@ PEER_HTTP = "storage.peer.http"
 TENANT_SHED = "tenant.admission.shed"
 REPAIR_CYCLE = "storage.repair.cycle"
 QUERY_COMPILE_FALLBACK = "query.compile.fallback"
+WATCHDOG_STALL = "watchdog.stall"
 
 _ZERO_SPAN_ID = "0" * 16
 # placeholder trace id carried by a negative head decision's context —
@@ -152,6 +153,10 @@ class Tracer:
     def __init__(self, capacity: int = 2048, sample_every: int = 1):
         self.capacity = capacity
         self.sample_every = max(1, sample_every)
+        # only the PROCESS tracer's ring rides the saturation plane (the
+        # module-level monitor_queue below); privately-constructed
+        # tracers are test fixtures whose rings gauge nothing
+        # m3lint: disable=inv-queue-gauge
         self._spans: deque[Span] = deque(maxlen=capacity)
         self._tl = threading.local()
         self._lock = threading.Lock()
@@ -339,6 +344,14 @@ def _env_sample() -> tuple[int, bool]:
 _sample_every, _enabled = _env_sample()
 _default = Tracer(sample_every=_sample_every)
 _default.enabled = _enabled
+
+# the process span ring is a bounded buffer like any other: its depth
+# rides the saturation plane (a full ring means the exporter is losing
+# spans between drains)
+from m3_tpu.utils import instrument as _instrument  # noqa: E402
+
+_instrument.monitor_queue("trace_ring", lambda: len(_default._spans),
+                          _default.capacity)
 
 
 def default_tracer() -> Tracer:

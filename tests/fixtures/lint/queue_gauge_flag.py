@@ -1,0 +1,25 @@
+"""inv-queue-gauge MUST-FLAG fixture: bounded buffers with no
+monitor_queue registration anywhere in the module — they can saturate
+and drop with nothing on the saturation plane."""
+
+import queue
+import threading
+from collections import deque
+
+
+class HintSink:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # bounded ring, silently drop-oldest: must flag
+        self._ring: deque = deque(maxlen=128)
+        # bounded handoff queue: must flag
+        self._q: queue.Queue = queue.Queue(maxsize=64)
+        # positional forms are bounded too: must flag
+        self._q2: queue.Queue = queue.Queue(64)
+        # UNbounded buffers: not the rule's business
+        self._log: deque = deque()
+        self._anyq: queue.Queue = queue.Queue(maxsize=0)
+
+    def push(self, item) -> None:
+        with self._lock:
+            self._ring.append(item)

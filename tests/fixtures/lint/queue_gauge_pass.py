@@ -1,0 +1,33 @@
+"""inv-queue-gauge MUST-PASS fixture: the bounded buffers register with
+instrument.monitor_queue (or carry an explicit waiver for an
+intentionally unmonitored internal)."""
+
+import threading
+from collections import deque
+
+from m3_tpu.utils import instrument
+
+
+class MonitoredSink:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=128)
+        self.drops = 0
+        self._unmonitor = instrument.monitor_queue(
+            "fixture_ring", lambda: len(self._ring), self._ring.maxlen,
+            drops_fn=lambda: self.drops, owner=self)
+
+    def push(self, item) -> None:
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.drops += 1
+            self._ring.append(item)
+
+
+class WaivedInternal:
+    """An intentionally unmonitored internal ring: the waiver documents
+    the decision in-code, and going stale makes it a finding."""
+
+    def __init__(self):
+        # m3lint: disable=inv-queue-gauge
+        self._scratch: deque = deque(maxlen=8)

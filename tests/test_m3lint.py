@@ -132,6 +132,20 @@ class TestInvariantRules:
         # cataloged histogram names
         assert run_lint("inv_pass.py", select=("inv-",)) == []
 
+    def test_queue_gauge_flags(self):
+        # every bounded shape lands — deque(maxlen=...), keyword AND
+        # positional Queue(maxsize) — while unbounded buffers
+        # (bare deque(), maxsize=0) stay out of scope
+        fs = run_lint("queue_gauge_flag.py", select=("inv-queue",))
+        assert rules_of(fs) == {"inv-queue-gauge"}
+        assert len(fs) == 3, fs
+
+    def test_queue_gauge_registered_or_waived_passes(self):
+        # a class registering monitor_queue passes; the intentionally
+        # unmonitored internal passes via its explicit waiver (which is
+        # therefore USED — no lint-unused-waiver either)
+        assert run_lint("queue_gauge_pass.py", select=("inv-queue",)) == []
+
 
 class TestWaivers:
     def test_waived_finding_is_suppressed(self):

@@ -350,6 +350,22 @@ class TestProcessRig:
         assert noisy["steady_pair_median_p99_ms"] is not None, noisy
         assert noisy["steady_pair_median_p99_ms"] <= noisy["slo_p99_ms"], noisy
 
+        # the soak trajectory artifact (profiling & saturation plane):
+        # sampled rows with QPS/p99/RSS, a NON-EMPTY contended-lock
+        # table from the armed lock-wait profiler, and >= 1 watchdog
+        # stall event (the drill wedges a live dbnode's tick loop; its
+        # own watchdog must report it with the wedged thread's stack)
+        traj = report["trajectory"]
+        assert traj["schema"] == rigmod.TrajectoryRecorder.SCHEMA
+        assert len(traj["samples"]) >= 3, traj["samples"]
+        assert any(s["rss_bytes"] for s in traj["samples"]), traj["samples"]
+        assert traj["contended_locks"], "no contended locks recorded"
+        assert traj["stall_events"], report.get("stall_drill")
+        drill = report["stall_drill"]
+        assert drill["events"], drill
+        assert any("dbnode.py" in (e.get("stack") or "")
+                   for e in drill["events"]), drill
+
         # every process is back at the end
         assert all(v == "ok" for v in report["final_heartbeats"].values())
 

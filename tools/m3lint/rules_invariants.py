@@ -51,6 +51,7 @@ RULES = {
     "inv-fault-point-unique": "fault point name declared at more than one site",
     "inv-histogram-catalog": "histogram/timer name missing from the catalog",
     "inv-crash-swallow": "broad except around a fault seam swallows SimulatedCrash",
+    "inv-queue-gauge": "bounded queue/ring without a monitor_queue registration",
 }
 
 # modules whose fault-point mentions are documentation or test scaffolding
@@ -449,12 +450,97 @@ def _check_crash_swallow(proj: Project):
 
 
 # ---------------------------------------------------------------------------
+# rule 9: bounded queues must register with the saturation plane
+# ---------------------------------------------------------------------------
+
+def _unbounding_const(node: ast.AST) -> bool:
+    """A literal that makes the buffer unbounded (None maxlen, 0/negative
+    maxsize)."""
+    return isinstance(node, ast.Constant) and (
+        node.value is None
+        or (isinstance(node.value, (int, float)) and node.value <= 0))
+
+
+def _is_bounded_queue_ctor(call: ast.Call) -> bool:
+    """A ``deque(..., maxlen)`` (non-None) or ``queue.Queue(maxsize)``
+    construction, keyword OR positional — a bounded buffer that can
+    silently fill and drop."""
+    name = _call_name(call)
+    if name == "deque":
+        for kw in call.keywords:
+            if kw.arg == "maxlen":
+                return not _unbounding_const(kw.value)
+        return len(call.args) >= 2 and not _unbounding_const(call.args[1])
+    if name == "Queue":
+        for kw in call.keywords:
+            if kw.arg == "maxsize":
+                return not _unbounding_const(kw.value)
+        return len(call.args) >= 1 and not _unbounding_const(call.args[0])
+    return False
+
+
+class _QueueScanner(ast.NodeVisitor):
+    """Bounded-queue ctors + monitor_queue calls, per enclosing class.
+
+    Scope key is the innermost ClassDef (None = module level): a class
+    that builds bounded buffers must register at least one monitor; a
+    module-level ring is satisfied by a module-level registration (the
+    default-instance idiom, e.g. the tracer's span ring)."""
+
+    def __init__(self):
+        self._stack: list[ast.ClassDef | None] = [None]
+        self.ctors: list[tuple[ast.ClassDef | None, int]] = []
+        self.monitored: set[ast.ClassDef | None] = set()
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_Call(self, node: ast.Call):
+        if _call_name(node) == "monitor_queue":
+            self.monitored.add(self._stack[-1])
+        elif _is_bounded_queue_ctor(node):
+            self.ctors.append((self._stack[-1], node.lineno))
+        self.generic_visit(node)
+
+
+def _check_queue_gauges(proj: Project):
+    """Every bounded queue/ring must be registered with
+    ``instrument.monitor_queue`` so its depth/capacity/drop gauges ride
+    the saturation plane (a bounded queue with no gauge fills and drops
+    invisibly — the failure mode this PR exists to kill). Deliberately
+    unmonitored internals carry a same-line/line-above
+    ``# m3lint: disable=inv-queue-gauge`` waiver."""
+    for mod in proj.modules:
+        if mod.rel in EXEMPT:
+            continue
+        sc = _QueueScanner()
+        sc.visit(mod.tree)
+        if not sc.ctors:
+            continue
+        for cls, lineno in sc.ctors:
+            # scope-matched blessing: a class's queues need a monitor in
+            # THAT class; module-level rings need a module-level call —
+            # one module-level registration must not silence every class
+            # in the file
+            if cls in sc.monitored:
+                continue
+            yield Finding(
+                "inv-queue-gauge", mod.path, lineno,
+                "bounded queue/ring is not registered with "
+                "instrument.monitor_queue — it can saturate and drop "
+                "with no depth/capacity/drop gauges on the saturation "
+                "plane (waive only for intentionally unmonitored "
+                "internals)")
+
 
 def check(proj: Project):
     # per-module rules run in both whole-tree and explicit-paths mode
     yield from _check_fault_seams(proj)
     yield from _check_histogram_catalog(proj)
     yield from _check_crash_swallow(proj)
+    yield from _check_queue_gauges(proj)
     if not proj.whole_tree:
         return
     # project-level rules reference fixed files; whole-tree mode only
