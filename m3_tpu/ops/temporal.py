@@ -172,6 +172,46 @@ def stage_instant_delta(v, t, lo, hi, is_counter: bool, is_rate: bool):
     return jnp.where(ok, out, jnp.nan)
 
 
+def stage_window_minmax(v, lo, hi, levels: int, is_min: bool):
+    """min/max_over_time via a sparse table (ROADMAP carried follow-up):
+    ``levels`` log-levels of shifted pairwise min/max over the sample
+    array — m[k][i] = op(v[i : i + 2^k]) — then every (series, step)
+    window answers with TWO gathers: op(m[k][lo], m[k][hi - 2^k]) where
+    k = floor(log2(hi - lo)). The two anchored ranges tile [lo, hi)
+    with overlap, which min/max absorb. O(N log W) build amortized over
+    all S x T windows vs the O(N W) rescan; NaN samples propagate
+    through the table exactly like np.minimum.reduceat on the host
+    path, so the compiled result is bit-identical to the interpreter.
+
+    ``levels`` is a trace-time constant (bucketed from the query's max
+    window sample count, so executables stay O(log) per axis); window
+    reads never cross a series row (bounds are row-local), so pad and
+    neighbor-row contamination in high table levels is unreachable."""
+    import jax
+    import jax.numpy as jnp
+
+    op = jnp.minimum if is_min else jnp.maximum
+    fill = jnp.inf if is_min else -jnp.inf
+    n = v.shape[0]
+    rows = [v]
+    cur = v
+    for k in range(1, levels):
+        w = 1 << (k - 1)
+        shifted = jnp.concatenate([cur[w:], jnp.full((w,), fill)])[:n]
+        cur = op(cur, shifted)
+        rows.append(cur)
+    tbl = jnp.stack(rows)  # [levels, N]
+    length = hi - lo
+    has = length > 0
+    safe_len = jnp.maximum(length, 1).astype(jnp.int64)
+    k = (63 - jax.lax.clz(safe_len)).astype(lo.dtype)
+    k = jnp.clip(k, 0, levels - 1)
+    span = jnp.left_shift(jnp.ones((), lo.dtype), k)
+    a = tbl[k, jnp.clip(lo, 0, n - 1)]
+    b = tbl[k, jnp.clip(hi - span, 0, n - 1)]
+    return jnp.where(has, op(a, b), jnp.nan)
+
+
 def stage_reset_adjusted(v, is_first, row_start_index):
     """Counter monotonization: v + cumulative in-row reset drops.
     row_start_index[i] = index of sample i's row's first sample."""
@@ -238,6 +278,8 @@ def _kernels():
             static_argnames=("is_counter", "is_rate")),
         "holt_winters": holt_winters,
         "reset_adjusted": jax.jit(stage_reset_adjusted),
+        "window_minmax": jax.jit(
+            stage_window_minmax, static_argnames=("levels", "is_min")),
     }
 
 
@@ -324,6 +366,27 @@ def holt_winters(values: np.ndarray, lo: np.ndarray, hi: np.ndarray,
     max_len = dispatch.next_pow2(max(max_len, 1))
     out = _kernels()["holt_winters"](v, lo_p, hi_p, float(sf), float(tf),
                                      max_len)
+    return np.asarray(out)[:S, :T]
+
+
+# sparse-table scratch bound: levels x padded-sample f64 elements (128MB);
+# past it the min/max base stays on the host reduceat path
+MINMAX_SCRATCH_ELEMS = 1 << 24
+
+
+def minmax_levels(max_len: int) -> int:
+    """Static level count for stage_window_minmax, bucketed to powers of
+    two so nearby max-window-lengths share one executable."""
+    return max(dispatch.next_pow2(max(max_len, 1)).bit_length(), 1)
+
+
+def window_minmax(values: np.ndarray, lo: np.ndarray, hi: np.ndarray,
+                  is_min: bool):
+    """Device min/max_over_time over [lo, hi) windows (sparse table)."""
+    v, _ = _pad_samples(values)
+    lo_p, hi_p, S, T = _pad_bounds(lo, hi)
+    levels = minmax_levels(int((hi - lo).max()) if lo.size else 0)
+    out = _kernels()["window_minmax"](v, lo_p, hi_p, levels, bool(is_min))
     return np.asarray(out)[:S, :T]
 
 

@@ -158,7 +158,15 @@ def _grouped_stats_jit():
 
 def _aggregate_groups_device(elem_ids, window_ids, values, order_seq, times):
     """jax lowering of aggregate_groups; pads N to a power of two with a
-    sentinel group that is trimmed on the way out."""
+    sentinel group that is trimmed on the way out.
+
+    When the series-sharded compute mesh is armed (M3_TPU_QUERY_SHARD /
+    a live multi-device accelerator — parallel.mesh.active_compute_mesh),
+    the padded sample triples are placed across it so the flush rollup
+    runs as one SPMD program: the kernel's grouped sort makes XLA gather
+    rows across devices, but the segment reductions and their combines
+    stay partitioned — the m3_agg_groups path rides the same mesh as the
+    fused-query plane (the psum-lowered grouped reductions live there)."""
     n = len(values)
     N = dispatch.next_pow2(n)
     pad = N - n
@@ -169,6 +177,17 @@ def _aggregate_groups_device(elem_ids, window_ids, values, order_seq, times):
     s_p = np.concatenate([order_seq.astype(np.int64),
                           np.arange(pad, dtype=np.int64) + (1 << 60)])
     t_p = np.concatenate([times, np.full(pad, BIG, np.int64)])
+
+    from m3_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.active_compute_mesh()
+    if mesh is not None and N % int(mesh.devices.size) == 0:
+        import jax
+
+        sh = mesh_mod.vec_sharding(mesh)
+        e_p, w_p, v_p, s_p, t_p = (jax.device_put(a, sh)
+                                   for a in (e_p, w_p, v_p, s_p, t_p))
+        dispatch.counters["windowed_agg.aggregate_groups[mesh]"] += 1
 
     kernel = _grouped_stats_jit()
     es, ws, new_group, count, s1, s2, gmin, gmax, last, vq = (

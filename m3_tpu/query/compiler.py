@@ -18,10 +18,28 @@ to the interpreter, counted, never an error):
 
   base:   vector selector (instant lookback gather), or
           rate/increase/delta/irate/idelta(sel[range]), or
-          avg/sum/count/present_over_time(sel[range])
+          avg/sum/count/present_over_time(sel[range]), or
+          min/max_over_time(sel[range]) (sparse-table range-min stage)
   over:   any chain of sum/avg/min/max/count/quantile `by`/`without`
           aggregations (at most one) and scalar-literal binary
           arithmetic (+ - * / % ^), in any order
+
+Sharded compute plane (PR 12, ROADMAP #1): when a ``("series",)``
+compute mesh is active (`parallel.mesh.active_compute_mesh` —
+M3_TPU_QUERY_SHARD or a live multi-device accelerator), the SAME plan
+runs across every device: host prep slices the CSR sample arrays into
+per-device SLABS (each device owns a contiguous block of series rows
+and only its own samples — gathers stay device-local instead of
+thrashing a replicated sample array), the base stage runs under an
+inner shard_map over those slabs, and every later stage boundary emits
+``jax.lax.with_sharding_constraint`` (series-sharded [S, T] until the
+aggregation, replicated [G, T] after it) so XLA's SPMD partitioner
+lowers the grouped segment reductions to psums over the series axis
+itself. The series axis pads to a multiple of the mesh size
+(``dispatch.next_bucket(S, multiple=n_devices)``); numerics are
+device-count independent up to float reassociation in the cross-device
+reductions (exact NaN masks, 1e-9 relative — the same envelope as
+single-device XLA, enforced at 1 and 8 devices by tests/test_parallel).
 
 Plan-shape cache: compiled programs are cached per plan SIGNATURE (the
 op sequence) by an ``functools.lru_cache`` factory — the m3lint-blessed
@@ -73,6 +91,7 @@ _EXTRAP = {"rate": (True, True), "increase": (True, False),
 _INSTANT = {"irate": (True, True), "idelta": (False, False)}
 _OVER_TIME = {"avg_over_time": "avg", "sum_over_time": "sum",
               "count_over_time": "count", "present_over_time": "present"}
+_MINMAX = {"min_over_time": True, "max_over_time": False}  # name -> is_min
 _AGG_OPS = {"sum", "avg", "min", "max", "count", "quantile"}
 _BIN_OPS = {"+", "-", "*", "/", "%", "^"}
 
@@ -167,7 +186,8 @@ def match(expr: Expr) -> PlanSpec | None:
         sel, range_ns, base = e, 0, "instant"
         nodes.append(e)
     elif isinstance(e, Call) and (
-            e.func in _EXTRAP or e.func in _INSTANT or e.func in _OVER_TIME) \
+            e.func in _EXTRAP or e.func in _INSTANT
+            or e.func in _OVER_TIME or e.func in _MINMAX) \
             and len(e.args) == 1 and isinstance(e.args[0], MatrixSelector):
         sel = e.args[0].selector
         if getattr(sel, "at_ns", None) in ("start", "end"):
@@ -207,32 +227,88 @@ def _apply_scalar_op(op: str, a, b):
 
 
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_CAP)
-def _program(sig: tuple):
-    """ONE jit'd whole-plan callable per signature (the blessed lru_cache
-    factory idiom — see tools/m3lint rules_jax): shape buckets reuse it
-    through jax's own executable cache."""
+def _program(sig: tuple, mesh=None):
+    """ONE jit'd whole-plan callable per (signature, mesh) — the blessed
+    lru_cache factory idiom (see tools/m3lint rules_jax): shape buckets
+    reuse it through jax's own executable cache, and the cached
+    ``compute_mesh`` singletons make the mesh key identity-stable.
+
+    Sample inputs arrive as [n_dev, cap] SLABS (n_dev == 1 without a
+    mesh): device d owns rows [d*Sp/n, (d+1)*Sp/n) and exactly those
+    rows' samples, with lo/hi rebased slab-local by host prep. On a mesh
+    the base stage runs under shard_map (every gather device-local) and
+    each later stage boundary emits with_sharding_constraint — series-
+    sharded until the aggregation stage, replicated after it — so the
+    SPMD partitioner lowers the grouped segment reductions to psums over
+    the series axis."""
     import jax
     import jax.numpy as jnp
 
     from m3_tpu.ops import temporal, windowed_agg
 
     base, stages = sig
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
 
-    def run(v, adj, t, csum, lo, hi, eval_ts, range_ns, seg,
-            phi, scalars, num_groups: int):
+        try:
+            from jax import shard_map
+        except ImportError:  # jax<0.5: experimental spelling
+            from jax.experimental.shard_map import shard_map
+        from m3_tpu.parallel.mesh import replicated_sharding, row_sharding
+
+        row_sh = row_sharding(mesh)
+        rep_sh = replicated_sharding(mesh)
+
+    def _constrain(x, grouped: bool):
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, rep_sh if grouped else row_sh)
+
+    def _base_stage(v, adj, t, csum, bmat, lo, hi, eval_ts, range_ns,
+                    mm_levels: int):
+        """The slab-local base stage: pure stage-kernel math over ONE
+        device's samples (or the whole array when unsharded)."""
         if base == "instant":
-            cur = temporal.stage_instant_values(v, lo, hi)
-        elif base in _EXTRAP:
+            return temporal.stage_instant_values(v, lo, hi)
+        if base in _EXTRAP:
             is_counter, is_rate = _EXTRAP[base]
-            cur = temporal.stage_extrapolated_rate(
+            return temporal.stage_extrapolated_rate(
                 v, adj, t, lo, hi, eval_ts, range_ns, is_counter, is_rate)
-        elif base in _INSTANT:
+        if base in _INSTANT:
             is_counter, is_rate = _INSTANT[base]
-            cur = temporal.stage_instant_delta(v, t, lo, hi, is_counter,
-                                               is_rate)
+            return temporal.stage_instant_delta(v, t, lo, hi, is_counter,
+                                                is_rate)
+        if base in _MINMAX:
+            if mm_levels == 0:
+                # sparse table would exceed the scratch cap: host prep
+                # computed the base matrix with the interpreter's exact
+                # reduceat math and ships it through bmat
+                return bmat
+            return temporal.stage_window_minmax(v, lo, hi, mm_levels,
+                                                _MINMAX[base])
+        return temporal.stage_over_time(_OVER_TIME[base], csum, lo, hi)
+
+    def run(vs, adjs, ts, csums, bmat, lo, hi, eval_ts, range_ns, seg,
+            phi, scalars, num_groups: int, mm_levels: int):
+        if mesh is None:
+            cur = _base_stage(vs[0], adjs[0], ts[0], csums[0], bmat,
+                              lo, hi, eval_ts, range_ns, mm_levels)
+        elif base in _MINMAX and mm_levels == 0:
+            cur = bmat  # host-computed base, already row-sharded
         else:
-            cur = temporal.stage_over_time(_OVER_TIME[base], csum, lo, hi)
+            def local(vs, adjs, ts, csums, lo, hi, eval_ts, range_ns):
+                return _base_stage(vs[0], adjs[0], ts[0], csums[0], None,
+                                   lo, hi, eval_ts, range_ns, mm_levels)
+
+            cur = shard_map(
+                local, mesh=mesh,
+                in_specs=(P("series", None),) * 6 + (P(None), P()),
+                out_specs=P("series", None),
+            )(vs, adjs, ts, csums, lo, hi, eval_ts, range_ns)
+        cur = _constrain(cur, grouped=False)
         si = 0
+        grouped = False
         for st in stages:
             if st[0] == "bin":
                 _, op, swapped = st
@@ -258,9 +334,11 @@ def _program(sig: tuple):
                 else:
                     cur = windowed_agg.stage_grouped_reduce(
                         op, cur, seg, num_groups)
+                grouped = True
+            cur = _constrain(cur, grouped)
         return cur
 
-    return jax.jit(run, static_argnames=("num_groups",))
+    return jax.jit(run, static_argnames=("num_groups", "mm_levels"))
 
 
 # ---------------------------------------------------------------------------
@@ -419,22 +497,53 @@ def try_execute(engine, expr: Expr, eval_ts: np.ndarray):
     return out
 
 
-def _pad_bounds(lo: np.ndarray, hi: np.ndarray, n_samples: int):
+def _pad_bounds(lo: np.ndarray, hi: np.ndarray, n_samples: int, Sp: int):
     """Half-octave (next_bucket) padding of the [S, T] bound matrices:
     the fused program pays for every padded cell, so the compiler uses
-    finer buckets than the per-op kernels' powers of two. Bounds are
-    global CSR sample indices in [0, n_samples]; they ship as int32 when
-    that fits — on the hot [S, T] axes that halves both the host->device
-    bytes and the gather-index reads — and int64 on a >2^31-sample fetch
-    (int32 would wrap negative and gather garbage silently)."""
+    finer buckets than the per-op kernels' powers of two. ``Sp`` is the
+    caller's series bucket (a multiple of the mesh size when sharded).
+    Bounds are slab-local CSR sample indices in [0, n_samples]; they
+    ship as int32 when that fits — on the hot [S, T] axes that halves
+    both the host->device bytes and the gather-index reads — and int64
+    on a >2^31-sample slab (int32 would wrap negative and gather
+    garbage silently)."""
     S, T = lo.shape
-    Sp, Tp = dispatch.next_bucket(S), dispatch.next_bucket(T)
+    Tp = dispatch.next_bucket(T)
     dt = np.int32 if n_samples < 2**31 else np.int64
     lo_p = np.zeros((Sp, Tp), dt)
     hi_p = np.zeros((Sp, Tp), dt)
     lo_p[:S, :T] = lo
     hi_p[:S, :T] = hi
     return lo_p, hi_p
+
+
+# slabs beyond this multiple of the balanced sample volume mean a
+# pathologically skewed series->sample distribution; the unsharded
+# program is cheaper than shipping mostly-padding slabs
+_MESH_SKEW_FACTOR = 4
+
+
+def _slab_cuts(offsets: np.ndarray, S: int, Sp: int, n_dev: int):
+    """Per-device sample-slab boundaries: device d owns the contiguous
+    row block [d*Sp/n, (d+1)*Sp/n) and — CSR rows being contiguous —
+    exactly one sample slice. Returns (sample cut [n+1], per-row slab
+    base offset [S]); padded rows (S..Sp) keep their zero bounds and
+    never rebase."""
+    rows_per = Sp // n_dev
+    row_cut = np.minimum(np.arange(n_dev + 1) * rows_per, S)
+    cut = offsets[row_cut]
+    base_off = np.repeat(cut[:-1], np.diff(row_cut))
+    return cut, base_off
+
+
+def _fill_slabs(arr: np.ndarray, cut: np.ndarray, cap: int, fill, dtype):
+    """[n_dev, cap] slab matrix from one CSR array (one slice per slab)."""
+    n_dev = len(cut) - 1
+    out = np.full((n_dev, cap), fill, dtype)
+    for d in range(n_dev):
+        a, b = int(cut[d]), int(cut[d + 1])
+        out[d, :b - a] = arr[a:b]
+    return out
 
 
 def _pad_eval_ts(eval_ts: np.ndarray) -> np.ndarray:
@@ -448,6 +557,7 @@ def _pad_eval_ts(eval_ts: np.ndarray) -> np.ndarray:
 
 def _execute(engine, spec: PlanSpec, labels, raws, eval_ts, col):
     from m3_tpu.ops import temporal
+    from m3_tpu.parallel import mesh as mesh_mod
     from m3_tpu.query import windows
     from m3_tpu.query.engine import Vector, _compact
     from m3_tpu.utils.instrument import default_registry
@@ -473,28 +583,80 @@ def _execute(engine, spec: PlanSpec, labels, raws, eval_ts, col):
     # (prefix sums, counter monotonization) run as one numpy pass — the
     # exact arrays the interpreter gathers from, and numpy's cumsum is an
     # order of magnitude faster than XLA:CPU's — while every per-(series,
-    # step) stage fuses into the one traced program below.
+    # step) stage fuses into the one traced program below. Samples ship
+    # as per-device SLABS (one slab without a mesh): each device owns a
+    # contiguous block of series rows and exactly those rows' samples,
+    # with lo/hi rebased slab-local, so sharded gathers never touch
+    # another device's sample volume.
     n = len(raws.values)
-    v_pad, t_pad = temporal._pad_samples(raws.values, raws.times)
+    mesh = mesh_mod.active_compute_mesh()
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    Sp = dispatch.next_bucket(S, multiple=n_dev)
+    cut, base_off = _slab_cuts(raws.offsets, S, Sp, n_dev)
+    cap = dispatch.next_pow2(int(np.diff(cut).max()))
+    if mesh is not None and \
+            n_dev * cap > _MESH_SKEW_FACTOR * dispatch.next_pow2(max(n, 1)):
+        default_registry().root_scope("compute").subscope(
+            "mesh", devices=str(n_dev)).counter("skew_fallback")
+        mesh, n_dev = None, 1
+        Sp = dispatch.next_bucket(S)
+        cut, base_off = _slab_cuts(raws.offsets, S, Sp, 1)
+        cap = dispatch.next_pow2(max(n, 1))
+
+    lo_p, hi_p = _pad_bounds(lo - base_off[:, None], hi - base_off[:, None],
+                             cap, Sp)
+    eval_pad = _pad_eval_ts(shifted)
+    Tp = lo_p.shape[1]
+
+    dummy = np.zeros((n_dev, 1))
+    ts = np.zeros((n_dev, 1), np.int64)
+    mm_levels = 0
+    bmat = np.zeros((1, 1))
+    vs = adjs = None
+    if spec.base in _MINMAX:
+        max_len = int((hi - lo).max()) if lo.size else 0
+        mm_levels = temporal.minmax_levels(max_len)
+        if mm_levels * cap * n_dev > temporal.MINMAX_SCRATCH_ELEMS:
+            # sparse table over the scratch cap: compute the base matrix
+            # with the interpreter's exact host reduceat and fuse only
+            # the downstream stages (mm_levels == 0 selects this in the
+            # program signature's static bucket; the sample slabs stay
+            # unbuilt — the program only reads bmat on this path)
+            mm_levels = 0
+            op = np.minimum if _MINMAX[spec.base] else np.maximum
+            bmat = np.full((Sp, Tp), np.nan)
+            bmat[:S, :T] = windows._reduceat(op, raws.values, lo, hi, np.nan)
+    if spec.base == "instant" or spec.base in _EXTRAP \
+            or spec.base in _INSTANT or mm_levels > 0:
+        vs = _fill_slabs(raws.values, cut, cap, 0.0, np.float64)
+    if spec.base in _EXTRAP or spec.base in _INSTANT:
+        ts = _fill_slabs(raws.times, cut, cap, np.iinfo(np.int64).max,
+                         np.int64)
     if spec.base in _EXTRAP and _EXTRAP[spec.base][0]:
-        adj = windows._reset_adjusted(raws)
-        adj_pad = np.concatenate([adj, np.zeros(len(v_pad) - n)])
-    else:  # unused by the program
-        adj_pad = v_pad
+        # counter monotonization is global host prep (bit parity with the
+        # interpreter's _reset_adjusted), then sliced per slab
+        adjs = _fill_slabs(windows._reset_adjusted(raws), cut, cap, 0.0,
+                           np.float64)
     if spec.base in ("sum_over_time", "avg_over_time"):
-        csum = np.empty(len(v_pad) + 1)
+        csum = np.empty(n + 1)
         csum[0] = 0.0
         np.cumsum(raws.values, out=csum[1:n + 1])
-        csum[n + 1:] = csum[n]
+        # slab csums are SLICES of the one global prefix array, so the
+        # fused csums[hi]-csums[lo] gather stays bit-identical to the
+        # interpreter's global gather on every device count
+        csums = np.empty((n_dev, cap + 1))
+        for d in range(n_dev):
+            a, b = int(cut[d]), int(cut[d + 1])
+            csums[d, :b - a + 1] = csum[a:b + 1]
+            csums[d, b - a + 1:] = csum[b]
     else:
-        # unused by the traced program (count/present_over_time gather
-        # only window counts; the other bases never touch csum — the
-        # base is a trace-time constant) — ship one element, not
-        # O(samples) zeros, on the hot path
-        csum = np.zeros(1)
-    lo_p, hi_p = _pad_bounds(lo, hi, n)
-    eval_pad = _pad_eval_ts(shifted)
-    Sp, Tp = lo_p.shape
+        # unused by the traced program for every other base (a trace-time
+        # constant) — ship one element per device, not O(samples) zeros
+        csums = dummy
+    if vs is None:
+        vs = dummy
+    if adjs is None:
+        adjs = vs
 
     if agg is not None:
         _, _aop, grouping, without, phi = agg
@@ -511,16 +673,38 @@ def _execute(engine, spec: PlanSpec, labels, raws, eval_ts, col):
                        np.float64)
 
     sig = spec.sig
-    key = (spec.sig_str, Sp, Tp, Gp)
-    key_str = f"{spec.sig_str}|S{Sp}|T{Tp}|G{Gp}"
-    program = _program(sig)
+    key = (spec.sig_str, Sp, Tp, Gp) + \
+        ((n_dev, cap) if mesh is not None else ())
+    key_str = f"{spec.sig_str}|S{Sp}|T{Tp}|G{Gp}" + \
+        (f"|M{n_dev}x{cap}" if mesh is not None else "")
+    program = _program(sig, mesh)
+    if mesh is not None:
+        import jax
+
+        row_sh = mesh_mod.row_sharding(mesh)
+
+        def put(a):
+            return jax.device_put(a, row_sh)
+
+        if adjs is vs:
+            vs = adjs = put(vs)
+        else:
+            vs, adjs = put(vs), put(adjs)
+        ts, csums = put(ts), put(csums)
+        lo_p, hi_p = put(lo_p), put(hi_p)
+        seg_pad = jax.device_put(seg_pad, mesh_mod.vec_sharding(mesh))
+        if spec.base in _MINMAX and mm_levels == 0:
+            bmat = put(bmat)
+        dispatch.counters["query.compile[sharded]"] += 1
+        default_registry().root_scope("compute").subscope(
+            "mesh", devices=str(n_dev)).counter("dispatch")
     t0 = time.perf_counter()
     tracker = dispatch.jit_tracker("query_plan", program)
     with tracker:
-        out = program(v_pad, adj_pad, t_pad, csum, lo_p, hi_p,
+        out = program(vs, adjs, ts, csums, bmat, lo_p, hi_p,
                       eval_pad, np.int64(spec.range_ns), seg_pad,
                       np.float64(phi if phi is not None else 0.0),
-                      scalars, num_groups=Gp)
+                      scalars, num_groups=Gp, mm_levels=mm_levels)
     hit = not tracker.miss
     _plan_cache_record(key, miss=tracker.miss)
     sc = default_registry().root_scope("compute").subscope(
@@ -546,6 +730,22 @@ def _execute(engine, spec: PlanSpec, labels, raws, eval_ts, col):
         else:
             out_labels = [dict(lb) for lb in labels]
     if col is not None:
-        col.set_compiled({"ran": True, "cache_key": key_str,
-                          "cache": "hit" if hit else "miss"})
+        info = {"ran": True, "cache_key": key_str,
+                "cache": "hit" if hit else "miss"}
+        if mesh is not None:
+            info["mesh"] = {"axis": "series", "devices": n_dev}
+            stage_shardings = [{"stage": f"base:{spec.base}",
+                               "spec": "P('series', None)"}]
+            grouped = False
+            for st in spec.stages:
+                if st[0] == "agg":
+                    grouped = True
+                    stage_shardings.append(
+                        {"stage": f"agg:{st[1]}", "spec": "P()"})
+                else:
+                    stage_shardings.append(
+                        {"stage": f"bin:{st[1]}",
+                         "spec": "P()" if grouped else "P('series', None)"})
+            info["sharding"] = stage_shardings
+        col.set_compiled(info)
     return _compact(Vector(out_labels, mat))

@@ -207,10 +207,19 @@ def over_time(fn: str, raws: RaggedSeries, eval_ts: np.ndarray, range_ns: int):
         var = np.maximum(s2 / np.where(empty, 1, count) - mean**2, 0.0)
         out = var if fn == "stdvar" else np.sqrt(var)
         return np.where(empty, np.nan, out)
-    if fn == "min":
-        return _reduceat(np.minimum, raws.values, lo, hi, np.nan)
-    if fn == "max":
-        return _reduceat(np.maximum, raws.values, lo, hi, np.nan)
+    if fn in ("min", "max"):
+        from m3_tpu.ops import temporal
+
+        n = len(raws.values)
+        max_len = int((hi - lo).max()) if lo.size else 0
+        device = (_use_device(raws, eval_ts)
+                  and temporal.minmax_levels(max_len)
+                  * dispatch.next_pow2(n) <= temporal.MINMAX_SCRATCH_ELEMS)
+        dispatch.record("temporal.window_minmax", device)
+        if device:
+            return temporal.window_minmax(raws.values, lo, hi, fn == "min")
+        op = np.minimum if fn == "min" else np.maximum
+        return _reduceat(op, raws.values, lo, hi, np.nan)
     if fn == "last":
         idx = np.clip(hi - 1, 0, max(len(raws.values) - 1, 0))
         return np.where(empty, np.nan, raws.values[idx] if len(raws.values) else np.nan)

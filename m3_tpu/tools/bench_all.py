@@ -2,9 +2,12 @@
 read_many path — config #3's fetch leg measured directly), #7 (the
 write-hot-path observability overhead guard), #8 (the batched
 write_batch ingest path vs the per-entry loop), #9 (end-to-end
-query_range latency, whole-query-compiled vs interpreted) and #10 (the
+query_range latency, whole-query-compiled vs interpreted), #10 (the
 profiler-overhead guard: sampling profiler + lock-wait profiling +
-stall watchdog armed vs off, same pairing discipline as #7).
+stall watchdog armed vs off, same pairing discipline as #7) and #11
+(the sharded query plane: the same fused query_range + grouped
+aggregation on the series-sharded device mesh vs single-device, swept
+over device counts).
 
 Prints one JSON line per config (same shape as bench.py). Sizes are
 env-tunable; defaults are sized to finish on CPU in a few minutes —
@@ -310,6 +313,8 @@ def config5_sharded_quantile():
 
     n_dev = min(4, len(jax.devices()))
     devices = np.array(jax.devices()[:n_dev])
+    # bench-only: one mesh per config run, compile paid before timing
+    # m3lint: disable=jax-jit-per-call
     mesh = Mesh(devices, axis_names=("shard",))
     S = max(int(10_000_000 * _scale()) // 64, 4096)
     S -= S % n_dev
@@ -378,11 +383,13 @@ def config5_sharded_quantile():
         in_specs=(spec, P(None, "shard"), P()), out_specs=P(),
     ))
 
-    jv = jax.device_put(jnp.asarray(dev_vals), jax.NamedSharding(mesh, spec))
-    joh = jax.device_put(jnp.asarray(onehot_t_host),
-                         jax.NamedSharding(mesh, P(None, "shard")))
-    jc = jax.device_put(jnp.asarray(np.maximum(cnt_host, 1.0)),
-                        jax.NamedSharding(mesh, P()))
+    # bench-only, once per config run (not per eval)
+    # m3lint: disable=jax-jit-per-call
+    sh_v, sh_oh, sh_c = (jax.NamedSharding(mesh, s)
+                         for s in (spec, P(None, "shard"), P()))
+    jv = jax.device_put(jnp.asarray(dev_vals), sh_v)
+    joh = jax.device_put(jnp.asarray(onehot_t_host), sh_oh)
+    jc = jax.device_put(jnp.asarray(np.maximum(cnt_host, 1.0)), sh_c)
     # both sides run the same iteration count, high enough to average
     # out scheduler noise (at 3 iters the run-to-run spread exceeded the
     # device/host gap on shared-CPU hosts)
@@ -864,10 +871,141 @@ def config10_profiler_overhead():
           ratio * rate_off, rate_off)
 
 
+def config11_sharded_query():
+    """Sharded multi-device query plane (PR 12, ROADMAP #1): end-to-end
+    query_range + grouped aggregation with the SAME fused program on the
+    series-sharded mesh vs single-device, swept over device counts on
+    the virtual CPU mesh (the shape that becomes a multi-chip bench the
+    day the TPU tunnel returns). Both sides run whole-query-compiled
+    (M3_TPU_QUERY_COMPILE=1), so the ratio isolates exactly what the
+    mesh changes: per-device sample slabs (device-local gathers), SPMD
+    stage partitioning, psum-lowered grouped reductions. Pairing
+    discipline as #9 (interleaved pairs, median-pair numbers; this host
+    is +-30% noisy). Correctness gate: the sharded result must match the
+    interpreter element-identically (NaN masks exact, values within the
+    documented 1e-9 reassociation envelope) before anything is
+    reported."""
+    import tempfile
+
+    import jax
+
+    from m3_tpu.encoding.m3tsz import hostpath
+    from m3_tpu.query.engine import Engine
+    from m3_tpu.storage.database import Database
+    from m3_tpu.storage.fileset import FilesetWriter
+    from m3_tpu.storage.options import (
+        DatabaseOptions, IndexOptions, NamespaceOptions, RetentionOptions,
+    )
+    from m3_tpu.utils.xtime import TimeUnit
+
+    NS = 10**9
+    BLOCK = 48 * 3600 * NS
+    START = 1_600_000_000 * NS
+    S = 4096
+    SAMP = 300 * NS                # one sample per 5m per series
+    T = (48 * 3600 * NS) // SAMP   # 576 samples per series
+    n_devices = len(jax.devices())
+    if n_devices < 2:
+        # a live single-device accelerator runs in-process (no virtual
+        # CPU re-exec): nothing to shard — note it, record nothing
+        print(json.dumps({"metric": "#11 sharded query skipped: 1 device",
+                          "value": 0.0, "unit": "M datapoints/sec",
+                          "vs_baseline": 0.0}), flush=True)
+        return
+    with tempfile.TemporaryDirectory() as root:
+        db = Database(root, DatabaseOptions(
+            n_shards=8, block_cache_entries=100_000))  # warm-cache serving
+        ns = db.create_namespace("default", NamespaceOptions(
+            retention=RetentionOptions(retention_ns=1000 * BLOCK,
+                                       block_size_ns=BLOCK),
+            index=IndexOptions(enabled=True, block_size_ns=BLOCK),
+            writes_to_commitlog=False, snapshot_enabled=False))
+        ids = [b"reqs,host=h%04d,i=%05d" % (i % 128, i) for i in range(S)]
+        fields = [[(b"__name__", b"reqs"), (b"host", b"h%04d" % (i % 128)),
+                   (b"i", b"%05d" % i)] for i in range(S)]
+        by_shard: dict[int, list[int]] = {}
+        for j, sid in enumerate(ids):
+            by_shard.setdefault(ns.shard_set.lookup(sid), []).append(j)
+        rng = np.random.default_rng(0)
+        for shard_id, rows in by_shard.items():
+            nb = len(rows)
+            times = np.broadcast_to(
+                START + np.arange(T, dtype=np.int64) * SAMP, (nb, T)).copy()
+            vals = rng.integers(1, 10, (nb, T)).astype(np.float64) \
+                .cumsum(axis=1)
+            streams = hostpath.encode_blocks(
+                times, vals.view(np.uint64), np.full(nb, START, np.int64),
+                np.full(nb, T, np.int32), TimeUnit.SECOND, False)
+            w = FilesetWriter(db.fs_root, "default", shard_id, START,
+                              BLOCK, 0)
+            for j, stream in zip(rows, streams):
+                w.write_series(ids[j], b"", stream)
+            w.close()
+        db.open(START + BLOCK)
+        ns.index.insert_many(ids, fields, np.full(S, START, np.int64))
+        eng = Engine(db, resolve_tiers=False)
+        qstart = START + 30 * 60 * NS
+        qend = START + 48 * 3600 * NS - SAMP
+        step = 2 * 60 * NS
+        n_dp = S * T
+        q = "sum by (host) (rate(reqs[30m]))"
+
+        prev = {k: os.environ.get(k)
+                for k in ("M3_TPU_QUERY_COMPILE", "M3_TPU_QUERY_SHARD")}
+        try:
+            os.environ["M3_TPU_QUERY_COMPILE"] = "1"
+
+            def run(shard: int):
+                os.environ["M3_TPU_QUERY_SHARD"] = str(shard)
+                return eng.query_range(q, qstart, qend, step)[0]
+
+            # correctness gate: sharded fused result vs the interpreter
+            v_s = run(n_devices)
+            os.environ["M3_TPU_QUERY_COMPILE"] = "0"
+            v_i = eng.query_range(q, qstart, qend, step)[0]
+            os.environ["M3_TPU_QUERY_COMPILE"] = "1"
+            ok = (v_s.labels == v_i.labels
+                  and np.array_equal(np.isnan(v_s.values),
+                                     np.isnan(v_i.values))
+                  and np.allclose(v_s.values, v_i.values, rtol=1e-9,
+                                  atol=0, equal_nan=True))
+            run(0)  # warm the single-device executable too
+            sweep_ratios: list[str] = []
+            headline = None
+            for n_dev in [n for n in (2, 4, 8) if n <= n_devices]:
+                run(n_dev)  # pay this mesh's compile outside the pairs
+                pairs: list[tuple[float, float, float]] = []
+                for _ in range(9):
+                    t0 = time.perf_counter()
+                    run(n_dev)
+                    dt_s = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    run(0)
+                    dt_1 = time.perf_counter() - t0
+                    pairs.append((dt_1 / dt_s, n_dp / dt_s, n_dp / dt_1))
+                pairs.sort(key=lambda p: p[0])
+                med = pairs[len(pairs) // 2]
+                sweep_ratios.append(f"{n_dev}dev:{med[0]:.2f}x")
+                headline = med  # the widest mesh is the recorded headline
+            _ratio, thr_s, thr_1 = headline
+            _emit(f"#11 sharded query_range e2e {S} series x ~1.4k steps "
+                  f"[sum-by(rate), {n_devices}-device series mesh vs "
+                  f"single-device; sweep {' '.join(sweep_ratios)}]"
+                  + ("" if ok else " (CORRECTNESS FAILED)"),
+                  thr_s, thr_1)
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        db.close()
+
+
 def main(argv=None) -> None:
     global _ACCEL
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11")
     ap.add_argument("--record", default=None,
                     help="also append the JSON lines to this file")
     args = ap.parse_args(argv)
@@ -879,9 +1017,9 @@ def main(argv=None) -> None:
             _ACCEL = True  # run in-process against the live tunnel
         else:
             # dead tunnel: re-exec with a scrubbed env (see module doc);
-            # 4 virtual CPU devices so config #5 exercises the real
-            # 4-shard shard_map + psum program, not a degenerate 1-shard
-            env = scrubbed_env(n_devices=4)
+            # 8 virtual CPU devices so config #11 sweeps the full series
+            # mesh and #5 still exercises its 4-shard shard_map + psum
+            env = scrubbed_env(n_devices=8)
             env[_CHILD_ENV] = "1"
             cmd = [sys.executable, "-m", "m3_tpu.tools.bench_all",
                    "--configs", args.configs]
@@ -894,7 +1032,8 @@ def main(argv=None) -> None:
            "3": config3_promql_rate_sum, "4": config4_regex_postings,
            "5": config5_sharded_quantile, "6": config6_read_many,
            "7": config7_tracing_overhead, "8": config8_write_batch,
-           "9": config9_query_compile, "10": config10_profiler_overhead}
+           "9": config9_query_compile, "10": config10_profiler_overhead,
+           "11": config11_sharded_query}
     for c in args.configs.split(","):
         c = c.strip()
         try:

@@ -73,17 +73,36 @@ def next_pow2(n: int) -> int:
     return 1 << max(n - 1, 1).bit_length() if n > 2 else max(n, 1)
 
 
-def next_bucket(n: int) -> int:
+def next_bucket(n: int, multiple: int = 1) -> int:
     """Smallest of {2^k, 3*2^(k-1)} >= n: half-octave shape buckets.
 
     The whole-query compiler pads its matrix axes with these instead of
     plain powers of two — worst-case padding waste drops from 2x to
     1.33x (the padded cells are real work for a fused [S, T] program)
     while the compile count per axis stays O(log), just with twice the
-    constant."""
+    constant.
+
+    ``multiple`` > 1 additionally requires the bucket to divide evenly
+    (the sharded compute plane pads its series axis to a multiple of the
+    mesh size so every device owns the same row count): prefer the next
+    ladder rung that divides WHEN it costs no more than rounding the
+    bucket up to the multiple (keeps 2/3-smooth mesh sizes on the
+    ladder); otherwise round up — never more than one ``multiple`` of
+    extra padding, and deterministic per (n, multiple) either way, so
+    shape-bucket reuse is unaffected."""
     p = next_pow2(n)
     half = 3 * p // 4
-    return half if 0 < n <= half else p
+    b = half if 0 < n <= half else p
+    if multiple > 1 and b % multiple:
+        r = b + (-b) % multiple
+        c = max(b, 2)
+        for _ in range(4):
+            # next half-octave rung: 2^k -> 3*2^(k-1), 3*2^(k-1) -> 2^(k+1)
+            c = 3 * c // 2 if (c & (c - 1)) == 0 else 4 * c // 3
+            if c % multiple == 0 and c <= r:
+                return c
+        return r
+    return b
 
 
 # -- jit/plan-cache telemetry ------------------------------------------------

@@ -61,3 +61,13 @@ TIMERS = {
 #   watchdog_loop_stalls {loop=...}                           counter
 #   profiler_samples / profiler_evicted_samples               (status
 #       JSON on /debug/profile; not registry families)
+#
+# Sharded compute plane (PR 12) mesh-dispatch counter families, under
+# the compute.mesh scope with a {devices=N} label:
+#   compute_mesh_dispatch {devices=...}        fused queries served on
+#       the series-sharded device mesh (query/compiler._execute)
+#   compute_mesh_skew_fallback {devices=...}   sharded dispatch declined
+#       because the series->sample distribution was too skewed for
+#       balanced slabs (ran the single-device program instead)
+# plus the dispatch-layer tallies query.compile[sharded] and
+# windowed_agg.aggregate_groups[mesh] on /debug counters.

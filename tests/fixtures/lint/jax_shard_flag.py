@@ -1,0 +1,31 @@
+"""MUST-FLAG: per-eval mesh/sharding construction — what the sharded
+compute plane (query/compiler.py + parallel/mesh.py) must NOT look like.
+An engine that rebuilds ``jax.sharding.Mesh``/``NamedSharding`` inside
+its eval path constructs fresh sharding objects per query: jit's C++
+dispatch fast path misses on them, and any drift in device enumeration
+order mints a fresh executable cache key — a recompile storm with a
+sharded spelling."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import numpy as np
+
+
+def _stage(v):
+    return jnp.cumsum(v)
+
+
+compiled_stage = jax.jit(_stage)
+
+
+class NaiveShardedEngine:
+    """Per-call mesh + sharding construction in the dispatch path."""
+
+    def eval_plan(self, values):
+        # jax-jit-per-call (sharding family): a fresh Mesh per query
+        mesh = Mesh(np.array(jax.devices()), ("series",))
+        # and a fresh NamedSharding on top of it, also per query
+        sharding = NamedSharding(mesh, P("series"))
+        return compiled_stage(jax.device_put(values, sharding))

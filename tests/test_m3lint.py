@@ -113,6 +113,23 @@ class TestJaxRules:
         # signature + bounded keyed plan cache + pow2 shape buckets
         assert run_lint("jax_plan_pass.py", select=("jax-",)) == []
 
+    def test_per_eval_sharding_construction_flags(self):
+        """The sharded compute plane's twin hazard (ROADMAP #1): a Mesh
+        or NamedSharding constructed inside an eval path is a fresh
+        sharding object per query — flagged under the jax-jit-per-call
+        family."""
+        fs = run_lint("jax_shard_flag.py", select=("jax-",))
+        assert rules_of(fs) == {"jax-jit-per-call"}
+        assert len(fs) == 2, fs  # the Mesh ctor AND the NamedSharding ctor
+        msgs = "\n".join(f.message for f in fs)
+        assert "eval_plan" in msgs and "mesh/sharding" in msgs
+
+    def test_blessed_sharding_idiom_passes(self):
+        # the parallel/mesh.py + compiler shape: lru_cache mesh/sharding
+        # factories, with_sharding_constraint inside the cached program
+        # factory
+        assert run_lint("jax_shard_pass.py", select=("jax-",)) == []
+
 
 class TestInvariantRules:
     def test_invariant_violations_flag(self):

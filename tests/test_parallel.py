@@ -149,6 +149,177 @@ class TestMeshFromPlacement:
             C.time_sharded_window_sums(jnp.asarray(rng.normal(size=(2, 16))), mesh8, 5)
 
 
+class TestComputeMeshPlumbing:
+    def test_next_bucket_pads_to_mesh_multiple(self):
+        from m3_tpu.utils.dispatch import next_bucket
+
+        for n in (1, 2, 3, 5, 7, 8, 9, 24, 100, 1000):
+            for m in (1, 2, 4, 8):
+                b = next_bucket(n, multiple=m)
+                assert b >= n and b % m == 0, (n, m, b)
+        # without a multiple the half-octave ladder is unchanged
+        assert next_bucket(5) == 6 and next_bucket(7) == 8
+        # a 2/3-smooth multiple stays on the ladder
+        assert next_bucket(5, multiple=8) == 8
+        assert next_bucket(9, multiple=8) == 16
+
+    def test_active_mesh_env_hatch(self, monkeypatch):
+        from m3_tpu.parallel import mesh as mesh_mod
+
+        monkeypatch.setenv("M3_TPU_QUERY_SHARD", "0")
+        assert mesh_mod.active_compute_mesh() is None
+        monkeypatch.setenv("M3_TPU_QUERY_SHARD", "8")
+        m8 = mesh_mod.active_compute_mesh()
+        assert m8 is not None and int(m8.devices.size) == 8
+        # identity-stable: the cached factory hands back the SAME object
+        assert mesh_mod.active_compute_mesh() is m8
+        monkeypatch.setenv("M3_TPU_QUERY_SHARD", "1")
+        m1 = mesh_mod.active_compute_mesh()
+        assert m1 is not None and int(m1.devices.size) == 1
+        # a count past the device pool clamps (device-count independence)
+        monkeypatch.setenv("M3_TPU_QUERY_SHARD", "4096")
+        assert int(mesh_mod.active_compute_mesh().devices.size) == 8
+        # unset + CPU backend: the plane stays off
+        monkeypatch.delenv("M3_TPU_QUERY_SHARD")
+        assert mesh_mod.active_compute_mesh() is None
+
+
+class TestShardedQueryPlane:
+    """Engine-path coverage for the series-sharded compute plane (PR 12,
+    ROADMAP #1): the SAME compiled plan, on a seeded random-plan sweep,
+    must agree with the interpreter exactly on NaN masks and within 1e-9
+    relative on values at BOTH 1 and 8 mesh devices."""
+
+    NS = 1_000_000_000
+    MIN = 60 * NS
+    START = 1_599_998_400_000_000_000
+
+    PLANS = [
+        "reqs",
+        "sum by (host) (rate(reqs[5m]))",
+        "avg by (job) (avg_over_time(reqs[4m]))",
+        "max_over_time(reqs[3m])",
+        "quantile by (job) (0.9, sum_over_time(reqs[2m]))",
+        "min by (job) (irate(reqs[5m]) ^ 2)",
+        "count without (host) (present_over_time(reqs[3m])) * 3",
+    ]
+
+    @pytest.fixture(scope="class")
+    def engine(self, tmp_path_factory):
+        from m3_tpu.query.engine import Engine
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.options import DatabaseOptions
+
+        db = Database(str(tmp_path_factory.mktemp("shardq") / "db"),
+                      DatabaseOptions(n_shards=4))
+        db.create_namespace("default")
+        db.open(self.START)
+        rng = np.random.default_rng(7)
+        hosts = [b"h%02d" % i for i in range(5)]
+        jobs = [b"api", b"web", b"batch"]
+        for i in range(40):
+            tags = [(b"host", hosts[i % 5]), (b"job", jobs[i % 3])]
+            t = self.START
+            acc = float(rng.integers(0, 50))
+            for _ in range(40):
+                t += int(rng.integers(5, 40)) * self.NS
+                if rng.random() < 0.06:
+                    acc = 0.0
+                acc += float(rng.integers(0, 9))
+                if rng.random() < 0.9:
+                    db.write_tagged("default", b"reqs", tags, t, acc)
+        yield Engine(db, resolve_tiers=False)
+        db.close()
+
+    def _run(self, engine, monkeypatch, q, compiled, shard):
+        monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "1" if compiled else "0")
+        monkeypatch.setenv("M3_TPU_QUERY_SHARD", str(shard))
+        v, _ = engine.query_range(q, self.START, self.START + 14 * self.MIN,
+                                  self.MIN)
+        return v
+
+    @staticmethod
+    def _assert_parity(a, b, q):
+        assert a.labels == b.labels, q
+        assert a.values.shape == b.values.shape, q
+        assert np.array_equal(np.isnan(a.values), np.isnan(b.values)), q
+        assert np.allclose(a.values, b.values, rtol=1e-9, atol=0,
+                           equal_nan=True), q
+
+    def test_sharded_vs_single_device_sweep(self, engine, monkeypatch):
+        from m3_tpu.utils import dispatch
+
+        for q in self.PLANS:
+            vi = self._run(engine, monkeypatch, q, compiled=False, shard=0)
+            sharded0 = dispatch.counters["query.compile[sharded]"]
+            v1 = self._run(engine, monkeypatch, q, compiled=True, shard=1)
+            v8 = self._run(engine, monkeypatch, q, compiled=True, shard=8)
+            assert dispatch.counters["query.compile[sharded]"] == \
+                sharded0 + 2, f"plan not sharded: {q}"
+            self._assert_parity(vi, v1, f"{q} @1dev")
+            self._assert_parity(vi, v8, f"{q} @8dev")
+            self._assert_parity(v1, v8, f"{q} 1dev-vs-8dev")
+
+    def test_plan_cache_key_carries_mesh(self, engine, monkeypatch):
+        from m3_tpu.query import compiler
+
+        compiler.clear_plan_cache()
+        self._run(engine, monkeypatch, "sum by (host) (rate(reqs[5m]))",
+                  compiled=True, shard=8)
+        # the key tuple grows (n_dev, cap) components under a mesh, so a
+        # sharded plan can never collide with its single-device twin
+        assert any(k.split("|")[-2] == "8"
+                   for k in compiler.plan_cache_info()), \
+            compiler.plan_cache_info()
+
+    def test_explain_reports_mesh_and_stage_shardings(self, engine,
+                                                      monkeypatch):
+        from m3_tpu.query import explain
+
+        monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "1")
+        monkeypatch.setenv("M3_TPU_QUERY_SHARD", "8")
+        with explain.collect(analyze=True) as col:
+            engine.query_range("sum by (host) (max_over_time(reqs[3m]))",
+                               self.START, self.START + 10 * self.MIN,
+                               self.MIN)
+        doc = col.to_dict()
+        assert doc["compiled"]["mesh"] == {"axis": "series", "devices": 8}
+        stages = {s["stage"]: s["spec"] for s in doc["compiled"]["sharding"]}
+        assert stages["base:max_over_time"] == "P('series', None)"
+        assert stages["agg:sum"] == "P()"
+        assert "|M8x" in doc["compiled"]["cache_key"]
+
+    def test_aggregate_groups_device_path_rides_the_mesh(self, monkeypatch):
+        """The interpreter's m3_agg_groups rollup/quantile path places
+        its padded sample triples across the active mesh — numerics
+        unchanged vs the numpy host path."""
+        from m3_tpu.ops import windowed_agg
+        from m3_tpu.utils import dispatch
+
+        rng = np.random.default_rng(3)
+        n = 4096
+        e = rng.integers(0, 257, n)
+        w = rng.integers(0, 6, n)
+        v = rng.normal(100, 10, n)
+        t = rng.integers(0, 10**9, n)
+        ge, gw, stats, vq, off = windowed_agg.aggregate_groups(
+            e, w, v, times=t)
+        monkeypatch.setenv("M3_TPU_DEVICE_OPS", "1")
+        monkeypatch.setenv("M3_TPU_QUERY_SHARD", "8")
+        before = dispatch.counters["windowed_agg.aggregate_groups[mesh]"]
+        de, dw, dstats, dvq, doff = windowed_agg.aggregate_groups(
+            e, w, v, times=t)
+        assert dispatch.counters["windowed_agg.aggregate_groups[mesh]"] == \
+            before + 1
+        np.testing.assert_array_equal(ge, de)
+        np.testing.assert_array_equal(gw, dw)
+        np.testing.assert_array_equal(off, doff)
+        np.testing.assert_allclose(dvq, vq, rtol=0)
+        for k in stats:
+            np.testing.assert_allclose(dstats[k], stats[k], rtol=1e-9,
+                                       err_msg=k)
+
+
 class TestTimeShardedResetAdjust:
     def test_matches_host_monotonization(self, rng, mesh8):
         """Sequence-parallel reset adjustment == the single-host numpy

@@ -85,6 +85,9 @@ class TestCoverageMatrix:
         "sum_over_time(reqs[2m])",
         "count_over_time(reqs[3m])",
         "present_over_time(reqs[3m])",
+        "max_over_time(reqs[5m])",
+        "min_over_time(reqs[3m])",
+        "sum by (job) (max_over_time(reqs[4m]))",
         "sum by (host) (rate(reqs[5m]))",
         "quantile by (job) (0.9, rate(reqs[5m]))",
         "max without (host) (delta(reqs[5m]))",
@@ -97,7 +100,7 @@ class TestCoverageMatrix:
         "stddev by (host) (rate(reqs[5m]))",          # uncovered aggregator
         "rate(reqs[5m]) + rate(reqs[5m])",            # vector-vector binop
         "rate(reqs[5m]) > 0.5",                       # comparison semantics
-        "max_over_time(reqs[5m])",                    # window min/max base
+        "last_over_time(reqs[5m])",                   # uncovered window fn
         "holt_winters(reqs[5m], 0.5, 0.5)",           # uncovered function
         "sum by (host) (sum by (job) (reqs))",        # two aggregations
         "quantile by (job) (scalar(reqs), reqs)",     # non-literal phi
@@ -132,7 +135,8 @@ class TestParitySweep:
              "delta(reqs[{r}]{o})", "irate(reqs[{r}]{o})",
              "idelta(reqs[{r}]{o})", "avg_over_time(reqs[{r}]{o})",
              "sum_over_time(reqs[{r}]{o})", "count_over_time(reqs[{r}]{o})",
-             "present_over_time(reqs[{r}]{o})", "reqs{o_instant}"]
+             "present_over_time(reqs[{r}]{o})", "min_over_time(reqs[{r}]{o})",
+             "max_over_time(reqs[{r}]{o})", "reqs{o_instant}"]
     AGGS = ["sum", "avg", "min", "max", "count", "quantile"]
     BIN_OPS = ["+", "-", "*", "/", "%", "^"]
     SCALARS = [2, 0.5, 3.7, -1.5, 60]
@@ -226,6 +230,40 @@ class TestParitySweep:
         vc, _ = engine.query_instant("sum by (job) (rate(reqs[5m]))",
                                      START + 10 * MIN)
         assert_parity(vi, vc, "instant")
+
+
+class TestMinMaxOverTime:
+    """The sparse-table range-min stage (carried PR-10 follow-up):
+    min/max_over_time plans stop falling back, with the host reduceat
+    math as the exact parity reference (min/max are picks, so values are
+    bit-identical, not just within the reassociation envelope)."""
+
+    def test_sparse_table_parity(self, engine, monkeypatch):
+        for q in ("max_over_time(reqs[5m])",
+                  "min_over_time(reqs[2m]) * -1",
+                  "quantile by (job) (0.5, max_over_time(reqs[6m]))"):
+            before = dispatch.counters["query.compile[compiled]"]
+            vi, vc = run_both(engine, monkeypatch, q, START,
+                              START + 14 * MIN, MIN)
+            assert dispatch.counters["query.compile[compiled]"] == \
+                before + 1, f"plan not compiled: {q}"
+            assert_parity(vi, vc, q)
+
+    def test_scratch_cap_routes_base_to_host(self, engine, monkeypatch):
+        """Past the table scratch cap the base matrix comes from the
+        interpreter's exact host reduceat (shipped through the program's
+        bmat input) — still ONE compiled program, never a fallback."""
+        from m3_tpu.ops import temporal
+
+        monkeypatch.setattr(temporal, "MINMAX_SCRATCH_ELEMS", 1)
+        q = "sum by (host) (max_over_time(reqs[4m]))"
+        before = dispatch.counters["query.compile[compiled]"]
+        fb = dispatch.counters["query.compile[fallback]"]
+        vi, vc = run_both(engine, monkeypatch, q, START, START + 12 * MIN,
+                          MIN)
+        assert dispatch.counters["query.compile[compiled]"] == before + 1
+        assert dispatch.counters["query.compile[fallback]"] == fb
+        assert_parity(vi, vc, q)
 
 
 class TestFallbackAndPolicy:

@@ -22,7 +22,14 @@ the class of bug whole-query compilation (ROADMAP #2) multiplies.
                           plain function body with no cache around it: a
                           fresh traced callable (and XLA compile) per
                           invocation — the recompile storm PR 6's
-                          jit_tracker can only observe after the fact
+                          jit_tracker can only observe after the fact.
+                          Also flags per-eval ``jax.sharding.Mesh`` /
+                          ``NamedSharding`` construction (the sharded
+                          compute plane's twin hazard: a mesh rebuilt
+                          per query defeats jit's C++ dispatch fast
+                          path, and a device-order drift mints fresh
+                          executable cache keys — build them once in an
+                          lru_cache factory, parallel/mesh.py style)
 ``jax-varying-static``    calling a jitted function in a loop with an
                           argument sliced by the loop variable (or a
                           per-iteration ``len()``): every iteration is a
@@ -67,6 +74,13 @@ def _is_jit_name(chain: str | None) -> bool:
 
 def _is_vmap_name(chain: str | None) -> bool:
     return chain in ("vmap", "jax.vmap", "pmap", "jax.pmap")
+
+
+def _is_sharding_ctor(chain: str | None) -> bool:
+    """Mesh/NamedSharding constructors in any in-tree spelling."""
+    return chain in ("Mesh", "jax.sharding.Mesh", "sharding.Mesh",
+                     "NamedSharding", "jax.NamedSharding",
+                     "jax.sharding.NamedSharding", "sharding.NamedSharding")
 
 
 def _static_params(fn: ast.FunctionDef) -> set[str]:
@@ -409,6 +423,17 @@ def _check_jit_per_call(mod: Module, col: _DefCollector, traced: set[str]):
             if id(node) in nested or not isinstance(node, ast.Call):
                 continue
             chain = _attr_chain(node.func)
+            if _is_sharding_ctor(chain):
+                if _is_cached_store(mod, node):
+                    continue
+                yield Finding(
+                    "jax-jit-per-call", mod.path, node.lineno,
+                    f"{qual} constructs {chain}(...) per call with no "
+                    f"cache — a per-eval mesh/sharding object defeats "
+                    f"jit's dispatch fast path and can mint fresh "
+                    f"executable cache keys (build it once in an "
+                    f"lru_cache factory, parallel/mesh.py style)")
+                continue
             if not (_is_jit_name(chain) or _is_vmap_name(chain)):
                 continue
             if _is_cached_store(mod, node):
