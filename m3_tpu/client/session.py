@@ -464,37 +464,75 @@ class Session:
 
         from m3_tpu.utils import querystats
 
+        # legs first (deterministic host order), then either every node
+        # RPC in flight at once through the pipeline executor (the
+        # coordinator no longer drains whole-node responses serially) or
+        # the serial loop. The serial path is pinned when the hatch is
+        # closed, when ANY connection lacks read_batch (minimal test
+        # doubles), or when fault injection is armed — the per-host
+        # injection schedule must stay deterministic under seeded chaos.
+        legs = []
         for host, conn in self.connections.items():
             readable = self._readable_shards_of(host)
             want = [sid for sid in series_ids if shard_of[sid] in readable]
-            if not want:
-                continue
-            leg_t0 = _time.perf_counter()
-            try:
-                # one batched request per host: HTTP conns AND in-process
-                # Databases expose read_batch (the storage side fuses the
-                # whole batch into one decode per (shard, block, volume)
-                # group); only minimal test doubles still expose read() only
-                batch = getattr(conn, "read_batch", None)
-                if batch is not None:
-                    rows = self._host_call(host, batch, namespace, want,
-                                           start_ns, end_ns)
-                else:
-                    rows = [self._host_call(host, conn.read, namespace, sid,
-                                            start_ns, end_ns)
-                            for sid in want]
-            except faults.SimulatedCrash:
-                faults.escalate()  # our own injected death, not a host error
-                raise
-            except Exception as e:  # noqa: BLE001 - per-host failure
-                errors.append((host, e))
-                querystats.record_node_leg(
-                    host, _time.perf_counter() - leg_t0)
-                continue
-            # per-node share of this fan-out read, onto the active query
-            # record (EXPLAIN ANALYZE renders one plan leg per node)
-            querystats.record_node_leg(
-                host, _time.perf_counter() - leg_t0, rows=len(want))
+            if want:
+                legs.append((host, conn, want,
+                             getattr(conn, "read_batch", None)))
+        from m3_tpu.storage import pipeline
+
+        overlapped = len(legs) > 1 and pipeline.active() \
+            and not faults.enabled() \
+            and all(batch is not None for _h, _c, _w, batch in legs)
+        if overlapped:
+            leg_results = self._fly_legs(legs, namespace, start_ns, end_ns)
+        else:
+            leg_results = None
+        def leg_failed(host, err, leg_dt):
+            """ONE per-host failure policy for both branches: a crash is
+            our own injected death (escalate + raise — on the overlapped
+            branch the worker already escalated, escalate() is
+            idempotent when unarmed); anything else degrades the leg
+            into the consistency accounting with its wall time on the
+            EXPLAIN record."""
+            if isinstance(err, faults.SimulatedCrash):
+                faults.escalate()
+                raise err
+            errors.append((host, err))
+            querystats.record_node_leg(host, leg_dt)
+
+        for k, (host, conn, want, batch) in enumerate(legs):
+            if leg_results is not None:
+                result, err, leg_dt = leg_results[k].result()
+                if err is not None:
+                    leg_failed(host, err, leg_dt)
+                    continue
+                rows, counters = result
+                querystats.merge_storage(counters)
+            else:
+                leg_t0 = _time.perf_counter()
+                try:
+                    # one batched request per host: HTTP conns AND
+                    # in-process Databases expose read_batch (the storage
+                    # side fuses the whole batch into one decode per
+                    # (shard, block, volume) group); only minimal test
+                    # doubles still expose read() only
+                    if batch is not None:
+                        rows = self._host_call(host, batch, namespace, want,
+                                               start_ns, end_ns)
+                    else:
+                        rows = [self._host_call(host, conn.read, namespace,
+                                                sid, start_ns, end_ns)
+                                for sid in want]
+                except faults.SimulatedCrash as e:
+                    # our own injected death: leg_failed escalates+raises
+                    leg_failed(host, e, _time.perf_counter() - leg_t0)
+                except Exception as e:  # noqa: BLE001 - per-host failure
+                    leg_failed(host, e, _time.perf_counter() - leg_t0)
+                    continue
+                leg_dt = _time.perf_counter() - leg_t0
+            # per-node share of this fan-out read, onto the active
+            # query record (EXPLAIN ANALYZE renders one leg per node)
+            querystats.record_node_leg(host, leg_dt, rows=len(want))
             for sid, dps in zip(want, rows):
                 successes[sid] += 1
                 if dps:
@@ -534,6 +572,31 @@ class Session:
             )
             out.append((t, v))
         return out
+
+    def _fly_legs(self, legs, namespace, start_ns, end_ns):
+        """Put every node's read_batch RPC in flight at once through the
+        shared leg policy (pipeline.submit_client_leg: trace context
+        re-activated per worker, timed, exceptions as values). Each leg
+        additionally collects its storage counters into a leg-local
+        QueryStats record — the consumer merges them onto the query's
+        record IN HOST ORDER, so warnings, node-leg attribution and
+        replica-merge order are byte-identical to the serial loop."""
+        from m3_tpu.storage import pipeline
+        from m3_tpu.utils import querystats
+
+        tracer = trace.default_tracer()
+        ctx = tracer.current()
+        futs = []
+        for host, _conn, want, batch in legs:
+            def leg(host=host, want=want, batch=batch):
+                with querystats.collect() as st:
+                    rows = self._host_call(host, batch, namespace, want,
+                                           start_ns, end_ns)
+                return rows, querystats.storage_counters(st)
+
+            futs.append(pipeline.submit_client_leg(
+                leg, tracer, ctx, point_ctx="fetch_many"))
+        return futs
 
     # -- index scatter/gather (the FetchTagged fan-out, session.go:1585) --
 

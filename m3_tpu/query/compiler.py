@@ -23,6 +23,13 @@ to the interpreter, counted, never an error):
   over:   any chain of sum/avg/min/max/count/quantile `by`/`without`
           aggregations (at most one) and scalar-literal binary
           arithmetic (+ - * / % ^), in any order
+  binop:  a TOP-LEVEL vector-vector arithmetic op between two covered
+          chains under default one-to-one matching (`match_vecbin`):
+          both sides run as their own fused programs and the combine is
+          the interpreter's exact numpy one-to-one match — same keys,
+          same duplicate-series errors, same result labels.
+          on()/ignoring()/group modifiers, bool mode and comparisons
+          stay with the interpreter (counted fallback).
 
 Sharded compute plane (PR 12, ROADMAP #1): when a ``("series",)``
 compute mesh is active (`parallel.mesh.active_compute_mesh` —
@@ -129,6 +136,43 @@ class PlanSpec:
             else:
                 parts.append(f"agg:{st[1]}")
         return "|".join(parts)
+
+
+@dataclass
+class VecBinSpec:
+    """A covered vector-vector binary op: both sides are covered chains,
+    matched one-to-one on their full label sets (default matching). The
+    sides compile into their own fused programs; the element-wise
+    combine is the interpreter's exact numpy op over the matched rows,
+    so parity composes from the sides' parity."""
+
+    op: str
+    lhs: PlanSpec
+    rhs: PlanSpec
+
+
+def match_vecbin(expr: Expr) -> VecBinSpec | None:
+    """VecBinSpec when `expr` is a top-level arithmetic binop between
+    two covered chains under DEFAULT one-to-one matching, else None.
+    on()/ignoring()/group modifiers, bool mode and comparisons keep the
+    interpreter's richer matching machinery (counted fallback)."""
+    if not isinstance(expr, BinaryExpr) or expr.op not in _BIN_OPS \
+            or expr.bool_mode:
+        return None
+    m = expr.matching
+    if m is not None and (m.on or m.labels or m.group_left
+                          or m.group_right or m.include):
+        return None
+    if _scalar_literal(expr.lhs) is not None \
+            or _scalar_literal(expr.rhs) is not None:
+        return None  # scalar arithmetic is covered in-chain by match()
+    lhs = match(expr.lhs)
+    if lhs is None:
+        return None
+    rhs = match(expr.rhs)
+    if rhs is None:
+        return None
+    return VecBinSpec(expr.op, lhs, rhs)
 
 
 def _scalar_literal(e: Expr) -> float | None:
@@ -473,7 +517,10 @@ def try_execute(engine, expr: Expr, eval_ts: np.ndarray):
     would (storage errors, limits)."""
     spec = match(expr)
     if spec is None:
-        return _fallback("uncovered_plan_shape")
+        vspec = match_vecbin(expr)
+        if vspec is None:
+            return _fallback("uncovered_plan_shape")
+        return _try_execute_vecbin(engine, expr, vspec, eval_ts)
     if not _jax_ready():
         return _fallback("jax_not_initialized")
     if os.environ.get("M3_TPU_QUERY_COMPILE") != "1" \
@@ -483,6 +530,12 @@ def try_execute(engine, expr: Expr, eval_ts: np.ndarray):
     from m3_tpu.query import explain as explain_mod
 
     col = explain_mod.current()
+    return _run_plan(engine, spec, eval_ts, col)
+
+
+def _run_plan(engine, spec: PlanSpec, eval_ts, col):
+    """Fetch + fused execution of ONE covered chain (shared by single-
+    plan queries and each side of a compiled vector-vector binop)."""
     with contextlib.ExitStack() as stack:
         if col is not None:
             for node in spec.nodes[:-1]:
@@ -495,6 +548,72 @@ def try_execute(engine, expr: Expr, eval_ts: np.ndarray):
                                          spec.range_ns)
         out = _execute(engine, spec, labels, raws, eval_ts, col)
     return out
+
+
+def _try_execute_vecbin(engine, expr, vspec: VecBinSpec, eval_ts):
+    """Serve a covered vector-vector binop: each side runs as its own
+    fused program (two fetches, exactly like the interpreter's two
+    subtree evaluations), then the interpreter's one-to-one default
+    matching combines them element-wise in numpy — identical match-key,
+    duplicate-series and result-label semantics, including the
+    EvalErrors the interpreter raises for many-to-many/many-to-one."""
+    if not _jax_ready():
+        return _fallback("jax_not_initialized")
+    if os.environ.get("M3_TPU_QUERY_COMPILE") != "1" \
+            and (_host_prefers_interpreter(vspec.lhs)
+                 or _host_prefers_interpreter(vspec.rhs)):
+        return _fallback("host_native_faster")
+    dispatch.counters["query.compile[compiled]"] += 1
+    from m3_tpu.query import explain as explain_mod
+
+    col = explain_mod.current()
+    with col.node(expr) if col is not None else contextlib.nullcontext():
+        lhs = _run_plan(engine, vspec.lhs, eval_ts, col)
+        l_info = col.compiled if col is not None else None
+        rhs = _run_plan(engine, vspec.rhs, eval_ts, col)
+        r_info = col.compiled if col is not None else None
+        out = _combine_vecbin(engine, vspec.op, lhs, rhs)
+    if col is not None:
+        col.set_compiled({"ran": True, "binop": vspec.op,
+                          "sides": [l_info, r_info]})
+    return out
+
+
+def _combine_vecbin(engine, op: str, lhs, rhs):
+    """The interpreter's `_vector_binary` restricted to the covered
+    shape (arithmetic op, default matching, no group modifiers): same
+    match keys, same duplicate-series errors, same result labels, same
+    numpy element-wise math — so NaN masks and values are exactly what
+    the interpreter computes from the same side vectors."""
+    from m3_tpu.query.engine import EvalError, Vector, _apply_op, _compact
+
+    rmap: dict[tuple, int] = {}
+    for j, lb in enumerate(rhs.labels):
+        k = engine._match_key(lb, None)
+        if k in rmap:
+            raise EvalError(
+                "many-to-many vector matching: duplicate series on "
+                "'one' side")
+        rmap[k] = j
+    out_l, out_v = [], []
+    seen: dict[tuple, int] = {}
+    for i, lb in enumerate(lhs.labels):
+        k = engine._match_key(lb, None)
+        j = rmap.get(k)
+        if j is None:
+            continue
+        if k in seen:
+            raise EvalError(
+                "many-to-one matching requires group_left/group_right")
+        seen[k] = i
+        raw = _apply_op(op, lhs.values[i], rhs.values[j])
+        out_l.append(engine._result_labels(lb, rhs.labels[j], None, False))
+        out_v.append(raw)
+    T = lhs.values.shape[1] if len(lhs.labels) else (
+        rhs.values.shape[1] if len(rhs.labels) else 0
+    )
+    return _compact(Vector(out_l, np.stack(out_v) if out_v
+                           else np.zeros((0, T))))
 
 
 def _pad_bounds(lo: np.ndarray, hi: np.ndarray, n_samples: int, Sp: int):

@@ -191,7 +191,9 @@ class Collector:
             before = (st.series_matched, st.blocks_read, st.bytes_decoded,
                       st.cache_hits, st.cache_misses,
                       dict(st.decode_rungs), dict(st.node_legs),
-                      dict(self._claimed))
+                      dict(self._claimed),
+                      (st.pipeline_groups, st.pipeline_wall_s,
+                       dict(st.pipeline_stage_s)))
         t0 = time.perf_counter()
         self._stack.append(entry)
         try:
@@ -206,7 +208,25 @@ class Collector:
 
     def _attribute(self, entry: dict, st, before) -> None:
         (series0, blocks0, bytes0, hits0, miss0, rungs0, legs0,
-         claimed0) = before
+         claimed0, pipe0) = before
+        # pipelined-dataflow overlap this node's subtree accrued: wall
+        # time vs sum-of-stage time per group (storage/pipeline.py) —
+        # the per-query proof that gather legs overlapped decode rungs
+        pg0, pw0, ps0 = pipe0
+        d_groups = st.pipeline_groups - pg0
+        if d_groups > 0:
+            d_wall = st.pipeline_wall_s - pw0
+            d_stage = {k: round((v - ps0.get(k, 0.0)) * 1e3, 3)
+                       for k, v in st.pipeline_stage_s.items()
+                       if v - ps0.get(k, 0.0) > 0}
+            stage_sum = sum(d_stage.values())
+            entry["pipeline"] = {
+                "groups": d_groups,
+                "wall_ms": round(d_wall * 1e3, 3),
+                "stage_ms": d_stage,
+                "overlap": round(stage_sum / (d_wall * 1e3), 3)
+                if d_wall > 0 else 0.0,
+            }
         deltas = {
             "series": st.series_matched - series0,
             "blocks": st.blocks_read - blocks0,

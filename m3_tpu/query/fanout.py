@@ -141,18 +141,33 @@ class FanoutNamespace:
                                           warnings)
 
     def _read_many_traced(self, series_ids, start_ns, end_ns, warnings):
+        from m3_tpu.storage import pipeline
+
         warns: list[ReadWarning] = []
         local = self._local
+        zones = self._fdb.zones
+        # pipelined fan-out: every remote zone's read_many RPC goes in
+        # flight BEFORE the local leg's fused fetch+decode runs on this
+        # thread, so cross-zone network legs overlap the local decode
+        # rung. Serial is pinned under the hatch or an armed fault plan
+        # (the fanout.zone injection schedule must stay deterministic).
+        futs = None
+        if zones and series_ids and pipeline.active() \
+                and not faults.enabled():
+            futs = self._fly_zone_reads(zones, series_ids, start_ns, end_ns)
         if local is not None:
             merged = list(local.read_many(series_ids, start_ns, end_ns))
         else:
             empty_t = np.array([], dtype=np.int64)
             empty_v = np.array([], dtype=np.uint64)
             merged = [(empty_t, empty_v) for _ in series_ids]
-        for zone in self._fdb.zones:
-            remote = self._zone_call(
-                zone, zone.read_many, self.name, series_ids, start_ns, end_ns,
-                warnings=warns)
+        for k, zone in enumerate(zones):
+            if futs is not None:
+                remote = self._reap_zone_read(zone, futs[k], warns)
+            else:
+                remote = self._zone_call(
+                    zone, zone.read_many, self.name, series_ids, start_ns,
+                    end_ns, warnings=warns)
             if remote is None:
                 continue
             for i, (rt, rv) in enumerate(remote):
@@ -170,6 +185,41 @@ class FanoutNamespace:
         if warnings is not None:
             warnings.extend(warns)
         return merged
+
+    def _fly_zone_reads(self, zones, series_ids, start_ns, end_ns):
+        """Submit every remote zone's read_many through the shared leg
+        policy (pipeline.submit_client_leg: trace context re-activated
+        per worker, timed, exceptions as values); `_reap_zone_read`
+        applies the per-zone failure policy in zone order, so
+        warnings/merge order match the serial loop."""
+        from m3_tpu.storage import pipeline
+        from m3_tpu.utils import trace
+
+        tracer = trace.default_tracer()
+        ctx = tracer.current()
+        return [pipeline.submit_client_leg(
+            lambda zone=zone: zone.read_many(self.name, series_ids,
+                                             start_ns, end_ns),
+            tracer, ctx, point_ctx="fanout_zone") for zone in zones]
+
+    def _reap_zone_read(self, zone, fut, warns: list):
+        """Consume one overlapped zone leg with _zone_call's exact
+        policy: strict mode raises, otherwise the zone is skipped with a
+        counter + ReadWarning; the leg rides EXPLAIN ANALYZE either way."""
+        from m3_tpu.utils import querystats
+
+        rows, err, dt = fut.result()
+        querystats.record_node_leg(f"zone:{zone.name}", dt)
+        if err is None:
+            return rows
+        if isinstance(err, faults.SimulatedCrash):
+            raise err  # our own injected death, never a zone failure
+        if self._fdb.strict:
+            raise FanoutError(f"remote zone {zone.name}: {err}") from err
+        _scope.subscope("zone", zone=zone.name).counter("errors")
+        log.warning("fanout: skipping zone %s: %s", zone.name, err)
+        warns.append(ReadWarning("fanout", zone.name, str(err)))
+        return None
 
     def read(self, series_id: bytes, start_ns: int, end_ns: int):
         [(t, v)] = self.read_many([series_id], start_ns, end_ns)

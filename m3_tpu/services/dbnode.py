@@ -418,6 +418,15 @@ class DBNodeService:
         )
         for ns in db_cfg.get("namespaces", [{"name": "default"}]) or []:
             self.db.create_namespace(ns["name"], namespace_options(ns.get("options")))
+        # pipelined-dataflow sizing (storage/pipeline.py): `pipeline:`
+        # config section {workers, depth, wal_chunk} — env vars win, so
+        # M3_TPU_PIPELINE* still overrides per process (and =0 disables)
+        from m3_tpu.storage import pipeline as storage_pipeline
+
+        pl_cfg = config.get("pipeline", {}) or {}
+        storage_pipeline.configure(
+            workers=pl_cfg.get("workers"), depth=pl_cfg.get("depth"),
+            wal_chunk=pl_cfg.get("wal_chunk"))
         from m3_tpu.cluster.runtime import RuntimeOptionsManager
 
         # live-tunable options: query limits, tick switches, persist pacing

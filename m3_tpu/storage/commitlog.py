@@ -84,12 +84,24 @@ class SalvageReport:
 
 class CommitLogWriter:
     def __init__(self, path: str, flush_every_bytes: int = 1 << 20):
+        import threading
+
         os.makedirs(os.path.dirname(path), exist_ok=True)
         self._f = open(path, "ab")
         self._buf = bytearray()
         self._series: dict[bytes, int] = {}
         self._flush_every = flush_every_bytes
         self.path = path
+        # writer lock: concurrent ingest threads interleaving the
+        # multi-append register records (or racing the series registry)
+        # would tear the entry framing inside a digest-valid chunk — an
+        # undecodable-chunk salvage truncation with NO crash involved.
+        # The pipelined write path routes appends through a per-namespace
+        # FIFO lane (storage/pipeline.py) so in steady state exactly one
+        # thread holds this; the lock is the correctness backstop (and
+        # the measured WAL class in the lock-wait profile: serial-path
+        # ingest threads contend here for the full flush+fsync I/O).
+        self._lock = threading.Lock()
         # a failed flush POISONS the writer: the file may hold a torn
         # interior chunk, and salvage replay truncates everything after
         # the first bad chunk — so acking any later write on this file
@@ -107,22 +119,35 @@ class CommitLogWriter:
 
     def write(self, series_id: bytes, encoded_tags: bytes, time_ns: int,
               value_bits: int, unit: int) -> None:
+        faults.check("commitlog.write")
+        with self._lock:
+            # poison check INSIDE the lock: a writer blocked here while a
+            # concurrent flush fails must not append (and so ack) onto a
+            # poisoned log — salvage replay would truncate those bytes
+            self._check_poisoned_locked()
+            sidx = self._series.get(series_id)
+            if sidx is None:
+                sidx = len(self._series)
+                self._series[series_id] = sidx
+                self._buf += struct.pack(">BI", 0, sidx)
+                self._buf += struct.pack(">I", len(series_id)) + series_id
+                self._buf += struct.pack(">I", len(encoded_tags)) \
+                    + encoded_tags
+            self._buf += struct.pack(">BIqQB", 1, sidx, time_ns, value_bits,
+                                     unit)
+            if len(self._buf) >= self._flush_every:
+                # the WAL write/fsync seam deliberately completes under
+                # the writer lock: the lock IS the append/flush ordering
+                # (same class as the raft persist-before-ack waivers)
+                # m3lint: disable=lock-blocking-call
+                self._flush_locked(fsync=False)
+
+    def _check_poisoned_locked(self) -> None:
         if self._failed is not None:
             raise OSError(
                 f"commitlog writer poisoned by earlier flush failure "
                 f"({self.path})"
             ) from self._failed
-        faults.check("commitlog.write")
-        sidx = self._series.get(series_id)
-        if sidx is None:
-            sidx = len(self._series)
-            self._series[series_id] = sidx
-            self._buf += struct.pack(">BI", 0, sidx)
-            self._buf += struct.pack(">I", len(series_id)) + series_id
-            self._buf += struct.pack(">I", len(encoded_tags)) + encoded_tags
-        self._buf += struct.pack(">BIqQB", 1, sidx, time_ns, value_bits, unit)
-        if len(self._buf) >= self._flush_every:
-            self.flush()
 
     def write_many(self, series_ids: list[bytes], tags_list: list[bytes],
                    times: np.ndarray, value_bits: np.ndarray,
@@ -138,11 +163,6 @@ class CommitLogWriter:
         check per batch (the per-point path checks per entry, so chunk
         BOUNDARIES may differ once a batch crosses the threshold; the
         entry stream never does)."""
-        if self._failed is not None:
-            raise OSError(
-                f"commitlog writer poisoned by earlier flush failure "
-                f"({self.path})"
-            ) from self._failed
         # same semantic seam as the per-point write() above — one name, one
         # injection schedule, whichever path the caller took
         # m3lint: disable=inv-fault-point-unique
@@ -150,6 +170,18 @@ class CommitLogWriter:
         n = len(series_ids)
         if n == 0:
             return
+        with self._lock:
+            # deliberate: the batched append (incl. a threshold flush)
+            # completes under the writer lock — see write()
+            # m3lint: disable=lock-blocking-call
+            self._write_many_locked(series_ids, tags_list, times,
+                                    value_bits, unit)
+
+    def _write_many_locked(self, series_ids, tags_list, times, value_bits,
+                           unit) -> None:
+        # same poisoned-writer rule as write(): checked under the lock
+        self._check_poisoned_locked()
+        n = len(series_ids)
         series = self._series
         # register records for series this log hasn't seen, keyed by the
         # batch position they must precede
@@ -185,14 +217,17 @@ class CommitLogWriter:
             pieces.append(blob[prev * sz :])
             self._buf += b"".join(pieces)
         if len(self._buf) >= self._flush_every:
-            self.flush()
+            self._flush_locked(fsync=False)
 
     def flush(self, fsync: bool = False) -> None:
-        if self._failed is not None:
-            raise OSError(
-                f"commitlog writer poisoned by earlier flush failure "
-                f"({self.path})"
-            ) from self._failed
+        with self._lock:
+            # deliberate: the flush+fsync seam holds the writer lock so
+            # no append can interleave a half-flushed chunk
+            # m3lint: disable=lock-blocking-call
+            self._flush_locked(fsync)
+
+    def _flush_locked(self, fsync: bool) -> None:
+        self._check_poisoned_locked()
         try:
             if not self._buf:
                 if fsync:
@@ -221,7 +256,8 @@ class CommitLogWriter:
         self._unmonitor()
         if self._failed is None:
             self.flush(fsync=True)
-        self._f.close()
+        with self._lock:
+            self._f.close()
 
 
 def _decode_payload(payload: bytes, series: dict[int, tuple[bytes, bytes]],

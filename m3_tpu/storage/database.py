@@ -555,6 +555,20 @@ class Database:
         if not ok:
             return results, 0
         clog = self._commitlogs.get(namespace)
+        from m3_tpu.storage import pipeline
+
+        if clog is not None and pipeline.active() \
+                and len(ok) > pipeline.wal_chunk_entries():
+            # pipelined write dataflow: WAL pack/flush for chunk N runs
+            # on the per-namespace FIFO lane while THIS thread runs
+            # chunk N-1's buffer/index inserts. Ack (returning) happens
+            # only after every chunk's WAL stage completed, and a chunk
+            # is buffered only AFTER its own WAL append succeeded — the
+            # acked => durably-logged contract and per-entry isolation
+            # are exactly the serial path's (M3_TPU_PIPELINE=0 pins it).
+            return self._write_batch_pipelined(
+                ns, namespace, clog, entries, series_ids, encs,
+                fields_list, times, vbits, by_shard, results, ok)
         if clog is not None:
             all_ok = len(ok) == n
             ok_idx = None if all_ok else np.asarray(ok, np.intp)
@@ -584,6 +598,66 @@ class Database:
         ns.write_many(series_ids, times, vbits, encs, fields_list,
                       routed=(by_shard, results))
         return results, len(ok)
+
+    def _write_batch_pipelined(self, ns, namespace, clog, entries,
+                               series_ids, encs, fields_list, times, vbits,
+                               by_shard, results, ok
+                               ) -> tuple[list[str | None], int]:
+        """The overlapped tail of _write_batch_traced: the clean rows
+        split into WAL chunks appended in order on the per-namespace
+        lane; as each chunk's append completes (== its entries are in
+        the WAL buffer/OS, the serial path's ack point), this thread
+        runs its buffer + index inserts while the lane packs the next
+        chunk. A chunk whose WAL append failed degrades exactly its own
+        entries and never touches the buffers (buffered => logged); the
+        emitted WAL entry stream is byte-identical to the serial path
+        (chunk boundaries only move the flush-threshold checks, as the
+        batched write_many already documents)."""
+        from m3_tpu.storage import pipeline
+
+        n = len(entries)
+        chunk = pipeline.wal_chunk_entries()
+        unit = int(ns.opts.write_time_unit)
+        lane = pipeline.default_executor().lane(f"wal:{namespace}")
+        chunks = [ok[lo:lo + chunk] for lo in range(0, len(ok), chunk)]
+        futs = []
+        for ch in chunks:
+            idx = np.asarray(ch, np.intp)
+            futs.append(lane.submit(
+                lambda s=[series_ids[i] for i in ch],
+                g=[encs[i] for i in ch], t=times[idx], v=vbits[idx]:
+                clog.write_many(s, g, t, v, unit)))
+        r = ns.opts.retention
+        windows = self._log_windows[namespace]
+        mask = np.zeros(n, bool)
+        n_ok = 0
+        for fut, ch in zip(futs, chunks):
+            try:
+                fut.result()
+            except faults.SimulatedCrash:
+                raise  # no handler survives a kill
+            except Exception as ex:  # noqa: BLE001 - this chunk was never
+                # durably logged: degrade exactly its entries, leave the
+                # buffers untouched (the serial path's WAL-failure rule,
+                # applied per chunk)
+                for i in ch:
+                    results[i] = str(ex)
+                continue
+            idx = np.asarray(ch, np.intp)
+            t_ch = times[idx]
+            for w in np.unique(t_ch - (t_ch % r.block_size_ns)).tolist():
+                windows.add(int(w))
+            mask[:] = False
+            mask[idx] = True
+            routed_chunk = {}
+            for s, rows in by_shard.items():
+                sub = rows[mask[np.asarray(rows, np.intp)]]
+                if len(sub):
+                    routed_chunk[s] = sub
+            ns.write_many(series_ids, times, vbits, encs, fields_list,
+                          routed=(routed_chunk, results), only_rows=ch)
+            n_ok += len(ch)
+        return results, n_ok
 
     def write_tagged_batch(self, namespace: str, entries) -> int:
         """The cluster-facade batch surface (ClusterDatabase parity) over

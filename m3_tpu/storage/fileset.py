@@ -383,6 +383,54 @@ class FilesetReader:
             off = nxt
         return out
 
+    # -- columnar gather (the pipelined read path's fetch rung) --
+
+    def _row_index(self):
+        """id -> row dict plus per-row (data_off, data_len) columns,
+        built by ONE walk of the mapped index and cached on the
+        immutable reader (like series_checksums). The legacy per-query
+        merge-join walk re-parses every entry per read_many call; the
+        pipelined dataflow's gather rung pays the walk once per volume
+        and serves every later query with dict lookups + data slices.
+        Concurrent first builds race benignly (idempotent, last wins)."""
+        import numpy as np
+
+        cached = getattr(self, "_rows", None)
+        if cached is not None:
+            return cached
+        n = self.n_series
+        rows: dict[bytes, int] = {}
+        data_off = np.empty(n, np.int64)
+        data_len = np.empty(n, np.int64)
+        off = 0
+        for i in range(n):
+            sid, _tags, d_off, d_len, off = self._parse_entry(off)
+            rows[sid] = i
+            data_off[i] = d_off
+            data_len[i] = d_len
+        self._rows = (rows, data_off, data_len)
+        return self._rows
+
+    def gather_many(self, series_ids: list[bytes]) -> list[bytes | None]:
+        """`read_many` semantics served from the cached row index: one
+        dict lookup + one data slice per requested series (duplicates
+        share the stream object), None for absent ids. Same results as
+        read_many — the pipelined gather rung, tested for parity."""
+        rows, data_off, data_len = self._row_index()
+        data = self._data
+        out: list[bytes | None] = [None] * len(series_ids)
+        memo: dict[bytes, bytes] = {}
+        for k, sid in enumerate(series_ids):
+            hit = memo.get(sid)
+            if hit is None:
+                i = rows.get(sid)
+                if i is None:
+                    continue
+                o = int(data_off[i])
+                hit = memo[sid] = bytes(data[o:o + int(data_len[i])])
+            out[k] = hit
+        return out
+
     def read_at(self, i: int) -> tuple[bytes, bytes, bytes]:
         """(id, encoded_tags, stream) for index position i."""
         off = int(self._entry_offsets()[i])

@@ -123,7 +123,8 @@ class Namespace:
 
     def write_many(self, series_ids: list[bytes], times, value_bits,
                    tags_list: list[bytes], fields_list: list | None = None,
-                   routed: tuple | None = None) -> list[str | None]:
+                   routed: tuple | None = None,
+                   only_rows: list | None = None) -> list[str | None]:
         """Storage-side batched writes (the write half of read_many's
         contract): rows route in one vectorized murmur3 pass
         (ShardSet.lookup_many — pass `routed` to reuse a route_many
@@ -131,7 +132,12 @@ class Namespace:
         per (shard, window) group (Shard.write_many), and the reverse
         index sees one pre-filtered insert_many pass. Rows landing on
         unowned shards degrade per entry — the batch never fails
-        wholesale. Returns per-row error strings (None = written)."""
+        wholesale. Returns per-row error strings (None = written).
+
+        ``only_rows`` (with ``routed``) restricts the pass to those row
+        indices — the pipelined write path's per-WAL-chunk call shape:
+        the routed dict is already chunk-filtered, and the index insert
+        must not re-insert other chunks' rows."""
         import numpy as np
 
         n = len(series_ids)
@@ -148,7 +154,8 @@ class Namespace:
                 [series_ids[i] for i in rows_l], times[ridx],
                 value_bits[ridx], [tags_list[i] for i in rows_l])
         if self.index is not None and fields_list is not None:
-            ok = [i for i in range(n)
+            cand = only_rows if only_rows is not None else range(n)
+            ok = [i for i in cand
                   if errors[i] is None and fields_list[i] is not None]
             if ok:
                 self.index.insert_many([series_ids[i] for i in ok],
@@ -198,6 +205,8 @@ class Namespace:
             return self._read_many_traced(series_ids, start_ns, end_ns)
 
     def _read_many_traced(self, series_ids, start_ns, end_ns):
+        from m3_tpu.storage import pipeline
+
         by_shard: dict[int, list[int]] = {}
         for i, shard_id in enumerate(self.shard_set.lookup_many(series_ids)):
             if shard_id not in self.shards:
@@ -208,6 +217,12 @@ class Namespace:
         if limits is not None and getattr(limits, "max_datapoints", 0):
             chunk = min(chunk, self.READ_MANY_LIMIT_CHUNK)
         out: list = [None] * len(series_ids)
+        if pipeline.active() and chunk >= len(series_ids):
+            # pipelined dataflow (no datapoint-limit chunking): ONE
+            # flattened schedule of per-(shard, block) gather legs
+            # across every shard, overlapping the caller's decode rung
+            return self._read_many_pipelined(series_ids, by_shard,
+                                             start_ns, end_ns, out)
         for shard_id, idxs in by_shard.items():
             shard = self.shards[shard_id]
             for lo in range(0, len(idxs), chunk):
@@ -219,6 +234,56 @@ class Namespace:
                         limits.add_datapoints(len(times))
                     out[i] = (times, vbits)
         return out
+
+    def _read_many_pipelined(self, series_ids, by_shard, start_ns, end_ns,
+                             out):
+        """Per-(shard, block) groups through the executor seam: group
+        N+1's fileset gather runs on the pool while group N decodes on
+        this thread, and a shard's series FINALIZE (buffer merge +
+        limits accounting, the partial columns downstream host prep
+        consumes) as soon as its last group decodes — while later
+        shards' gathers are still in flight. Results are identical to
+        the serial path: groups run in the same nested order, decode
+        stays one dispatch per group, and per-series parts keep the
+        filesets-then-buffer order merge_dedup resolves last-write-wins.
+        """
+        from m3_tpu.storage import pipeline
+        from m3_tpu.utils import querystats
+
+        groups = []
+        last_group_of: dict[int, object] = {}
+        for shard_id, idxs in by_shard.items():
+            shard = self.shards[shard_id]
+            sids = [series_ids[i] for i in idxs]
+            parts: list[list] = [[] for _ in idxs]
+            plan = (shard, idxs, sids, parts)
+            shard_groups = shard.plan_read_groups(sids, start_ns, end_ns,
+                                                  parts)
+            groups.extend(shard_groups)
+            if shard_groups:
+                last_group_of[id(shard_groups[-1])] = plan
+            else:
+                self._finalize_shard_read(plan, start_ns, end_ns, out)
+
+        def consume(g, payload):
+            g.consume(payload)
+            plan = last_group_of.get(id(g))
+            if plan is not None:  # this shard's partial columns are
+                # complete: hand them downstream now, mid-pipeline
+                self._finalize_shard_read(plan, start_ns, end_ns, out)
+
+        stats = pipeline.run_stages(groups, lambda g: g.gather(), consume)
+        querystats.record_pipeline(stats.items, stats.wall_s, stats.stages)
+        return out
+
+    def _finalize_shard_read(self, plan, start_ns, end_ns, out) -> None:
+        shard, idxs, sids, parts = plan
+        limits = self.limits
+        for i, sid, pl in zip(idxs, sids, parts):
+            times, vbits = shard.finish_read(sid, pl, start_ns, end_ns)
+            if limits is not None:
+                limits.add_datapoints(len(times))
+            out[i] = (times, vbits)
 
     def flush(self, now_ns: int) -> int:
         """WARM flush: first volume for aged-out buffered windows."""

@@ -4,10 +4,12 @@ write-hot-path observability overhead guard), #8 (the batched
 write_batch ingest path vs the per-entry loop), #9 (end-to-end
 query_range latency, whole-query-compiled vs interpreted), #10 (the
 profiler-overhead guard: sampling profiler + lock-wait profiling +
-stall watchdog armed vs off, same pairing discipline as #7) and #11
+stall watchdog armed vs off, same pairing discipline as #7), #11
 (the sharded query plane: the same fused query_range + grouped
 aggregation on the series-sharded device mesh vs single-device, swept
-over device counts).
+over device counts) and #12 (the pipelined dataflow: sparse
+multi-group read_many->query e2e, executor-pipelined vs the pinned
+serial seed path, pair-median, correctness-gated).
 
 Prints one JSON line per config (same shape as bench.py). Sizes are
 env-tunable; defaults are sized to finish on CPU in a few minutes —
@@ -1002,10 +1004,121 @@ def config11_sharded_query():
         db.close()
 
 
+def config12_pipelined_read():
+    """Pipelined dataflow (ISSUE 14 / ROADMAP #2): end-to-end
+    query_range over a SPARSE high-cardinality multi-group namespace —
+    16k series x 12 block volumes, a handful of points per (series,
+    block), the shape where the per-(shard, block) gather rung dominates
+    the fetch (ROADMAP #3's sparse-series premise). Pipelined
+    (M3_TPU_PIPELINE=1: per-group gathers prefetched on the executor
+    behind the decode rung, columnar row-index gather, cache
+    bookkeeping skipped while the block cache is disabled — this is a
+    cold scan) vs the pinned serial seed path (=0: per-query merge-join
+    walk, inline legs). Same pairing discipline as #9: interleaved
+    pairs, MEDIAN pair reported, correctness gated on exact NaN masks +
+    1e-9 values BEFORE anything is emitted. On a multi-core host the
+    executor adds genuine gather/decode wall-clock overlap on top of
+    the columnar gather; this 1-core container measures the
+    restructured dataflow alone."""
+    import tempfile
+
+    from m3_tpu.encoding.m3tsz import hostpath
+    from m3_tpu.query.engine import Engine
+    from m3_tpu.storage.database import Database
+    from m3_tpu.storage.fileset import FilesetWriter
+    from m3_tpu.storage.options import (
+        DatabaseOptions, IndexOptions, NamespaceOptions, RetentionOptions,
+    )
+    from m3_tpu.utils.xtime import TimeUnit
+
+    NS = 10**9
+    BLOCK = 3600 * NS
+    START = 1_600_000_000 * NS
+    S = max(int(160_000 * _scale()), 2048)
+    NB, T = 12, 4
+    with tempfile.TemporaryDirectory() as root:
+        db = Database(root, DatabaseOptions(
+            n_shards=8, block_cache_entries=0))  # cold multi-group scans
+        ns = db.create_namespace("default", NamespaceOptions(
+            retention=RetentionOptions(retention_ns=1000 * BLOCK,
+                                       block_size_ns=BLOCK),
+            index=IndexOptions(enabled=True, block_size_ns=BLOCK),
+            writes_to_commitlog=False, snapshot_enabled=False))
+        ids = [b"reqs,host=h%04d,i=%05d" % (i % 100, i) for i in range(S)]
+        fields = [[(b"__name__", b"reqs"), (b"host", b"h%04d" % (i % 100)),
+                   (b"i", b"%05d" % i)] for i in range(S)]
+        by_shard: dict[int, list[int]] = {}
+        for j, sid in enumerate(ids):
+            by_shard.setdefault(ns.shard_set.lookup(sid), []).append(j)
+        rng = np.random.default_rng(0)
+        for b in range(NB):
+            bs = START + b * BLOCK
+            for shard_id, rows in by_shard.items():
+                nb = len(rows)
+                times = np.broadcast_to(
+                    bs + np.arange(T, dtype=np.int64) * (BLOCK // T),
+                    (nb, T)).copy()
+                vals = rng.integers(1, 10, (nb, T)).astype(np.float64) \
+                    .cumsum(axis=1)
+                streams = hostpath.encode_blocks(
+                    times, vals.view(np.uint64), np.full(nb, bs, np.int64),
+                    np.full(nb, T, np.int32), TimeUnit.SECOND, False)
+                w = FilesetWriter(db.fs_root, "default", shard_id, bs,
+                                  BLOCK, 0)
+                for j, stream in zip(rows, streams):
+                    w.write_series(ids[j], b"", stream)
+                w.close()
+        db.open(START + NB * BLOCK)
+        ns.index.insert_many(ids, fields, np.full(S, START, np.int64))
+        eng = Engine(db, resolve_tiers=False)
+        q = "sum by (host) (sum_over_time(reqs[30m]))"
+        qs = START + 30 * 60 * NS
+        qe = START + NB * BLOCK - 60 * NS
+        step = 30 * 60 * NS
+        n_dp = S * NB * T  # samples the query reads end to end
+
+        def run():
+            return eng.query_range(q, qs, qe, step)[0]
+
+        prev = os.environ.get("M3_TPU_PIPELINE")
+        try:
+            os.environ["M3_TPU_PIPELINE"] = "1"
+            v_p = run()
+            os.environ["M3_TPU_PIPELINE"] = "0"
+            v_s = run()
+            ok = (v_p.labels == v_s.labels
+                  and np.array_equal(np.isnan(v_p.values),
+                                     np.isnan(v_s.values))
+                  and np.allclose(v_p.values, v_s.values, rtol=1e-9,
+                                  atol=0, equal_nan=True))
+            pairs: list[tuple[float, float, float]] = []
+            for _ in range(7):
+                os.environ["M3_TPU_PIPELINE"] = "1"
+                t0 = time.perf_counter()
+                run()
+                dt_p = time.perf_counter() - t0
+                os.environ["M3_TPU_PIPELINE"] = "0"
+                t0 = time.perf_counter()
+                run()
+                dt_s = time.perf_counter() - t0
+                pairs.append((dt_s / dt_p, n_dp / dt_p, n_dp / dt_s))
+            pairs.sort(key=lambda p: p[0])
+            _ratio, thr_p, thr_s = pairs[len(pairs) // 2]
+            _emit(f"#12 pipelined read_many->query e2e {S} series x "
+                  f"{NB} blocks [sparse multi-group scan, pipelined vs "
+                  f"serial]" + ("" if ok else " (CORRECTNESS FAILED)"),
+                  thr_p, thr_s)
+        finally:
+            if prev is None:
+                os.environ.pop("M3_TPU_PIPELINE", None)
+            else:
+                os.environ["M3_TPU_PIPELINE"] = prev
+
+
 def main(argv=None) -> None:
     global _ACCEL
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12")
     ap.add_argument("--record", default=None,
                     help="also append the JSON lines to this file")
     args = ap.parse_args(argv)
@@ -1033,7 +1146,7 @@ def main(argv=None) -> None:
            "5": config5_sharded_quantile, "6": config6_read_many,
            "7": config7_tracing_overhead, "8": config8_write_batch,
            "9": config9_query_compile, "10": config10_profiler_overhead,
-           "11": config11_sharded_query}
+           "11": config11_sharded_query, "12": config12_pipelined_read}
     for c in args.configs.split(","):
         c = c.strip()
         try:

@@ -41,6 +41,10 @@ HISTOGRAMS = {
     "fetch_many_seconds",       # session batched fetch
     "request_seconds",          # coordinator request + per-tenant SLO
     "flush_seconds",            # aggregator flush
+    # pipelined dataflow (storage/pipeline)
+    "stage_seconds",            # pipeline.stage{stage=gather|decode}:
+    #                             per-run stage-time sums; compared with
+    #                             the run's wall time they expose overlap
     # profiling & saturation plane (utils/profiler)
     "sample_seconds",           # profiler per-pass sampling wall time
     "wait_seconds",             # lock.wait_seconds{cls=site}: per-class

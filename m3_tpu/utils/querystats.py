@@ -59,6 +59,13 @@ class QueryStats:
     # fanout zone this query touched (the cross-node half of EXPLAIN
     # ANALYZE — the coordinator's plan tree shows each node's share)
     node_legs: dict = field(default_factory=dict)
+    # pipelined-dataflow overlap (storage/pipeline.py run_stages): how
+    # many (shard, block) groups rode the executor, the wall time of the
+    # pipelined pass, and the per-stage (gather/decode) time sums —
+    # stage_sum > wall is overlap, surfaced on ?explain=analyze
+    pipeline_groups: int = 0
+    pipeline_wall_s: float = 0.0
+    pipeline_stage_s: dict = field(default_factory=dict)
     duration_s: float = 0.0
 
     def to_dict(self) -> dict:
@@ -80,6 +87,18 @@ class QueryStats:
             out["node_legs"] = {
                 host: {"calls": c, "ms": round(s * 1e3, 3), "rows": r}
                 for host, (c, s, r) in self.node_legs.items()
+            }
+        if self.pipeline_groups:
+            stage_sum = sum(self.pipeline_stage_s.values())
+            out["pipeline"] = {
+                "groups": self.pipeline_groups,
+                "wall_ms": round(self.pipeline_wall_s * 1e3, 3),
+                "stage_ms": {k: round(v * 1e3, 3)
+                             for k, v in self.pipeline_stage_s.items()},
+                "stage_sum_ms": round(stage_sum * 1e3, 3),
+                # >1.0 means stages overlapped in wall time
+                "overlap": round(stage_sum / self.pipeline_wall_s, 3)
+                if self.pipeline_wall_s > 0 else 0.0,
             }
         return out
 
@@ -215,6 +234,20 @@ def record(series_matched: int = 0, blocks_read: int = 0,
         st.decode_rungs[decode_rung] = st.decode_rungs.get(decode_rung, 0) + 1
 
 
+def record_pipeline(groups: int, wall_s: float, stages: dict) -> None:
+    """Accrue one pipelined-dataflow pass (storage/pipeline run_stages)
+    onto the active query's record: groups scheduled, wall time, and
+    per-stage time sums. ?explain=analyze renders wall vs stage-sum so
+    the gather/decode overlap is visible per query. No-op outside one."""
+    st = getattr(_tls, "current", None)
+    if st is None or not groups:
+        return
+    st.pipeline_groups += groups
+    st.pipeline_wall_s += wall_s
+    for stage, dt in stages.items():
+        st.pipeline_stage_s[stage] = st.pipeline_stage_s.get(stage, 0.0) + dt
+
+
 def record_node_leg(leg: str, seconds: float, rows: int = 0) -> None:
     """Accrue one remote leg (storage-node RPC, fanout zone) onto the
     active query's record: EXPLAIN ANALYZE shows each node's share of a
@@ -245,9 +278,14 @@ def collect():
 def storage_counters(st: QueryStats) -> dict:
     """The storage-side counters a node embeds in its /read_batch
     response envelope (merged coordinator-side via merge_storage)."""
-    return {"series": st.series_matched, "blocks": st.blocks_read,
-            "bytes": st.bytes_decoded, "cache_hits": st.cache_hits,
-            "cache_misses": st.cache_misses, "rungs": dict(st.decode_rungs)}
+    out = {"series": st.series_matched, "blocks": st.blocks_read,
+           "bytes": st.bytes_decoded, "cache_hits": st.cache_hits,
+           "cache_misses": st.cache_misses, "rungs": dict(st.decode_rungs)}
+    if st.pipeline_groups:
+        out["pipeline"] = {"groups": st.pipeline_groups,
+                           "wall_s": st.pipeline_wall_s,
+                           "stages": dict(st.pipeline_stage_s)}
+    return out
 
 
 def merge_storage(doc: dict | None) -> None:
@@ -265,6 +303,12 @@ def merge_storage(doc: dict | None) -> None:
     st.cache_misses += int(doc.get("cache_misses", 0))
     for rung, cnt in (doc.get("rungs") or {}).items():
         st.decode_rungs[rung] = st.decode_rungs.get(rung, 0) + int(cnt)
+    pipe = doc.get("pipeline")
+    if pipe:
+        record_pipeline(int(pipe.get("groups", 0)),
+                        float(pipe.get("wall_s", 0.0)),
+                        {k: float(v)
+                         for k, v in (pipe.get("stages") or {}).items()})
 
 
 @contextmanager

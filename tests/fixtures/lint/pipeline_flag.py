@@ -1,0 +1,37 @@
+"""must-flag: hand-rolled thread-pool/queue pipelines outside the
+executor seam (conc-handrolled-pipeline)."""
+
+import queue
+import threading
+from collections import deque
+
+
+class HandRolledPool:
+    """Classic hand-rolled pipeline: N worker threads draining a shared
+    queue — must flag (scheduling outside storage/pipeline.py)."""
+
+    def __init__(self, n):
+        self._q = queue.Queue(64)
+        for _ in range(n):
+            threading.Thread(target=self._worker, daemon=True).start()
+
+    def _worker(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            item()
+
+
+class ComprehensionPool:
+    """Pool spawned via a list comprehension over a deque backlog —
+    must flag too (the loop is a comprehension, not a for)."""
+
+    def __init__(self, n):
+        self._backlog = deque()
+        self._threads = [threading.Thread(target=self._run)
+                         for _ in range(n)]
+
+    def _run(self):
+        while self._backlog:
+            self._backlog.popleft()()

@@ -1,0 +1,48 @@
+"""must-pass: the blessed shapes around conc-handrolled-pipeline."""
+
+import queue
+import threading
+
+
+class SingleDrain:
+    """One background drain thread over a queue (the exporter/
+    DivergenceReporter idiom) — not a pool, must NOT flag."""
+
+    def __init__(self):
+        self._q = queue.Queue(64)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            item()
+
+
+class AcceptLoop:
+    """Per-connection thread spawns in a loop WITHOUT a work queue (the
+    socket-server accept idiom) — must NOT flag."""
+
+    def __init__(self, sock):
+        self._sock = sock
+
+    def serve(self):
+        while True:
+            conn, _addr = self._sock.accept()
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        conn.close()
+
+
+class UsesExecutorSeam:
+    """Pipelining through the executor seam — must NOT flag."""
+
+    def run(self, items):
+        from m3_tpu.storage import pipeline
+
+        return pipeline.run_stages(items, lambda it: it,
+                                   lambda it, payload: None)

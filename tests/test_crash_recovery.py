@@ -492,7 +492,45 @@ def _repair_chaos_iteration(root: str, seed: int) -> tuple[int, int]:
     return crashes, clean_cycles
 
 
+# the pipelined-dataflow spec (ISSUE 14): the batched kill/torn-write
+# sweep with the WAL chunked onto the executor lane (tiny chunk so every
+# batch pipelines) and submit-time task faults landing mid-pipeline.
+# M3_TPU_PIPELINE=0 pins the serial path for bisection — the same seeds
+# run the seed-era code body.
+PIPELINE_CHAOS_SPEC = BATCH_CHAOS_SPEC + ";pipeline.task=error:p0.03"
+
+
 class TestChaosQuick:
+    def test_chaos_pipelined_iterations_quick(self, tmp_path, monkeypatch):
+        """Kill/torn-write mid-pipeline (ISSUE 14): with the write-side
+        overlap ARMED (chunked WAL lane) and pipeline.task faults firing,
+        no entry of an acked batch is ever lost across restart + salvage
+        replay — a chunk is buffered only after ITS WAL append, so the
+        acked => durable contract holds chunk by chunk."""
+        monkeypatch.setenv("M3_TPU_PIPELINE", "1")
+        monkeypatch.setenv("M3_TPU_PIPELINE_WAL_CHUNK", "4")
+        crashes = 0
+        for seed in range(6):
+            faults.configure(PIPELINE_CHAOS_SPEC, seed=seed)
+            crashed, _n = _chaos_iteration_batched(
+                str(tmp_path / f"p{seed}"), seed)
+            crashes += crashed
+        assert crashes >= 1
+
+    def test_pipeline_hatch_pins_serial_under_chaos(self, tmp_path,
+                                                    monkeypatch):
+        """The bisection hatch: the same seeded sweep with
+        M3_TPU_PIPELINE=0 runs the serial write body (pipeline.task
+        never fires — no tasks exist) and holds the same contract."""
+        monkeypatch.setenv("M3_TPU_PIPELINE", "0")
+        monkeypatch.setenv("M3_TPU_PIPELINE_WAL_CHUNK", "4")
+        for seed in range(3):
+            plan = faults.configure(PIPELINE_CHAOS_SPEC, seed=seed)
+            _chaos_iteration_batched(str(tmp_path / f"s{seed}"), seed)
+            assert not any(p == "pipeline.task"
+                           for p, *_ in plan.schedule), \
+                "serial path must never reach the pipeline seam"
+
     def test_chaos_iterations_quick(self, tmp_path):
         """A handful of seeds in tier-1 so the harness itself never rots;
         the 200-iteration sweep is the chaos lane."""
@@ -547,6 +585,25 @@ class TestChaosFull:
         crashes = acked_total = 0
         for seed in range(iters):
             faults.configure(BATCH_CHAOS_SPEC, seed=seed)
+            crashed, n = _chaos_iteration_batched(
+                str(tmp_path / str(seed)), seed)
+            crashes += crashed
+            acked_total += n
+        assert crashes >= iters // 10
+        assert acked_total > 0
+
+    def test_chaos_pipelined_kill_mid_flush_never_loses_acked_writes(
+            self, tmp_path, monkeypatch):
+        """The ISSUE-14 sweep: the batched chaos iteration with the WAL
+        lane armed fleet-wide (tiny chunks, pipeline.task faults) across
+        M3_TPU_CHAOS_ITERS seeds — zero acked-write loss with overlap
+        enabled."""
+        monkeypatch.setenv("M3_TPU_PIPELINE", "1")
+        monkeypatch.setenv("M3_TPU_PIPELINE_WAL_CHUNK", "4")
+        iters = int(os.environ.get("M3_TPU_CHAOS_ITERS", "200"))
+        crashes = acked_total = 0
+        for seed in range(iters):
+            faults.configure(PIPELINE_CHAOS_SPEC, seed=seed)
             crashed, n = _chaos_iteration_batched(
                 str(tmp_path / str(seed)), seed)
             crashes += crashed

@@ -76,6 +76,21 @@ class TestConcurrencyRules:
                  if f"self.{m}" in f.message}
         assert attrs == {"_entries", "_count"}
 
+    def test_handrolled_pipeline_flags(self):
+        fs = run_lint("pipeline_flag.py", select=("conc-",))
+        assert rules_of(fs) == {"conc-handrolled-pipeline"}
+        assert len(fs) == 2
+        msgs = "\n".join(f.message for f in fs)
+        assert "HandRolledPool" in msgs
+        assert "ComprehensionPool" in msgs
+        assert "storage/pipeline.py" in msgs
+
+    def test_handrolled_pipeline_blessed_idioms_pass(self):
+        # single drain thread, accept loop, and the executor seam
+        assert run_lint("pipeline_pass.py", select=("conc-",)) == []
+        seam = os.path.join(REPO, "m3_tpu", "storage", "pipeline.py")
+        assert lint_paths([seam], select=("conc-handrolled",)) == []
+
     def test_guarded_mutation_locked_helpers_pass(self):
         # _locked helper convention + __init__-only helpers
         assert run_lint("lock_guarded_pass.py", select=("lock-",)) == []

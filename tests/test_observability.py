@@ -654,8 +654,11 @@ class TestExplainAnalyzeFanout(TestTwoNodeFanoutTrace):
         for node, child in ((root, rate), (rate, sel)):
             assert child["duration_ms"] <= node["duration_ms"] + 0.5
         assert root["duration_ms"] <= stats["duration_ms"] + 0.5
-        assert sum(leg["duration_ms"] for leg in legs) \
-            <= sel["duration_ms"] + 0.5
+        # node legs fly CONCURRENTLY on the pipelined fan-out
+        # (storage/pipeline.py), so their SUM may exceed the selector
+        # stage's wall time — each individual leg still nests within it
+        for leg in legs:
+            assert leg["duration_ms"] <= sel["duration_ms"] + 0.5
         assert sum(leg.get("rows", 0) for leg in legs) == 32
         # dispatch-rung attribution: the selector stage carries exactly
         # the rungs the envelope reports (decode happened ON THE NODES;

@@ -56,7 +56,12 @@ class TestSamplingProfiler:
         assert "parked_leaf" in folded and folded.endswith(":wait")
         top = {d["frame"]: d for d in p.top(50)}
         leaf = next(k for k in top if k.endswith(":wait"))
-        assert top[leaf]["self"] == 4 and top[leaf]["total"] >= 4
+        # top() aggregates the frame ACROSS threads: any other parked
+        # daemon thread in the process (the always-on pipeline worker
+        # pools park in Condition.wait by design) shares this leaf, so
+        # the cross-thread self count is a floor — the per-thread-role
+        # exactness is pinned by the collapsed row count above
+        assert top[leaf]["self"] >= 4 and top[leaf]["total"] >= 4
         assert not any(k.endswith(":parked_root") for k in top)
 
     def test_bounded_table_eviction(self):

@@ -98,7 +98,6 @@ class TestCoverageMatrix:
     UNCOVERED = [
         "topk(3, rate(reqs[5m]))",                    # uncovered aggregator
         "stddev by (host) (rate(reqs[5m]))",          # uncovered aggregator
-        "rate(reqs[5m]) + rate(reqs[5m])",            # vector-vector binop
         "rate(reqs[5m]) > 0.5",                       # comparison semantics
         "last_over_time(reqs[5m])",                   # uncovered window fn
         "holt_winters(reqs[5m], 0.5, 0.5)",           # uncovered function
@@ -230,6 +229,106 @@ class TestParitySweep:
         vc, _ = engine.query_instant("sum by (job) (rate(reqs[5m]))",
                                      START + 10 * MIN)
         assert_parity(vi, vc, "instant")
+
+
+class TestVectorVectorBinop:
+    """Vector-vector binops on matching label sets (the carried PR-10
+    follow-up): both sides compile into their own fused programs and the
+    combine replicates the interpreter's one-to-one default matching —
+    parity holds on labels, NaN masks and values, and on the ERRORS the
+    matching machinery raises."""
+
+    COVERED = [
+        "rate(reqs[5m]) + rate(reqs[5m])",
+        "irate(reqs[5m]) / avg_over_time(reqs[3m])",
+        "sum by (job) (irate(reqs[5m])) / sum by (job) "
+        "(count_over_time(reqs[5m]))",
+        "max_over_time(reqs[4m]) - min_over_time(reqs[4m])",
+        "(rate(reqs[5m]) * 8) % (delta(reqs[3m]) + 2)",
+        "reqs ^ present_over_time(reqs[2m])",
+        "sum by (host, job) (reqs) * sum by (host, job) (reqs offset 1m)",
+    ]
+    UNCOVERED = [
+        "rate(reqs[5m]) > rate(reqs[3m])",            # comparison
+        "reqs + on (job) reqs",                       # explicit on()
+        "reqs + ignoring (host) reqs",                # explicit ignoring()
+        "sum by (job) (reqs) + bool sum by (job) (reqs)",  # bool mode
+        "reqs and reqs",                              # set operator
+        "topk(2, reqs) + reqs",                       # uncovered side
+    ]
+
+    def test_covered_shapes_match(self):
+        for q in self.COVERED:
+            assert compiler.match_vecbin(promql.parse(q)) is not None, q
+            # the single-chain matcher stays blind to these (its sig
+            # space is one selector); the vecbin matcher owns them
+            assert compiler.match(promql.parse(q)) is None, q
+
+    def test_uncovered_shapes_fall_back(self):
+        for q in self.UNCOVERED:
+            assert compiler.match_vecbin(promql.parse(q)) is None, q
+            assert compiler.match(promql.parse(q)) is None, q
+
+    def test_parity(self, engine, monkeypatch):
+        for q in self.COVERED:
+            before = dispatch.counters["query.compile[compiled]"]
+            vi, vc = run_both(engine, monkeypatch, q, START,
+                              START + 14 * MIN, MIN)
+            assert dispatch.counters["query.compile[compiled]"] == \
+                before + 1, f"plan not compiled: {q}"
+            assert_parity(vi, vc, q)
+
+    def test_partial_label_match_drops_unmatched(self, engine, monkeypatch):
+        # per-host aggregate vs per-(host,job) series: match keys differ
+        # per series; only exact label-set matches combine — and the
+        # interpreter agrees on WHICH rows survive
+        q = ("sum by (host) (rate(reqs[5m])) "
+             "+ sum by (host) (irate(reqs[4m]))")
+        vi, vc = run_both(engine, monkeypatch, q, START, START + 10 * MIN,
+                          MIN)
+        assert_parity(vi, vc, q)
+
+    def test_empty_key_intersection_parity(self, engine, monkeypatch):
+        # per-job keys vs the unlabeled sum(): no key matches — both
+        # paths agree the result is EMPTY, not an error
+        q = "sum by (job) (reqs) * sum(reqs)"
+        vi, vc = run_both(engine, monkeypatch, q, START, START + 10 * MIN,
+                          MIN)
+        assert vi.labels == vc.labels == []
+
+    def test_matching_errors_are_interpreter_identical(self, engine):
+        """The compiled combine raises the interpreter's exact matching
+        errors (dup keys can't be minted through the shared fixture's
+        parser — every series has a distinct label set — so the two
+        matchers are fed identical crafted vectors directly)."""
+        from m3_tpu.query.engine import EvalError, Vector
+        from m3_tpu.query.promql import BinaryExpr
+
+        dup = Vector([{b"k": b"v"}, {b"k": b"v"}], np.ones((2, 3)))
+        one = Vector([{b"k": b"v"}], np.ones((1, 3)))
+        e = BinaryExpr("+", None, None, False, None)
+        for lhs, rhs in ((dup, one), (one, dup)):
+            with pytest.raises(EvalError) as interp:
+                engine._vector_binary(e, lhs, rhs)
+            with pytest.raises(EvalError) as comp:
+                compiler._combine_vecbin(engine, "+", lhs, rhs)
+            assert str(comp.value) == str(interp.value)
+
+    def test_explain_reports_both_sides(self, engine, monkeypatch):
+        monkeypatch.setenv("M3_TPU_QUERY_COMPILE", "1")
+        q = "rate(reqs[5m]) + rate(reqs[5m])"
+        engine.query_range(q, START, START + 10 * MIN, MIN)  # warm
+        with explain.collect(analyze=True) as col:
+            engine.query_range(q, START, START + 10 * MIN, MIN)
+        doc = col.to_dict()
+        assert doc["compiled"]["ran"] is True
+        assert doc["compiled"]["binop"] == "+"
+        sides = doc["compiled"]["sides"]
+        assert len(sides) == 2 and all(s["ran"] for s in sides)
+        # the plan tree shows the binary node with both subtrees
+        [root] = doc["tree"]
+        assert root["node"] == "binary"
+        assert len(root["children"]) == 2
 
 
 class TestMinMaxOverTime:

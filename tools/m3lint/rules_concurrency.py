@@ -47,6 +47,8 @@ RULES = {
     "lock-order": "lock-order inversion (potential deadlock)",
     "lock-blocking-call": "blocking call while holding a lock",
     "lock-guarded-mutation": "lock-guarded attribute mutated without the lock",
+    "conc-handrolled-pipeline":
+        "hand-rolled thread-pool/queue pipeline outside the executor seam",
 }
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
@@ -308,9 +310,81 @@ class _FuncWalker:
                 self.mutations.append((attr, line, held))
 
 
+# ---------------------------------------------------------------------------
+# conc-handrolled-pipeline: worker pools belong behind storage/pipeline.py
+# ---------------------------------------------------------------------------
+
+# the blessed executor seam itself (PipelineExecutor/SerialLane)
+_PIPELINE_SEAM = os.path.join("storage", "pipeline.py")
+_QUEUEISH_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                   "deque"}
+
+
+class _PipelineScanner(ast.NodeVisitor):
+    """Per enclosing class (None = module level): Thread constructions
+    INSIDE a loop/comprehension (a worker-pool spawn) and queue-ish
+    constructions. A scope showing both is a hand-rolled pipeline: it
+    has its own (unmonitored, un-fault-injected, un-heartbeated)
+    scheduling instead of the storage/pipeline.py executor seam. Single
+    background drains (one Thread + one queue, the exporter/reporter
+    idiom) do not flag — the loop-spawn is what makes it a pool."""
+
+    def __init__(self):
+        self._cls_stack: list[ast.ClassDef | None] = [None]
+        self._loop_depth = 0
+        self.pool_spawns: dict[ast.ClassDef | None, int] = {}
+        self.queues: set[ast.ClassDef | None] = set()
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._cls_stack.append(node)
+        self.generic_visit(node)
+        self._cls_stack.pop()
+
+    def _loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _loop
+    visit_ListComp = visit_SetComp = visit_GeneratorExp = _loop
+
+    def visit_Call(self, node: ast.Call):
+        chain = _attr_chain(node.func)
+        leaf = chain.rsplit(".", 1)[-1] if chain else None
+        scope = self._cls_stack[-1]
+        if leaf == "Thread" and self._loop_depth > 0:
+            self.pool_spawns.setdefault(scope, node.lineno)
+        elif leaf in _QUEUEISH_CTORS:
+            self.queues.add(scope)
+        self.generic_visit(node)
+
+
+def _check_handrolled_pipelines(mod: Module):
+    if mod.rel == _PIPELINE_SEAM:
+        return  # the one blessed executor seam
+    sc = _PipelineScanner()
+    sc.visit(mod.tree)
+    for scope, line in sorted(sc.pool_spawns.items(),
+                              key=lambda kv: kv[1]):
+        if scope not in sc.queues:
+            continue  # loop-spawned threads without a queue: a server
+            # accept loop / per-task spawn, not a pipeline
+        where = scope.name if scope is not None else "module scope"
+        yield Finding(
+            "conc-handrolled-pipeline", mod.path, line,
+            f"{where} spawns worker threads in a loop AND owns a work "
+            f"queue — a hand-rolled pipeline outside the executor seam. "
+            f"Use storage/pipeline.py (PipelineExecutor / run_stages / "
+            f"SerialLane): one pool, one saturation story "
+            f"(inv-queue-gauge), one fault surface (pipeline.task), one "
+            f"watchdog heartbeat (waive only for deliberate stand-alone "
+            f"harnesses)")
+
+
 def check(proj: Project):
     for mod in proj.modules:
         yield from _check_module(mod)
+        yield from _check_handrolled_pipelines(mod)
 
 
 def _check_module(mod: Module):
