@@ -80,6 +80,36 @@ def encode_blocks(times, vbits, starts, n_points,
     return m3tsz_tpu.blocks_to_bytes(blocks)
 
 
+def encode_blocks_ragged(times, vbits, offsets, starts,
+                         unit: TimeUnit, int_optimized: bool) -> list[bytes]:
+    """Encode a RAGGED (CSR) sealed window to per-series streams without
+    one global [B, max_T] rectangle (ROADMAP #3, the ingest-side padding
+    tax): rows bucket by geometric length (ops.ragged.length_buckets) and
+    each bucket pads only to ITS max before the ordinary batched encode —
+    a window where one series wrote 10k points and a million wrote one no
+    longer materializes a million 10k-wide padded lanes.  Streams are
+    byte-identical to encode_blocks over the fully-padded window (the
+    encoder reads exactly n_points lanes per row; the pad rule matches
+    seal's monotone-tail rule), pinned by the seeded parity sweep in
+    tests/test_paged_memory.py.  Zero-length rows return b""."""
+    from m3_tpu.ops import ragged
+
+    offsets = np.asarray(offsets, np.int64)
+    starts = np.asarray(starts)
+    lens = np.diff(offsets)
+    out: list[bytes] = [b""] * len(lens)
+    for rows in ragged.length_buckets(lens):
+        if lens[rows[0]] == 0:
+            continue
+        sub_t, sub_v, sub_n = ragged.csr_to_padded(
+            np.asarray(times), np.asarray(vbits), offsets, rows)
+        streams = encode_blocks(sub_t, sub_v, starts[rows], sub_n,
+                                unit, int_optimized)
+        for r, s in zip(rows.tolist(), streams):
+            out[r] = s
+    return out
+
+
 def decode_stream(stream: bytes, unit: TimeUnit,
                   int_optimized: bool) -> tuple[np.ndarray, np.ndarray]:
     """Decode one stream to (times int64, value_bits uint64) on the best

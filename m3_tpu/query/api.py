@@ -749,12 +749,32 @@ class CoordinatorAPI:
             return False
         raise ValueError(f"explain must be 'plan' or 'analyze', got {raw!r}")
 
+    @staticmethod
+    def _precision_of(q) -> str | None:
+        """?precision=bf16 — the per-query grant for the hot tier's
+        reduced-precision value mirror (storage/hottier). Anything else
+        than the explicit opt-in keeps full precision."""
+        raw = (q.get("precision", [""])[0] or "").lower()
+        if not raw:
+            return None
+        if raw == "bf16":
+            return "bf16"
+        raise ValueError(f"precision must be 'bf16', got {raw!r}")
+
     def _run_explained(self, q, engine, run):
         """Run one engine evaluation, collecting its plan tree when
         ?explain= asks for one. Returns ((result, eval_ts), plan_doc) —
         plan_doc is None without explain; with it, the finished record
         (tree + trace id + envelope-parity stats) also lands in the
         /debug/explain ring."""
+        from m3_tpu.storage import hottier
+
+        base_run = run
+        precision = self._precision_of(q)
+        if precision is not None:
+            def run():  # noqa: F811 - deliberate wrap
+                with hottier.negotiated_precision(precision):
+                    return base_run()
         mode = self._explain_mode(q)
         if mode is None:
             return run(), None

@@ -273,15 +273,57 @@ class Engine:
         ns_list = (resolver.resolve_namespaces(self.db, self.namespace,
                                                t_min, t_max, self.now_fn())
                    if self.resolve_tiers else [self.namespace])
-        docs, series = resolver.fetch_tagged(
-            self.db, ns_list, matchers_to_query(sel.matchers), t_min, t_max,
-            warnings=getattr(self._warn_tls, "sink", None))
-        labels = []
-        per_series = []
-        for doc, (times, vbits) in zip(docs, series):
-            labels.append(dict(doc.fields))
-            per_series.append((times, vbits.view(np.float64)))
-        return labels, RaggedSeries.from_lists(per_series)
+        iq = matchers_to_query(sel.matchers)
+        warn_sink = getattr(self._warn_tls, "sink", None)
+        # version key sampled BEFORE the read: a write racing the fetch
+        # can then only make the key stale (harmless hot-tier miss) —
+        # sampling after would cache pre-write data under the post-write
+        # version and serve it warm until the next bump
+        fetch_key = self._fetch_key(sel, ns_list, t_min, t_max)
+        ragged_res = resolver.fetch_tagged_ragged(
+            self.db, ns_list, iq, t_min, t_max, warnings=warn_sink)
+        if ragged_res is not None:
+            # single-tier storage read: the CSR lands here straight from
+            # the per-shard ragged finalize — no per-series tuples, no
+            # concatenate; the compiler's slab prep consumes it as-is
+            docs, times, vbits, offsets = ragged_res
+            labels = [dict(doc.fields) for doc in docs]
+            raws = RaggedSeries(times, vbits.view(np.float64), offsets)
+        else:
+            docs, series = resolver.fetch_tagged(
+                self.db, ns_list, iq, t_min, t_max, warnings=warn_sink)
+            labels = []
+            per_series = []
+            for doc, (times, vbits) in zip(docs, series):
+                labels.append(dict(doc.fields))
+                per_series.append((times, vbits.view(np.float64)))
+            raws = RaggedSeries.from_lists(per_series)
+        # hot-tier identity (storage/hottier.py): the fetch is fully
+        # determined by (namespace versions, selector, range), so the
+        # compiled path can key prepared device slabs on it
+        raws.fetch_key = fetch_key
+        return labels, raws
+
+    def _fetch_key(self, sel, ns_list, t_min: int, t_max: int):
+        """Content-version key for one selector fetch, or None when any
+        namespace lacks version tracking (cluster facades)."""
+        parts = []
+        for name in ns_list:
+            try:
+                ns = self.db.namespaces[name]
+            except Exception:  # noqa: BLE001 - facade without the map
+                return None
+            if not getattr(ns, "supports_ragged_read", False):
+                # facades (cluster, fanout) have no local version truth;
+                # fanout would even DELEGATE data_version to its local
+                # namespace, keying out remote-zone changes — no hot tier
+                return None
+            parts.append((name, ns.ns_uid, ns.data_version()))
+        mk = tuple(sorted((m.name, getattr(m.match_type, "value",
+                                           str(m.match_type)), m.value)
+                          for m in sel.matchers))
+        return (tuple(parts), mk, sel.offset_ns,
+                getattr(sel, "at_ns", None), t_min, t_max)
 
     # -- evaluation --
 

@@ -45,6 +45,11 @@ class FanoutNamespace:
     # the warnings= out-param (thread-safe) instead of draining the
     # shared last_warnings field
     supports_read_warnings = True
+    # CLASS attribute, deliberately False: __getattr__ below delegates
+    # unknown names to the LOCAL namespace, so without this shadow the
+    # ragged fast path / hot-tier version probes would resolve to the
+    # local namespace's methods and silently skip the remote zones
+    supports_ragged_read = False
 
     def __init__(self, fdb: "FanoutDatabase", name: str):
         self._fdb = fdb

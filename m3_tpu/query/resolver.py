@@ -73,6 +73,48 @@ def resolve_namespaces(db, unagg: str, t_min: int, t_max: int,
     return out or [unagg]
 
 
+def fetch_tagged_ragged(db, namespaces: list[str], index_query, t_min: int,
+                        t_max: int, limit=None, keep_empty: bool = False,
+                        warnings: list | None = None):
+    """Single-tier fast path of fetch_tagged returning the RAGGED CSR
+    (docs, times, value_bits, offsets) — or None when the shape needs
+    the stitching path (multi-tier fanout, cluster facades without a
+    ragged surface).  Row order matches fetch_tagged exactly: matched
+    docs in index order with empty series dropped (or appended at the
+    end under keep_empty) — dropping/reordering empty rows never moves
+    sample data, so the CSR arrays come through untouched."""
+    from m3_tpu.utils import querystats
+
+    if len(namespaces) != 1:
+        return None
+    ns = db.namespaces[namespaces[0]]
+    # capability marker, NOT hasattr: delegating facades (fanout) would
+    # resolve a hasattr probe through __getattr__ to the local namespace
+    # and this fast path would silently skip their remote legs
+    if not getattr(ns, "supports_ragged_read", False):
+        return None
+    with querystats.stage("query_ids"):
+        if limit is not None:
+            docs = ns.query_ids(index_query, t_min, t_max, limit=limit)
+        else:
+            docs = ns.query_ids(index_query, t_min, t_max)
+    querystats.record(series_matched=len(docs))
+    ids = [d.series_id for d in docs]
+    with querystats.stage("read_many"):
+        times, vbits, offsets = ns.read_many_ragged(ids, t_min, t_max)
+    lens = np.diff(offsets)
+    if not (lens == 0).any():
+        return docs, times, vbits, offsets
+    nz = np.nonzero(lens > 0)[0]
+    order = np.concatenate([nz, np.nonzero(lens == 0)[0]]) \
+        if keep_empty else nz
+    docs = [docs[i] for i in order.tolist()]
+    new_offsets = np.empty(len(order) + 1, np.int64)
+    new_offsets[0] = 0
+    np.cumsum(lens[order], out=new_offsets[1:])
+    return docs, times, vbits, new_offsets
+
+
 def fetch_tagged(db, namespaces: list[str], index_query, t_min: int,
                  t_max: int, limit=None, keep_empty: bool = False,
                  warnings: list | None = None):

@@ -19,6 +19,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from m3_tpu.storage import pagepool
+
 _GROW = 1024
 
 
@@ -94,6 +96,33 @@ class _ColumnLog:
     def view(self):
         return self.sidx[: self.n], self.times[: self.n], self.vbits[: self.n]
 
+    def release(self) -> None:
+        """No-op twin of PagedColumnLog.release (grow-arrays just die)."""
+
+
+@dataclass
+class RaggedSealedWindow:
+    """One block window sealed to the ragged (offsets, lengths) layout:
+    sorted by (series, time), deduped last-write-wins, NO rectangular
+    padding — the CSR the length-bucketed ragged encode consumes
+    (hostpath.encode_blocks_ragged) and the paged-memory twin of
+    SealedWindow (ROADMAP #3)."""
+
+    block_start: int
+    series_indices: np.ndarray  # [B] int32 buffer-level series indices
+    times: np.ndarray           # [N] int64
+    value_bits: np.ndarray      # [N] uint64
+    offsets: np.ndarray         # [B+1] int64 row boundaries
+    raw_count: int = 0
+
+    @property
+    def n_series(self) -> int:
+        return len(self.series_indices)
+
+    @property
+    def n_points(self) -> np.ndarray:
+        return np.diff(self.offsets).astype(np.int32)
+
 
 @dataclass
 class SealedWindow:
@@ -123,9 +152,20 @@ class ShardBuffer:
         self.series_ids: list[bytes] = []
         self.series_tags: list[bytes] = []  # encoded tag blobs
         self._logs: dict[int, _ColumnLog] = {}
+        # paged columnar memory (ROADMAP #3): window logs draw fixed-size
+        # pages from a shared pool instead of doubling grow-arrays; the
+        # M3_TPU_PAGED=0 hatch (read once, at buffer construction) pins
+        # the seed _ColumnLog bodies for bisection
+        self._paged = pagepool.active()
+        self._pool = (pagepool.monitor_pool(pagepool.PagePool())
+                      if self._paged else None)
         # one lock per shard buffer (the reference's per-shard lock):
         # HTTP handler threads write while the tick thread seals/expires
         self._lock = threading.RLock()
+
+    def _new_log(self):
+        return (pagepool.PagedColumnLog(self._pool) if self._paged
+                else _ColumnLog())
 
     # -- write path --
 
@@ -146,7 +186,7 @@ class ShardBuffer:
             bs = t_ns - (t_ns % self._block_size_ns)
             log = self._logs.get(bs)
             if log is None:
-                log = self._logs[bs] = _ColumnLog()
+                log = self._logs[bs] = self._new_log()
             log.append(idx, t_ns, vbits)
             return idx
 
@@ -173,7 +213,7 @@ class ShardBuffer:
                 sel = bs == w
                 log = self._logs.get(int(w))
                 if log is None:
-                    log = self._logs[int(w)] = _ColumnLog()
+                    log = self._logs[int(w)] = self._new_log()
                 log.extend(idxs[sel], times[sel], vbits[sel])
 
     # -- read path --
@@ -199,6 +239,53 @@ class ShardBuffer:
             np.concatenate(ts_parts), np.concatenate(vb_parts), start_ns, end_ns
         )
 
+    def read_many_csr(self, series_ids: list[bytes], start_ns: int,
+                      end_ns: int):
+        """Buffered rows for MANY series in ONE pass per window: the
+        batched twin of read(), returning a (times, vbits, offsets) CSR
+        aligned to the request.  Rows keep the exact concatenation order
+        read() produces per series (windows in _logs iteration order,
+        append order within a window) and are NOT merged/filtered — the
+        caller's ragged finalize (`ops.ragged.merge_csr`) applies the
+        one last-write-wins + range pass over filesets AND buffer parts
+        together, which resolves identically.  Requires unique ids (the
+        caller falls back to per-series read() on duplicates)."""
+        R = len(series_ids)
+        empty = (np.empty(0, np.int64), np.empty(0, np.uint64),
+                 np.zeros(R + 1, np.int64))
+        with self._lock:
+            pos_of = np.full(len(self.series_ids), -1, np.int64)
+            found = False
+            for pos, sid in enumerate(series_ids):
+                idx = self._series.get(sid)
+                if idx is not None:
+                    pos_of[idx] = pos
+                    found = True
+            if not found:
+                return empty
+            parts_p, parts_t, parts_v = [], [], []
+            for bs, log in self._logs.items():
+                if bs + self._block_size_ns <= start_ns or bs >= end_ns:
+                    continue
+                sidx, times, vbits = log.view()
+                pos = pos_of[sidx]
+                m = pos >= 0
+                if m.any():
+                    parts_p.append(pos[m])
+                    parts_t.append(times[m])
+                    parts_v.append(vbits[m])
+        if not parts_t:
+            return empty
+        rid = np.concatenate(parts_p) if len(parts_p) > 1 else parts_p[0]
+        t = np.concatenate(parts_t) if len(parts_t) > 1 else parts_t[0]
+        v = np.concatenate(parts_v) if len(parts_v) > 1 else parts_v[0]
+        order = np.argsort(rid, kind="stable")
+        counts = np.bincount(rid, minlength=R)
+        offsets = np.empty(R + 1, np.int64)
+        offsets[0] = 0
+        np.cumsum(counts, out=offsets[1:])
+        return t[order], v[order], offsets
+
     # -- seal/flush path --
 
     def block_starts(self) -> list[int]:
@@ -209,28 +296,39 @@ class ShardBuffer:
         log = self._logs.get(block_start)
         return log.n if log else 0
 
-    def seal(self, block_start: int, drop: bool = True) -> SealedWindow | None:
-        """Group one block window into a padded batch for device encode.
-
-        Stable-sorts by (series, time), dedupes last-write-wins, pads to the
-        max points of any series in the window.
-        """
+    def _seal_sorted(self, block_start: int, drop: bool):
+        """Locked extract + the ONE sort/dedup definition both seal
+        layouts share: stable (series, time) sort, same-timestamp dedupe
+        keeping the LAST append.  Returns (sidx, times, vbits,
+        raw_count, fill_ratio) or None for an absent/empty window."""
         with self._lock:
             log = self._logs.get(block_start)
             if log is None or log.n == 0:
                 return None
             raw_count = log.n
             sidx, times, vbits = (a.copy() for a in log.view())
+            fill = log.fill_ratio() if hasattr(log, "fill_ratio") else 1.0
             if drop:
                 del self._logs[block_start]
+                log.release()
         order = np.lexsort((np.arange(len(sidx)), times, sidx))
         sidx, times, vbits = sidx[order], times[order], vbits[order]
-        # dedupe: same series + same timestamp -> keep the last append
         keep = np.ones(len(sidx), bool)
         if len(sidx) > 1:
             same = (sidx[1:] == sidx[:-1]) & (times[1:] == times[:-1])
             keep[:-1] = ~same
-        sidx, times, vbits = sidx[keep], times[keep], vbits[keep]
+        return sidx[keep], times[keep], vbits[keep], raw_count, fill
+
+    def seal(self, block_start: int, drop: bool = True) -> SealedWindow | None:
+        """Group one block window into a padded batch for device encode.
+
+        Stable-sorts by (series, time), dedupes last-write-wins, pads to the
+        max points of any series in the window.
+        """
+        ext = self._seal_sorted(block_start, drop)
+        if ext is None:
+            return None
+        sidx, times, vbits, raw_count, _fill = ext
 
         uniq, counts = np.unique(sidx, return_counts=True)
         B, T = len(uniq), int(counts.max())
@@ -256,9 +354,44 @@ class ShardBuffer:
             raw_count=raw_count,
         )
 
+    def seal_csr(self, block_start: int,
+                 drop: bool = True) -> RaggedSealedWindow | None:
+        """Seal one block window to the RAGGED layout: same stable sort
+        by (series, time) + last-write-wins dedup as seal(), but the
+        output stays a CSR — no rectangular scatter, no padding, so a
+        window where one series wrote 10k points and a million wrote one
+        costs O(samples), not O(series x 10k).  The length-bucketed
+        ragged encode (hostpath.encode_blocks_ragged) consumes this
+        directly and produces byte-identical streams to the padded
+        path."""
+        from m3_tpu.utils.instrument import default_registry
+
+        ext = self._seal_sorted(block_start, drop)
+        if ext is None:
+            return None
+        sidx, times, vbits, raw_count, fill = ext
+        # page-occupancy telemetry: how much of the window's page
+        # allocation held real rows at seal time (padding-waste measure)
+        default_registry().root_scope("storage").subscope(
+            "page_pool").observe("page_fill", fill)
+        uniq, counts = np.unique(sidx, return_counts=True)
+        offsets = np.empty(len(uniq) + 1, np.int64)
+        offsets[0] = 0
+        np.cumsum(counts, out=offsets[1:])
+        return RaggedSealedWindow(
+            block_start=block_start,
+            series_indices=uniq.astype(np.int32),
+            times=times,
+            value_bits=vbits,
+            offsets=offsets,
+            raw_count=raw_count,
+        )
+
     def drop_window(self, block_start: int) -> None:
         with self._lock:
-            self._logs.pop(block_start, None)
+            log = self._logs.pop(block_start, None)
+            if log is not None:
+                log.release()
 
     def drop_window_prefix(self, block_start: int, n: int) -> None:
         """Drop the first n appended rows of a window — the rows a seal
@@ -270,6 +403,12 @@ class ShardBuffer:
                 return
             if log.n <= n:
                 del self._logs[block_start]
+                log.release()
+                return
+            if hasattr(log, "drop_prefix"):
+                # paged log: advance the head, free covered pages — no
+                # suffix copy under the shard lock
+                log.drop_prefix(n)
                 return
             # bulk copy the surviving suffix: this runs under the shard
             # lock, so a per-row python loop would stall every writer
@@ -290,8 +429,9 @@ class ShardBuffer:
             dropped = 0
             for bs in list(self._logs):
                 if bs < cutoff_block_start:
-                    dropped += self._logs[bs].n
-                    del self._logs[bs]
+                    log = self._logs.pop(bs)
+                    dropped += log.n
+                    log.release()
             return dropped
 
     @property

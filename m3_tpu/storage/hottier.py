@@ -1,0 +1,148 @@
+"""Device-resident hot tier: a bounded, paged cache of PREPARED query
+slabs pinned in device memory (ROADMAP #3).
+
+The whole-query compiler's host prep (window bounds, per-device slab
+fill, prefix sums) plus the host->device transfer of those slabs is
+what a REPEATED dashboard query pays after the block cache has already
+amortized the decode.  This tier keys the prepared slab set on the
+fetch's content identity — (namespace data versions, selector, time
+range, eval grid, plan base, precision) — so an unchanged repeat skips
+`window_bounds_batch`, `_slab_cuts`/`_fill_slabs` and the transfer
+entirely: the compiled program re-runs against warm device buffers.
+
+On CPU backends the "device" is jax's host platform and the tier is an
+ordinary arena of committed buffers; when a TPU tunnel is live the same
+code pins the working set in device HBM (the serving-tier story).  A
+``bf16`` mirror (half the bytes; EQuARX's reduced-precision argument)
+is negotiated PER QUERY: the API layer's ``?precision=bf16`` opt-in
+installs a thread-local grant, and only plan bases whose output
+tolerance permits it (`compiler._BF16_OK_BASES`) quantize — the
+precision rides the cache key, so full-precision queries can never read
+a quantized entry.
+
+Saturation plane: byte occupancy/entries/evictions ride the
+``queue_*{queue=hot_tier}`` gauges (PR-11 snapshot-hook seam, m3lint
+``inv-pagepool-gauge``); per-query hit/miss counters land under
+``storage.hot_tier`` and the ``hot_tier`` block on ``?explain=analyze``.
+``M3_TPU_HOT_TIER_MB=0`` disables the tier.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from contextlib import contextmanager
+
+from m3_tpu.utils.instrument import monitor_queue
+
+
+class HotTier:
+    """Bytes-bounded LRU of prepared slab entries (device arrays)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()  # key -> (entry, nbytes)
+        self.bytes_used = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return hit[0]
+
+    def put(self, key, entry: dict, nbytes: int) -> None:
+        if nbytes > self.max_bytes:
+            return  # one oversized query must not wipe the working set
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes_used -= old[1]
+            self._entries[key] = (entry, nbytes)
+            self.bytes_used += nbytes
+            while self.bytes_used > self.max_bytes and self._entries:
+                _k, (_e, nb) = self._entries.popitem(last=False)
+                self.bytes_used -= nb
+                self.evictions += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.bytes_used = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+_lock = threading.Lock()
+_default: HotTier | None = None
+_default_built = False
+
+
+def default() -> HotTier | None:
+    """The process hot tier, sized by M3_TPU_HOT_TIER_MB (default 256;
+    0 disables). Built lazily on the first compiled query; the built
+    flag is read lock-free on the hot path (set LAST, after _default,
+    so a racing reader sees either "not built" or the finished tier)."""
+    global _default, _default_built
+    if _default_built:
+        return _default
+    with _lock:
+        if not _default_built:
+            try:
+                mb = int(os.environ.get("M3_TPU_HOT_TIER_MB", "256"))
+            except ValueError:
+                mb = 256
+            _default = HotTier(mb << 20) if mb > 0 else None
+            _default_built = True
+        return _default
+
+
+def reset_default() -> None:
+    """Drop the process tier (tests re-read the env on next use)."""
+    global _default, _default_built
+    with _lock:
+        _default = None
+        _default_built = False
+
+
+# saturation-plane registration: depth/capacity in BYTES, drops =
+# LRU evictions (one module-level registration, label set bounded)
+monitor_queue("hot_tier",
+              lambda: _default.bytes_used if _default is not None else 0,
+              capacity=lambda: _default.max_bytes
+              if _default is not None else 0,
+              drops_fn=lambda: _default.evictions
+              if _default is not None else 0)
+
+
+# ---------------------------------------------------------------------------
+# per-query precision negotiation (the bf16 mirror opt-in)
+# ---------------------------------------------------------------------------
+
+_tl = threading.local()
+
+
+@contextmanager
+def negotiated_precision(precision: str | None):
+    """Install the query's precision grant for this thread ("bf16" from
+    the API layer's ?precision=bf16; None = full precision). The
+    compiler honors it only for tolerance-permitting plan bases."""
+    prev = getattr(_tl, "precision", None)
+    _tl.precision = precision
+    try:
+        yield
+    finally:
+        _tl.precision = prev
+
+
+def query_precision() -> str | None:
+    return getattr(_tl, "precision", None)

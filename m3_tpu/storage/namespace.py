@@ -6,6 +6,8 @@ Role parity with the reference dbNamespace
 
 from __future__ import annotations
 
+import itertools
+
 from m3_tpu.index.index import NamespaceIndex
 from m3_tpu.index.query import Query
 from m3_tpu.storage.options import DatabaseOptions, NamespaceOptions
@@ -18,6 +20,14 @@ class Namespace:
     # large enough to keep the batched path's dispatch economy, small
     # enough that an over-limit query stops within one chunk
     READ_MANY_LIMIT_CHUNK = 4096
+
+    # capability marker for resolver.fetch_tagged_ragged and the hot
+    # tier's fetch-version keys: ONLY local storage namespaces qualify.
+    # Facades that delegate unknown attributes to a local namespace
+    # (fanout) must override this with a CLASS attribute set to False —
+    # hasattr probes would otherwise resolve through their __getattr__
+    # and silently bypass the facade's own read path
+    supports_ragged_read = True
 
     def __init__(
         self,
@@ -41,10 +51,31 @@ class Namespace:
         )
         # set by Database.create_namespace; carries the shared QueryLimits
         self.database = None
+        # process-unique instance id: hot-tier keys must never collide
+        # across two Namespace objects that happen to share a name and
+        # fresh data-version counters (test fixtures, re-created tenants)
+        self.ns_uid = next(self._UID)
+        # bumped by every shard add/remove (see data_version)
+        self._placement_epoch = 0
+
+    _UID = itertools.count()
 
     @property
     def limits(self):
         return getattr(self.database, "limits", None)
+
+    def data_version(self) -> tuple:
+        """Content-version fingerprint for the device-resident hot tier
+        (storage/hottier.py): changes whenever any owned shard's readable
+        content could have changed. The placement epoch (bumped by every
+        add/remove_shard) rides along because a remove+add swap can
+        return the version SUM to a previously-seen value with different
+        readable content — a sum alone would alias and serve stale
+        pages."""
+        shards = list(self.shards.values())  # placement changes mutate
+        # the dict concurrently; iterate a snapshot
+        return (self._placement_epoch, len(shards),
+                sum(s.data_version for s in shards))
 
     def add_shard(self, shard_id: int, now_ns: int | None = None) -> Shard:
         """Start owning a shard (placement assignment). Local fileset data
@@ -58,6 +89,7 @@ class Namespace:
                 shard.cache = self.database.block_cache
                 shard.persist_limiter = self.database.persist_limiter
             self.shards[shard_id] = shard
+            self._placement_epoch += 1
             shard.bootstrap_from_fs(now_ns)
             shard.bootstrapped = True
         return shard
@@ -71,6 +103,7 @@ class Namespace:
         shard = self.shards.pop(shard_id, None)
         if shard is None:
             return
+        self._placement_epoch += 1
         for bs in shard.buffer.block_starts():
             try:
                 shard.flush(bs)
@@ -204,7 +237,34 @@ class Namespace:
                 .histogram("read_many_seconds"):
             return self._read_many_traced(series_ids, start_ns, end_ns)
 
-    def _read_many_traced(self, series_ids, start_ns, end_ns):
+    def read_many_ragged(self, series_ids: list[bytes], start_ns: int,
+                         end_ns: int):
+        """Batch read returning the RAGGED (times, vbits, offsets) CSR
+        aligned to `series_ids` (ROADMAP #3): the per-shard finalize
+        hands its merged columns straight through — no per-series tuple
+        materialization — and the resolver/engine feed the CSR directly
+        into `RaggedSeries`, which is exactly what the whole-query
+        compiler's `_slab_cuts`/`_fill_slabs` slab prep consumes.  Same
+        results, limits accounting and warnings contract as read_many
+        (per-row slices are element-identical); paths the paged finalize
+        doesn't cover (M3_TPU_PAGED=0, datapoint-limit chunking, serial
+        hatch) assemble the CSR from the per-series views in one pass."""
+        from m3_tpu.ops import ragged
+        from m3_tpu.utils import trace
+        from m3_tpu.utils.instrument import default_registry
+
+        with trace.span(trace.READ_MANY, namespace=self.name,
+                        series=len(series_ids)), \
+                default_registry().root_scope("db") \
+                .histogram("read_many_seconds"):
+            res = self._read_many_traced(series_ids, start_ns, end_ns,
+                                         want_ragged=True)
+        if isinstance(res, tuple):
+            return res
+        return ragged.pairs_to_csr(res)
+
+    def _read_many_traced(self, series_ids, start_ns, end_ns,
+                          want_ragged: bool = False):
         from m3_tpu.storage import pipeline
 
         by_shard: dict[int, list[int]] = {}
@@ -222,7 +282,8 @@ class Namespace:
             # flattened schedule of per-(shard, block) gather legs
             # across every shard, overlapping the caller's decode rung
             return self._read_many_pipelined(series_ids, by_shard,
-                                             start_ns, end_ns, out)
+                                             start_ns, end_ns, out,
+                                             want_ragged=want_ragged)
         for shard_id, idxs in by_shard.items():
             shard = self.shards[shard_id]
             for lo in range(0, len(idxs), chunk):
@@ -236,7 +297,7 @@ class Namespace:
         return out
 
     def _read_many_pipelined(self, series_ids, by_shard, start_ns, end_ns,
-                             out):
+                             out, want_ragged: bool = False):
         """Per-(shard, block) groups through the executor seam: group
         N+1's fileset gather runs on the pool while group N decodes on
         this thread, and a shard's series FINALIZE (buffer merge +
@@ -247,9 +308,16 @@ class Namespace:
         stays one dispatch per group, and per-series parts keep the
         filesets-then-buffer order merge_dedup resolves last-write-wins.
         """
-        from m3_tpu.storage import pipeline
+        from m3_tpu.ops import ragged
+        from m3_tpu.storage import pagepool, pipeline
         from m3_tpu.utils import querystats
 
+        # paged: batched ragged finalize per shard; fragments of the
+        # namespace-level ragged combine (one merged per-shard CSR each,
+        # landed by the finalize callback mid-flight) are only tracked
+        # when the caller asked for the CSR back
+        paged = pagepool.active()
+        frags: list | None = [] if (paged and want_ragged) else None
         groups = []
         last_group_of: dict[int, object] = {}
         for shard_id, idxs in by_shard.items():
@@ -263,22 +331,47 @@ class Namespace:
             if shard_groups:
                 last_group_of[id(shard_groups[-1])] = plan
             else:
-                self._finalize_shard_read(plan, start_ns, end_ns, out)
+                self._finalize_shard_read(plan, start_ns, end_ns, out,
+                                          paged, frags)
 
         def consume(g, payload):
             g.consume(payload)
             plan = last_group_of.get(id(g))
             if plan is not None:  # this shard's partial columns are
                 # complete: hand them downstream now, mid-pipeline
-                self._finalize_shard_read(plan, start_ns, end_ns, out)
+                self._finalize_shard_read(plan, start_ns, end_ns, out,
+                                          paged, frags)
 
         stats = pipeline.run_stages(groups, lambda g: g.gather(), consume)
         querystats.record_pipeline(stats.items, stats.wall_s, stats.stages)
+        if want_ragged and frags is not None:
+            # pure O(N) scatter: each fragment is already merged and
+            # filtered, and every row lives in exactly one fragment —
+            # the combine just lands rows at their query-order positions
+            return ragged.combine_fragments(frags, len(series_ids))
         return out
 
-    def _finalize_shard_read(self, plan, start_ns, end_ns, out) -> None:
+    def _finalize_shard_read(self, plan, start_ns, end_ns, out,
+                             paged: bool = False,
+                             frags: list | None = None) -> None:
         shard, idxs, sids, parts = plan
         limits = self.limits
+        if paged:
+            # batched ragged finalize (ROADMAP #3): ONE merge pass over
+            # the shard's series instead of per-series concatenates;
+            # out[] carries zero-copy row slices of the shard CSR
+            import numpy as np
+
+            t, v, offs = shard.finish_read_many(sids, parts, start_ns,
+                                                end_ns)
+            for j, i in enumerate(idxs):
+                a, b = int(offs[j]), int(offs[j + 1])
+                if limits is not None:
+                    limits.add_datapoints(b - a)
+                out[i] = (t[a:b], v[a:b])
+            if frags is not None:
+                frags.append((np.asarray(idxs, np.int64), t, v, offs))
+            return
         for i, sid, pl in zip(idxs, sids, parts):
             times, vbits = shard.finish_read(sid, pl, start_ns, end_ns)
             if limits is not None:

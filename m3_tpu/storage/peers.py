@@ -315,6 +315,7 @@ def bootstrap_shard_from_peers(db, namespace: str, shard_id: int,
         shard._filesets[bs] = FilesetReader(
             shard.fs_root, namespace, shard_id, bs, 0
         )
+        shard.bump_data_version()
         written += 1
     # the reverse index learns the streamed series (spanning every index
     # block the data block overlaps, like fs bootstrap)
@@ -547,6 +548,7 @@ def repair_shard_block(db, namespace: str, shard_id: int, block_start: int,
         shard._filesets[block_start] = FilesetReader(
             shard.fs_root, namespace, shard_id, block_start, volume
         )
+        shard.bump_data_version()
         if shard.cache is not None:  # cached decodes predate the repair
             shard.cache.invalidate_block(namespace, shard_id, block_start)
     # peer-only series become queryable

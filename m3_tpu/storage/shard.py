@@ -139,6 +139,14 @@ class Shard:
         # volumes.
         self.warm_writes = 0
         self.cold_writes = 0
+        # monotone data-content version: bumped by every mutation a read
+        # could observe (writes, flush/volume swaps, bootstrap, repair,
+        # expiry). The device-resident hot tier (storage/hottier.py) keys
+        # prepared query slabs on it — an unchanged version means an
+        # identical fetch, so warm device pages can serve without a
+        # rebuild. Guarded by _seq_lock (a lost bump would serve stale
+        # pages, the one unacceptable failure mode).
+        self.data_version = 0
 
     # -- write --
 
@@ -155,6 +163,7 @@ class Shard:
         # clean without the point
         with self._seq_lock:
             self._write_seq[bs] = self._write_seq.get(bs, 0) + 1
+            self.data_version += 1
         return idx
 
     def write_many(self, series_ids: list[bytes], times: np.ndarray,
@@ -176,6 +185,14 @@ class Shard:
         with self._seq_lock:
             for w, c in zip(uniq.tolist(), counts.tolist()):
                 self._write_seq[w] = self._write_seq.get(w, 0) + c
+            self.data_version += 1
+
+    def bump_data_version(self) -> None:
+        """Mark the shard's readable content changed (volume swaps from
+        flush/bootstrap/repair, expiry) — hot-tier entries keyed on the
+        old version stop matching."""
+        with self._seq_lock:
+            self.data_version += 1
 
     def write_seq(self, block_start: int) -> int:
         return self._write_seq.get(block_start, 0)
@@ -263,6 +280,13 @@ class Shard:
             # shard callers), not just the flattened namespace schedule
             querystats.record_pipeline(stats.items, stats.wall_s,
                                        stats.stages)
+            from m3_tpu.storage import pagepool
+
+            if pagepool.active():
+                t, v, offs = self.finish_read_many(series_ids, parts,
+                                                   start_ns, end_ns)
+                return [(t[offs[i]:offs[i + 1]], v[offs[i]:offs[i + 1]])
+                        for i in range(len(series_ids))]
             return [self.finish_read(sid, pl, start_ns, end_ns)
                     for sid, pl in zip(series_ids, parts)]
         return self._read_many_serial(series_ids, start_ns, end_ns)
@@ -282,6 +306,38 @@ class Shard:
             groups.append(_FilesetReadGroup(self, bs, reader, series_ids,
                                             parts))
         return groups
+
+    def finish_read_many(self, series_ids: list[bytes], parts: list[list],
+                         start_ns: int, end_ns: int):
+        """Batched RAGGED finalize (ROADMAP #3): the per-series
+        ``np.concatenate`` + ``merge_dedup`` pass in finish_read —
+        profiled at ~15% of the sparse read path — becomes ONE buffer
+        CSR gather (`ShardBuffer.read_many_csr`), one preallocated fill
+        and one vectorized merge over every series at once
+        (`ops.ragged.assemble_rows`).  Returns the (times, vbits,
+        offsets) CSR aligned to `series_ids`; per-row results are
+        element-identical to finish_read (same part order, same
+        keep-last dedup, same range filter)."""
+        from m3_tpu.ops import ragged
+
+        if len(set(series_ids)) != len(series_ids):
+            # duplicate ids: the CSR position map is one row per id —
+            # take the per-series seed finalize (correctness over speed
+            # on a shape no production caller emits)
+            pairs = [self.finish_read(sid, list(pl), start_ns, end_ns)
+                     for sid, pl in zip(series_ids, parts)]
+            return ragged.pairs_to_csr(pairs)
+        bt, bv, boffs = self.buffer.read_many_csr(series_ids, start_ns,
+                                                  end_ns)
+        if len(bt):
+            # buffer leg LAST: last-write-wins keeps buffered points,
+            # exactly the finish_read append order (parts lists are
+            # owned by this read — appending in place, like finish_read)
+            for i, pl in enumerate(parts):
+                a, b = boffs[i], boffs[i + 1]
+                if b > a:
+                    pl.append((bt[a:b], bv[a:b]))
+        return ragged.assemble_rows(parts, start_ns, end_ns)
 
     def finish_read(self, series_id: bytes, parts: list, start_ns: int,
                     end_ns: int) -> tuple[np.ndarray, np.ndarray]:
@@ -372,19 +428,40 @@ class Shard:
 
         faults.check("shard.snapshot", shard=self.shard_id,
                      block_start=block_start)
-        sealed = self.buffer.seal(block_start, drop=False)
-        if sealed is None:
-            return False
-        ids = [self.buffer.series_ids[i] for i in sealed.series_indices]
-        tags = [self.buffer.series_tags[i] for i in sealed.series_indices]
-        try:
-            streams = hostpath.encode_blocks(
-                sealed.times, sealed.value_bits, sealed.starts,
-                sealed.n_points, self.opts.write_time_unit,
-                self.opts.int_optimized,
-            )
-        except OverflowError:
-            return False
+        from m3_tpu.storage import pagepool
+
+        if pagepool.active():
+            # ragged seal + length-bucketed encode: no [B, max_T]
+            # rectangle for the snapshot either (byte-identical streams)
+            sealed = self.buffer.seal_csr(block_start, drop=False)
+            if sealed is None:
+                return False
+            ids = [self.buffer.series_ids[i] for i in sealed.series_indices]
+            tags = [self.buffer.series_tags[i]
+                    for i in sealed.series_indices]
+            try:
+                streams = hostpath.encode_blocks_ragged(
+                    sealed.times, sealed.value_bits, sealed.offsets,
+                    np.full(sealed.n_series, block_start, np.int64),
+                    self.opts.write_time_unit, self.opts.int_optimized,
+                )
+            except OverflowError:
+                return False
+        else:
+            sealed = self.buffer.seal(block_start, drop=False)
+            if sealed is None:
+                return False
+            ids = [self.buffer.series_ids[i] for i in sealed.series_indices]
+            tags = [self.buffer.series_tags[i]
+                    for i in sealed.series_indices]
+            try:
+                streams = hostpath.encode_blocks(
+                    sealed.times, sealed.value_bits, sealed.starts,
+                    sealed.n_points, self.opts.write_time_unit,
+                    self.opts.int_optimized,
+                )
+            except OverflowError:
+                return False
         writer = FilesetWriter(
             snapshot_root, self.namespace, self.shard_id, block_start,
             self.opts.retention.block_size_ns, snapshot_id,
@@ -496,6 +573,10 @@ class Shard:
         faults.check("shard.flush", shard=self.shard_id,
                      block_start=block_start)
         self._drain_retired()
+        from m3_tpu.storage import pagepool
+
+        if pagepool.active():
+            return self._flush_ragged(block_start)
 
         # Seal WITHOUT dropping: the buffer window is the only copy until the
         # fileset volume is durably on disk; a failed flush must leave it
@@ -556,6 +637,19 @@ class Shard:
                 f"flush encode overflow: shard={self.shard_id} bs={block_start}"
             )
 
+        self._write_volume_and_swap(ids, tags, streams, extra,
+                                    block_start, volume, prev,
+                                    sealed.raw_count)
+        return True
+
+    def _write_volume_and_swap(self, ids, tags, streams, extra,
+                               block_start: int, volume: int, prev,
+                               raw_count: int) -> None:
+        """The flush DURABILITY TAIL shared by the padded and ragged
+        bodies (which only differ in how they seal/merge/encode): paced
+        volume write + checkpoint, reader retire/swap, cache
+        invalidation, and only THEN dropping exactly the sealed prefix —
+        concurrent appends after the seal copy stay buffered."""
         writer = FilesetWriter(
             self.fs_root, self.namespace, self.shard_id, block_start,
             self.opts.retention.block_size_ns, volume,
@@ -576,9 +670,73 @@ class Shard:
         if self.cache is not None:  # cached decodes are for the old volume
             self.cache.invalidate_block(self.namespace, self.shard_id,
                                         block_start)
-        # volume durable: drop exactly the rows this seal covered —
-        # concurrent appends after the seal copy stay buffered
-        self.buffer.drop_window_prefix(block_start, sealed.raw_count)
+        self.buffer.drop_window_prefix(block_start, raw_count)
+        self.bump_data_version()
+
+    def _flush_ragged(self, block_start: int) -> bool:
+        """The paged-memory flush body (M3_TPU_PAGED=1): ragged seal
+        (no [B, max_T] scatter), per-series merge against the previous
+        volume on CSR slices, length-bucketed ragged encode — streams
+        byte-identical to the padded body, volumes indistinguishable on
+        disk.  Durability order is the seed body's: seal without drop,
+        write + checkpoint, swap, only then drop the covered prefix."""
+        from m3_tpu.encoding.m3tsz import hostpath
+        from m3_tpu.ops import ragged
+
+        sealed = self.buffer.seal_csr(block_start, drop=False)
+        if sealed is None:
+            return False
+        ids = [self.buffer.series_ids[i] for i in sealed.series_indices]
+        tags = [self.buffer.series_tags[i] for i in sealed.series_indices]
+        times, vbits, offsets = (sealed.times, sealed.value_bits,
+                                 sealed.offsets)
+
+        prev = self._filesets.get(block_start)
+        volume = 0
+        extra: list[tuple[bytes, bytes, bytes]] = []  # untouched old series
+        replaced: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        if prev is not None:
+            volume = prev.volume + 1
+            new_ids = {sid: k for k, sid in enumerate(ids)}
+            for i in range(prev.n_series):
+                sid, stags, stream = prev.read_at(i)
+                if sid not in new_ids:
+                    extra.append((sid, stags, stream))
+                    continue
+                k = new_ids[sid]
+                old_t, old_v = hostpath.decode_stream(
+                    stream, self.opts.write_time_unit,
+                    self.opts.int_optimized,
+                )
+                a, b = int(offsets[k]), int(offsets[k + 1])
+                replaced[k] = merge_dedup(
+                    np.concatenate([old_t, times[a:b]]),
+                    np.concatenate([old_v, vbits[a:b]]),
+                )
+        if replaced:
+            rows = []
+            for k in range(sealed.n_series):
+                hit = replaced.get(k)
+                if hit is None:
+                    a, b = int(offsets[k]), int(offsets[k + 1])
+                    hit = (times[a:b], vbits[a:b])
+                rows.append([hit])
+            times, vbits, offsets = ragged.assemble_rows(rows)
+
+        try:
+            streams = hostpath.encode_blocks_ragged(
+                times, vbits, offsets,
+                np.full(sealed.n_series, block_start, np.int64),
+                self.opts.write_time_unit, self.opts.int_optimized,
+            )
+        except OverflowError:
+            raise RuntimeError(
+                f"flush encode overflow: shard={self.shard_id} bs={block_start}"
+            )
+
+        self._write_volume_and_swap(ids, tags, streams, extra,
+                                    block_start, volume, prev,
+                                    sealed.raw_count)
         return True
 
     # -- bootstrap --
@@ -606,6 +764,8 @@ class Shard:
             with self._maint_lock:
                 self._filesets[block_start] = reader
             n += 1
+        if n:
+            self.bump_data_version()
         return n
 
     # -- maintenance --
@@ -675,7 +835,9 @@ class Shard:
                 if cur is not None and vol < cur.volume \
                         and (bs, vol) not in in_grace:
                     self._delete_volume_files(bs, vol)
-        self.buffer.expire_before(cutoff)
+        expired = self.buffer.expire_before(cutoff)
+        if dropped or expired:
+            self.bump_data_version()
         return dropped
 
     def close(self) -> None:

@@ -1004,24 +1004,12 @@ def config11_sharded_query():
         db.close()
 
 
-def config12_pipelined_read():
-    """Pipelined dataflow (ISSUE 14 / ROADMAP #2): end-to-end
-    query_range over a SPARSE high-cardinality multi-group namespace —
-    16k series x 12 block volumes, a handful of points per (series,
-    block), the shape where the per-(shard, block) gather rung dominates
-    the fetch (ROADMAP #3's sparse-series premise). Pipelined
-    (M3_TPU_PIPELINE=1: per-group gathers prefetched on the executor
-    behind the decode rung, columnar row-index gather, cache
-    bookkeeping skipped while the block cache is disabled — this is a
-    cold scan) vs the pinned serial seed path (=0: per-query merge-join
-    walk, inline legs). Same pairing discipline as #9: interleaved
-    pairs, MEDIAN pair reported, correctness gated on exact NaN masks +
-    1e-9 values BEFORE anything is emitted. On a multi-core host the
-    executor adds genuine gather/decode wall-clock overlap on top of
-    the columnar gather; this 1-core container measures the
-    restructured dataflow alone."""
-    import tempfile
-
+def _sparse_multigroup_setup(root, S, NB, T):
+    """The #12 workload: a SPARSE high-cardinality multi-group namespace
+    — S series x NB block volumes, a handful of points per (series,
+    block) — plus the query that scans it end to end.  Shared by #12
+    (pipelined vs serial) and #13 (paged ragged finalize vs the seed
+    per-series concatenate path)."""
     from m3_tpu.encoding.m3tsz import hostpath
     from m3_tpu.query.engine import Engine
     from m3_tpu.storage.database import Database
@@ -1034,51 +1022,75 @@ def config12_pipelined_read():
     NS = 10**9
     BLOCK = 3600 * NS
     START = 1_600_000_000 * NS
+    db = Database(root, DatabaseOptions(
+        n_shards=8, block_cache_entries=0))  # cold multi-group scans
+    ns = db.create_namespace("default", NamespaceOptions(
+        retention=RetentionOptions(retention_ns=1000 * BLOCK,
+                                   block_size_ns=BLOCK),
+        index=IndexOptions(enabled=True, block_size_ns=BLOCK),
+        writes_to_commitlog=False, snapshot_enabled=False))
+    ids = [b"reqs,host=h%04d,i=%05d" % (i % 100, i) for i in range(S)]
+    fields = [[(b"__name__", b"reqs"), (b"host", b"h%04d" % (i % 100)),
+               (b"i", b"%05d" % i)] for i in range(S)]
+    by_shard: dict[int, list[int]] = {}
+    for j, sid in enumerate(ids):
+        by_shard.setdefault(ns.shard_set.lookup(sid), []).append(j)
+    rng = np.random.default_rng(0)
+    for b in range(NB):
+        bs = START + b * BLOCK
+        for shard_id, rows in by_shard.items():
+            nb = len(rows)
+            times = np.broadcast_to(
+                bs + np.arange(T, dtype=np.int64) * (BLOCK // T),
+                (nb, T)).copy()
+            vals = rng.integers(1, 10, (nb, T)).astype(np.float64) \
+                .cumsum(axis=1)
+            streams = hostpath.encode_blocks(
+                times, vals.view(np.uint64), np.full(nb, bs, np.int64),
+                np.full(nb, T, np.int32), TimeUnit.SECOND, False)
+            w = FilesetWriter(db.fs_root, "default", shard_id, bs,
+                              BLOCK, 0)
+            for j, stream in zip(rows, streams):
+                w.write_series(ids[j], b"", stream)
+            w.close()
+    db.open(START + NB * BLOCK)
+    ns.index.insert_many(ids, fields, np.full(S, START, np.int64))
+    eng = Engine(db, resolve_tiers=False)
+    q = "sum by (host) (sum_over_time(reqs[30m]))"
+    qs = START + 30 * 60 * NS
+    qe = START + NB * BLOCK - 60 * NS
+    step = 30 * 60 * NS
+
+    def run():
+        return eng.query_range(q, qs, qe, step)[0]
+
+    return run
+
+
+def config12_pipelined_read():
+    """Pipelined dataflow (ISSUE 14 / ROADMAP #2): end-to-end
+    query_range over the sparse multi-group workload
+    (_sparse_multigroup_setup) — the shape where the per-(shard, block)
+    gather rung dominates the fetch (ROADMAP #3's sparse-series
+    premise). Pipelined (M3_TPU_PIPELINE=1: per-group gathers prefetched
+    on the executor behind the decode rung, columnar row-index gather,
+    cache bookkeeping skipped while the block cache is disabled — this
+    is a cold scan) vs the pinned serial seed path (=0: per-query
+    merge-join walk, inline legs). Same pairing discipline as #9:
+    interleaved pairs, MEDIAN pair reported, correctness gated on exact
+    NaN masks + 1e-9 values BEFORE anything is emitted. On a multi-core
+    host the executor adds genuine gather/decode wall-clock overlap on
+    top of the columnar gather; this 1-core container measures the
+    restructured dataflow alone."""
+    import tempfile
+
+    NS = 10**9
+    BLOCK = 3600 * NS
     S = max(int(160_000 * _scale()), 2048)
     NB, T = 12, 4
     with tempfile.TemporaryDirectory() as root:
-        db = Database(root, DatabaseOptions(
-            n_shards=8, block_cache_entries=0))  # cold multi-group scans
-        ns = db.create_namespace("default", NamespaceOptions(
-            retention=RetentionOptions(retention_ns=1000 * BLOCK,
-                                       block_size_ns=BLOCK),
-            index=IndexOptions(enabled=True, block_size_ns=BLOCK),
-            writes_to_commitlog=False, snapshot_enabled=False))
-        ids = [b"reqs,host=h%04d,i=%05d" % (i % 100, i) for i in range(S)]
-        fields = [[(b"__name__", b"reqs"), (b"host", b"h%04d" % (i % 100)),
-                   (b"i", b"%05d" % i)] for i in range(S)]
-        by_shard: dict[int, list[int]] = {}
-        for j, sid in enumerate(ids):
-            by_shard.setdefault(ns.shard_set.lookup(sid), []).append(j)
-        rng = np.random.default_rng(0)
-        for b in range(NB):
-            bs = START + b * BLOCK
-            for shard_id, rows in by_shard.items():
-                nb = len(rows)
-                times = np.broadcast_to(
-                    bs + np.arange(T, dtype=np.int64) * (BLOCK // T),
-                    (nb, T)).copy()
-                vals = rng.integers(1, 10, (nb, T)).astype(np.float64) \
-                    .cumsum(axis=1)
-                streams = hostpath.encode_blocks(
-                    times, vals.view(np.uint64), np.full(nb, bs, np.int64),
-                    np.full(nb, T, np.int32), TimeUnit.SECOND, False)
-                w = FilesetWriter(db.fs_root, "default", shard_id, bs,
-                                  BLOCK, 0)
-                for j, stream in zip(rows, streams):
-                    w.write_series(ids[j], b"", stream)
-                w.close()
-        db.open(START + NB * BLOCK)
-        ns.index.insert_many(ids, fields, np.full(S, START, np.int64))
-        eng = Engine(db, resolve_tiers=False)
-        q = "sum by (host) (sum_over_time(reqs[30m]))"
-        qs = START + 30 * 60 * NS
-        qe = START + NB * BLOCK - 60 * NS
-        step = 30 * 60 * NS
+        run = _sparse_multigroup_setup(root, S, NB, T)
         n_dp = S * NB * T  # samples the query reads end to end
-
-        def run():
-            return eng.query_range(q, qs, qe, step)[0]
 
         prev = os.environ.get("M3_TPU_PIPELINE")
         try:
@@ -1115,10 +1127,199 @@ def config12_pipelined_read():
                 os.environ["M3_TPU_PIPELINE"] = prev
 
 
+def config13_paged_memory():
+    """Paged ragged columnar memory (ISSUE 15 / ROADMAP #3), two legs.
+
+    (a) Write+read STEADY STATE at 1M live series (the default-scale
+    lane runs the honest million): bulk write_many rounds into the
+    page-pool buffer, one warm flush (ragged seal + length-bucketed
+    encode), more live rounds, then a batched read merging fileset +
+    live buffer — M3_TPU_PAGED=1 vs the pinned seed grow-array/
+    per-series-concatenate path (=0), interleaved pairs, MEDIAN pair
+    reported with RSS and p99 ingest-round wall time in the metric
+    line.  The baseline's read rate is measured on a 1/64 series
+    subset (its per-series cost is constant in subset size — the full
+    quadratic scan takes hours, which is the point of this PR) and
+    charged at that rate for the full read volume.
+
+    (b) The #12 sparse multi-group e2e query shape with the PIPELINE
+    armed on BOTH sides, toggling only M3_TPU_PAGED — isolating the
+    ragged finalize (finish_read's per-series np.concatenate +
+    merge_dedup tax, profiled ~15% of this path in PR 14) from the
+    overlap win #12 already records. Correctness gated on exact NaN
+    masks + 1e-9 values before anything is emitted."""
+    import tempfile
+
+    from m3_tpu.storage.database import Database
+    from m3_tpu.storage.options import (
+        DatabaseOptions, NamespaceOptions, RetentionOptions,
+    )
+    from m3_tpu.utils.selfscrape import rss_bytes
+
+    NS = 10**9
+    BLOCK = 3600 * NS
+    START = 1_600_000_000 * NS - (1_600_000_000 * NS) % (3600 * NS)
+    # 1M live series AT THE DEFAULT 0.1 SCALE — the ROADMAP #3 acceptance
+    # bench is the honest million, not a scaled stand-in
+    S = max(int(10_000_000 * _scale()), 8192)
+    ROUNDS = 2  # write rounds per block window
+
+    def steady_state(root, paged: str):
+        """One full side: write ROUNDS rounds into two block windows
+        (flushing the first — live buffer + fileset merge on the read),
+        then a batched read.  The PAGED side reads every live series;
+        the grow-array baseline reads a 1/64 SUBSET — its per-series
+        finalize cost is CONSTANT in subset size (each buffer.read masks
+        the whole window log regardless), so the subset's datapoints/sec
+        is the baseline's exact full-read rate, measured in minutes
+        instead of the hours the quadratic full scan actually takes at
+        1M live series.  Throughput combines the measured write wall
+        with the full read volume at the measured read rate."""
+        os.environ["M3_TPU_PAGED"] = paged
+        db = Database(root, DatabaseOptions(n_shards=4,
+                                            block_cache_entries=0))
+        ns = db.create_namespace("default", NamespaceOptions(
+            retention=RetentionOptions(retention_ns=1000 * BLOCK,
+                                       block_size_ns=BLOCK),
+            writes_to_commitlog=False, snapshot_enabled=False))
+        db.open(START)
+        ids = [b"m%07d" % i for i in range(S)]
+        tags = [b""] * S
+        lat = []
+        write_dp = 0
+        t_write0 = time.perf_counter()
+        for b in range(2):
+            bs = START + b * BLOCK
+            for r in range(ROUNDS):
+                times = np.full(S, bs + (r + 1) * 60 * NS, np.int64)
+                # per-series distinct values: the correctness digest
+                # below sums them, so a read path that scrambles or
+                # zeroes values across series cannot slip through
+                vals = (np.arange(S, dtype=np.float64) * 0.5
+                        + r).view(np.uint64)
+                t0 = time.perf_counter()
+                ns.write_many(ids, times, vals, tags)
+                lat.append(time.perf_counter() - t0)
+                write_dp += S
+            if b == 0:  # warm flush: the seal + encode + volume write —
+                # counted in the wall (throughput) but NOT in lat: p99
+                # reports INGEST-round latency, not flush cost
+                for shard in ns.shards.values():
+                    shard.flush(bs)
+        write_wall = time.perf_counter() - t_write0
+        # RSS at end of ingest: the buffer-resident state (page pool vs
+        # grow-arrays), before the read materializes result columns —
+        # the two sides read different volumes (subset methodology), so
+        # post-read RSS would not be comparable
+        rss = rss_bytes()
+        read_ids = ids if paged == "1" else ids[::64]
+        t0 = time.perf_counter()
+        out = ns.read_many(read_ids, START, START + 2 * BLOCK)
+        read_wall = time.perf_counter() - t0
+        read_dp = sum(len(t) for t, _ in out)
+        read_rate = read_dp / read_wall if read_wall else 0.0
+        full_read_dp = 2 * ROUNDS * S
+        thr = (write_dp + full_read_dp) \
+            / (write_wall + full_read_dp / max(read_rate, 1e-9))
+        # correctness digest over the shared subset
+        sub = out if paged != "1" else out[::64]
+        digest = (sum(int(len(t)) for t, _ in sub),
+                  sum(int(t.sum()) for t, _ in sub if len(t)),
+                  sum(int(v.view(np.float64).sum()) for _, v in sub
+                      if len(v)))
+        db.close()
+        return thr, float(np.quantile(lat, 0.99)), rss, digest
+
+    # a 1M-series pair costs minutes; run interleaved pairs until the
+    # wall budget is spent (≥1 pair always) and report the median pair
+    budget_s = float(os.environ.get("M3_TPU_BENCH13_BUDGET_S", "360"))
+    prev_paged = os.environ.get("M3_TPU_PAGED")
+    try:
+        with tempfile.TemporaryDirectory() as root:
+            pairs = []
+            meta = {}
+            t_budget0 = time.perf_counter()
+            for it in range(3):
+                thr_p, p99_p, rss_p, dig_p = steady_state(
+                    os.path.join(root, f"p{it}"), "1")
+                thr_s, p99_s, rss_s, dig_s = steady_state(
+                    os.path.join(root, f"s{it}"), "0")
+                if dig_p != dig_s:
+                    _emit("#13 paged 1M steady state (CORRECTNESS FAILED)",
+                          0.0, 1.0)
+                    return
+                pairs.append((thr_p / thr_s, thr_p, thr_s))
+                meta[thr_p / thr_s] = (p99_p, p99_s, rss_p, rss_s)
+                if time.perf_counter() - t_budget0 > budget_s:
+                    break
+            pairs.sort(key=lambda p: p[0])
+            ratio, thr_p, thr_s = pairs[len(pairs) // 2]
+            p99_p, p99_s, rss_p, rss_s = meta[ratio]
+            _emit(f"#13 paged write+read steady state {S} live series "
+                  f"[p99 {p99_p * 1e3:.0f}ms vs {p99_s * 1e3:.0f}ms, RSS "
+                  f"{rss_p >> 20}MB vs {rss_s >> 20}MB, paged vs "
+                  f"grow-array; baseline read rate via 1/64 subset]",
+                  thr_p, thr_s)
+    finally:
+        # steady_state exports the hatch per side: restore so a custom
+        # --configs order never benchmarks later configs on the wrong path
+        if prev_paged is None:
+            os.environ.pop("M3_TPU_PAGED", None)
+        else:
+            os.environ["M3_TPU_PAGED"] = prev_paged
+
+    # leg (b): the #12 shape, pipeline armed both sides, PAGED toggled
+    S12 = max(int(160_000 * _scale()), 2048)
+    NB, T = 12, 4
+    with tempfile.TemporaryDirectory() as root:
+        prev_pipe = os.environ.get("M3_TPU_PIPELINE")
+        try:
+            os.environ["M3_TPU_PAGED"] = "1"
+            os.environ["M3_TPU_PIPELINE"] = "1"
+            run = _sparse_multigroup_setup(root, S12, NB, T)
+            n_dp = S12 * NB * T
+            v_p = run()
+            os.environ["M3_TPU_PAGED"] = "0"
+            v_s = run()
+            ok = (v_p.labels == v_s.labels
+                  and np.array_equal(np.isnan(v_p.values),
+                                     np.isnan(v_s.values))
+                  and np.allclose(v_p.values, v_s.values, rtol=1e-9,
+                                  atol=0, equal_nan=True))
+            pairs = []
+            for _ in range(7):
+                os.environ["M3_TPU_PAGED"] = "1"
+                t0 = time.perf_counter()
+                run()
+                dt_p = time.perf_counter() - t0
+                os.environ["M3_TPU_PAGED"] = "0"
+                t0 = time.perf_counter()
+                run()
+                dt_s = time.perf_counter() - t0
+                pairs.append((dt_s / dt_p, n_dp / dt_p, n_dp / dt_s))
+            pairs.sort(key=lambda p: p[0])
+            _ratio, thr_p, thr_s = pairs[len(pairs) // 2]
+            _emit(f"#13 ragged finalize e2e {S12} series x {NB} blocks "
+                  f"[#12 shape, pipeline on, paged vs per-series "
+                  f"concatenate]" + ("" if ok else " (CORRECTNESS FAILED)"),
+                  thr_p, thr_s)
+        finally:
+            # RESTORE (not pop): an operator-pinned M3_TPU_PAGED must
+            # survive into later configs of a custom --configs order
+            if prev_paged is None:
+                os.environ.pop("M3_TPU_PAGED", None)
+            else:
+                os.environ["M3_TPU_PAGED"] = prev_paged
+            if prev_pipe is None:
+                os.environ.pop("M3_TPU_PIPELINE", None)
+            else:
+                os.environ["M3_TPU_PIPELINE"] = prev_pipe
+
+
 def main(argv=None) -> None:
     global _ACCEL
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13")
     ap.add_argument("--record", default=None,
                     help="also append the JSON lines to this file")
     args = ap.parse_args(argv)
@@ -1146,7 +1347,8 @@ def main(argv=None) -> None:
            "5": config5_sharded_quantile, "6": config6_read_many,
            "7": config7_tracing_overhead, "8": config8_write_batch,
            "9": config9_query_compile, "10": config10_profiler_overhead,
-           "11": config11_sharded_query, "12": config12_pipelined_read}
+           "11": config11_sharded_query, "12": config12_pipelined_read,
+           "13": config13_paged_memory}
     for c in args.configs.split(","):
         c = c.strip()
         try:

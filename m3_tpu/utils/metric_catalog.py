@@ -50,6 +50,13 @@ HISTOGRAMS = {
     "wait_seconds",             # lock.wait_seconds{cls=site}: per-class
     #                             acquire-wait (published via
     #                             merge_histogram at snapshot time)
+    # paged columnar memory & device-resident hot tier (ROADMAP #3)
+    "page_fill",                # storage.page_pool: fraction of a sealed
+    #                             window's page allocation holding real
+    #                             rows (padding-waste measure, observed
+    #                             at every ragged seal)
+    "hot_tier_entry_bytes",     # storage.hot_tier: resident bytes of one
+    #                             prepared-slab entry at admission
 }
 
 TIMERS = {
@@ -75,3 +82,15 @@ TIMERS = {
 #       balanced slabs (ran the single-device program instead)
 # plus the dispatch-layer tallies query.compile[sharded] and
 # windowed_agg.aggregate_groups[mesh] on /debug counters.
+#
+# Paged columnar memory & device-resident hot tier (ROADMAP #3):
+#   queue_depth/capacity/dropped {queue=page_pool}   pages in use /
+#       pages resident / pages evicted back to the OS, aggregated over
+#       every shard's pool (storage/pagepool.monitor_pool)
+#   storage_page_pool_resident_bytes                 gauge refreshed by
+#       the pagepool snapshot hook
+#   queue_depth/capacity/dropped {queue=hot_tier}    prepared-slab bytes
+#       used / byte cap / LRU evictions (storage/hottier)
+#   storage_hot_tier_hit / storage_hot_tier_miss     per-query counters
+#       (compiled path; the same outcome rides the ?explain=analyze
+#       hot_tier block)

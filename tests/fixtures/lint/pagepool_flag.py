@@ -1,0 +1,16 @@
+"""inv-pagepool-gauge MUST-FLAG fixture: a page pool and a hot tier
+constructed with no saturation-plane registration in their scopes —
+their occupancy and evictions are invisible."""
+
+from m3_tpu.storage.hottier import HotTier
+from m3_tpu.storage.pagepool import PagePool
+
+
+class UnmonitoredBuffer:
+    def __init__(self):
+        # pool with no monitor_pool/monitor_queue in this class: must flag
+        self._pool = PagePool()
+
+
+# module-level tier with no module-level registration: must flag
+_tier = HotTier(1 << 20)

@@ -1,0 +1,18 @@
+"""inv-pagepool-gauge MUST-PASS fixture: page-pool/hot-tier ctors with
+the registration discipline (pagepool.monitor_pool for pools, a
+module-level monitor_queue for the module-level tier)."""
+
+from m3_tpu.storage import pagepool
+from m3_tpu.storage.hottier import HotTier
+from m3_tpu.utils import instrument
+
+
+class MonitoredBuffer:
+    def __init__(self):
+        self._pool = pagepool.monitor_pool(pagepool.PagePool())
+
+
+_tier = HotTier(1 << 20)
+instrument.monitor_queue("fixture_hot_tier", lambda: _tier.bytes_used,
+                         capacity=lambda: _tier.max_bytes,
+                         drops_fn=lambda: _tier.evictions)

@@ -178,6 +178,19 @@ class TestInvariantRules:
         # therefore USED — no lint-unused-waiver either)
         assert run_lint("queue_gauge_pass.py", select=("inv-queue",)) == []
 
+    def test_pagepool_ctor_without_registration_flags(self):
+        # ISSUE 15: PagePool/HotTier ctors are held to the queue-gauge
+        # discipline — both the class-scope pool and the module-level
+        # tier must register on the saturation plane
+        fs = run_lint("pagepool_flag.py", select=("inv-pagepool",))
+        assert rules_of(fs) == {"inv-pagepool-gauge"}
+        assert len(fs) == 2, fs
+
+    def test_pagepool_registered_passes(self):
+        # monitor_pool in the constructing class (even wrapping the ctor
+        # call) and a module-level monitor_queue both bless their scopes
+        assert run_lint("pagepool_pass.py", select=("inv-pagepool",)) == []
+
 
 class TestWaivers:
     def test_waived_finding_is_suppressed(self):

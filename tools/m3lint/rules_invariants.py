@@ -52,6 +52,8 @@ RULES = {
     "inv-histogram-catalog": "histogram/timer name missing from the catalog",
     "inv-crash-swallow": "broad except around a fault seam swallows SimulatedCrash",
     "inv-queue-gauge": "bounded queue/ring without a monitor_queue registration",
+    "inv-pagepool-gauge": "page pool/hot tier constructed without a "
+                          "saturation-plane registration",
 }
 
 # modules whose fault-point mentions are documentation or test scaffolding
@@ -479,8 +481,16 @@ def _is_bounded_queue_ctor(call: ast.Call) -> bool:
     return False
 
 
+# memory-pool ctors held to the same registration discipline as bounded
+# queues (ISSUE 15): a page pool or hot tier that fills/evicts with no
+# occupancy gauges is the same invisible-saturation failure mode
+_POOL_CTORS = {"PagePool", "HotTier"}
+_POOL_REGISTERS = {"monitor_pool", "monitor_queue"}
+
+
 class _QueueScanner(ast.NodeVisitor):
-    """Bounded-queue ctors + monitor_queue calls, per enclosing class.
+    """Bounded-queue + pool ctors and their registrations, per enclosing
+    class.
 
     Scope key is the innermost ClassDef (None = module level): a class
     that builds bounded buffers must register at least one monitor; a
@@ -490,7 +500,9 @@ class _QueueScanner(ast.NodeVisitor):
     def __init__(self):
         self._stack: list[ast.ClassDef | None] = [None]
         self.ctors: list[tuple[ast.ClassDef | None, int]] = []
+        self.pool_ctors: list[tuple[ast.ClassDef | None, int]] = []
         self.monitored: set[ast.ClassDef | None] = set()
+        self.pool_monitored: set[ast.ClassDef | None] = set()
 
     def visit_ClassDef(self, node: ast.ClassDef):
         self._stack.append(node)
@@ -498,8 +510,18 @@ class _QueueScanner(ast.NodeVisitor):
         self._stack.pop()
 
     def visit_Call(self, node: ast.Call):
-        if _call_name(node) == "monitor_queue":
+        name = _call_name(node)
+        if name == "monitor_queue":
             self.monitored.add(self._stack[-1])
+        if name in _POOL_REGISTERS:
+            self.pool_monitored.add(self._stack[-1])
+        if name in _POOL_CTORS \
+                and self._stack[-1] is not None \
+                and self._stack[-1].name != name:
+            # the class DEFINING the pool is not a construction site
+            self.pool_ctors.append((self._stack[-1], node.lineno))
+        elif name in _POOL_CTORS and self._stack[-1] is None:
+            self.pool_ctors.append((None, node.lineno))
         elif _is_bounded_queue_ctor(node):
             self.ctors.append((self._stack[-1], node.lineno))
         self.generic_visit(node)
@@ -517,6 +539,18 @@ def _check_queue_gauges(proj: Project):
             continue
         sc = _QueueScanner()
         sc.visit(mod.tree)
+        for cls, lineno in sc.pool_ctors:
+            # pool discipline (inv-pagepool-gauge): a PagePool/HotTier
+            # construction site must register it on the saturation plane
+            # (monitor_pool / monitor_queue) in the SAME scope
+            if cls in sc.pool_monitored:
+                continue
+            yield Finding(
+                "inv-pagepool-gauge", mod.path, lineno,
+                "page pool / hot tier constructed without a "
+                "monitor_pool/monitor_queue registration in this scope "
+                "— its occupancy and evictions are invisible to the "
+                "saturation plane")
         if not sc.ctors:
             continue
         for cls, lineno in sc.ctors:
