@@ -11,8 +11,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from m3_tpu.index import postings as P
-from m3_tpu.utils import dispatch
+from m3_tpu.index import device, postings as P
+from m3_tpu.utils import dispatch, querystats
 from m3_tpu.index.query import (
     AllQuery,
     ConjunctionQuery,
@@ -74,6 +74,11 @@ def search_segment(seg: Segment, query: Query) -> np.ndarray:
             # an empty conjunction would be the identity (match-all); that's
             # never intentional from the query layer — reject it
             raise ValueError("empty conjunction query")
+        ids, reason = device.match(seg, query)
+        if ids is not None:
+            querystats.record_index(device_segments=1)
+            return ids
+        querystats.record_index(fallback=reason)
         positives: list[np.ndarray] = []
         negatives: list[np.ndarray] = []
         for q in query.queries:
@@ -103,6 +108,11 @@ def search_segment(seg: Segment, query: Query) -> np.ndarray:
             acc = P.difference(acc, n)
         return acc
     if isinstance(query, DisjunctionQuery):
+        ids, reason = device.match(seg, query)
+        if ids is not None:
+            querystats.record_index(device_segments=1)
+            return ids
+        querystats.record_index(fallback=reason)
         parts = [search_segment(seg, q) for q in query.queries]
         if len(parts) >= 3 and dispatch.use_device(
             len(parts) * seg.n_docs, BITMAP_WORK_THRESHOLD
@@ -131,6 +141,7 @@ def search(segments: list[Segment], query: Query, limit: int | None = None):
     seen: set[bytes] = set()
     out: list = []
     for seg in segments:
+        querystats.record_index(segments=1)
         ids = search_segment(seg, query)
         ids_of = getattr(seg, "series_ids_at", None)
         docs_of = getattr(seg, "docs_at", None)

@@ -38,32 +38,23 @@ import numpy as np
 
 from m3_tpu.index import postings as P
 from m3_tpu.index.segment import Document
+from m3_tpu.metrics.filters import literal_prefix as _literal_prefix
+from m3_tpu.metrics.filters import literal_suffix as _literal_suffix
+from m3_tpu.metrics.filters import prefix_upper_bound as _prefix_upper_bound
+from m3_tpu.utils import querystats
 from m3_tpu.utils.ident import decode_tags, encode_tags
 
 MAGIC = b"M3PKSG02"
 _HDR = struct.Struct("<9Q")
 _CACHE_CAP = 256
 
-_META = re.compile(rb"[\\^$.|?*+()\[\]{}]")
+# below this many candidate terms a scalar byte-compare bisect beats
+# building/consulting the vectorized 8-byte key column
+_KEYED_LOOKUP_MIN = 1024
 
 
 def _align8(n: int) -> int:
     return (n + 7) & ~7
-
-
-def _literal_prefix(src: bytes) -> bytes:
-    """Longest prefix every match must start with. Conservative: top-level
-    alternation anywhere kills the prefix, and a quantifier after the last
-    literal makes that literal optional, so it is dropped."""
-    if b"|" in src:
-        return b""
-    m = _META.search(src)
-    if m is None:
-        return src
-    prefix = src[: m.start()]
-    if m.group() in (b"*", b"?", b"{") and prefix:
-        prefix = prefix[:-1]
-    return prefix
 
 
 class _LazyDocs:
@@ -133,7 +124,10 @@ class PackedSegment:
         self._payload_len = off + 4 * post_len
         self.docs = _LazyDocs(self)
         self._regex_cache: OrderedDict = OrderedDict()
+        self._term_idx_cache: OrderedDict = OrderedDict()
         self._vocab_clean_cache: bool | None = None
+        self._term_keys_cache: np.ndarray | None = None
+        self._device_postings = None
 
     def series_ids(self):
         """Every doc's series id, sliced straight out of the id blob —
@@ -207,8 +201,45 @@ class PackedSegment:
     def _term_range(self, fi: int) -> tuple[int, int]:
         return int(self._field_term_start[fi]), int(self._field_term_start[fi + 1])
 
+    @property
+    def _term_keys(self) -> np.ndarray:
+        """u64 key per term: the first 8 bytes big-endian, zero-padded.
+        Key order agrees with byte order everywhere keys differ (zero-pad
+        vs prefix-shorter both sort the shorter string first), so a
+        vectorized ``searchsorted`` over this column replaces all but the
+        tie-run tail of a Python byte-compare bisect. Built lazily in 8
+        vectorized gathers over the term blob — no per-term slicing —
+        and cached forever (the segment is immutable): ~8 bytes/term."""
+        keys = self._term_keys_cache
+        if keys is None:
+            offs = self._term_off[:-1].astype(np.int64)
+            lens = self._term_off[1:].astype(np.int64) - offs - 1
+            blob = np.frombuffer(self._term_blob, np.uint8)
+            keys = np.zeros(self.n_terms, np.uint64)
+            limit = max(blob.size - 1, 0)
+            for j in range(8):
+                b = blob[np.minimum(offs + j, limit)]
+                keys = (keys << np.uint64(8)) | np.where(
+                    j < lens, b, 0).astype(np.uint64)
+            self._term_keys_cache = keys
+        return keys
+
+    @staticmethod
+    def _term_key(value: bytes) -> int:
+        v = value[:8]
+        return int.from_bytes(v + b"\0" * (8 - len(v)), "big")
+
     def _bisect_term(self, lo: int, hi: int, value: bytes) -> int:
-        """First term index in [lo, hi) with term >= value."""
+        """First term index in [lo, hi) with term >= value. Wide ranges
+        run ONE vectorized searchsorted over the 8-byte key column; the
+        scalar byte-compare loop then only walks the (usually empty) run
+        of terms sharing value's first 8 bytes. Strict key inequality
+        implies the same byte inequality, so the narrowing is exact."""
+        if hi - lo >= _KEYED_LOOKUP_MIN:
+            keys = self._term_keys
+            k = np.uint64(self._term_key(value))
+            lo = lo + int(np.searchsorted(keys[lo:hi], k, side="left"))
+            hi = lo + int(np.searchsorted(keys[lo:hi], k, side="right"))
         while lo < hi:
             mid = (lo + hi) // 2
             if self._term_at(mid) < value:
@@ -245,22 +276,59 @@ class PackedSegment:
         src = pattern.pattern
         if isinstance(src, str):
             src = src.encode()
-        key = (field, src)
+        key = (field, src, pattern.flags)
         cached = self._regex_cache.get(key)
         if cached is not None:
             self._regex_cache.move_to_end(key)
             return cached
-        fi = self._field_index(field)
-        if fi < 0:
-            return P.EMPTY
-        lo, hi = self._term_range(fi)
-        lo, hi = self._narrow_by_prefix(src, lo, hi)
-        idxs = self._scan_vocab(src, pattern, lo, hi)
-        out = self._gather_postings(idxs)
+        out = self._gather_postings(self.term_indices_regexp(field, pattern))
         self._regex_cache[key] = out
         if len(self._regex_cache) > _CACHE_CAP:
             self._regex_cache.popitem(last=False)
         return out
+
+    def term_indices_regexp(self, field: bytes,
+                            pattern: re.Pattern) -> np.ndarray:
+        """Absolute term indices matching the pattern — the term-selection
+        surface the device-compiled postings programs consume
+        (index/device.py needs WHICH CSR rows to intersect, not the
+        materialized host union). Same narrowing as postings_regexp
+        (shared LRU cache, keyed on field+source+flags): literal-prefix
+        binary search bounds the vocab range before any Python ``re``
+        runs, then the batched blob scan picks the matches."""
+        src = pattern.pattern
+        if isinstance(src, str):
+            src = src.encode()
+        key = (field, src, pattern.flags)
+        cached = self._term_idx_cache.get(key)
+        if cached is not None:
+            self._term_idx_cache.move_to_end(key)
+            return cached
+        fi = self._field_index(field)
+        if fi < 0:
+            idxs = np.empty(0, np.int64)
+        else:
+            lo0, hi0 = self._term_range(fi)
+            if pattern.flags & (re.I | re.X | re.S | re.M):
+                # compile-time flags change what the literals mean —
+                # prefix narrowing and the batched blob rescan (which
+                # recompiles from source, losing the flags) are both
+                # unsound; match per-term with the caller's own pattern
+                querystats.record_index(terms_scanned=hi0 - lo0)
+                idxs = np.asarray([i for i in range(lo0, hi0)
+                                   if pattern.fullmatch(self._term_at(i))],
+                                  np.int64)
+            else:
+                lo, hi = self._narrow_by_prefix(src, lo0, hi0)
+                querystats.record_index(
+                    terms_scanned=hi - lo,
+                    terms_prefiltered=(hi0 - lo0) - (hi - lo))
+                idxs = np.asarray(self._scan_vocab(src, pattern, lo, hi),
+                                  np.int64)
+        self._term_idx_cache[key] = idxs
+        if len(self._term_idx_cache) > _CACHE_CAP:
+            self._term_idx_cache.popitem(last=False)
+        return idxs
 
     def _gather_postings(self, term_idxs) -> np.ndarray:
         """Union of the postings of many terms, gathered vectorized (no
@@ -285,16 +353,24 @@ class PackedSegment:
         if not prefix:
             return lo, hi
         new_lo = self._bisect_term(lo, hi, prefix)
-        # upper bound: smallest byte-string > every prefix-extension
-        upper = prefix
-        while upper and upper[-1] == 0xFF:
-            upper = upper[:-1]
-        if upper:
-            upper = upper[:-1] + bytes([upper[-1] + 1])
-            new_hi = self._bisect_term(new_lo, hi, upper)
-        else:
-            new_hi = hi
+        upper = _prefix_upper_bound(prefix)
+        new_hi = self._bisect_term(new_lo, hi, upper) if upper else hi
         return new_lo, new_hi
+
+    def _scan_scalar(self, src: bytes, pattern: re.Pattern,
+                     lo: int, hi: int) -> list[int]:
+        """Per-term matching tail for ranges the batched blob scan cannot
+        soundly cover. A literal suffix (filters.literal_suffix) gates
+        each term with a C-speed ``endswith`` before the Python regex
+        engine ever runs — on adversarial backtracking patterns the
+        endswith reject is the common case."""
+        sfx = _literal_suffix(src)
+        if sfx:
+            return [i for i in range(lo, hi)
+                    if self._term_at(i).endswith(sfx)
+                    and pattern.fullmatch(self._term_at(i))]
+        return [i for i in range(lo, hi)
+                if pattern.fullmatch(self._term_at(i))]
 
     def _scan_vocab(self, src: bytes, pattern: re.Pattern,
                     lo: int, hi: int) -> list[int]:
@@ -303,16 +379,14 @@ class PackedSegment:
         if lo >= hi:
             return []
         if not self._vocab_clean:
-            return [i for i in range(lo, hi)
-                    if pattern.fullmatch(self._term_at(i))]
+            return self._scan_scalar(src, pattern, lo, hi)
         start = int(self._term_off[lo])
         end = int(self._term_off[hi])
         blob = self._term_blob[start:end]
         try:
             rx = re.compile(b"(?m)^(?:" + src + b")$")
         except re.error:
-            return [i for i in range(lo, hi)
-                    if pattern.fullmatch(self._term_at(i))]
+            return self._scan_scalar(src, pattern, lo, hi)
         spans = [(m.start(), m.end()) for m in rx.finditer(blob)]
         if not spans:
             return []
@@ -328,8 +402,7 @@ class PackedSegment:
         # match individually — finditer never revisits them, so the batched
         # scan is unsound for this pattern; fall back to per-term matching
         if bool((in_range & (arr[:, 1] >= offs[idx + 1])).any()):
-            return [i for i in range(lo, hi)
-                    if pattern.fullmatch(self._term_at(i))]
+            return self._scan_scalar(src, pattern, lo, hi)
         # full-term matches only: begin at the term start (rejects mid-term
         # hits of patterns containing \n) and end at the term's own \n
         valid = (in_range & (arr[:, 0] == offs[idx])
@@ -346,6 +419,38 @@ class PackedSegment:
 
     def postings_all(self) -> np.ndarray:
         return np.arange(self.n_docs, dtype=np.uint32)
+
+    # -- device-resident ragged CSR (index/device.py consumes these) --
+
+    def postings_csr(self, term_idxs) -> tuple[np.ndarray, np.ndarray]:
+        """(starts, lens) int64 rows of the flat postings column for the
+        given absolute term indices — the host half of the ragged CSR
+        a device postings program consumes (the offsets stay host-side;
+        only the doc-id column lives on device)."""
+        term_idxs = np.asarray(term_idxs, np.int64)
+        starts = self._post_off[term_idxs].astype(np.int64)
+        lens = self._post_off[term_idxs + 1].astype(np.int64) - starts
+        return starts, lens
+
+    def device_postings(self):
+        """The flat doc-id postings column committed to device as int32,
+        built once per sealed segment and cached forever (the segment is
+        immutable, so seal/compaction time is the only transfer). Padded
+        to a half-octave bucket so similarly-sized segments share device
+        buffer shapes; the pad cells are never addressed by a valid CSR
+        row, and the fused program's gather clips into them only for
+        lanes it masks out anyway."""
+        col = self._device_postings
+        if col is None:
+            import jax.numpy as jnp
+
+            from m3_tpu.utils import dispatch
+
+            n = len(self._postings)
+            host = np.zeros(dispatch.next_bucket(max(n, 64)), np.int32)
+            host[:n] = self._postings
+            col = self._device_postings = jnp.asarray(host)
+        return col
 
     # -- persistence --
 

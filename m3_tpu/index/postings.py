@@ -32,11 +32,15 @@ def difference(a: np.ndarray, b: np.ndarray) -> np.ndarray:
 
 
 def union_many(lists: list[np.ndarray]) -> np.ndarray:
+    """N-way union as ONE concatenate + unique pass: O(N log N) on the
+    total element count, instead of the pairwise-reduce union's repeated
+    merge allocations (each intermediate is re-sorted and re-scanned)."""
+    lists = [p for p in lists if len(p)]
     if not lists:
         return EMPTY
     if len(lists) == 1:
-        return lists[0]
-    return np.unique(np.concatenate(lists))
+        return lists[0].astype(np.uint32, copy=False)
+    return np.unique(np.concatenate(lists)).astype(np.uint32, copy=False)
 
 
 def to_bitmap(p: np.ndarray, n_docs: int) -> np.ndarray:

@@ -114,12 +114,39 @@ class Segment:
 
     def postings_regexp(self, field: bytes, pattern: re.Pattern) -> np.ndarray:
         """Union of postings whose term fully matches the pattern — the
-        vocabulary scan standing in for FST-automaton intersection."""
+        vocabulary scan standing in for FST-automaton intersection,
+        narrowed first to the sorted-vocab range sharing the pattern's
+        anchored literal prefix (binary search, not a full scan) and then
+        by its literal suffix; ``fullmatch`` still decides membership, so
+        the narrowing can only skip terms that cannot match."""
+        from m3_tpu.metrics import filters
+
         f = self._fields.get(field)
         if not f:
             return P.EMPTY
         vocab, plists = f
-        hits = [plists[i] for i, v in enumerate(vocab) if pattern.fullmatch(v)]
+        src = pattern.pattern
+        if isinstance(src, str):
+            src = src.encode()
+        if pattern.flags & (re.IGNORECASE | re.VERBOSE):
+            # compile-time flags change what the literal bytes mean —
+            # byte-wise range/suffix narrowing would be unsound
+            src = b""
+        lo, hi = 0, len(vocab)
+        prefix = filters.literal_prefix(src)
+        if prefix:
+            lo = bisect_left(vocab, prefix)
+            upper = filters.prefix_upper_bound(prefix)
+            if upper:
+                hi = bisect_left(vocab, upper, lo)
+        suffix = filters.literal_suffix(src)
+        hits = [plists[i] for i in range(lo, hi)
+                if (not suffix or vocab[i].endswith(suffix))
+                and pattern.fullmatch(vocab[i])]
+        from m3_tpu.utils import querystats
+
+        querystats.record_index(terms_scanned=hi - lo,
+                                terms_prefiltered=len(vocab) - (hi - lo))
         return P.union_many(hits)
 
     def postings_field(self, field: bytes) -> np.ndarray:

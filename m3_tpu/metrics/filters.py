@@ -12,6 +12,57 @@ from __future__ import annotations
 import re
 from dataclasses import dataclass
 
+_META = re.compile(rb"[\\^$.|?*+()\[\]{}]")
+
+
+def literal_prefix(src: bytes) -> bytes:
+    """Longest literal prefix every match of ``src`` must start with.
+    Conservative: a top-level alternation anywhere kills the prefix, and
+    a quantifier after the last literal makes that literal optional, so
+    it is dropped. The index uses this to binary-search the sorted term
+    dictionary to a candidate range before any Python ``re`` runs."""
+    if b"|" in src:
+        return b""
+    m = _META.search(src)
+    if m is None:
+        return src
+    prefix = src[: m.start()]
+    if m.group() in (b"*", b"?", b"{") and prefix:
+        prefix = prefix[:-1]
+    return prefix
+
+
+def literal_suffix(src: bytes) -> bytes:
+    """Longest literal suffix every match of ``src`` must end with (the
+    mirror of literal_prefix; a shorter-than-true suffix is still sound
+    as a narrowing filter). An escape as the last metacharacter also
+    swallows the byte it escapes: ``\\d`` must not contribute ``d``. An
+    extension group anywhere (``(?i)``, ``(?i:...)``, lookarounds) kills
+    the suffix: inline flags can make the trailing literal match
+    case-insensitively, which byte-wise endswith narrowing would miss."""
+    if b"|" in src or b"(?" in src:
+        return b""
+    last = None
+    for m in _META.finditer(src):
+        last = m
+    if last is None:
+        return src
+    if last.group() == b"\\":
+        return src[last.end() + 1:]
+    return src[last.end():]
+
+
+def prefix_upper_bound(prefix: bytes) -> bytes:
+    """Smallest byte string greater than every extension of ``prefix``
+    (for the half-open vocab range [prefix, upper)); empty when no such
+    bound exists (prefix is all 0xFF)."""
+    upper = prefix
+    while upper and upper[-1] == 0xFF:
+        upper = upper[:-1]
+    if upper:
+        upper = upper[:-1] + bytes([upper[-1] + 1])
+    return upper
+
 
 def _glob_to_regex(glob: str) -> str:
     out = []

@@ -50,3 +50,35 @@ def pairwise_and(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 def cardinality(masks: jnp.ndarray) -> jnp.ndarray:
     """Set sizes of a [Q, W] batch -> [Q] int32."""
     return lax.population_count(masks).sum(axis=-1).astype(jnp.int32)
+
+
+# -- bool <-> word bridges for fused programs --------------------------------
+#
+# The device-compiled index (index/device.py) builds per-matcher doc
+# membership as boolean vectors (scatter-friendly), then runs its dense
+# intersect legs on uint64 words (population-count/AND/OR-friendly).
+# These helpers are meant to be TRACED INLINE inside a cached program —
+# they are not jit entry points themselves.
+
+
+def words_from_bool(bits):
+    """[..., N] bool -> [..., N/64] uint64 words (little-endian bit
+    order, matching postings.to_bitmap/from_bitmap). N must be a
+    multiple of 64 — the caller pads the doc axis to a word-aligned
+    shape bucket."""
+    u8 = jnp.packbits(bits, axis=-1, bitorder="little")
+    grouped = u8.reshape(u8.shape[:-1] + (u8.shape[-1] // 8, 8))
+    return lax.bitcast_convert_type(grouped, jnp.uint64)
+
+
+def and_reduce_words(words):
+    """AND-reduce [Q, W] uint64 -> [W] (the conjunct leg, traceable with
+    a leading axis of any static size, including zero -> all-ones)."""
+    return lax.reduce(words, jnp.uint64(~jnp.uint64(0)),
+                      lambda a, b: a & b, dimensions=(0,))
+
+
+def or_reduce_words(words):
+    """OR-reduce [Q, W] uint64 -> [W] (the disjunct leg)."""
+    return lax.reduce(words, jnp.uint64(0), lambda a, b: a | b,
+                      dimensions=(0,))

@@ -447,13 +447,10 @@ def clear_plan_cache() -> None:
 
 
 def _jax_ready() -> bool:
-    """Compile only when jax is importable WITHOUT risking a wedge: jax
-    already imported (ingest/encode initialized it), or the operator
-    explicitly forced the path (M3_TPU_QUERY_COMPILE=1 accepts the
-    import). Mirrors dispatch._accelerator_present's tunnel caution."""
-    if "jax" in sys.modules:
-        return True
-    return os.environ.get("M3_TPU_QUERY_COMPILE") == "1"
+    """Compile only when jax is importable WITHOUT risking a wedge —
+    the shared dispatch.jax_ready rung (jax already imported, or
+    M3_TPU_QUERY_COMPILE=1 explicitly accepts the import)."""
+    return dispatch.jax_ready("M3_TPU_QUERY_COMPILE")
 
 
 def _fallback(reason: str):

@@ -193,7 +193,10 @@ class Collector:
                       dict(st.decode_rungs), dict(st.node_legs),
                       dict(self._claimed),
                       (st.pipeline_groups, st.pipeline_wall_s,
-                       dict(st.pipeline_stage_s)))
+                       dict(st.pipeline_stage_s)),
+                      (st.index_segments, st.index_device_segments,
+                       dict(st.index_fallback), st.index_terms_scanned,
+                       st.index_terms_prefiltered, st.index_postings_rows))
         t0 = time.perf_counter()
         self._stack.append(entry)
         try:
@@ -208,7 +211,23 @@ class Collector:
 
     def _attribute(self, entry: dict, st, before) -> None:
         (series0, blocks0, bytes0, hits0, miss0, rungs0, legs0,
-         claimed0, pipe0) = before
+         claimed0, pipe0, idx0) = before
+        # postings-walk account this node's subtree accrued (the
+        # selector's label matching: index/executor.py + index/device.py)
+        iseg0, idev0, ifb0, iscan0, ipre0, irows0 = idx0
+        d_segs = st.index_segments - iseg0
+        if d_segs > 0:
+            d_fb = {r: c - ifb0.get(r, 0)
+                    for r, c in st.index_fallback.items()
+                    if c - ifb0.get(r, 0) > 0}
+            entry["index"] = {
+                "segments": d_segs,
+                "device_segments": st.index_device_segments - idev0,
+                "fallback": d_fb,
+                "terms_scanned": st.index_terms_scanned - iscan0,
+                "terms_prefiltered": st.index_terms_prefiltered - ipre0,
+                "postings_rows": st.index_postings_rows - irows0,
+            }
         # pipelined-dataflow overlap this node's subtree accrued: wall
         # time vs sum-of-stage time per group (storage/pipeline.py) —
         # the per-query proof that gather legs overlapped decode rungs

@@ -7,9 +7,12 @@ profiler-overhead guard: sampling profiler + lock-wait profiling +
 stall watchdog armed vs off, same pairing discipline as #7), #11
 (the sharded query plane: the same fused query_range + grouped
 aggregation on the series-sharded device mesh vs single-device, swept
-over device counts) and #12 (the pipelined dataflow: sparse
+over device counts), #12 (the pipelined dataflow: sparse
 multi-group read_many->query e2e, executor-pipelined vs the pinned
-serial seed path, pair-median, correctness-gated).
+serial seed path, pair-median, correctness-gated) and #14 (the
+device-compiled inverted index: boolean matcher evaluation at 1M/10M
+terms, fused ragged postings program vs the PR-0 scalar walk,
+pair-median, correctness-gated at every device count).
 
 Prints one JSON line per config (same shape as bench.py). Sizes are
 env-tunable; defaults are sized to finish on CPU in a few minutes —
@@ -1316,10 +1319,147 @@ def config13_paged_memory():
                 os.environ["M3_TPU_PIPELINE"] = prev_pipe
 
 
+def config14_matcher_postings():
+    """Device-compiled inverted index (ISSUE 16 / ROADMAP #4): boolean
+    label-matcher evaluation over one packed segment at 1M and 10M
+    terms — the fused ragged postings program (index/device.py: prefix-
+    narrowed term resolution + ONE jit'd AND/OR/NOT combine over CSR
+    rows) vs the PR-0 scalar walk reconstructed inline (per-term
+    ``re.fullmatch`` over the full field vocabulary, pairwise sorted-
+    array set ops).  Segment caches are cleared per evaluation so the
+    device side pays matcher RESOLUTION every time; only the program-
+    shape cache stays warm (that persistence is the design).  Pairing
+    discipline as #11 (interleaved pairs, median pair reported), swept
+    over the single-device and full virtual-mesh shard settings, and
+    correctness-gated: the device doc-id sets must equal the scalar
+    walk's exactly at every device count before anything is emitted."""
+    import functools as _ft
+    import re  # noqa: F401 - patterns below are compiled by the leaves
+
+    import jax
+
+    from m3_tpu.index import device, packed
+    from m3_tpu.index import postings as P
+    from m3_tpu.index.query import (
+        ConjunctionQuery, DisjunctionQuery, NegationQuery, RegexpQuery,
+        TermQuery,
+    )
+    from m3_tpu.index.segment import Document
+
+    def scalar_leaf(seg, leaf):
+        # the PR-0 walk: every term in the field pays a compiled-regex
+        # fullmatch, every matched term pays a pairwise union
+        if isinstance(leaf, TermQuery):
+            return seg.postings_term(leaf.field_name, leaf.value)
+        rx = leaf.compiled()
+        out = P.EMPTY
+        for t in seg.terms(leaf.field_name):
+            if rx.fullmatch(t):
+                out = P.union(out, seg.postings_term(leaf.field_name, t))
+        return out
+
+    def scalar_eval(seg, query):
+        if isinstance(query, DisjunctionQuery):
+            return _ft.reduce(P.union,
+                              (scalar_leaf(seg, q) for q in query.queries),
+                              P.EMPTY)
+        pos = [q for q in query.queries
+               if not isinstance(q, NegationQuery)]
+        acc = _ft.reduce(P.intersect,
+                         (scalar_leaf(seg, q) for q in pos))
+        for q in query.queries:
+            if isinstance(q, NegationQuery):
+                acc = P.difference(acc, scalar_leaf(seg, q.inner))
+        return acc
+
+    def device_eval(seg, query):
+        # resolution caches cleared: the device side re-pays term
+        # bisect/narrowed regex scan per evaluation, like a cold query
+        seg._regex_cache.clear()
+        seg._term_idx_cache.clear()
+        ids, reason = device.match(seg, query)
+        if reason is not None:
+            raise RuntimeError(f"unexpected fallback: {reason}")
+        return ids
+
+    n_devices = len(jax.devices())
+    prev = {k: os.environ.get(k)
+            for k in ("M3_TPU_DEVICE_OPS", "M3_TPU_INDEX_COMPILE",
+                      "M3_TPU_QUERY_SHARD")}
+    try:
+        # pin the dispatch hatches: the bench isolates the two paths,
+        # it does not re-test the work-threshold doctrine
+        os.environ["M3_TPU_DEVICE_OPS"] = "1"
+        os.environ["M3_TPU_INDEX_COMPILE"] = "1"
+        for n in (max(int(1_000_000 * _scale()), 50_000),
+                  max(int(10_000_000 * _scale()), 200_000)):
+            seg = packed.build([
+                Document(i, b"s%08d" % i,
+                         [(b"pod", b"pod-%08d" % i),
+                          (b"dc", b"dc-%d" % (i % 4)),
+                          (b"app", b"app-%03d" % (i % 50))])
+                for i in range(n)])
+            # fixed-selectivity shapes (10k regex-matched terms at any
+            # n >= 50k): conj regex+term, disj of regexes, conj with NOT
+            queries = [
+                ConjunctionQuery((RegexpQuery(b"pod", rb"pod-0000\d+"),
+                                  TermQuery(b"dc", b"dc-1"))),
+                DisjunctionQuery((RegexpQuery(b"pod", rb"pod-00001\d+"),
+                                  RegexpQuery(b"pod", rb"pod-00002\d+"))),
+                ConjunctionQuery((TermQuery(b"dc", b"dc-2"),
+                                  NegationQuery(
+                                      TermQuery(b"app", b"app-007")))),
+            ]
+            want = [scalar_eval(seg, q) for q in queries]
+            shards = ["0"] + ([str(n_devices)] if n_devices > 1 else [])
+            ok = True
+            for shard in shards:  # gate at every device count
+                os.environ["M3_TPU_QUERY_SHARD"] = shard
+                got = [device_eval(seg, q) for q in queries]
+                ok = ok and all(
+                    np.array_equal(g.astype(np.int64), w.astype(np.int64))
+                    for g, w in zip(got, want))
+            n_dp = len(queries) * n
+            sweep: list[str] = []
+            headline = None
+            for shard in shards:
+                os.environ["M3_TPU_QUERY_SHARD"] = shard
+                tag = "1dev" if shard == "0" else f"{shard}dev"
+                # this mesh's executables were compiled by the gate pass;
+                # interleaved pairs below measure steady-state serving
+                pairs: list[tuple[float, float, float]] = []
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    for q in queries:
+                        device_eval(seg, q)
+                    dt_d = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    for q in queries:
+                        scalar_eval(seg, q)
+                    dt_h = time.perf_counter() - t0
+                    pairs.append((dt_h / dt_d, n_dp / dt_d, n_dp / dt_h))
+                pairs.sort(key=lambda p: p[0])
+                med = pairs[len(pairs) // 2]
+                sweep.append(f"{tag}:{med[0]:.2f}x")
+                headline = med  # widest mesh is the recorded headline
+            _ratio, thr_d, thr_h = headline
+            _emit(f"#14 matcher postings {n}-term segment [3 boolean "
+                  f"matcher queries, fused device program vs PR-0 scalar "
+                  f"walk; sweep {' '.join(sweep)}]"
+                  + ("" if ok else " (CORRECTNESS FAILED)"),
+                  thr_d, thr_h)
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def main(argv=None) -> None:
     global _ACCEL
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13,14")
     ap.add_argument("--record", default=None,
                     help="also append the JSON lines to this file")
     args = ap.parse_args(argv)
@@ -1348,7 +1488,7 @@ def main(argv=None) -> None:
            "7": config7_tracing_overhead, "8": config8_write_batch,
            "9": config9_query_compile, "10": config10_profiler_overhead,
            "11": config11_sharded_query, "12": config12_pipelined_read,
-           "13": config13_paged_memory}
+           "13": config13_paged_memory, "14": config14_matcher_postings}
     for c in args.configs.split(","):
         c = c.strip()
         try:

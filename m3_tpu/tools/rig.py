@@ -75,7 +75,7 @@ class RigConfig:
                  batch_size: int = 24, burst_every: int = 8,
                  burst_mult: int = 4, write_interval_s: float = 0.05,
                  query_interval_s: float = 0.08, duration_s: float = 10.0,
-                 slo_p99_ms: float = 2000.0):
+                 slo_p99_ms: float = 2000.0, churn_per_batch: int = 0):
         self.seed = seed
         self.tenants = tuple(tenants)
         self.zipf_s = zipf_s
@@ -87,6 +87,11 @@ class RigConfig:
         self.query_interval_s = query_interval_s
         self.duration_s = duration_s
         self.slo_p99_ms = slo_p99_ms
+        # cardinality-explosion shape: this many entries of every batch
+        # carry a monotonically-unique `churn` tag, so each one mints a
+        # brand-NEW series (continuous index ingest + segment churn — the
+        # episode that must not blow up read latency)
+        self.churn_per_batch = churn_per_batch
 
 
 class TrafficGen:
@@ -101,6 +106,7 @@ class TrafficGen:
         self.rng = random.Random(f"rig-traffic:{cfg.seed}")
         self._weights = zipf_weights(len(cfg.tenants), cfg.zipf_s)
         self._batches = 0
+        self._minted = 0  # monotonic: a churn tag value never repeats
 
     def pick_tenant(self) -> str:
         i = self.rng.choices(range(len(self.cfg.tenants)),
@@ -126,6 +132,11 @@ class TrafficGen:
             name = f"rig_metric_{sid}".encode()
             tags = ((b"tenant", tenant.encode()),
                     (b"sid", str(sid).encode()))
+            if k < self.cfg.churn_per_batch:
+                # cardinality explosion: a never-repeating tag value
+                # makes this entry a brand-new series every time
+                tags += ((b"churn", b"c%08d" % self._minted),)
+                self._minted += 1
             # 1us spacing keeps timestamps unique inside one batch (LWW
             # dedup must never collapse two ledgered datapoints)
             entries.append((name, tags, t_ns + k * 1000,

@@ -56,6 +56,21 @@ def _accelerator_present() -> bool:
     return _accel_cache
 
 
+def jax_ready(force_env: str = "M3_TPU_QUERY_COMPILE") -> bool:
+    """True when a serving path may touch jax WITHOUT risking a wedge:
+    jax is already imported (the ingest/encode pipeline or service
+    startup initialized it), or the operator explicitly forced the path
+    (``force_env=1`` accepts the import). The shared rung under the
+    whole-query compiler and the device-compiled index — mirrors
+    _accelerator_present's dead-tunnel caution: a query thread must
+    never be the first importer."""
+    import sys
+
+    if "jax" in sys.modules:
+        return True
+    return os.environ.get(force_env) == "1"
+
+
 def use_device(n: int, threshold: int = DEFAULT_DEVICE_THRESHOLD) -> bool:
     force = os.environ.get("M3_TPU_DEVICE_OPS")
     if force == "1":

@@ -57,6 +57,12 @@ HISTOGRAMS = {
     #                             at every ragged seal)
     "hot_tier_entry_bytes",     # storage.hot_tier: resident bytes of one
     #                             prepared-slab entry at admission
+    # device-compiled inverted index (ROADMAP #4)
+    "postings_seconds",         # compute.index: wall time of one fused
+    #                             postings-program call (index/device.py;
+    #                             a shape-cache miss includes compile —
+    #                             compute.jit{op=postings_program} splits
+    #                             hit/miss and compile time out)
 }
 
 TIMERS = {
@@ -94,3 +100,15 @@ TIMERS = {
 #   storage_hot_tier_hit / storage_hot_tier_miss     per-query counters
 #       (compiled path; the same outcome rides the ?explain=analyze
 #       hot_tier block)
+#
+# Device-compiled inverted index (ROADMAP #4), compute.index scope:
+#   compute_index_device                       segments whose boolean
+#       postings algebra ran as ONE fused ragged program
+#       (index/device.py match)
+#   compute_index_fallback {reason=...}        segments that took the
+#       counted scalar walk instead — reason is one of
+#       unpacked_segment / nested_boolean / trivial_query /
+#       jax_not_ready / small_work; the same split rides the
+#       ?explain=analyze `index` block per query
+# plus the dispatch-layer tallies index.postings[device|host] and
+# jit_postings_program[hit|miss] on /debug counters.

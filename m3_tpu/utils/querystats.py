@@ -66,6 +66,18 @@ class QueryStats:
     pipeline_groups: int = 0
     pipeline_wall_s: float = 0.0
     pipeline_stage_s: dict = field(default_factory=dict)
+    # device-compiled inverted index (index/device.py): segments the
+    # postings walk visited, how many ran the fused device program vs
+    # fell back to the scalar walk (and why), the term-dictionary scan
+    # account (terms regex-scanned vs skipped by literal prefix/suffix
+    # narrowing) and postings rows fed to the intersect legs — the
+    # ?explain=analyze `index` block
+    index_segments: int = 0
+    index_device_segments: int = 0
+    index_fallback: dict = field(default_factory=dict)  # reason -> segments
+    index_terms_scanned: int = 0
+    index_terms_prefiltered: int = 0
+    index_postings_rows: int = 0
     duration_s: float = 0.0
 
     def to_dict(self) -> dict:
@@ -88,6 +100,8 @@ class QueryStats:
                 host: {"calls": c, "ms": round(s * 1e3, 3), "rows": r}
                 for host, (c, s, r) in self.node_legs.items()
             }
+        if self.index_segments:
+            out["index"] = self.index_block()
         if self.pipeline_groups:
             stage_sum = sum(self.pipeline_stage_s.values())
             out["pipeline"] = {
@@ -101,6 +115,17 @@ class QueryStats:
                 if self.pipeline_wall_s > 0 else 0.0,
             }
         return out
+
+    def index_block(self) -> dict:
+        """The rendered ?explain=analyze / stats-envelope `index` doc."""
+        return {
+            "segments": self.index_segments,
+            "device_segments": self.index_device_segments,
+            "fallback": dict(self.index_fallback),
+            "terms_scanned": self.index_terms_scanned,
+            "terms_prefiltered": self.index_terms_prefiltered,
+            "postings_rows": self.index_postings_rows,
+        }
 
 
 _tls = threading.local()
@@ -248,6 +273,27 @@ def record_pipeline(groups: int, wall_s: float, stages: dict) -> None:
         st.pipeline_stage_s[stage] = st.pipeline_stage_s.get(stage, 0.0) + dt
 
 
+def record_index(segments: int = 0, device_segments: int = 0,
+                 fallback: str | None = None, terms_scanned: int = 0,
+                 terms_prefiltered: int = 0,
+                 postings_rows: int = 0) -> None:
+    """Accrue one postings-walk account (index/executor.py) onto the
+    active query's record: segments visited, device-program vs
+    scalar-fallback outcomes (with the fallback reason), the term
+    dictionary scan/prefilter split and postings rows intersected — the
+    ?explain=analyze `index` block. No-op outside a query."""
+    st = getattr(_tls, "current", None)
+    if st is None:
+        return
+    st.index_segments += segments
+    st.index_device_segments += device_segments
+    if fallback is not None:
+        st.index_fallback[fallback] = st.index_fallback.get(fallback, 0) + 1
+    st.index_terms_scanned += terms_scanned
+    st.index_terms_prefiltered += terms_prefiltered
+    st.index_postings_rows += postings_rows
+
+
 def record_node_leg(leg: str, seconds: float, rows: int = 0) -> None:
     """Accrue one remote leg (storage-node RPC, fanout zone) onto the
     active query's record: EXPLAIN ANALYZE shows each node's share of a
@@ -281,6 +327,8 @@ def storage_counters(st: QueryStats) -> dict:
     out = {"series": st.series_matched, "blocks": st.blocks_read,
            "bytes": st.bytes_decoded, "cache_hits": st.cache_hits,
            "cache_misses": st.cache_misses, "rungs": dict(st.decode_rungs)}
+    if st.index_segments:
+        out["index"] = st.index_block()
     if st.pipeline_groups:
         out["pipeline"] = {"groups": st.pipeline_groups,
                            "wall_s": st.pipeline_wall_s,
@@ -303,6 +351,16 @@ def merge_storage(doc: dict | None) -> None:
     st.cache_misses += int(doc.get("cache_misses", 0))
     for rung, cnt in (doc.get("rungs") or {}).items():
         st.decode_rungs[rung] = st.decode_rungs.get(rung, 0) + int(cnt)
+    idx = doc.get("index")
+    if idx:
+        st.index_segments += int(idx.get("segments", 0))
+        st.index_device_segments += int(idx.get("device_segments", 0))
+        for reason, cnt in (idx.get("fallback") or {}).items():
+            st.index_fallback[reason] = \
+                st.index_fallback.get(reason, 0) + int(cnt)
+        st.index_terms_scanned += int(idx.get("terms_scanned", 0))
+        st.index_terms_prefiltered += int(idx.get("terms_prefiltered", 0))
+        st.index_postings_rows += int(idx.get("postings_rows", 0))
     pipe = doc.get("pipeline")
     if pipe:
         record_pipeline(int(pipe.get("groups", 0)),

@@ -1,0 +1,34 @@
+"""MUST-FLAG: the naive per-query postings compiler — what the
+device-compiled inverted index (index/device.py) must NOT look like. A
+matcher evaluator that builds ``jax.jit`` inside its match path pays one
+trace+XLA-compile PER QUERY, and feeding the jitted program exact
+per-matcher selection shapes makes every distinct regex a fresh
+executable on top (the recompile storm on a million-term dictionary)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _combine(words):
+    return jnp.bitwise_and.reduce(words, axis=0)
+
+
+class NaivePostingsIndex:
+    """Per-call jit construction in the matcher dispatch path."""
+
+    def match(self, words):
+        # jax-jit-per-call: a fresh traced callable (and compile) every
+        # query — no lru_cache factory keyed on the matcher signature
+        program = jax.jit(_combine)
+        return program(words)
+
+    def match_many(self, selections):
+        out = []
+        for i in range(len(selections)):
+            # jax-varying-static: per-iteration slice = a new postings
+            # shape = a new compile per matcher, unbounded
+            out.append(combine_stage(selections[:i]))
+        return out
+
+
+combine_stage = jax.jit(_combine)

@@ -1,0 +1,59 @@
+"""MUST-PASS: the blessed postings-program cache — the shape
+index/device.py actually uses. ONE ``functools.lru_cache`` factory per
+matcher-shape signature (n_pos, n_neg, conjunction), static half-octave
+buckets for the ragged postings/doc axes passed via ``static_argnames``,
+and the flat doc-id column committed to device once per immutable
+segment — so jax's executable cache stays O(log) per axis instead of
+one entry per (query, segment) pair."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def _program(n_pos: int, n_neg: int, conjunction: bool):
+    """ONE jit'd fused postings program per matcher-shape signature."""
+
+    def run(col, starts, lens, *, lb, npad):
+        def member(starts_m, lens_m):
+            k = starts_m.shape[0]
+            rid = jnp.repeat(jnp.arange(k, dtype=jnp.int32), lens_m,
+                             total_repeat_length=lb)
+            lane = jnp.arange(lb, dtype=jnp.int32)
+            cum = jnp.cumsum(lens_m) - lens_m
+            idx = starts_m[rid] + (lane - cum[rid])
+            ids = col[jnp.clip(idx, 0, col.shape[0] - 1)]
+            tgt = jnp.where(lane < lens_m.sum(), ids, npad - 1)
+            return jnp.zeros(npad, jnp.bool_).at[tgt].set(True)
+
+        bits = jax.vmap(member)(starts, lens)
+        acc = bits[:n_pos].all(axis=0) if conjunction \
+            else bits[:n_pos].any(axis=0)
+        if n_neg:
+            acc = acc & ~bits[n_pos:].any(axis=0)
+        return acc
+
+    return jax.jit(run, static_argnames=("lb", "npad"))
+
+
+def _bucket(n: int) -> int:
+    p = 1 << max(n - 1, 1).bit_length()
+    half = 3 * p // 4
+    return half if 0 < n <= half else p
+
+
+class CompiledPostingsIndex:
+    def __init__(self, column):
+        # committed once per immutable segment, reused by every query
+        self._col = jnp.asarray(column)
+
+    def match(self, starts, lens, n_pos, conjunction):
+        lb = _bucket(max(int(lens.sum(axis=1).max()), 64))
+        npad = _bucket(len(self._col) + 1)
+        prog = _program(n_pos, len(starts) - n_pos, conjunction)
+        acc = prog(self._col, jnp.asarray(starts), jnp.asarray(lens),
+                   lb=lb, npad=npad)
+        return np.nonzero(np.asarray(acc))[0]

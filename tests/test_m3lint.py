@@ -128,6 +128,22 @@ class TestJaxRules:
         # signature + bounded keyed plan cache + pow2 shape buckets
         assert run_lint("jax_plan_pass.py", select=("jax-",)) == []
 
+    def test_naive_postings_compiler_flags(self):
+        """The device-compiled index hazard (ROADMAP #4): jit built
+        inside the matcher dispatch path, and exact per-matcher shapes
+        fed to a jitted combine in a loop, must both fail the gate."""
+        fs = run_lint("jax_postings_flag.py", select=("jax-",))
+        assert rules_of(fs) == {"jax-jit-per-call", "jax-varying-static"}
+        msgs = "\n".join(f.message for f in fs)
+        assert "match" in msgs  # the per-call construction site
+        assert "combine_stage" in msgs  # the per-iteration shape bucket
+
+    def test_blessed_postings_compiler_passes(self):
+        # the index/device.py shape: lru_cache program factory per
+        # matcher signature + static_argnames shape buckets + a column
+        # committed once per immutable segment
+        assert run_lint("jax_postings_pass.py", select=("jax-",)) == []
+
     def test_per_eval_sharding_construction_flags(self):
         """The sharded compute plane's twin hazard (ROADMAP #1): a Mesh
         or NamedSharding constructed inside an eval path is a fresh

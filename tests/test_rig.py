@@ -290,6 +290,71 @@ class TestInProcessRigSmoke:
 
 
 # ---------------------------------------------------------------------------
+# cardinality-explosion episode (tier-1, in-process): index churn under
+# live reads — the ISSUE-16 rig lane for the device-compiled index
+
+
+class TestCardinalityChurnEpisode:
+    """A tenant whose writes keep minting brand-new series (the
+    ``churn_per_batch`` knob: monotonically-unique churn tags) drives
+    continuous index ingest and segment churn. The episode's claim: the
+    read path stays bounded — client p99 holds under the explosion, no
+    query errors — while the live-series population multiplies."""
+
+    def test_churn_minting_deterministic_and_unique(self):
+        cfg = RigConfig(seed=9, tenants=("a", "b"), batch_size=8,
+                        churn_per_batch=4)
+        g1, g2 = TrafficGen(cfg), TrafficGen(cfg)
+        seen = set()
+        minted = 0
+        for _ in range(30):
+            batch = g1.next_batch(0)
+            assert batch == g2.next_batch(0)  # same seed, same sequence
+            for name, tags, _t, _v in batch[1]:
+                if b"churn" in dict(tags):
+                    minted += 1
+                    seen.add((name, tags))
+        # every churn entry is a NEW series identity, never a repeat
+        assert minted >= 30 * cfg.churn_per_batch
+        assert len(seen) == minted
+
+    def test_bounded_read_p99_under_index_churn(self, tmp_path):
+        from m3_tpu.query.api import CoordinatorAPI
+        from m3_tpu.storage import limits as storage_limits
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.options import DatabaseOptions
+
+        db = Database(str(tmp_path / "data"), DatabaseOptions(n_shards=2))
+        db.create_namespace("churnT")
+        db.open()
+        api = CoordinatorAPI(db, "churnT")
+        try:
+            before = storage_limits.live_series(db, "churnT")
+            cfg = RigConfig(seed=77, tenants=("churnT",), zipf_s=1.0,
+                            series_per_tenant=8, batch_size=16,
+                            churn_per_batch=12, write_interval_s=0.01,
+                            query_interval_s=0.02, duration_s=2.5)
+            rig = Rig(cfg, rigmod.db_write_fn(db), rigmod.api_query_fn(api))
+            report = rig.run()
+            after = storage_limits.live_series(db, "churnT")
+
+            # the explosion actually happened: the live-series population
+            # grew by hundreds of freshly minted identities
+            assert after - before > 300
+            st = report["tenants"]["churnT"]
+            assert st["writes_acked"] > 500 and st["write_errors"] == 0
+
+            # and reads stayed healthy THROUGH the churn: all served, no
+            # errors, client p99 inside the default SLO bound
+            assert st["queries_ok"] > 20
+            assert st["query_errors"] == 0
+            assert st["client_p99_ms"] is not None
+            assert st["client_p99_ms"] < cfg.slo_p99_ms
+        finally:
+            db.close()
+
+
+# ---------------------------------------------------------------------------
 # process-level chaos lane (`run_tests.sh rig`; marked chaos -> not tier-1)
 
 
