@@ -109,6 +109,9 @@ class ClusterDatabase:
         self.namespaces = _Namespaces(self)
         self.limits = None
         self._open = True
+        # placement hot-swap (client/topology_watch.py): set by
+        # watch_placement; closed with the facade
+        self._placement_watcher = None
         # namespace -> NamespaceOptions mirrored from the KV registry (the
         # coordinator syncs it); gives retention-tier read resolution its
         # retention/resolution metadata in cluster mode
@@ -131,6 +134,21 @@ class ClusterDatabase:
         must stop fanning out to it)."""
         self._ns_opts.pop(name, None)
         self.namespaces.pop(name, None)
+
+    def watch_placement(self, kv, key: str | None = None,
+                        connection_factory=None):
+        """Attach a version-gated placement watcher to this facade's
+        session (client/topology_watch.py): a topology change atomically
+        swaps the session's map so writes dual-route through handoffs and
+        reads follow the new replica set. Tick-driven holders (the
+        coordinator) call .poll(); holders without a tick call .start().
+        Returns the watcher."""
+        from m3_tpu.client.topology_watch import PlacementWatcher
+
+        self._placement_watcher = PlacementWatcher(
+            kv, self.session, key=key,
+            connection_factory=connection_factory)
+        return self._placement_watcher
 
     # -- write path (quorum fan-out) --
 
@@ -191,6 +209,8 @@ class ClusterDatabase:
         return {"flushed": 0, "expired": 0}
 
     def close(self) -> None:
+        if self._placement_watcher is not None:
+            self._placement_watcher.stop()
         for conn in self.session.connections.values():
             close = getattr(conn, "close", None)
             if close:

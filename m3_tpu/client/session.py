@@ -231,8 +231,10 @@ class Session:
         finally:
             observe(_time.perf_counter() - t0)
 
-    def _shard(self, series_id: bytes) -> int:
-        return murmur3_32(series_id, self.shard_seed) % self.topology.n_shards
+    def _shard(self, series_id: bytes, topology: TopologyMap | None = None
+               ) -> int:
+        topo = topology if topology is not None else self.topology
+        return murmur3_32(series_id, self.shard_seed) % topo.n_shards
 
     # -- write path --
 
@@ -241,8 +243,13 @@ class Session:
         from m3_tpu.utils.ident import tags_to_id
 
         series_id = tags_to_id(metric_name, tags)
-        shard = self._shard(series_id)
-        hosts = self.topology.hosts_for_shard(shard)
+        # capture ONCE: a placement hot-swap (topology_watch) mid-call must
+        # not mix two maps' routing within one write. The captured map
+        # dual-routes to INITIALIZING and LEAVING replicas during handoff
+        # (hosts_for_shard spans all states) so no window is unowned.
+        topo = self.topology
+        shard = self._shard(series_id, topo)
+        hosts = topo.hosts_for_shard(shard)
         result = WriteResult(acks=0)
         for host in hosts:
             conn = self.connections.get(host)
@@ -261,7 +268,7 @@ class Session:
                 raise
             except Exception as e:  # per-host failure feeds the accumulator
                 result.errors.append((host, e))
-        need = required_acks(self.write_consistency, self.topology.replica_factor)
+        need = required_acks(self.write_consistency, topo.replica_factor)
         if result.acks < need:
             raise ConsistencyError(
                 f"write got {result.acks}/{need} acks "
@@ -283,23 +290,23 @@ class Session:
         restores the old all-or-raise surface on top)."""
         from m3_tpu.utils.ident import tags_to_id
 
-        need = required_acks(self.write_consistency,
-                             self.topology.replica_factor)
+        topo = self.topology  # one map for the whole batch (hot-swap safe)
+        need = required_acks(self.write_consistency, topo.replica_factor)
         shard_of = []
         for metric_name, tags, t_ns, value in entries:
-            shard_of.append(self._shard(tags_to_id(metric_name, tags)))
+            shard_of.append(self._shard(tags_to_id(metric_name, tags), topo))
         acks = [0] * len(entries)
         errors: list[tuple[str, object]] = []
         # replicas present in the placement but missing a connection can
         # never ack; record them so a quorum failure names its cause
         needed_shards = set(shard_of)
         for host in sorted({
-            h for s in needed_shards for h in self.topology.hosts_for_shard(s)
+            h for s in needed_shards for h in topo.hosts_for_shard(s)
         }):
             if host not in self.connections:
                 errors.append((host, ConnectionError(f"no connection to {host}")))
         for host, conn in self.connections.items():
-            inst = self.topology.placement.instances.get(host)
+            inst = topo.placement.instances.get(host)
             owned = set(inst.shards) if inst else set()
             idxs = [i for i, s in enumerate(shard_of) if s in owned]
             if not idxs:
@@ -350,8 +357,9 @@ class Session:
         ReadWarnings (self.last_warnings / the warnings out-param), not
         errors."""
         self.last_warnings = []  # never serve a prior call's warnings
-        shard = self._shard(series_id)
-        hosts = self.topology.readable_hosts_for_shard(shard)
+        topo = self.topology  # hot-swap safe: one map per call
+        shard = self._shard(series_id, topo)
+        hosts = topo.readable_hosts_for_shard(shard)
         if not hosts:
             raise ConsistencyError(f"no readable replicas for shard {shard}")
         # unstrict levels are satisfied by ANY successful replica read
@@ -359,7 +367,7 @@ class Session:
         if is_unstrict(self.read_consistency):
             need = 1
         else:
-            need = required_acks(self.read_consistency, self.topology.replica_factor)
+            need = required_acks(self.read_consistency, topo.replica_factor)
         parts_t, parts_v = [], []
         successes = 0
         errors = []
@@ -450,12 +458,12 @@ class Session:
     def _fetch_many_traced(self, namespace, series_ids, start_ns, end_ns,
                            warnings):
         self.last_warnings = []  # never serve a prior call's warnings
+        topo = self.topology  # hot-swap safe: one map for the whole batch
         if is_unstrict(self.read_consistency):
             need = 1
         else:
-            need = required_acks(self.read_consistency,
-                                 self.topology.replica_factor)
-        shard_of = {sid: self._shard(sid) for sid in series_ids}
+            need = required_acks(self.read_consistency, topo.replica_factor)
+        shard_of = {sid: self._shard(sid, topo) for sid in series_ids}
         successes = {sid: 0 for sid in series_ids}
         parts: dict[bytes, list] = {sid: [] for sid in series_ids}
         replica_sums: dict[bytes, set[int]] = {}
@@ -473,7 +481,7 @@ class Session:
         # injection schedule must stay deterministic under seeded chaos.
         legs = []
         for host, conn in self.connections.items():
-            readable = self._readable_shards_of(host)
+            readable = self._readable_shards_of(host, topo)
             want = [sid for sid in series_ids if shard_of[sid] in readable]
             if want:
                 legs.append((host, conn, want,
@@ -600,10 +608,12 @@ class Session:
 
     # -- index scatter/gather (the FetchTagged fan-out, session.go:1585) --
 
-    def _readable_shards_of(self, host: str) -> set[int]:
+    def _readable_shards_of(self, host: str,
+                            topology: TopologyMap | None = None) -> set[int]:
         from m3_tpu.cluster.placement import ShardState
 
-        inst = self.topology.placement.instances.get(host)
+        topo = topology if topology is not None else self.topology
+        inst = topo.placement.instances.get(host)
         if inst is None:
             return set()
         return {
@@ -620,11 +630,12 @@ class Session:
         from m3_tpu.index.segment import Document
 
         doc = query_to_json(query)
+        topo = self.topology  # hot-swap safe: one map per scatter/gather
         covered: set[int] = set()
         merged: dict[bytes, list] = {}
         errors = []
         for host, conn in self.connections.items():
-            shards = self._readable_shards_of(host)
+            shards = self._readable_shards_of(host, topo)
             if not shards:
                 continue
             if shards and shards <= covered:
@@ -641,7 +652,7 @@ class Session:
             covered |= shards
             for sid, fields in rows:
                 merged.setdefault(sid, fields)
-        missing = set(range(self.topology.n_shards)) - covered
+        missing = set(range(topo.n_shards)) - covered
         if missing:
             raise ConsistencyError(
                 f"index query missing shards {sorted(missing)[:8]}... "
@@ -659,8 +670,9 @@ class Session:
         out: set[bytes] = set()
         errors = []
         covered: set[int] = set()
+        topo = self.topology  # hot-swap safe: one map per union
         for host, conn in self.connections.items():
-            shards = self._readable_shards_of(host)
+            shards = self._readable_shards_of(host, topo)
             if not shards:
                 continue
             if shards <= covered:
@@ -673,7 +685,7 @@ class Session:
                 raise
             except Exception as e:  # noqa: BLE001
                 errors.append((host, e))
-        missing = set(range(self.topology.n_shards)) - covered
+        missing = set(range(topo.n_shards)) - covered
         if missing:
             raise ConsistencyError(
                 f"{fn_name} missing shards {sorted(missing)[:8]} "

@@ -1,0 +1,157 @@
+"""Placement hot-swap for Session holders: the version-gated watcher.
+
+Role parity with the reference's topology watch
+(/root/reference/src/dbnode/topology/dynamic.go — client sessions hold a
+watchable topology map and atomically swap to a new one on placement
+changes). Until PR 17 only the coordinator's tick did this
+(`_refresh_topology`); every other Session holder (the rig's load
+clients, embedded harnesses, ClusterDatabase built outside the
+coordinator) kept the `TopologyMap` it was constructed with forever — a
+placement change under live load routed writes at dead or drained nodes.
+
+One discipline, shared everywhere:
+
+- **Version-gated.** `poll()` keys on the placement's KV VERSION (the
+  `sync_namespaces` discipline): no change, no work — a poll on a quiet
+  cluster is one KV read.
+- **Atomic swap.** The rebuilt `TopologyMap` replaces
+  ``session.topology`` in a single reference assignment; Session methods
+  capture the map once at entry, so in-flight ops finish on the map they
+  started with while new ops route on the new one. During a handoff the
+  map dual-routes writes to INITIALIZING **and** LEAVING replicas
+  (`hosts_for_shard` spans all states) so no window is unowned, and
+  reads prefer AVAILABLE/LEAVING.
+- **Lazy connection reconcile.** New/re-endpointed instances get fresh
+  connections from the caller's factory; removed instances' connections
+  close. Breaker state rides the existing per-host policies — a swapped
+  host earns trust the same way a recovered one does.
+
+`poll()` for tick-driven callers (the coordinator), `start()`/`stop()`
+for a background thread (the rig's live-load sessions). Each successful
+swap publishes the `session_topology_version` gauge.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from m3_tpu.utils import faults
+from m3_tpu.utils.instrument import Logger, default_registry
+
+_scope = default_registry().root_scope("session")
+
+
+class PlacementWatcher:
+    """Watch one placement KV key and hot-swap a Session's topology.
+
+    ``connection_factory(endpoint) -> NodeConnection`` builds transports
+    for instances the session lacks; None (in-process harnesses) keeps
+    the existing connection dict untouched apart from the swap."""
+
+    def __init__(self, kv, session, key: str | None = None,
+                 connection_factory=None):
+        from m3_tpu.cluster import placement as pl
+
+        self.kv = kv
+        self.session = session
+        self.key = key or pl.PLACEMENT_KEY
+        self.connection_factory = connection_factory
+        self.version = -1
+        self.log = Logger("topology")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def poll(self) -> bool:
+        """One version-gated check; True when the topology swapped."""
+        from m3_tpu.cluster import placement as pl
+        from m3_tpu.cluster.topology import TopologyMap
+
+        if hasattr(self.kv, "refresh"):
+            # cross-process KV (file-backed): observe other processes'
+            # placement writes even without a local tick driving refresh
+            self.kv.refresh()
+        loaded = pl.load_placement(self.kv, self.key)
+        if loaded is None:
+            return False
+        p, kv_version = loaded
+        if kv_version == self.version:
+            return False
+        self._reconcile_connections(p)
+        # the atomic hot-swap: one reference assignment — in-flight ops
+        # captured the old map at entry and drain on it
+        self.session.topology = TopologyMap(p)
+        self.version = kv_version
+        _scope.gauge("topology_version", kv_version)
+        self.log.info("topology swapped", version=kv_version,
+                      instances=len(p.instances))
+        return True
+
+    def _reconcile_connections(self, p) -> None:
+        if self.connection_factory is None:
+            return
+        conns = self.session.connections
+        for iid, inst in p.instances.items():
+            if not inst.endpoint:
+                continue
+            cur = conns.get(iid)
+            if cur is not None and not self._endpoint_matches(cur,
+                                                              inst.endpoint):
+                close = getattr(cur, "close", None)
+                if close:
+                    close()  # instance restarted on a new endpoint
+                cur = None
+            if cur is None:
+                conns[iid] = self.connection_factory(inst.endpoint)
+        for iid in list(conns):
+            if iid not in p.instances:
+                conn = conns.pop(iid)
+                close = getattr(conn, "close", None)
+                if close:
+                    close()
+
+    @staticmethod
+    def _endpoint_matches(conn, endpoint: str) -> bool:
+        """Does an existing connection already point at this endpoint?
+        Transports without host/port attributes (test doubles) are never
+        churned."""
+        from m3_tpu.client.http_conn import parse_endpoint
+
+        host = getattr(conn, "host", None)
+        port = getattr(conn, "port", None)
+        if host is None or port is None:
+            return True
+        try:
+            return (host, port) == parse_endpoint(endpoint)
+        except (ValueError, TypeError):
+            # unparseable endpoint: keep the existing connection rather
+            # than churning on bad metadata
+            return True
+
+    # -- background polling (sessions without a tick of their own) ----------
+
+    def start(self, interval_s: float = 1.0) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, args=(float(interval_s),), daemon=True,
+            name="placement-watch")
+        self._thread.start()
+
+    def _run(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.poll()
+            except faults.SimulatedCrash:
+                faults.escalate()  # our own injected death, not a KV error
+                raise
+            except Exception as e:  # noqa: BLE001 - a KV hiccup must not
+                # kill the watch; the next poll retries
+                self.log.info("placement poll failed; retrying",
+                              error=str(e))
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+            self._thread = None

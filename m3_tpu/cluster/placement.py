@@ -249,6 +249,12 @@ def remove_instance(p: Placement, instance_id: str,
             # — no INITIALIZING shard links back via source_id)
             del leaving.shards[sid]
             continue
+        if len(owners) >= out.replica_factor:
+            # a prior move (add/replace) already has this shard's
+            # replacement INITIALIZING elsewhere; assigning another owner
+            # would over-replicate. The leaver's LEAVING copy stays until
+            # that in-flight move cuts over and reaps it.
+            continue
         exclude = {i.id for i in owners} | {instance_id}
         if within_subcluster:
             exclude |= {i.id for i in out.instances.values()
@@ -284,8 +290,14 @@ def replace_instance(p: Placement, old_id: str, new: Instance) -> Placement:
     if old is None:
         raise KeyError(old_id)
     new_inst = _bare_copy(new)
+    # inherit only the shards the old instance was SERVING: a shard it
+    # was already handing off (LEAVING) has its replacement INITIALIZING
+    # elsewhere — inheriting it too would over-replicate, and the
+    # in-flight owner keeps its original source_id (mark_available reaps
+    # the old instance's LEAVING copy when that move completes)
     new_inst.shards = {
-        sid: Shard(sid, ShardState.INITIALIZING, old_id) for sid in old.shards
+        sid: Shard(sid, ShardState.INITIALIZING, old_id)
+        for sid, sh in old.shards.items() if sh.state != ShardState.LEAVING
     }
     for sid in list(old.shards):
         old.shards[sid] = Shard(sid, ShardState.LEAVING)

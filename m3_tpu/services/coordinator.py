@@ -305,7 +305,13 @@ class CoordinatorService:
 
         self._divergence_reporter = DivergenceReporter(session)
         session.divergence_sink = self._divergence_reporter.submit
-        return ClusterDatabase(session)
+        cdb = ClusterDatabase(session)
+        # placement hot-swap: the shared watcher owns change detection and
+        # connection reconcile; the coordinator's tick drives poll()
+        self._placement_watcher = cdb.watch_placement(
+            self.kv, key=key, connection_factory=HTTPNodeConnection)
+        self._placement_watcher.version = self._placement_version
+        return cdb
 
     def _sync_namespace_options(self) -> None:
         """Mirror the KV namespace registry's options into the cluster
@@ -338,38 +344,12 @@ class CoordinatorService:
 
     def _refresh_topology(self) -> None:
         """Pick up placement changes (node add/remove/endpoint) between
-        ticks."""
-        from m3_tpu.client.http_conn import HTTPNodeConnection, parse_endpoint
-        from m3_tpu.cluster import placement as pl
-        from m3_tpu.cluster.topology import TopologyMap
-
-        loaded = pl.load_placement(self.kv, self._placement_key)
-        if loaded is None:
-            return
-        p, kv_version = loaded
-        if kv_version == self._placement_version:
-            return
-        session = self.db.session
-        for iid, inst in p.instances.items():
-            if not inst.endpoint:
-                continue
-            cur = session.connections.get(iid)
-            if cur is not None and (cur.host, cur.port) != parse_endpoint(
-                inst.endpoint
-            ):
-                cur.close()  # instance restarted on a new endpoint
-                cur = None
-            if cur is None:
-                session.connections[iid] = HTTPNodeConnection(inst.endpoint)
-        for iid in list(session.connections):
-            if iid not in p.instances:
-                conn = session.connections.pop(iid)
-                close = getattr(conn, "close", None)
-                if close:
-                    close()
-        session.topology = TopologyMap(p)
-        self._placement_version = kv_version
-        self.log.info("topology refreshed", version=kv_version)
+        ticks via the shared watcher (client/topology_watch.py) — one
+        version-gated check, atomic map swap, lazy connection reconcile."""
+        if self._placement_watcher.poll():
+            self._placement_version = self._placement_watcher.version
+            self.log.info("topology refreshed",
+                          version=self._placement_version)
 
     def run(self) -> None:
         if not self.db._open:

@@ -37,7 +37,7 @@ class NodeAPI:
         "/label_names", "/label_values", "/blocks/starts",
         "/blocks/metadata", "/blocks/stream", "/blocks/rollup",
         "/debug/repair", "/repair/enqueue", "/debug/flush",
-        "/debug/profile",
+        "/debug/profile", "/debug/placement", "/shards/flush",
     })
 
     def __init__(self, db: Database):
@@ -45,6 +45,10 @@ class NodeAPI:
         # the node's RepairDaemon (set by DBNodeService; None standalone):
         # /debug/repair and /repair/enqueue surface it
         self.repair = None
+        # the node's HandoffController + placement summary callable (set
+        # by DBNodeService on placement-driven nodes): /debug/placement
+        self.handoff = None
+        self.placement_status = None
         self._server: ThreadingHTTPServer | None = None
         scope = default_registry().root_scope("dbnode")
         # per-path latency histograms, pre-resolved (bounded set)
@@ -300,6 +304,24 @@ class NodeAPI:
                 # normally wait for their window to complete)
                 self.db.flush_all()
                 return 200, b'{"ok":true}'
+            if path == "/shards/flush" and method == "POST":
+                # donor buffer/WAL tail handoff: flush ONE shard's buffered
+                # windows so the joining replica's digest verification (and
+                # catch-up stream) covers this node's acked-but-unflushed
+                # writes before cutover reclaims the LEAVING shard
+                doc = json.loads(body or b"{}")
+                flushed = self.db.flush_shard(int(doc["shard"]))
+                return 200, json.dumps(
+                    {"ok": True, "flushed": flushed}).encode()
+            if path == "/debug/placement":
+                # per-shard handoff state/progress/last-error + this node's
+                # placement view (the rig's elasticity episode polls it)
+                out = dict(self.placement_status()
+                           if self.placement_status is not None else {})
+                out["handoff"] = (self.handoff.status()
+                                  if self.handoff is not None
+                                  else {"enabled": False})
+                return 200, json.dumps(out).encode()
             return 404, b'{"error":"unknown path"}'
         except faults.SimulatedCrash:
             # a simulated crash must NOT be served as an error response —
@@ -456,6 +478,26 @@ class DBNodeService:
         self._repair_placement_ttl_s = 5.0
         self._repair_placement: tuple[float, object] = (-1e18, None)
         self._repair_placement_lock = threading.Lock()
+        # the off-tick shard handoff controller (services/handoff.py):
+        # sync_placement only ENQUEUES newly-INITIALIZING shards; the
+        # paced stream + donor tail handoff + digest-verified cutover run
+        # on the pipeline's handoff lane, paying into the repair plane's
+        # rate budget. Shards a placement change takes AWAY keep serving
+        # one grace tick (donor-side cutover safety) before dropping.
+        self._shard_grace: set[int] = set()
+        if self.kv is not None:
+            from m3_tpu.services.handoff import HandoffController
+
+            self.handoff = HandoffController(
+                self.db, self.kv, self.instance_id, self._load_placement,
+                self._peer_for_instance,
+                placement_key=self.placement_key,
+                pacer=self.repair.pacer,
+            )
+            self.api.handoff = self.handoff
+            self.api.placement_status = self._placement_status
+        else:
+            self.handoff = None
         # OTLP-style telemetry export (config `export:` / M3_TPU_EXPORT_*
         # env): storage nodes ship their span rings + seam histograms to
         # the same collector as the coordinator, so exported traces stitch
@@ -492,21 +534,24 @@ class DBNodeService:
         inst = p.instances.get(self.instance_id)
         return set(inst.shards) if inst else set()
 
-    def _peers_for_shard(self, p, shard_id: int) -> list:
-        """HTTP peers that can stream this shard (AVAILABLE/LEAVING)."""
-        from m3_tpu.cluster.placement import ShardState
+    def _peer_for_instance(self, inst):
+        """HTTP peer for one placement instance (the handoff controller's
+        transport half), under the repair plane's tunable peer timeout."""
         from m3_tpu.storage.peers import HTTPPeer
 
-        peers = []
-        for iid, inst in p.instances.items():
-            if iid == self.instance_id:
-                continue
-            sh = inst.shards.get(shard_id)
-            if sh is not None and sh.state in (ShardState.AVAILABLE,
-                                               ShardState.LEAVING):
-                if inst.endpoint:
-                    peers.append(HTTPPeer(inst.endpoint))
-        return peers
+        if not inst.endpoint:
+            return None
+        return HTTPPeer(inst.endpoint,
+                        timeout_s=self.repair.opts.peer_timeout_s)
+
+    def _placement_status(self) -> dict:
+        """This node's placement view for /debug/placement."""
+        return {
+            "instance_id": self.instance_id,
+            "placement_version": self._placement_version,
+            "owned_shards": sorted(self.db.owned_shards),
+            "grace_shards": sorted(self._shard_grace),
+        }
 
     def _repair_peers_for_shard(self, shard_id: int) -> list:
         """Replica peers for the repair daemon, from a TTL-cached
@@ -545,82 +590,43 @@ class DBNodeService:
         return peers
 
     def sync_placement(self) -> None:
-        """Reconcile shard ownership with the current placement; bootstrap
-        and mark newly-assigned INITIALIZING shards AVAILABLE."""
-        from m3_tpu.cluster import placement as pl
-        from m3_tpu.cluster.placement import ShardState
-        from m3_tpu.storage.peers import bootstrap_shard_from_peers
+        """Reconcile shard ownership with the current placement and hand
+        newly-INITIALIZING shards to the off-tick handoff controller
+        (services/handoff.py): the paced peer stream, donor tail flush and
+        digest-verified `mark_available` cutover all run on the pipeline's
+        handoff lane, never inside this tick.
 
+        Donor-side cutover safety: a shard the placement takes away keeps
+        serving ONE extra sync (grace tick) before `assign_shards` drops
+        it — clients still draining in-flight ops off a pre-swap topology
+        map read the old owner meanwhile."""
+        from m3_tpu.cluster.placement import ShardState
+
+        # the kill-mid-sync seam: chaos sweeps crash a node here to prove
+        # a placement change interrupted between load and assign resumes
+        faults.check("placement.sync")
         p, version = self._load_placement()
         if p is None:
             return
         inst = p.instances.get(self.instance_id)
         owned = set(inst.shards) if inst else set()
-        added, removed = self.db.assign_shards(owned)
+        leaving_now = (self.db.owned_shards - owned) - self._shard_grace
+        added, removed = self.db.assign_shards(owned | leaving_now)
+        if leaving_now:
+            self.log.info("shards leaving; serving one grace tick",
+                          shards=sorted(leaving_now))
+        self._shard_grace = leaving_now
         if added or removed:
             self.log.info("placement reassignment",
                           added=sorted(added), removed=sorted(removed))
         self._placement_version = version
-        if inst is None:
+        if inst is None or self.handoff is None:
             return
         initializing = [
             s.id for s in inst.shards.values()
             if s.state == ShardState.INITIALIZING
         ]
-        if not initializing:
-            return
-        # Only shards whose data sources were actually reachable (or that
-        # have no source at all) may go AVAILABLE: marking an empty replica
-        # available drops the donor's LEAVING shard — the only full copy.
-        ready: list[int] = []
-        for sid in initializing:
-            peers = self._peers_for_shard(p, sid)
-            if not peers:
-                ready.append(sid)  # fresh shard: nothing to stream
-                continue
-            # one probe pass doubles as reachability check AND block-start
-            # discovery (bootstrap reuses the probed starts)
-            reached = 0
-            starts_by_ns: dict[str, set[int]] = {}
-            for ns_name in self.db.namespaces:
-                starts: set[int] = set()
-                for peer in peers:
-                    try:
-                        starts.update(peer.block_starts(ns_name, sid))
-                        reached += 1
-                    except faults.SimulatedCrash:
-                        # injected at the peer.http seam: THIS node dying
-                        # mid-probe, never "peer down" (swallowing it here
-                        # falsifies the rig's crash assertions)
-                        faults.escalate()
-                        raise
-                    except Exception:  # noqa: BLE001 - peer down
-                        continue
-                starts_by_ns[ns_name] = starts
-            if reached == 0:
-                self.log.info("no reachable peer for shard; deferring",
-                              shard=sid)
-                continue
-            for ns_name, starts in starts_by_ns.items():
-                n = bootstrap_shard_from_peers(self.db, ns_name, sid, peers,
-                                               known_starts=starts)
-                if n:
-                    self.log.info("peer-bootstrapped shard",
-                                  shard=sid, namespace=ns_name, blocks=n)
-            ready.append(sid)
-        if not ready:
-            return
-        key = self.placement_key or pl.PLACEMENT_KEY
-        me = self.instance_id
-
-        def make_available(cur):
-            return pl.mark_available(cur, me, ready)
-
-        try:
-            pl.cas_update_placement(self.kv, make_available, key)
-            self.log.info("shards available", shards=ready)
-        except Exception as e:  # noqa: BLE001 - retried next tick
-            self.log.info("mark_available failed; will retry", error=str(e))
+        self.handoff.request(initializing)
 
     def _placement_changed(self) -> bool:
         p, version = self._load_placement()
@@ -675,6 +681,9 @@ class DBNodeService:
             try:
                 self.sync_namespaces()
                 self.sync_placement()
+            except faults.SimulatedCrash:
+                faults.escalate()
+                raise
             except Exception as e:  # noqa: BLE001 - a KV hiccup at boot
                 # must not kill the node; the tick loop retries
                 self.log.info("initial cluster sync failed; will retry",
@@ -711,7 +720,11 @@ class DBNodeService:
                             # options, rules) for other processes' writes
                             self.kv.refresh()
                         self.sync_namespaces()
-                        if self._placement_changed():
+                        if self._placement_changed() or self._shard_grace \
+                                or (self.handoff is not None
+                                    and self.handoff.pending()):
+                            # re-sync without a version bump too: deferred
+                            # handoffs retry, and grace-tick shards drop
                             self.sync_placement()
                     with scope.timer("tick"):
                         stats = self.db.tick()
@@ -729,6 +742,8 @@ class DBNodeService:
         from m3_tpu.utils import profiler
 
         profiler.default_watchdog().unregister("dbnode.tick")
+        if self.handoff is not None:
+            self.handoff.stop()
         self.repair.stop()
         self.api.shutdown()
         if self.exporter is not None:

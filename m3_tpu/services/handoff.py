@@ -1,0 +1,413 @@
+"""Off-tick shard handoff controller: verified, paced shard acquisition.
+
+Role parity with the reference's peer-bootstrap + placement cutover flow
+(/root/reference/src/dbnode/storage/bootstrap/bootstrapper/peers driving
+shard states INITIALIZING -> AVAILABLE through the placement service).
+PR 1-16 ran this inline in the dbnode tick (`sync_placement`): a real
+shard handoff stalled the tick past the stall watchdog, cutover was
+unverified, and the donor's unflushed acked writes were silently dropped
+when the LEAVING shard was reclaimed — `bootstrap_shard_from_peers`
+copies only flushed filesets.
+
+This controller makes handoff a first-class background operation:
+
+- **Off-tick.** `sync_placement` only ENQUEUES newly-INITIALIZING shards
+  here; the work runs on the shared pipeline's strict-FIFO ``handoff``
+  lane (storage/pipeline.py) with its own stall-watchdog heartbeat, so
+  the tick never blocks on a peer stream again.
+- **Paced.** Streamed bootstrap bytes pay into the repair plane's
+  `PersistRateLimiter` (the PR-9 storm-safety discipline): a mass
+  reassignment trickles behind foreground reads instead of starving
+  them.
+- **Verified cutover.** `mark_available` CAS fires only after (1) the
+  donor flushed its mutable window for the shard (`/shards/flush` — the
+  buffer/WAL tail handoff; without it the donor's acked-but-unflushed
+  writes die with the LEAVING shard) and (2) this node's rollup-digest
+  table equals the donor's for every namespace (the PR-9 /blocks/rollup
+  exchange), with digest-divergent blocks repaired in place via
+  `repair_shard_block` between attempts.
+- **Resumable.** Per-shard progress survives re-requests: bootstrap
+  skips blocks already held, repair is incremental, and a shard killed
+  mid-handoff (fault points ``handoff.stream`` / ``placement.cutover``)
+  simply re-enters the lane on the next placement sync.
+
+The donor side of the protocol lives in `services/dbnode.py`: a LEAVING
+shard keeps serving reads until cutover, then survives ONE extra grace
+tick before `assign_shards` drops it (clients mid-swap drain off the old
+map meanwhile).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from m3_tpu.utils import faults, trace
+from m3_tpu.utils.instrument import Logger, default_registry
+
+
+class HandoffController:
+    """Per-shard handoff state machine over the shared ``handoff`` lane.
+
+    Pluggable topology half (RepairDaemon discipline): callers supply
+    ``load_placement() -> (Placement | None, kv_version)`` and
+    ``peer_for_instance(Instance) -> PeerSource | None`` — services/
+    dbnode.py passes KV + HTTPPeer implementations, tests pass closures
+    over in-process Databases."""
+
+    # digest-verify attempts per lane pass; each failed attempt repairs
+    # the divergent blocks before re-comparing, so under live dual-routed
+    # writes the tables converge instead of chasing the buffer forever
+    VERIFY_ATTEMPTS = 3
+    # stall-watchdog interval while a handoff is in flight: one paced
+    # bootstrap stream can legitimately run for a while between beats
+    HEARTBEAT_S = 60.0
+
+    def __init__(self, db, kv, instance_id: str, load_placement,
+                 peer_for_instance, placement_key: str | None = None,
+                 pacer=None):
+        from m3_tpu.cluster import placement as pl
+
+        self.db = db
+        self.kv = kv
+        self.instance_id = instance_id
+        self.load_placement = load_placement
+        self.peer_for_instance = peer_for_instance
+        self.placement_key = placement_key or pl.PLACEMENT_KEY
+        self.pacer = pacer
+        self.log = Logger("handoff")
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._inflight: set[int] = set()
+        # per-shard resumable progress: the /debug/placement payload
+        self._progress: dict[int, dict] = {}
+        self.totals = {"completed": 0, "deferred": 0, "cutover_failures": 0,
+                       "errors": 0}
+        self._scope = default_registry().root_scope("placement")
+        self._hb = None  # registered only while handoffs are in flight
+
+    # -- intake (called from the dbnode tick) -------------------------------
+
+    def request(self, shard_ids) -> list:
+        """Enqueue handoffs for newly-INITIALIZING shards; already-queued
+        shards dedup. Returns the submitted lane futures (tests join on
+        them; the tick ignores the return)."""
+        from m3_tpu.storage.pipeline import default_executor
+        from m3_tpu.utils import profiler
+
+        if self._stop.is_set():
+            return []
+        want = {int(s) for s in shard_ids}
+        submitted: list[int] = []
+        with self._lock:
+            # prune records the placement no longer asks about (another
+            # node reclaimed the shard, or the move was cancelled)
+            for sid, rec in self._progress.items():
+                if sid not in want and sid not in self._inflight \
+                        and rec["state"] not in ("done", "superseded"):
+                    rec["state"] = "superseded"
+            for sid in sorted(want):
+                if sid in self._inflight:
+                    continue
+                rec = self._progress.setdefault(sid, {
+                    "shard": sid, "attempts": 0, "namespaces": {},
+                    "last_error": None})
+                rec["state"] = "pending"
+                rec["attempts"] += 1
+                self._inflight.add(sid)
+                submitted.append(sid)
+            if submitted and self._hb is None:
+                self._hb = profiler.register_heartbeat(
+                    "handoff.shard", self.HEARTBEAT_S)
+        lane = default_executor().lane("handoff")
+        return [lane.submit(lambda sid=sid: self._run_one(sid))
+                for sid in submitted]
+
+    def pending(self) -> bool:
+        """True while any shard is in flight or awaiting a retry — the
+        tick re-syncs the placement while this holds, so deferred
+        handoffs retry without needing a placement version bump."""
+        with self._lock:
+            if self._inflight:
+                return True
+            return any(r["state"] in ("deferred", "error")
+                       for r in self._progress.values())
+
+    # -- the lane task ------------------------------------------------------
+
+    def _run_one(self, sid: int) -> None:
+        try:
+            self._handoff_shard(sid)
+        except faults.SimulatedCrash:
+            # armed (chaos rig): the whole process dies mid-handoff here;
+            # unarmed in-process: propagate so the lane future carries the
+            # crash — resumability is proven by re-requesting the shard
+            faults.escalate()
+            raise
+        except Exception as e:  # noqa: BLE001 - one shard's failure must
+            # not wedge the lane for every other handoff; retried next sync
+            self._note(sid, "error", error=str(e))
+            with self._lock:
+                self.totals["errors"] += 1
+            self._scope.counter("handoff_errors")
+            self.log.info("shard handoff failed; will retry",
+                          shard=sid, error=str(e))
+        finally:
+            with self._lock:
+                self._inflight.discard(sid)
+                if not self._inflight and self._hb is not None:
+                    self._hb.close()
+                    self._hb = None
+
+    def _handoff_shard(self, sid: int) -> None:
+        from m3_tpu.cluster.placement import ShardState
+        from m3_tpu.storage.peers import bootstrap_shard_from_peers
+
+        if self._stop.is_set():
+            return
+        if self._hb is not None:
+            self._hb.beat()
+        p, _version = self.load_placement()
+        if p is None:
+            self._defer(sid, "no_placement")
+            return
+        inst = p.instances.get(self.instance_id)
+        sh = inst.shards.get(sid) if inst is not None else None
+        if sh is None or sh.state != ShardState.INITIALIZING:
+            # stale request: the placement moved on (cancelled move,
+            # concurrent cutover) — nothing to do
+            self._note(sid, "superseded")
+            return
+        # the kill-mid-stream seam: chaos sweeps crash a node here to
+        # prove a half-streamed handoff resumes instead of corrupting
+        faults.check("handoff.stream", shard=sid)
+        donor, peers = self._resolve_peers(p, sid, sh)
+        if not peers:
+            # fresh shard (no replica holds it): nothing to stream
+            self._cutover(sid)
+            return
+        # one probe pass doubles as reachability check AND block-start
+        # discovery (bootstrap reuses the probed starts). Only shards
+        # whose data sources were actually reachable may go AVAILABLE:
+        # marking an empty replica available drops the donor's LEAVING
+        # shard — the only full copy.
+        reachable: list = []
+        donor_reached = donor is None
+        starts_by_ns: dict[str, set[int]] = {}
+        for ns_name in list(self.db.namespaces):
+            starts: set[int] = set()
+            for peer in peers:
+                try:
+                    starts.update(peer.block_starts(ns_name, sid))
+                    if peer not in reachable:
+                        reachable.append(peer)
+                    if peer is donor:
+                        donor_reached = True
+                except faults.SimulatedCrash:
+                    # injected at the peer.http seam: THIS node dying
+                    # mid-probe, never "peer down"
+                    faults.escalate()
+                    raise
+                except Exception:  # noqa: BLE001 - peer down
+                    continue
+            starts_by_ns[ns_name] = starts
+        if not reachable:
+            self._defer(sid, "unreachable")
+            return
+        if not donor_reached:
+            # dead-donor replace: the source process is gone, so its
+            # unflushed tail is unrecoverable no matter how long we wait
+            # — every majority-acked write lives on the surviving
+            # replicas, so stream/verify against those instead of
+            # deferring forever on a tail flush that can never succeed.
+            # The dead peer drops out of the stream/verify set entirely:
+            # verify treats an unreachable peer as divergence, which
+            # would otherwise wedge the shard in deferred.
+            self.log.info("donor unreachable; handing off from survivors",
+                          shard=sid)
+            donor = None
+            peers = reachable
+        self._note(sid, "streaming")
+        rec_ns = {}
+        for ns_name, starts in starts_by_ns.items():
+            n = bootstrap_shard_from_peers(self.db, ns_name, sid, peers,
+                                           known_starts=starts,
+                                           pacer=self.pacer)
+            rec_ns[ns_name] = n
+            if n:
+                self.log.info("peer-bootstrapped shard", shard=sid,
+                              namespace=ns_name, blocks=n)
+        with self._lock:
+            self._progress[sid]["namespaces"] = rec_ns
+        # donor buffer/WAL tail handoff: the donor's mutable window holds
+        # acked writes no fileset stream carries — have it flush them so
+        # the digest exchange below covers CURRENT data, then stream the
+        # resulting divergent blocks across
+        self._note(sid, "tail_flush")
+        if donor is not None:
+            try:
+                donor.flush_shard(sid)
+            except faults.SimulatedCrash:
+                faults.escalate()
+                raise
+            except Exception as e:  # noqa: BLE001 - donor unreachable:
+                # cutting over anyway would drop its unflushed writes
+                self._defer(sid, f"tail_flush_failed: {e}")
+                return
+        self._note(sid, "verifying")
+        verify_peers = [donor] if donor is not None else peers
+        if not self._verify_and_catch_up(sid, verify_peers):
+            self._defer(sid, "digests_diverged")
+            return
+        self._cutover(sid)
+
+    def _resolve_peers(self, p, sid: int, sh):
+        """(donor peer or None, all streamable peers). The donor is the
+        shard's source instance (LEAVING holder) — the replica whose
+        mutable window the tail handoff must drain; other AVAILABLE/
+        LEAVING holders join the stream set for majority merges."""
+        from m3_tpu.cluster.placement import ShardState
+
+        donor = None
+        peers = []
+        for iid, inst in p.instances.items():
+            if iid == self.instance_id:
+                continue
+            owned = inst.shards.get(sid)
+            if owned is None or owned.state not in (ShardState.AVAILABLE,
+                                                    ShardState.LEAVING):
+                continue
+            peer = self.peer_for_instance(inst)
+            if peer is None:
+                continue
+            peers.append(peer)
+            if sh.source_id and iid == sh.source_id:
+                donor = peer
+        return donor, peers
+
+    def _verify_and_catch_up(self, sid: int, peers) -> bool:
+        """True once this node's rollup-digest table equals every verify
+        peer's for every namespace; between attempts, digest-divergent
+        blocks are repaired in place (stream + merge + higher volume)."""
+        from m3_tpu.storage.peers import (
+            local_rollup_digests,
+            repair_shard_block,
+        )
+
+        for _attempt in range(self.VERIFY_ATTEMPTS):
+            if self._hb is not None:
+                self._hb.beat()
+            divergent: dict[str, set[int]] = {}
+            for ns_name in list(self.db.namespaces):
+                local = local_rollup_digests(self.db, ns_name, sid)
+                for peer in peers:
+                    try:
+                        remote = peer.rollup_digests(ns_name, sid)
+                    except faults.SimulatedCrash:
+                        faults.escalate()
+                        raise
+                    except Exception:  # noqa: BLE001 - peer unreachable
+                        # mid-verify: treat as diverged, retry/defer below
+                        divergent.setdefault(ns_name, set())
+                        continue
+                    for bs in set(local) | set(remote):
+                        if local.get(bs) != remote.get(bs):
+                            divergent.setdefault(ns_name, set()).add(bs)
+            if not divergent:
+                return True
+            for ns_name, starts in divergent.items():
+                for bs in sorted(starts):
+                    try:
+                        repair_shard_block(self.db, ns_name, sid, bs, peers,
+                                           pacer=self.pacer)
+                    except faults.SimulatedCrash:
+                        faults.escalate()
+                        raise
+                    except Exception as e:  # noqa: BLE001 - one block's
+                        # failure: the next compare pass decides the fate
+                        self.log.info("handoff catch-up repair failed",
+                                      shard=sid, namespace=ns_name,
+                                      block_start=bs, error=str(e))
+        return False
+
+    def _cutover(self, sid: int) -> None:
+        from m3_tpu.cluster import placement as pl
+
+        # the kill-mid-CAS seam: a node dying between verify and CAS must
+        # leave the placement untouched (the donor keeps the shard)
+        faults.check("placement.cutover", shard=sid)
+        me = self.instance_id
+
+        def make_available(cur):
+            return pl.mark_available(cur, me, [sid])
+
+        try:
+            pl.cas_update_placement(self.kv, make_available,
+                                    self.placement_key)
+        except faults.SimulatedCrash:
+            faults.escalate()
+            raise
+        except Exception as e:  # noqa: BLE001 - CAS contention/KV outage:
+            # retried on the next placement sync; the counter makes the
+            # previously log-only failure visible
+            with self._lock:
+                self.totals["cutover_failures"] += 1
+            self._scope.counter("cutover_failures")
+            self._note(sid, "error", error=f"cutover: {e}")
+            self.log.info("mark_available failed; will retry",
+                          shard=sid, error=str(e))
+            return
+        self._note(sid, "done")
+        with self._lock:
+            self.totals["completed"] += 1
+        self.log.info("shard cutover complete", shard=sid)
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _note(self, sid: int, state: str, error: str | None = None) -> None:
+        with self._lock:
+            rec = self._progress.setdefault(sid, {
+                "shard": sid, "attempts": 0, "namespaces": {},
+                "last_error": None})
+            rec["state"] = state
+            if error is not None:
+                rec["last_error"] = error
+
+    def _defer(self, sid: int, reason: str) -> None:
+        """A shard that cannot SAFELY go AVAILABLE yet: record why (the
+        previously log-only path), count it per reason, and leave it for
+        the next placement sync to re-request."""
+        self._note(sid, "deferred", error=reason)
+        with self._lock:
+            self.totals["deferred"] += 1
+        label = reason.split(":", 1)[0]  # bounded label set
+        self._scope.subscope("sync", reason=label).counter("deferred")
+        with trace.span(trace.PLACEMENT_SYNC_DEFER, shard=sid, reason=label):
+            pass
+        self.log.info("handoff deferred", shard=sid, reason=reason)
+
+    # -- status (/debug/placement) ------------------------------------------
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "in_flight": sorted(self._inflight),
+                "totals": dict(self.totals),
+                "shards": {str(sid): dict(rec) for sid, rec
+                           in sorted(self._progress.items())},
+            }
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        """Drain: in-flight lane tasks observe the stop flag at their next
+        phase boundary; new requests are not accepted past this point."""
+        self._stop.set()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._inflight:
+                    break
+            time.sleep(0.05)
+        with self._lock:
+            if self._hb is not None:
+                self._hb.close()
+                self._hb = None

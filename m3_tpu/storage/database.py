@@ -910,3 +910,18 @@ class Database:
                     if shard.flush(bs):
                         flushed += 1
         return flushed
+
+    def flush_shard(self, shard_id: int) -> int:
+        """Force-flush every buffered window of ONE shard across all
+        namespaces — the donor half of shard handoff (tail handoff): the
+        mutable window's acked writes become flushed volumes the target
+        can stream and digest-verify before cutover."""
+        flushed = 0
+        for ns in self.namespaces.values():
+            shard = ns.shards.get(shard_id)
+            if shard is None:
+                continue
+            for bs in shard.buffer.block_starts():
+                if shard.flush(bs):
+                    flushed += 1
+        return flushed

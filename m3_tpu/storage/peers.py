@@ -132,6 +132,9 @@ class InProcessPeer:
     def rollup_digests(self, namespace, shard):
         return local_rollup_digests(self.db, namespace, shard)
 
+    def flush_shard(self, shard):
+        return self.db.flush_shard(shard)
+
 
 class PeerClientError(Exception):
     """A peer answered with a deterministic 4xx (e.g. a namespace it
@@ -197,7 +200,10 @@ class HTTPPeer:
     def _get(self, path: str):
         return self.policy.call(self._fetch, path)
 
-    def _fetch(self, path: str):
+    def _post(self, path: str, doc: dict):
+        return self.policy.call(self._fetch, path, json.dumps(doc).encode())
+
+    def _fetch(self, path: str, body: bytes | None = None):
         import urllib.error
 
         from m3_tpu.utils import trace
@@ -207,7 +213,7 @@ class HTTPPeer:
                 default_registry().root_scope("peer").histogram(
                     "http_seconds"):
             faults.check("peer.http", url=self.base + path)
-            req = urllib.request.Request(self.base + path,
+            req = urllib.request.Request(self.base + path, data=body,
                                          headers=trace.inject_headers())
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
@@ -269,15 +275,30 @@ class HTTPPeer:
         )
         return unpack_rollup(base64.b64decode(doc.get("rollup_b64", "")))
 
+    def flush_shard(self, shard):
+        """Donor buffer/WAL tail handoff (shard handoff cutover safety):
+        make the peer flush every buffered window of this shard so its
+        rollup digests cover acked-but-unflushed writes — without this,
+        cutover would verify against stale filesets and the donor's
+        mutable window would die with the LEAVING shard."""
+        doc = self._post("/shards/flush", {"shard": int(shard)})
+        return int(doc.get("flushed", 0))
+
 
 def bootstrap_shard_from_peers(db, namespace: str, shard_id: int,
                                peers: list[PeerSource],
-                               known_starts: set[int] | None = None) -> int:
+                               known_starts: set[int] | None = None,
+                               pacer=None) -> int:
     """Stream every flushed block a replica set has for this shard into
     local fileset volumes (the new-node bootstrap path). Returns blocks
     written. Majority checksum wins when peers disagree. Callers that
     already probed the peers' block starts pass them via known_starts to
-    avoid re-fetching."""
+    avoid re-fetching.
+
+    `pacer` (optional, `.acquire(n_bytes)`) is the repair plane's token
+    bucket: every stream pulled off a peer pays into the shared budget so
+    a mass reassignment cannot starve foreground reads (the same storm-
+    safety discipline `repair_shard_block` applies)."""
     ns = db.namespaces[namespace]
     shard = ns.shards[shard_id]
     if known_starts is not None:
@@ -300,7 +321,8 @@ def bootstrap_shard_from_peers(db, namespace: str, shard_id: int,
     for bs in sorted(all_starts):
         if bs in shard._filesets:
             continue  # already have a volume
-        merged = _merged_block_from_peers(namespace, shard_id, bs, peers)
+        merged = _merged_block_from_peers(namespace, shard_id, bs, peers,
+                                          pacer=pacer)
         if not merged:
             continue
         writer = FilesetWriter(
@@ -333,7 +355,7 @@ def bootstrap_shard_from_peers(db, namespace: str, shard_id: int,
     return written
 
 
-def _merged_block_from_peers(namespace, shard_id, bs, peers):
+def _merged_block_from_peers(namespace, shard_id, bs, peers, pacer=None):
     """(series -> (tags, stream)) agreed by majority checksum; divergent
     series fall back to the first non-empty stream."""
     metas = []
@@ -366,6 +388,8 @@ def _merged_block_from_peers(namespace, shard_id, bs, peers):
                 except Exception:  # noqa: BLE001 - try the next replica
                     continue
                 if stream:
+                    if pacer is not None:
+                        pacer.acquire(len(stream))
                     out[sid] = (tags, stream)
                     break
     return out

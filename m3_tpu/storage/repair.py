@@ -149,6 +149,13 @@ class RepairDaemon:
         with self._opts_lock:
             return self._opts
 
+    @property
+    def pacer(self):
+        """The repair plane's shared token bucket: the handoff controller
+        pays its bootstrap streams into the SAME budget (one storm-safety
+        rate for all background replication traffic)."""
+        return self._pacer
+
     def set_opts(self, opts: RepairOptions) -> None:
         with self._opts_lock:
             self._opts = opts

@@ -786,6 +786,7 @@ class TrajectoryRecorder:
         self.rig = rig
         self.sample_s = sample_s
         self.samples: list[dict] = []
+        self.topology_events: list[dict] = []       # annotate() rows
         self._events: dict[tuple, dict] = {}        # dedup key -> event
         self._locks: dict[tuple, dict] = {}         # (svc, site) -> doc
         self._prev_hist = None
@@ -853,6 +854,14 @@ class TrajectoryRecorder:
         self.samples.append(row)
         return row
 
+    def annotate(self, action: str, **doc) -> None:
+        """Topology/episode annotations on the trajectory timeline:
+        t_s-aligned with the sampled rows, so a p99 excursion can be
+        read against the add/drain/restart that caused it."""
+        self.topology_events.append(
+            {"action": action,
+             "t_s": round(time.monotonic() - self._t0, 3), **doc})
+
     def artifact(self) -> dict:
         events = sorted(self._events.values(),
                         key=lambda e: e.get("t_unix", 0))
@@ -863,6 +872,7 @@ class TrajectoryRecorder:
             "sample_interval_s": self.sample_s,
             "services": sorted(self.profile_ports),
             "samples": self.samples,
+            "topology_events": list(self.topology_events),
             "stall_events": events,
             "contended_locks": locks[:32],
         }
@@ -1019,17 +1029,10 @@ class RigCluster:
                  n_shards: int = 4, seed: int = 0):
         import os as _os
         import pathlib
-        import socket
 
         from m3_tpu.tools.em import AgentClient, ClusterEnv, EmAgent
 
-        def free_port() -> int:
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-            s.close()
-            return port
-
+        free_port = _free_port
         self.workdir = workdir
         self.tenants = tuple(tenants)
         self.seed = seed
@@ -1065,6 +1068,7 @@ class RigCluster:
         self.kv_addr = ""
         self.tenant_quotas = tenant_quotas or {}
         self.replica_factor = min(2, n_dbnodes)
+        self._next_dbnode = n_dbnodes  # next node index for add_dbnode
 
     # -- deployment --
 
@@ -1126,10 +1130,13 @@ class RigCluster:
 
         # 4. coordinator (admission quotas in config; runtime-tunable
         #    via the m3_tpu.tenants KV key) + aggregator
+        # no quotas -> list each tenant with no limits: the hand-rolled
+        # YAML parser has no flow syntax, so a literal `{}` won't parse
         quota_yaml = "".join(
             f"    {t}:\n" + "".join(f"      {k}: {v}\n"
                                     for k, v in (q or {}).items())
-            for t, q in self.tenant_quotas.items()) or "    {}\n"
+            for t, q in self.tenant_quotas.items()) \
+            or "".join(f"    {t}:\n" for t in self.tenants)
         self.agents["hc"].put_file("coord.yml", COORD_CFG.format(
             default_ns=self.tenants[0], kv_addr=self.kv_addr,
             port=self.coord_port, tenant_quota_yaml=quota_yaml))
@@ -1180,11 +1187,117 @@ class RigCluster:
         aggregator. The coordinator is the measurement plane and stays
         up (its loss is a different drill)."""
         out = []
-        for i, nid in enumerate(sorted(self.node_ports)):
-            out.append((f"h{i}", nid, "dbnode"))
+        for nid in sorted(self.node_ports):
+            out.append((self._agent_of(nid), nid, "dbnode"))
         out.append((sorted(self.kvd_ports)[0], "kvd", "kvd"))
         out.append(("hc", "agg", "aggregator"))
         return out
+
+    # -- elasticity verbs (ROADMAP #6(b)) ----------------------------------
+    # The rig's only lever is the placement CAS: shard streaming, digest
+    # verification, cutover, and the donor grace tick all run inside the
+    # nodes (services/handoff.py controllers).
+
+    def _agent_of(self, nid: str) -> str:
+        """dbnode id -> its em agent name (node{i} lives on h{i})."""
+        return "h" + nid.removeprefix("node")
+
+    def refresh_placement(self) -> None:
+        from m3_tpu.cluster import placement as pl
+
+        loaded = pl.load_placement(self._kv)
+        if loaded is not None:
+            self.placement = loaded[0]
+
+    def add_dbnode(self, wait_s: float = 120.0) -> str:
+        """Scale-out verb: spawn a NEW dbnode process on a fresh em
+        agent, wait for health, then CAS it into the live placement.
+        Its fair share of shards lands INITIALIZING (sourced from the
+        donors, which go LEAVING but keep serving); the nodes' handoff
+        controllers do the rest."""
+        import os as _os
+
+        from m3_tpu.cluster import placement as pl
+        from m3_tpu.cluster.placement import Instance
+        from m3_tpu.tools.em import AgentClient, ClusterEnv, EmAgent
+
+        i = self._next_dbnode
+        self._next_dbnode += 1
+        name, nid = f"h{i}", f"node{i}"
+        a = EmAgent(_os.path.join(self.workdir, name), "127.0.0.1:0",
+                    agent_id=name)
+        self._agent_objs.append(a)
+        self.agents[name] = AgentClient(f"http://127.0.0.1:{a.port}")
+        port = _free_port()
+        self.node_ports[nid] = port
+        self.agents[name].put_file("node.yml", NODE_CFG.format(
+            workdir=f"{self.workdir}/{name}", n_shards=self.n_shards,
+            node_id=nid, kv_addr=self.kv_addr, port=port))
+        self.agents[name].start(nid, "m3_tpu.services.dbnode", "node.yml",
+                                env=self.base_service_env)
+        ClusterEnv.wait_until(
+            lambda: _http_ok(f"http://127.0.0.1:{port}/health"),
+            timeout_s=wait_s, desc=f"{nid} health")
+        endpoint = f"http://127.0.0.1:{port}"
+
+        def add(cur):
+            return pl.add_instance(
+                cur, Instance(nid, isolation_group=f"g{i}",
+                              endpoint=endpoint))
+
+        pl.cas_update_placement(self._kv, add)
+        self.refresh_placement()
+        return nid
+
+    def drain_dbnode(self, nid: str) -> None:
+        """Paced-drain verb: CAS remove_instance — every shard the node
+        holds goes LEAVING with a new owner INITIALIZING from it; the
+        receiving nodes stream at the shared repair rate budget and cut
+        over per shard. The process keeps serving until retired."""
+        from m3_tpu.cluster import placement as pl
+
+        pl.cas_update_placement(
+            self._kv, lambda cur: pl.remove_instance(cur, nid))
+        self.refresh_placement()
+
+    def retire_dbnode(self, nid: str) -> None:
+        """Stop a fully-drained node's process and forget its port (only
+        after wait_placement_settled shows it out of the placement)."""
+        agent = self.agents[self._agent_of(nid)]
+        try:
+            agent.stop(nid)
+        except Exception:  # noqa: BLE001 - already dead is drained enough
+            pass
+        self.node_ports.pop(nid, None)
+
+    def restart_dbnode(self, nid: str, wait_s: float = 120.0) -> None:
+        """Rolling-restart verb: SIGKILL (crash consistency — WAL
+        replay, no graceful flush) then relaunch and wait for health
+        before the caller moves to the next node."""
+        from m3_tpu.tools.em import ClusterEnv
+
+        agent = self.agents[self._agent_of(nid)]
+        agent.kill(nid)
+        agent.start(nid, env=self.base_service_env, grace_s=0.5)
+        port = self.node_ports[nid]
+        ClusterEnv.wait_until(
+            lambda: _http_ok(f"http://127.0.0.1:{port}/health"),
+            timeout_s=wait_s, desc=f"{nid} back after restart")
+
+    def wait_placement_settled(self, timeout_s: float = 120.0) -> None:
+        """Poll KV until every shard everywhere is AVAILABLE — streamed,
+        digest-verified, and cut over by the nodes themselves."""
+        from m3_tpu.cluster.placement import ShardState
+        from m3_tpu.tools.em import ClusterEnv
+
+        def settled() -> bool:
+            self.refresh_placement()
+            return all(sh.state is ShardState.AVAILABLE
+                       for inst in self.placement.instances.values()
+                       for sh in inst.shards.values())
+
+        ClusterEnv.wait_until(settled, timeout_s=timeout_s, every_s=0.5,
+                              desc="placement settled (all AVAILABLE)")
 
     def set_tenant_quotas_kv(self, doc: dict) -> None:
         """Runtime quota update THROUGH the metadata plane: the
@@ -1223,6 +1336,25 @@ def _http_ok(url: str, key: str = "ok", timeout_s: float = 5.0) -> bool:
             return bool(json.loads(r.read().decode()).get(key))
     except Exception:  # noqa: BLE001
         return False
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def node_placement(port: int, timeout_s: float = 10.0) -> dict:
+    """One node's /debug/placement: placement version, owned/grace
+    shards, and the handoff controller's per-shard progress records."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/placement",
+            timeout=timeout_s) as r:
+        return json.loads(r.read().decode())
 
 
 # ---------------------------------------------------------------------------
@@ -1382,13 +1514,159 @@ def run_production_rig(workdir: str, seconds: float = 20.0, seed: int = 7,
     return report
 
 
+def run_elasticity_episode(workdir: str, seconds: float = 20.0,
+                           seed: int = 7,
+                           slo_p99_ms: float = 5000.0) -> dict:
+    """ROADMAP #6(b), the elasticity episode: add-node -> paced drain ->
+    rolling restart, all under live zipf load, overlapping a seeded
+    chaos schedule on the metadata/aggregation planes (a kvd replica and
+    the aggregator; the dbnodes' failures are the episode's own verbs).
+    The placement CAS verbs are the ONLY lever the rig pulls — shard
+    streaming, digest verification, cutover, and the donor grace tick
+    all run inside the nodes (services/handoff.py). Proven at the end:
+    zero acked-write loss, every shard AVAILABLE on the post-change
+    owners, rollup convergence, and a client read p99 that stayed
+    bounded while the topology churned (trajectory rows annotated with
+    the topology events)."""
+    from m3_tpu.client.http_conn import HTTPNodeConnection
+    from m3_tpu.client.topology_watch import PlacementWatcher
+    from m3_tpu.tools.em import ClusterEnv
+
+    tenants = ("elastic0", "elastic1")
+    cluster = RigCluster(workdir, tenants, n_dbnodes=2, n_shards=4,
+                         seed=seed)
+    report: dict = {"seed": seed, "seconds": seconds}
+    watcher = None
+    recorder = None
+    try:
+        cluster.deploy()
+        session = cluster.session()
+        # the hot-swap plane under test: the load session follows
+        # placement changes through the watcher, never a rebuild
+        watcher = PlacementWatcher(
+            cluster._kv, session,
+            connection_factory=lambda ep: HTTPNodeConnection(
+                ep, timeout_s=5.0))
+        watcher.poll()
+        watcher.start(0.5)
+        ledger = WriteLedger()
+        cfg = RigConfig(seed=seed, tenants=tenants, duration_s=seconds,
+                        slo_p99_ms=slo_p99_ms)
+        rig = Rig(cfg, session_write_fn(session),
+                  http_query_fn(cluster.coord_port), ledger=ledger)
+        recorder = TrajectoryRecorder(cluster.coord_port,
+                                      cluster.profile_ports(), rig=rig)
+        recorder.start()
+        targets = [t for t in cluster.chaos_targets() if t[2] != "dbnode"]
+        schedule = ChaosSchedule.generate(seed, max(8.0, seconds), targets)
+        report["schedule"] = [e.to_doc() for e in schedule]
+        runner = ChaosRunner(cluster.agents, schedule,
+                             base_env={s: cluster.base_service_env
+                                       for _a, s, _k in targets},
+                             seed=seed)
+        # load loops driven directly (not rig.run): the episode's verbs
+        # pace the run, and the loops stop when the last verb lands
+        writer = threading.Thread(target=rig._writer_loop, daemon=True)
+        querier = threading.Thread(target=rig._query_loop, daemon=True)
+        writer.start()
+        querier.start()
+        runner.start()
+        slice_s = max(2.0, seconds / 5.0)
+        time.sleep(slice_s)  # baseline load on the 2-node deployment
+
+        # ---- scale out: add-node, handoff streams onto it live ----
+        new_nid = cluster.add_dbnode()
+        recorder.annotate("add_node", node=new_nid)
+        cluster.wait_placement_settled()
+        recorder.annotate("handoff_settled", node=new_nid)
+        report["handoff_status"] = {
+            nid: node_placement(port)
+            for nid, port in cluster.node_ports.items()}
+        time.sleep(slice_s)
+
+        # ---- paced drain of an original node ----
+        drain_nid = sorted(cluster.node_ports)[0]
+        recorder.annotate("drain", node=drain_nid)
+        cluster.drain_dbnode(drain_nid)
+        cluster.wait_placement_settled()
+        time.sleep(1.5)  # the donor's grace tick: it still serves reads
+        cluster.retire_dbnode(drain_nid)
+        recorder.annotate("drained", node=drain_nid)
+        report["drained_node"] = drain_nid
+        time.sleep(slice_s)
+
+        # ---- rolling restart (SIGKILL + WAL replay) of survivors ----
+        for nid in sorted(cluster.node_ports):
+            recorder.annotate("restart", node=nid)
+            cluster.restart_dbnode(nid)
+        time.sleep(slice_s)
+
+        runner.join(60.0)
+        rig._stop.set()
+        writer.join(10.0)
+        querier.join(10.0)
+        report["phase"] = rig.report()
+        report["chaos_executed"] = runner.executed
+        report["chaos_errors"] = runner.errors
+
+        # ---- verification on the post-change topology ----
+        cluster.wait_all_healthy()
+        verify_session = cluster.session()
+
+        def _tenants_readable():
+            try:
+                for t in tenants:
+                    verify_session.fetch(t, b"rig-readiness-probe", 0, 1)
+                return True
+            except Exception:  # noqa: BLE001 - not ready yet
+                return False
+
+        ClusterEnv.wait_until(_tenants_readable, timeout_s=90,
+                              desc="tenants readable after elasticity")
+        report["verify"] = ledger.verify(session_fetch_fn(verify_session))
+        report["convergence"] = convergence_audit(
+            cluster, tenants, budget_cycles=10, interval_s=1.0)
+        report["final_placement"] = {
+            iid: {str(sh.id): sh.state.value
+                  for sh in inst.shards.values()}
+            for iid, inst in cluster.placement.instances.items()}
+        recorder.stop()
+        report["trajectory"] = recorder.artifact()
+        try:
+            import os as _os
+
+            with open(_os.path.join(workdir, "elasticity.json"), "w") as f:
+                json.dump(report["trajectory"], f, indent=2, default=str)
+        except OSError:
+            pass
+    finally:
+        if watcher is not None:
+            watcher.stop()
+        if recorder is not None:
+            recorder.stop()
+        cluster.teardown()
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="production chaos/load rig")
     ap.add_argument("--workdir", required=True)
     ap.add_argument("--seconds", type=float, default=20.0)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--slo-p99-ms", type=float, default=5000.0)
+    ap.add_argument("--episode", choices=("production", "elasticity"),
+                    default="production",
+                    help="production = kill/partition schedule; "
+                         "elasticity = add/drain/restart under load")
     args = ap.parse_args(argv)
+    if args.episode == "elasticity":
+        report = run_elasticity_episode(args.workdir, args.seconds,
+                                        args.seed, args.slo_p99_ms)
+        print(json.dumps(report, indent=2, default=str))
+        ok = (not report.get("verify", {}).get("missing")
+              and report.get("convergence", {}).get("converged", False)
+              and not report.get("chaos_errors"))
+        return 0 if ok else 1
     report = run_production_rig(args.workdir, args.seconds, args.seed,
                                 args.slo_p99_ms)
     print(json.dumps(report, indent=2, default=str))

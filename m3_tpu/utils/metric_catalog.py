@@ -112,3 +112,19 @@ TIMERS = {
 #       ?explain=analyze `index` block per query
 # plus the dispatch-layer tallies index.postings[device|host] and
 # jit_postings_program[hit|miss] on /debug counters.
+#
+# Topology elasticity (PR 17), placement scope — the off-tick handoff
+# controller (services/handoff.py) and the client-plane placement
+# watcher (client/topology_watch.py):
+#   placement_sync_deferred {reason=...}       handoffs that could NOT
+#       safely cut over this pass — reason is one of unreachable /
+#       tail_flush_failed / digests_diverged / no_placement; each defer
+#       also emits the placement.sync.defer tracepoint with the shard id
+#   placement_cutover_failures                 mark_available CAS lost
+#       (KV contention/outage); the shard re-enters the handoff lane on
+#       the next placement sync
+#   placement_handoff_errors                   a shard handoff aborted on
+#       an unexpected error (retried next sync)
+#   session_topology_version                   gauge: the placement KV
+#       version the client session's TopologyMap was last hot-swapped
+#       to; lag against the KV's own version is swap latency

@@ -62,6 +62,7 @@ TENANT_SHED = "tenant.admission.shed"
 REPAIR_CYCLE = "storage.repair.cycle"
 QUERY_COMPILE_FALLBACK = "query.compile.fallback"
 WATCHDOG_STALL = "watchdog.stall"
+PLACEMENT_SYNC_DEFER = "placement.sync.defer"
 
 _ZERO_SPAN_ID = "0" * 16
 # placeholder trace id carried by a negative head decision's context —

@@ -24,8 +24,15 @@
 #                         (PR 9) the anti-entropy convergence audit:
 #                         every replica pair reaches per-(shard, block)
 #                         rollup-digest equality within the repair-cycle
-#                         budget, driven by the nodes' own RepairDaemons;
-#                         never tier-1
+#                         budget, driven by the nodes' own RepairDaemons.
+#                         (PR 17) The lane also runs the topology
+#                         ELASTICITY episode: add-node -> paced verified
+#                         drain -> rolling restart under live load with
+#                         chaos overlapping the placement changes, zero
+#                         acked-write loss through every handoff, and the
+#                         post-episode convergence audit. Both episodes
+#                         share the M3_TPU_RIG_SECONDS budget; never
+#                         tier-1
 #   run_tests.sh tsan   — opt-in ThreadSanitizer stage for the native
 #                         layer: (1) pytest tests/test_race_native.py
 #                         (uninstrumented pytest; its tests spawn their

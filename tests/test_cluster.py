@@ -169,6 +169,112 @@ class TestPlacement:
         assert p2.n_shards == 4 and p2.replica_factor == 2
         assert {i.id for i in p2.instances.values()} == {"n0", "n1", "n2"}
 
+    # -- elasticity edge cases (PR 17): mutations composed mid-handoff --
+
+    def test_remove_donor_while_handoff_pending(self):
+        """remove_instance on a node that is DONOR for an unfinished add:
+        the mid-flight INITIALIZING owner IS the shard's replacement, so
+        the drain must not assign a redundant third owner."""
+        insts = [Instance(f"n{i}", isolation_group=f"g{i}") for i in range(3)]
+        p = pl.initial_placement(insts, n_shards=6, replica_factor=2)
+        p2 = pl.add_instance(p, Instance("n3", isolation_group="g3"))
+        pending = p2.instances["n3"].shard_ids(ShardState.INITIALIZING)
+        assert pending  # the prior handoff is genuinely mid-flight
+        victim = p2.instances["n3"].shards[pending[0]].source_id
+        p3 = pl.remove_instance(p2, victim)
+        p3.validate()  # no shard gained more than RF non-LEAVING owners
+        # n3's pending handoffs survive the donor's drain intact
+        for sid in pending:
+            sh = p3.instances["n3"].shards.get(sid)
+            assert sh is not None and sh.state == ShardState.INITIALIZING
+        # every in-flight owner completes; the drained donor is pruned
+        cur = p3
+        for iid in sorted(p3.instances):
+            if iid in cur.instances:
+                cur = pl.mark_available(cur, iid)
+        cur.validate()
+        assert victim not in cur.instances
+        assert all(sh.state == ShardState.AVAILABLE
+                   for inst in cur.instances.values()
+                   for sh in inst.shards.values())
+
+    def test_replace_donor_mid_stream(self):
+        """replace_instance of a donor mid-stream: the replacement
+        inherits only the shards the donor was SERVING — a shard already
+        streaming to its new owner keeps that single replacement (and its
+        original source_id), instead of growing a second copy."""
+        insts = [Instance(f"n{i}", isolation_group=f"g{i}") for i in range(3)]
+        p = pl.initial_placement(insts, n_shards=6, replica_factor=2)
+        p2 = pl.add_instance(p, Instance("n3", isolation_group="g3"))
+        pending = p2.instances["n3"].shard_ids(ShardState.INITIALIZING)
+        donor_id = p2.instances["n3"].shards[pending[0]].source_id
+        p3 = pl.replace_instance(p2, donor_id,
+                                 Instance("n9", isolation_group="g9"))
+        p3.validate()
+        mid_stream = [sid for sid in pending
+                      if p2.instances["n3"].shards[sid].source_id == donor_id]
+        for sid in mid_stream:
+            assert sid not in p3.instances["n9"].shards
+            # the in-flight move still names its original source; cutover
+            # reaps the old instance's LEAVING copy through it
+            assert p3.instances["n3"].shards[sid].source_id == donor_id
+        for sh in p3.instances["n9"].shards.values():
+            assert sh.state == ShardState.INITIALIZING
+            assert sh.source_id == donor_id
+        cur = p3
+        for iid in sorted(p3.instances):
+            if iid in cur.instances:
+                cur = pl.mark_available(cur, iid)
+        cur.validate()
+        assert donor_id not in cur.instances
+
+    def test_mark_available_stale_or_removed_source(self):
+        """Cutover with a stale source: a source that was pruned (donor
+        crashed mid-drain) or whose copy is no longer LEAVING must be
+        tolerated — a KeyError here would poison the CAS retry loop."""
+        p = pl.Placement(n_shards=2, replica_factor=1)
+        x = Instance("x")
+        x.shards[0] = pl.Shard(0, ShardState.INITIALIZING, "ghost")
+        x.shards[1] = pl.Shard(1, ShardState.AVAILABLE)
+        p.instances["x"] = x
+        out = pl.mark_available(p, "x")
+        assert out.instances["x"].shards[0].state == ShardState.AVAILABLE
+
+        # source exists but no longer holds the shard LEAVING (cancelled
+        # drain): flip the target, leave the source's copy alone
+        p2 = pl.Placement(n_shards=1, replica_factor=2)
+        a, b = Instance("a"), Instance("b")
+        a.shards[0] = pl.Shard(0, ShardState.AVAILABLE)
+        b.shards[0] = pl.Shard(0, ShardState.INITIALIZING, "a")
+        p2.instances = {"a": a, "b": b}
+        out2 = pl.mark_available(p2, "b")
+        assert out2.instances["b"].shards[0].state == ShardState.AVAILABLE
+        assert out2.instances["a"].shards[0].state == ShardState.AVAILABLE
+
+    def test_json_roundtrip_mixed_states_and_sources(self):
+        """Serialization through KV mid-elasticity: INITIALIZING (with
+        source), LEAVING, and AVAILABLE shards all survive a round-trip
+        byte-exactly — the handoff controllers on every node decide from
+        this document."""
+        insts = [Instance(f"n{i}", isolation_group=f"g{i}") for i in range(3)]
+        p = pl.initial_placement(insts, n_shards=6, replica_factor=2)
+        p2 = pl.add_instance(p, Instance("n3", isolation_group="g3"))
+        p2.instances["n3"].endpoint = "http://127.0.0.1:9003"
+        rt = pl.Placement.from_json(p2.to_json())
+        assert rt.n_shards == p2.n_shards
+        assert rt.replica_factor == p2.replica_factor
+        states = {s.value for inst in rt.instances.values()
+                  for s in (sh.state for sh in inst.shards.values())}
+        assert {"INITIALIZING", "LEAVING", "AVAILABLE"} <= states
+        for iid, inst in p2.instances.items():
+            got = rt.instances[iid]
+            assert got.endpoint == inst.endpoint
+            assert ({(s.id, s.state, s.source_id)
+                     for s in inst.shards.values()}
+                    == {(s.id, s.state, s.source_id)
+                        for s in got.shards.values()})
+        rt.validate()
+
 
 def make_cluster(tmp_path, n_nodes=3, n_shards=6, rf=3):
     insts = [Instance(f"node-{i}") for i in range(n_nodes)]

@@ -1,0 +1,413 @@
+"""Shard handoff controller (services/handoff.py) + placement hot-swap
+(client/topology_watch.py).
+
+The zero-acked-write-loss half of PR 17's tentpole, proven in-process:
+a donor Database with flushed filesets AND unflushed acked writes hands
+a shard to a new owner through the full protocol — probe, paced
+bootstrap, donor buffer/WAL tail flush, rollup-digest verification with
+repair catch-up, then the `mark_available` CAS — and the unflushed
+points are readable on the new owner before the donor ever drops the
+shard. Chaos: seeded crashes at the ``handoff.stream`` and
+``placement.cutover`` fault points kill the handoff mid-stream and
+mid-CAS; the placement stays untouched and a re-request completes."""
+
+from __future__ import annotations
+
+import pytest
+
+from m3_tpu.cluster import placement as pl
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.cluster.placement import Instance, ShardState
+from m3_tpu.services.handoff import HandoffController
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import DatabaseOptions
+from m3_tpu.storage.peers import InProcessPeer, local_rollup_digests
+from m3_tpu.storage.sharding import ShardSet
+from m3_tpu.utils import faults
+from m3_tpu.utils.ident import tags_to_id
+
+SEC = 10**9
+HOUR = 3600 * SEC
+START = 1_599_998_400_000_000_000
+N_SHARDS = 4
+N_SERIES = 24
+
+
+def _series(i: int):
+    name = b"hand_m%d" % i
+    tags = [(b"k", b"v%d" % i)]
+    return name, tags, tags_to_id(name, tags)
+
+
+class _DownPeer:
+    """A peer whose process is gone: every call fails."""
+
+    def block_starts(self, namespace, shard):
+        raise ConnectionError("peer down")
+
+    def rollup_digests(self, namespace, shard):
+        raise ConnectionError("peer down")
+
+    def flush_shard(self, shard):
+        raise ConnectionError("peer down")
+
+
+class HandoffEnv:
+    """Donor owning every shard (flushed + unflushed acked writes), a
+    fresh target, and an add_instance placement in a KVStore — the
+    in-process mirror of a scale-out."""
+
+    def __init__(self, tmp_path):
+        self.kv = KVStore()
+        self.donor = Database(str(tmp_path / "old"),
+                              DatabaseOptions(n_shards=N_SHARDS))
+        self.donor.create_namespace("t")
+        self.donor.open(now_ns=START)
+        self.target = Database(str(tmp_path / "new"),
+                               DatabaseOptions(n_shards=N_SHARDS))
+        self.target.create_namespace("t")
+        self.target.open(now_ns=START)
+
+        shard_of = ShardSet(N_SHARDS).lookup
+        self.points: dict[int, list] = {}  # series index -> [(t, v)]
+        self.shard_of_series: dict[int, int] = {}
+        # flushed history: written, then force-flushed to filesets
+        for i in range(N_SERIES):
+            name, tags, sid_bytes = _series(i)
+            self.shard_of_series[i] = shard_of(sid_bytes)
+            pts = [(START + j * 60 * SEC, float(100 * i + j))
+                   for j in range(3)]
+            for t, v in pts:
+                self.donor.write_tagged("t", name, tags, t, v)
+            self.points[i] = pts
+        for s in range(N_SHARDS):
+            self.donor.flush_shard(s)
+        # the tail: acked writes still in the donor's mutable buffer —
+        # the data inline sync_placement used to silently drop
+        for i in range(N_SERIES):
+            name, tags, _sid = _series(i)
+            t, v = START + HOUR + i * SEC, float(1000 + i)
+            self.donor.write_tagged("t", name, tags, t, v)
+            self.points[i].append((t, v))
+
+        p = pl.initial_placement([Instance("old", isolation_group="g0")],
+                                 n_shards=N_SHARDS, replica_factor=1)
+        p2 = pl.add_instance(p, Instance("new", isolation_group="g1"))
+        pl.store_placement(self.kv, p2)
+        self.moved = p2.instances["new"].shard_ids(ShardState.INITIALIZING)
+        assert self.moved  # the scale-out actually moved shards
+        self.target.assign_shards(set(self.moved))
+        self.peers = {"old": InProcessPeer(self.donor),
+                      "new": InProcessPeer(self.target)}
+
+    def controller(self, peer_for_instance=None) -> HandoffController:
+        def load():
+            loaded = pl.load_placement(self.kv)
+            return loaded if loaded is not None else (None, -1)
+
+        return HandoffController(
+            self.target, self.kv, "new", load,
+            peer_for_instance or (lambda inst: self.peers.get(inst.id)))
+
+    def placement(self) -> pl.Placement:
+        return pl.load_placement(self.kv)[0]
+
+    def close(self):
+        self.donor.close()
+        self.target.close()
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = HandoffEnv(tmp_path)
+    yield e
+    e.close()
+
+
+class TestVerifiedHandoff:
+    def test_zero_acked_write_loss_through_cutover(self, env):
+        ctl = env.controller()
+        for sid in env.moved:
+            ctl._run_one(sid)
+
+        # cutover happened for every moved shard, and the donor's
+        # LEAVING copies were reaped by mark_available
+        p = env.placement()
+        for sid in env.moved:
+            assert p.instances["new"].shards[sid].state == \
+                ShardState.AVAILABLE
+            assert sid not in p.instances["old"].shards
+        assert ctl.totals["completed"] == len(env.moved)
+        assert not ctl.pending()
+
+        # the proof: every acked point — including the donor's
+        # unflushed tail — reads back from the NEW owner
+        for i in range(N_SERIES):
+            if env.shard_of_series[i] not in env.moved:
+                continue
+            _name, _tags, sid_bytes = _series(i)
+            got = {(d.timestamp_ns, d.value)
+                   for d in env.target.read("t", sid_bytes, 0, 1 << 62)}
+            assert got == set(env.points[i]), f"series {i} lost data"
+
+        # and the digest tables agree — the condition cutover gated on
+        for sid in env.moved:
+            assert (local_rollup_digests(env.target, "t", sid)
+                    == local_rollup_digests(env.donor, "t", sid))
+
+    def test_status_and_counters(self, env):
+        ctl = env.controller()
+        for sid in env.moved:
+            ctl._run_one(sid)
+        st = ctl.status()
+        assert st["in_flight"] == []
+        assert st["totals"]["completed"] == len(env.moved)
+        for sid in env.moved:
+            assert st["shards"][str(sid)]["state"] == "done"
+            assert st["shards"][str(sid)]["namespaces"].get("t", 0) >= 1
+
+    def test_unreachable_peers_defer_not_cutover(self, env):
+        """A shard whose data sources are all down must NOT go
+        AVAILABLE: cutover would reap the donor's LEAVING copy — the
+        only full copy — off the placement."""
+        ctl = env.controller(
+            peer_for_instance=lambda inst:
+                _DownPeer() if inst.id == "old"
+                else env.peers.get(inst.id))
+        sid = env.moved[0]
+        ctl._run_one(sid)
+        assert ctl.totals["deferred"] == 1
+        assert ctl.status()["shards"][str(sid)]["state"] == "deferred"
+        assert ctl.pending()  # the tick keeps re-syncing until it lands
+        p = env.placement()
+        assert p.instances["new"].shards[sid].state == \
+            ShardState.INITIALIZING
+        assert p.instances["old"].shards[sid].state == ShardState.LEAVING
+
+    def test_superseded_request_is_noop(self, env):
+        """The placement moved on (shard no longer INITIALIZING here):
+        the controller must not touch it."""
+        sid = env.moved[0]
+        pl.cas_update_placement(
+            env.kv, lambda cur: pl.mark_available(cur, "new", [sid]))
+        before = env.placement().to_json()
+        ctl = env.controller()
+        ctl._run_one(sid)
+        assert env.placement().to_json() == before
+        assert ctl.status()["shards"][str(sid)]["state"] == "superseded"
+        assert ctl.totals["completed"] == 0
+
+
+class TestHandoffChaos:
+    """The acceptance chaos: seeded crashes mid-stream and mid-CAS.
+    _run_one is driven on the test thread (not the shared lane) so the
+    injected SimulatedCrash surfaces here instead of killing a worker."""
+
+    def test_crash_mid_stream_then_resume(self, env):
+        sid = env.moved[0]
+        ctl = env.controller()
+        with faults.active("handoff.stream=crash:n1"):
+            with pytest.raises(faults.SimulatedCrash):
+                ctl._handoff_shard(sid)
+        # the kill left the placement untouched: donor still owns the
+        # shard, the target is still INITIALIZING
+        p = env.placement()
+        assert p.instances["new"].shards[sid].state == \
+            ShardState.INITIALIZING
+        assert p.instances["old"].shards[sid].state == ShardState.LEAVING
+        # "restart": a fresh controller re-requests and completes, tail
+        # included
+        ctl2 = env.controller()
+        ctl2._run_one(sid)
+        p2 = env.placement()
+        assert p2.instances["new"].shards[sid].state == \
+            ShardState.AVAILABLE
+        for i in range(N_SERIES):
+            if env.shard_of_series[i] != sid:
+                continue
+            _n, _t, sid_bytes = _series(i)
+            got = {(d.timestamp_ns, d.value)
+                   for d in env.target.read("t", sid_bytes, 0, 1 << 62)}
+            assert got == set(env.points[i])
+
+    def test_crash_mid_cutover_cas(self, env):
+        """Death between digest verification and the mark_available CAS:
+        the placement must be untouched (the donor keeps the shard and
+        its tail), and the retry completes without re-streaming damage."""
+        sid = env.moved[0]
+        ctl = env.controller()
+        with faults.active("placement.cutover=crash:n1"):
+            with pytest.raises(faults.SimulatedCrash):
+                ctl._handoff_shard(sid)
+        p = env.placement()
+        assert p.instances["new"].shards[sid].state == \
+            ShardState.INITIALIZING
+        assert p.instances["old"].shards[sid].state == ShardState.LEAVING
+        ctl2 = env.controller()
+        ctl2._run_one(sid)
+        p2 = env.placement()
+        assert p2.instances["new"].shards[sid].state == \
+            ShardState.AVAILABLE
+        assert sid not in p2.instances["old"].shards
+        for i in range(N_SERIES):
+            if env.shard_of_series[i] != sid:
+                continue
+            _n, _t, sid_bytes = _series(i)
+            got = {(d.timestamp_ns, d.value)
+                   for d in env.target.read("t", sid_bytes, 0, 1 << 62)}
+            assert got == set(env.points[i])
+
+    def test_cutover_cas_contention_counted(self, env):
+        """A CAS that keeps losing (KV contention) is a counted,
+        retryable failure — not a silent log line, never a half-cutover."""
+        sid = env.moved[0]
+
+        class _ContendedKV:
+            def __init__(self, kv):
+                self._kv = kv
+
+            def get(self, key):
+                return self._kv.get(key)
+
+            def check_and_set(self, key, version, data):
+                from m3_tpu.cluster.kv import VersionMismatch
+
+                raise VersionMismatch(key)
+
+        ctl = env.controller()
+        ctl.kv = _ContendedKV(env.kv)
+        ctl._run_one(sid)
+        assert ctl.totals["cutover_failures"] == 1
+        assert ctl.status()["shards"][str(sid)]["state"] == "error"
+        assert env.placement().instances["new"].shards[sid].state == \
+            ShardState.INITIALIZING
+
+
+class TestDeadDonorReplace:
+    def test_dead_donor_streams_from_survivors(self, tmp_path):
+        """replace of a DEAD node: the donor process is gone, so the
+        tail flush can never succeed. The controller must fall back to
+        the surviving replicas (which hold every majority-acked write)
+        instead of deferring forever."""
+        kv = KVStore()
+        survivor = Database(str(tmp_path / "s"),
+                            DatabaseOptions(n_shards=N_SHARDS))
+        survivor.create_namespace("t")
+        survivor.open(now_ns=START)
+        target = Database(str(tmp_path / "r"),
+                          DatabaseOptions(n_shards=N_SHARDS))
+        target.create_namespace("t")
+        target.open(now_ns=START)
+        name, tags, sid_bytes = _series(0)
+        survivor.write_tagged("t", name, tags, START, 7.0)
+        shard = ShardSet(N_SHARDS).lookup(sid_bytes)
+        survivor.flush_shard(shard)
+
+        p = pl.initial_placement(
+            [Instance("dead", isolation_group="g0"),
+             Instance("live", isolation_group="g1")],
+            n_shards=N_SHARDS, replica_factor=2)
+        p2 = pl.replace_instance(p, "dead", Instance("r", isolation_group="g2"))
+        pl.store_placement(kv, p2)
+        target.assign_shards(
+            p2.instances["r"].shard_ids(ShardState.INITIALIZING))
+        peers = {"live": InProcessPeer(survivor), "dead": _DownPeer()}
+
+        def load():
+            loaded = pl.load_placement(kv)
+            return loaded if loaded is not None else (None, -1)
+
+        ctl = HandoffController(target, kv, "r", load,
+                                lambda inst: peers.get(inst.id))
+        try:
+            ctl._run_one(shard)
+            cur = pl.load_placement(kv)[0]
+            assert cur.instances["r"].shards[shard].state == \
+                ShardState.AVAILABLE
+            got = {(d.timestamp_ns, d.value)
+                   for d in target.read("t", sid_bytes, 0, 1 << 62)}
+            assert got == {(START, 7.0)}
+        finally:
+            survivor.close()
+            target.close()
+
+
+class TestPlacementWatcher:
+    def test_version_gated_hot_swap(self):
+        from m3_tpu.client.session import Session
+        from m3_tpu.client.topology_watch import PlacementWatcher
+        from m3_tpu.cluster.topology import TopologyMap
+
+        kv = KVStore()
+        p = pl.initial_placement(
+            [Instance("a", isolation_group="g0"),
+             Instance("b", isolation_group="g1")],
+            n_shards=N_SHARDS, replica_factor=2)
+        pl.store_placement(kv, p)
+        session = Session(TopologyMap(p), {})
+        watcher = PlacementWatcher(kv, session)
+        assert watcher.poll()  # first poll adopts the stored version
+        old_map = session.topology
+        assert watcher.poll() is False  # version-gated: no change, no swap
+        assert session.topology is old_map
+
+        p2 = pl.add_instance(p, Instance("c", isolation_group="g2"))
+        pl.store_placement(kv, p2)
+        assert watcher.poll()
+        assert session.topology is not old_map
+        assert "c" in session.topology.placement.instances
+
+    def test_connection_reconcile(self):
+        from m3_tpu.client.session import Session
+        from m3_tpu.client.topology_watch import PlacementWatcher
+        from m3_tpu.cluster.topology import TopologyMap
+
+        class FakeConn:
+            def __init__(self, endpoint):
+                from m3_tpu.client.http_conn import parse_endpoint
+
+                self.host, self.port = parse_endpoint(endpoint)
+                self.closed = False
+
+            def close(self):
+                self.closed = True
+
+        kv = KVStore()
+        a = Instance("a", isolation_group="g0",
+                     endpoint="http://127.0.0.1:9001")
+        b = Instance("b", isolation_group="g1",
+                     endpoint="http://127.0.0.1:9002")
+        p = pl.initial_placement([a, b], n_shards=N_SHARDS,
+                                 replica_factor=2)
+        p.instances["a"].endpoint = "http://127.0.0.1:9001"
+        p.instances["b"].endpoint = "http://127.0.0.1:9002"
+        pl.store_placement(kv, p)
+        session = Session(TopologyMap(p), {})
+        built = []
+
+        def factory(ep):
+            conn = FakeConn(ep)
+            built.append(conn)
+            return conn
+
+        watcher = PlacementWatcher(kv, session, connection_factory=factory)
+        assert watcher.poll()
+        assert set(session.connections) == {"a", "b"}
+        conn_a = session.connections["a"]
+
+        # instance b restarts on a new endpoint; a is unchanged
+        p2 = pl.Placement.from_json(p.to_json())
+        p2.instances["b"].endpoint = "http://127.0.0.1:9102"
+        pl.store_placement(kv, p2)
+        assert watcher.poll()
+        assert session.connections["a"] is conn_a  # not churned
+        assert session.connections["b"].port == 9102
+
+        # instance b removed: its connection closes and drops
+        old_b = session.connections["b"]
+        p3 = pl.Placement.from_json(p2.to_json())
+        del p3.instances["b"]
+        pl.store_placement(kv, p3)
+        assert watcher.poll()
+        assert "b" not in session.connections
+        assert old_b.closed

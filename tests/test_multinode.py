@@ -10,6 +10,7 @@ integration/fake: real services + real wire protocol, file-backed KV."""
 from __future__ import annotations
 
 import json
+import time
 import urllib.request
 
 import numpy as np
@@ -208,14 +209,22 @@ class TestAddNode:
         pl.cas_update_placement(kv, add)
         svc3 = make_node(tmp_path, kv, "node3")
         try:
-            # the new node claimed shards and marked them AVAILABLE
-            p, _ = pl.load_placement(kv)
-            inst = p.instances["node3"]
-            assert inst.shards, "new node got no shards"
             from m3_tpu.cluster.placement import ShardState
 
-            assert all(s.state == ShardState.AVAILABLE
-                       for s in inst.shards.values())
+            # the new node claimed shards and marks them AVAILABLE as its
+            # off-tick handoffs verify + cut over (bounded wait: handoff
+            # runs on the pipeline lane, not inline in sync_placement)
+            deadline = time.monotonic() + 15.0
+            while True:
+                p, _ = pl.load_placement(kv)
+                inst = p.instances["node3"]
+                if inst.shards and all(s.state == ShardState.AVAILABLE
+                                       for s in inst.shards.values()):
+                    break
+                assert time.monotonic() < deadline, \
+                    {sid: s.state for sid, s in inst.shards.items()}
+                time.sleep(0.05)
+            assert inst.shards, "new node got no shards"
             # and it actually holds streamed data for its shards
             total = sum(
                 len(ns.series_ids()) for ns in svc3.db.namespaces.values()

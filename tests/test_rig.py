@@ -434,6 +434,57 @@ class TestProcessRig:
         # every process is back at the end
         assert all(v == "ok" for v in report["final_heartbeats"].values())
 
+    def test_elasticity_episode(self, tmp_path):
+        """ROADMAP #6(b): add-node -> paced drain -> rolling restart
+        under live load with a chaos schedule on the kvd/aggregator
+        planes. The placement CAS is the rig's only lever — the nodes'
+        handoff controllers stream, digest-verify, and cut over. Budget
+        rides M3_TPU_RIG_SECONDS like the production run."""
+        seconds = float(os.environ.get("M3_TPU_RIG_SECONDS", "20"))
+        seed = int(os.environ.get("M3_TPU_RIG_SEED", "7"))
+        report = rigmod.run_elasticity_episode(
+            str(tmp_path / "rig"), seconds=max(10.0, seconds), seed=seed,
+            slo_p99_ms=5000.0)
+
+        # the topology actually churned: every verb ran and landed on
+        # the trajectory timeline
+        acts = [e["action"]
+                for e in report["trajectory"]["topology_events"]]
+        for want in ("add_node", "handoff_settled", "drain", "drained",
+                     "restart"):
+            assert want in acts, acts
+        assert not report["chaos_errors"], report["chaos_errors"]
+
+        # zero acked-write loss through add/drain/restart
+        assert report["verify"]["acked"] > 0
+        assert report["verify"]["missing"] == [], report["verify"]
+        assert report["verify"]["checked"] == report["verify"]["acked"]
+
+        # the handoff controllers did the work, observable on the new
+        # /debug/placement surface (per-shard records, cutover totals)
+        completed = sum(
+            doc.get("handoff", {}).get("totals", {}).get("completed", 0)
+            for doc in report["handoff_status"].values())
+        assert completed > 0, report["handoff_status"]
+
+        # the drained node is GONE and every shard ended AVAILABLE on
+        # the post-change owners
+        final = report["final_placement"]
+        assert report["drained_node"] not in final, final
+        assert final, final
+        assert all(st == "AVAILABLE" for shards in final.values()
+                   for st in shards.values()), final
+
+        # bounded read p99 while the topology churned
+        for t, st in report["phase"]["tenants"].items():
+            if st["client_p99_ms"] is not None:
+                assert st["client_p99_ms"] < 5000.0, (t, st)
+
+        # anti-entropy convergence on the post-change replica pairs
+        conv = report["convergence"]
+        assert conv["converged"], conv
+        assert conv["replica_pairs"] > 0, conv
+
     def test_crash_rule_kills_real_process(self, tmp_path):
         """The M3_TPU_FAULTS_EXIT satellite end to end: a crash-mode
         fault rule firing inside a REAL dbnode makes the process exit
