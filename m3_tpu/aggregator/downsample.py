@@ -5,6 +5,11 @@ Role parity with /root/reference/src/cmd/services/m3coordinator/downsample
 aggregated output back to storage) and ingest/write.go's
 DownsamplerAndWriter: every incoming write goes to the downsampler (rule
 match -> aggregation) and/or the unaggregated namespace.
+
+The flush loop also hosts the standing-query plane (query/standing.py):
+recording rules evaluate incrementally right after the aggregation
+flush, under the same leader/local discipline, writing into the same
+per-policy aggregated namespaces.
 """
 
 from __future__ import annotations
@@ -23,17 +28,64 @@ class Downsampler:
     aggregated namespaces (created on demand)."""
 
     def __init__(self, db, ruleset: RuleSet, local_leader: bool = True,
-                 buffer_past_ns: int = 0):
+                 buffer_past_ns: int = 0, source_namespace: str = "default",
+                 register_namespace=None, now_fn=None):
         self.db = db
         self.aggregator = Aggregator(ruleset, buffer_past_ns=buffer_past_ns)
         # local leader mode (leader_local.go role): this process always
         # flushes; the clustered service swaps in an elected flush manager
         self.local_leader = local_leader
+        self.source_namespace = source_namespace
+        # registry-sync hook: a namespace created on demand mid-flush
+        # must ALSO land in the KV namespace registry, or a dbnode
+        # restarting later re-creates it empty and abandons its WAL
+        # (the coordinator wires this to the registry CAS when a KV is
+        # configured; None = local single-process deployments)
+        self.register_namespace = register_namespace
+        self._registered: set[str] = set()
         self._handler = storage_flush_handler(db, self._namespace_for)
+        self.standing = None
+        if ruleset.standing_rules:
+            self.standing = self._make_standing(ruleset, now_fn)
+        self._now_fn = now_fn
+
+    def _make_standing(self, ruleset: RuleSet, now_fn):
+        from m3_tpu.query.standing import StandingEvaluator
+
+        return StandingEvaluator(
+            self.db, ruleset.standing_rules,
+            source_namespace=self.source_namespace,
+            namespace_for=self._namespace_for, now_fn=now_fn,
+            write_raw_namespace=self.source_namespace)
+
+    def set_ruleset(self, rs: RuleSet) -> None:
+        """Swap the live ruleset (KV reload): the matcher's version bump
+        invalidates its match cache; the standing evaluator keeps state
+        for surviving rule names."""
+        self.aggregator.matcher.ruleset = rs
+        if rs.standing_rules:
+            if self.standing is None:
+                self.standing = self._make_standing(rs, self._now_fn)
+            else:
+                self.standing.set_rules(rs.standing_rules)
+        elif self.standing is not None:
+            self.standing.set_rules(())
+
+    def _policy_complete(self, policy: StoragePolicy) -> bool:
+        """A tier is COMPLETE (eligible for cheapest-tier read
+        resolution) when a downsample-all mapping rule feeds it: every
+        named metric lands there at the policy's resolution."""
+        rs = self.aggregator.matcher.ruleset
+        return any(policy in r.policies and r.filter.matches_all()
+                   for r in rs.mapping_rules)
 
     def _namespace_for(self, policy: StoragePolicy) -> str:
         name = policy.namespace_name
+        complete = self._policy_complete(policy)
         if name not in self.db.namespaces:
+            # Database.create_namespace runs the full live-bootstrap
+            # path (filesets, snapshots, commitlog replay) since PR 7 —
+            # a namespace re-created mid-flush picks its WAL back up
             self.db.create_namespace(
                 name,
                 NamespaceOptions(
@@ -43,8 +95,12 @@ class Downsampler:
                                           2 * 3600 * 10**9),
                     ),
                     aggregated_resolution_ns=policy.resolution_ns,
+                    aggregated_complete=complete,
                 ),
             )
+        if self.register_namespace is not None and name not in self._registered:
+            self.register_namespace(name, policy, complete)
+            self._registered.add(name)
         return name
 
     def append(self, metric_type: MetricType, series_id: bytes, tags, t_ns: int,
@@ -57,7 +113,10 @@ class Downsampler:
             return 0
         now_ns = now_ns if now_ns is not None else time.time_ns()
         metrics = self.aggregator.flush(now_ns)
-        return self._handler(metrics)
+        written = self._handler(metrics)
+        if self.standing is not None:
+            self.standing.evaluate(now_ns)
+        return written
 
 
 class DownsamplerAndWriter:

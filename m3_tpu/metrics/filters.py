@@ -110,6 +110,14 @@ class TagFilter:
             raise ValueError("empty filter")
         return cls(clauses)
 
+    def matches_all(self) -> bool:
+        """True for the downsample-all shape (`__name__:*`): every clause
+        is an unnegated `*` glob on the metric name, so every NAMED
+        metric matches. An aggregated namespace fed only by such rules is
+        COMPLETE — the marker cheapest-tier read resolution requires."""
+        return all(c.name == b"__name__" and c.pattern == "*"
+                   and not c.negate for c in self.clauses)
+
     def matches(self, tags: dict[bytes, bytes]) -> bool:
         for name, rx, negate in self._compiled:
             value = tags.get(name)

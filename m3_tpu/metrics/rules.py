@@ -76,6 +76,31 @@ class RollupRule:
     targets: tuple[RollupTarget, ...]
 
 
+@dataclass(frozen=True)
+class StandingRule:
+    """A standing (recording) query: a PromQL expression evaluated
+    continuously on the policy's resolution grid, its output written as
+    new series named `name` (the Prometheus recording-rule role, fused
+    with the reference's rollup storage policies: the policy both paces
+    the evaluation grid and names the aggregated output namespace).
+
+    Unlike mapping/rollup rules — which match individual incoming
+    datapoints — a standing rule is a whole QUERY: it compiles through
+    query/compiler.py exactly like an ad-hoc request and re-evaluates
+    incrementally when its input shards' data versions bump
+    (query/standing.py)."""
+
+    name: str            # output metric name (recording-rule convention)
+    expr: str            # PromQL over the source namespace
+    policy: StoragePolicy  # eval grid resolution + output retention
+    labels: tuple[tuple[bytes, bytes], ...] = ()  # stamped on outputs
+    # also write outputs into the unaggregated namespace so fine-step
+    # dashboard reads within raw retention see them (the aggregated
+    # copy serves long-range reads past raw retention via the resolver
+    # fanout); False = aggregated-tier only
+    write_raw: bool = True
+
+
 @dataclass
 class MatchResult:
     mappings: list[MappingRule] = field(default_factory=list)
@@ -91,9 +116,10 @@ class MatchResult:
 class RuleSet:
     """The active ruleset: matches tag dicts to mapping/rollup outcomes."""
 
-    def __init__(self, mapping_rules=(), rollup_rules=()):
+    def __init__(self, mapping_rules=(), rollup_rules=(), standing_rules=()):
         self.mapping_rules: list[MappingRule] = list(mapping_rules)
         self.rollup_rules: list[RollupRule] = list(rollup_rules)
+        self.standing_rules: list[StandingRule] = list(standing_rules)
         self.version = 1
 
     def match(self, tags: dict[bytes, bytes]) -> MatchResult:

@@ -22,6 +22,7 @@ from m3_tpu.metrics.rules import (
     RollupRule,
     RollupTarget,
     RuleSet,
+    StandingRule,
 )
 from m3_tpu.metrics.transformation import TransformationType
 
@@ -131,11 +132,34 @@ def _rollup_from_doc(doc: dict) -> RollupRule:
     )
 
 
+def _standing_to_doc(r: StandingRule) -> dict:
+    doc = {"name": r.name, "expr": r.expr, "policy": str(r.policy)}
+    if r.labels:
+        doc["labels"] = {k.decode(): v.decode() for k, v in r.labels}
+    if not r.write_raw:
+        doc["write_raw"] = False
+    return doc
+
+
+def _standing_from_doc(doc: dict) -> StandingRule:
+    return StandingRule(
+        name=doc.get("name", ""),
+        expr=doc["expr"],
+        policy=StoragePolicy.parse(doc["policy"]),
+        labels=tuple(sorted((k.encode(), v.encode())
+                            for k, v in (doc.get("labels") or {}).items())),
+        write_raw=bool(doc.get("write_raw", True)),
+    )
+
+
 def ruleset_to_doc(rs: RuleSet) -> dict:
-    return {
+    doc = {
         "mapping": [_mapping_to_doc(r) for r in rs.mapping_rules],
         "rollup": [_rollup_to_doc(r) for r in rs.rollup_rules],
     }
+    if rs.standing_rules:
+        doc["standing"] = [_standing_to_doc(r) for r in rs.standing_rules]
+    return doc
 
 
 def ruleset_from_doc(doc: dict | None) -> RuleSet:
@@ -144,26 +168,39 @@ def ruleset_from_doc(doc: dict | None) -> RuleSet:
         return rs
     rs.mapping_rules = [_mapping_from_doc(d) for d in doc.get("mapping", []) or []]
     rs.rollup_rules = [_rollup_from_doc(d) for d in doc.get("rollup", []) or []]
+    rs.standing_rules = [_standing_from_doc(d)
+                         for d in doc.get("standing", []) or []]
     return rs
 
 
 def validate_doc(doc: dict) -> None:
     """Raises ValueError on a malformed doc (parse round-trip + rule-name
     uniqueness, the reference store's validation role)."""
-    unknown = set(doc) - {"mapping", "rollup"}
+    unknown = set(doc) - {"mapping", "rollup", "standing"}
     if unknown:
         # a typo'd key ("mappingRules") would otherwise silently store an
         # EMPTY ruleset and wipe live aggregation
         raise ValueError(f"unknown ruleset doc keys: {sorted(unknown)}")
     rs = ruleset_from_doc(doc)  # raises on bad filters/policies/enums
     for kind, rules in (("mapping", rs.mapping_rules),
-                        ("rollup", rs.rollup_rules)):
+                        ("rollup", rs.rollup_rules),
+                        ("standing", rs.standing_rules)):
         names = [r.name for r in rules]
         if len(names) != len(set(names)):
             dupes = sorted({n for n in names if names.count(n) > 1})
             raise ValueError(f"duplicate {kind} rule names: {dupes}")
         if any(not n for n in names):
             raise ValueError(f"every {kind} rule needs a name")
+    for r in rs.standing_rules:
+        # a standing rule is a QUERY — an unparseable expr must be
+        # rejected at store time, not discovered at the first flush
+        from m3_tpu.query import promql
+
+        try:
+            promql.parse(r.expr)
+        except Exception as e:  # noqa: BLE001 - parser error surface
+            raise ValueError(
+                f"standing rule {r.name!r}: bad expr: {e}") from e
 
 
 # -- KV store ---------------------------------------------------------------

@@ -418,6 +418,18 @@ class CoordinatorAPI:
             limit = int(q.get("limit", ["20"])[0])
             return 200, "application/json", json.dumps(
                 {"plans": explain_mod.recent(limit)}).encode()
+        if path == "/debug/standing":
+            # per-rule standing-query evaluation state (watermarks, eval/
+            # skip tallies, matched shards, last error) — the rig's
+            # standing_rules episode audits recovery through this surface
+            standing = getattr(getattr(self.writer, "downsampler", None),
+                               "standing", None)
+            if standing is None:
+                return 404, "application/json", json.dumps(
+                    {"status": "error", "error": "no standing rules"}
+                ).encode()
+            return 200, "application/json", json.dumps(
+                standing.status()).encode()
         if path == "/debug/slow_queries":
             from m3_tpu.utils import querystats
 

@@ -260,19 +260,25 @@ class Engine:
     def _fetch(self, sel: VectorSelector, eval_ts: np.ndarray, range_ns: int):
         """(labels, RaggedSeries) for samples covering the windows.
 
-        Namespaces are chosen by retention-tier resolution (query/resolver):
-        a range past raw retention reads the downsampled namespaces and
-        stitches — the reference's aggregated-namespace fanout
-        (cluster_resolver.go)."""
+        Namespaces are chosen by tier resolution (query/resolver): a
+        coarse-step read goes to the cheapest complete aggregated tier
+        (resolve_read), and a range past raw retention reads the
+        downsampled namespaces and stitches — the reference's
+        aggregated-namespace fanout (cluster_resolver.go)."""
         shifted = self._resolve_ts(sel, eval_ts)
         t_min = int(shifted[0]) - max(range_ns, self.lookback_ns)
         t_max = int(shifted[-1]) + 1
         from m3_tpu.index.query import matchers_to_query
         from m3_tpu.query import resolver
 
-        ns_list = (resolver.resolve_namespaces(self.db, self.namespace,
-                                               t_min, t_max, self.now_fn())
-                   if self.resolve_tiers else [self.namespace])
+        if self.resolve_tiers:
+            step_ns = int(eval_ts[1] - eval_ts[0]) if len(eval_ts) > 1 else 0
+            ns_list, tier_info = resolver.resolve_read(
+                self.db, self.namespace, t_min, t_max, step_ns, range_ns,
+                self.now_fn())
+            self._record_tier_choice(tier_info)
+        else:
+            ns_list = [self.namespace]
         iq = matchers_to_query(sel.matchers)
         warn_sink = getattr(self._warn_tls, "sink", None)
         # version key sampled BEFORE the read: a write racing the fetch
@@ -303,6 +309,24 @@ class Engine:
         # compiled path can key prepared device slabs on it
         raws.fetch_key = fetch_key
         return labels, raws
+
+    def _record_tier_choice(self, info: dict) -> None:
+        """Per-tier read counters (query.tier scope, {tier=mode/res}) +
+        the explain `tiers` block: every selector fetch records which
+        tier served it, so ?explain=analyze shows the routing and
+        dashboards can watch aggregated-tier hit rates."""
+        from m3_tpu.query import explain as explain_mod
+        from m3_tpu.utils.instrument import default_registry
+
+        tier = info.get("mode", "raw")
+        if tier == "aggregated":
+            res = int(info.get("resolution_ns", 0))
+            tier = f"aggregated_{res // 1_000_000_000}s"
+        default_registry().root_scope("query").subscope(
+            "tier", tier=tier).counter("reads")
+        col = explain_mod.current()
+        if col is not None:
+            col.add_tier(info)
 
     def _fetch_key(self, sel, ns_list, t_min: int, t_max: int):
         """Content-version key for one selector fetch, or None when any

@@ -163,6 +163,10 @@ class Collector:
         # per query — {"ran": bool, "cache_key": ..., "cache": hit|miss}
         # or {"ran": False, "reason": ...} on fallback
         self.compiled: dict | None = None
+        # retention-tier routing (query/resolver.resolve_read): one
+        # record per selector fetch — {"mode": aggregated|raw|stitched|
+        # pinned_raw, "namespaces": [...], resolution/step when routed}
+        self.tiers: list[dict] = []
         # legs already attributed to a (descendant) plan node: children
         # exit before parents, so a parent only claims what its subtree
         # hasn't — the selector gets the rpc legs, not every ancestor
@@ -292,11 +296,18 @@ class Collector:
         cache key and hit/miss ride the ?explain= envelope and the ring)."""
         self.compiled = info
 
+    def add_tier(self, info: dict) -> None:
+        """Record one selector fetch's tier-resolution choice (the
+        cheapest-tier routing, query/resolver.resolve_read)."""
+        self.tiers.append(info)
+
     def to_dict(self) -> dict:
         doc = {"mode": "analyze" if self.analyze else "plan",
                "tree": self.tree()}
         if self.compiled is not None:
             doc["compiled"] = self.compiled
+        if self.tiers:
+            doc["tiers"] = self.tiers
         return doc
 
 

@@ -16,10 +16,18 @@ Selection semantics (the reference's "default" fanout option):
   tier's samples from that tier's earliest sample onward and fills the
   older span from coarser tiers — so a rate() spanning the boundary sees
   one continuous, deduplicated stream.
+
+Cheapest-tier resolution (resolve_read, ROADMAP #2): BEFORE the coverage
+fallback above, a query whose step is coarse enough is routed to the
+cheapest (coarsest-resolution) COMPLETE aggregated namespace that covers
+its range — long-range dashboards read tiny pre-aggregated series
+instead of decoding raw samples. `M3_TPU_TIER_RESOLVE=0` pins reads to
+the retention-driven path (raw within retention) for parity testing.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 
@@ -31,6 +39,7 @@ class Tier:
     name: str
     resolution_ns: int  # 0 = raw
     retention_ns: int
+    complete: bool = False  # holds EVERY metric (downsample-all fed)
 
 
 def namespace_tiers(db) -> list[Tier]:
@@ -42,8 +51,67 @@ def namespace_tiers(db) -> list[Tier]:
         if opts is None:
             continue
         out.append(Tier(name, opts.aggregated_resolution_ns,
-                        opts.retention.retention_ns))
+                        opts.retention.retention_ns,
+                        getattr(opts, "aggregated_complete", False)))
     return out
+
+
+def tier_resolution_enabled() -> bool:
+    """M3_TPU_TIER_RESOLVE=0 disables cheapest-tier selection (reads pin
+    to the retention-driven raw path). Read per query so operators and
+    parity tests can flip the hatch on a live process."""
+    return os.environ.get("M3_TPU_TIER_RESOLVE") != "0"
+
+
+def resolve_read(db, unagg: str, t_min: int, t_max: int, step_ns: int,
+                 range_ns: int = 0, now_ns: int | None = None
+                 ) -> tuple[list[str], dict]:
+    """Namespaces to read for one selector fetch, plus the tier-choice
+    record the explain surface reports.
+
+    Choice matrix (cheapest covering tier wins):
+    - candidates are COMPLETE aggregated tiers whose resolution covers
+      the requested grid (resolution <= step) and window (2*resolution
+      <= range for range selectors — a rate needs >= 2 samples per
+      window) and whose retention covers the range start;
+    - among candidates the COARSEST resolution wins (fewest samples
+      decoded); resolution ties break to the longer retention, then the
+      lexically smaller name (determinism);
+    - no candidate (fine step, partial tiers, uncovered range) falls
+      back to the retention-driven resolve_namespaces fanout: raw alone
+      when it covers, else finest-first stitching.
+    """
+    now_ns = now_ns if now_ns is not None else time.time_ns()
+    if not tier_resolution_enabled():
+        return [unagg], {"mode": "pinned_raw", "namespaces": [unagg]}
+    if step_ns > 0:
+        best = None
+        for t in namespace_tiers(db):
+            if t.name == unagg or t.resolution_ns <= 0 or not t.complete:
+                continue
+            if t.resolution_ns > step_ns:
+                continue
+            if range_ns and 2 * t.resolution_ns > range_ns:
+                continue
+            if now_ns - t.retention_ns > t_min:
+                continue
+            pref = (t.resolution_ns, t.retention_ns)
+            if (best is None
+                    or pref > (best.resolution_ns, best.retention_ns)
+                    or (pref == (best.resolution_ns, best.retention_ns)
+                        and t.name < best.name)):
+                best = t
+        if best is not None:
+            return [best.name], {
+                "mode": "aggregated", "namespaces": [best.name],
+                "resolution_ns": best.resolution_ns,
+                "retention_ns": best.retention_ns,
+                "step_ns": step_ns,
+            }
+    ns_list = resolve_namespaces(db, unagg, t_min, t_max, now_ns)
+    mode = "raw" if ns_list == [unagg] else "stitched"
+    return ns_list, {"mode": mode, "namespaces": list(ns_list),
+                     "step_ns": step_ns}
 
 
 def resolve_namespaces(db, unagg: str, t_min: int, t_max: int,

@@ -72,6 +72,7 @@ def namespace_options(doc: dict | None) -> NamespaceOptions:
         ),
         int_optimized=bool(doc.get("int_optimized", False)),
         aggregated_resolution_ns=dur(res) if res else 0,
+        aggregated_complete=bool(doc.get("complete", False)),
         **kwargs,
     )
 
@@ -132,8 +133,9 @@ class CoordinatorService:
                                      strict=bool(rm_cfg.get("strict")))
         ruleset = ruleset_from_config(config.get("rules"))
         self.downsampler = (
-            Downsampler(self.db, ruleset)
-            if (ruleset.mapping_rules or ruleset.rollup_rules)
+            self._make_downsampler(ruleset)
+            if (ruleset.mapping_rules or ruleset.rollup_rules
+                or ruleset.standing_rules)
             else None
         )
         self.writer = DownsamplerAndWriter(
@@ -249,14 +251,57 @@ class CoordinatorService:
         profiler.arm_from_env("coordinator")
         self._stop = threading.Event()
 
+    def _make_downsampler(self, ruleset) -> Downsampler:
+        db_cfg = self.config.get("db", {}) or {}
+        return Downsampler(
+            self.db, ruleset,
+            source_namespace=db_cfg.get("namespace", "default"),
+            register_namespace=(self._register_tier_namespace
+                                if self.kv is not None else None),
+        )
+
+    def _register_tier_namespace(self, name: str, policy, complete: bool
+                                 ) -> None:
+        """Registry-sync leg of on-demand tier creation: the aggregated
+        namespace the downsampler just created locally must also land in
+        the KV namespace registry, so dbnodes (and a restarted
+        coordinator) re-create it BEFORE opening storage and its WAL
+        replays instead of being abandoned."""
+        from m3_tpu.query.admin import update_namespace_registry
+
+        sec = 10**9
+        doc = {
+            "retention": {
+                "period": f"{policy.retention_ns // sec}s",
+                "block_size":
+                    f"{max(policy.resolution_ns * 720, 2 * 3600 * sec) // sec}s",
+            },
+            "resolution": f"{policy.resolution_ns // sec}s",
+        }
+        if complete:
+            doc["complete"] = True
+
+        def add(registry):
+            registry.setdefault(name, doc)
+            return registry
+
+        try:
+            update_namespace_registry(self.kv, add)
+        except Exception as e:  # noqa: BLE001 - registry contention/outage
+            # must not fail the flush; the next namespace_for retries
+            self.downsampler._registered.discard(name)
+            self.log.info("tier namespace registry sync failed",
+                          namespace=name, error=str(e))
+
     def _apply_ruleset(self, rs) -> None:
         """KV rules watcher: swap the live matcher's ruleset (its version
         bump invalidates the match cache), creating the downsampler on
         first rules if the node booted without any."""
-        if not (rs.mapping_rules or rs.rollup_rules) and self.downsampler is None:
+        if not (rs.mapping_rules or rs.rollup_rules or rs.standing_rules) \
+                and self.downsampler is None:
             return
         if self.downsampler is None:
-            self.downsampler = Downsampler(self.db, rs)
+            self.downsampler = self._make_downsampler(rs)
             self.writer.downsampler = self.downsampler
             self.log.info("downsampler created from KV rules",
                           version=rs.version)
@@ -265,10 +310,11 @@ class CoordinatorService:
         # the KV version can collide with the boot ruleset's (both start
         # at 1); the cache invalidates on CHANGE, so force a distinct one
         rs.version = max(rs.version, old.version + 1)
-        self.downsampler.aggregator.matcher.ruleset = rs
+        self.downsampler.set_ruleset(rs)
         self.log.info("ruleset reloaded", version=rs.version,
                       mapping=len(rs.mapping_rules),
-                      rollup=len(rs.rollup_rules))
+                      rollup=len(rs.rollup_rules),
+                      standing=len(rs.standing_rules))
 
     def _build_cluster_db(self, cl_cfg: dict):
         from m3_tpu.client.cluster_db import ClusterDatabase

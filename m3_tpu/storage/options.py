@@ -42,6 +42,12 @@ class NamespaceOptions:
     # "aggregated" attributes, namespace/types.go AggregationOptions —
     # what retention-tier read resolution keys on)
     aggregated_resolution_ns: int = 0
+    # the aggregated namespace holds EVERY metric at its resolution (a
+    # downsample-all mapping rule feeds it) — only complete tiers are
+    # eligible for cheapest-tier read resolution, because routing a
+    # query to a partial tier would silently drop the unmatched series
+    # (the reference's AggregationsOptions.DownsampleOptions "all" bit)
+    aggregated_complete: bool = False
     # encode value streams with the M3TSZ int optimization (the reference's
     # production default; float-XOR only when False)
     int_optimized: bool = False

@@ -1456,10 +1456,117 @@ def config14_matcher_postings():
                 os.environ[k] = v
 
 
+def config15_tier_resolution():
+    """Cheapest-tier read resolution (ISSUE 18 / ROADMAP #2): a 30-day
+    dashboard query_range at a 1h step, served from the complete 1h
+    aggregated tier (resolve_read routes the fetch there) vs the same
+    query pinned to the raw namespace (M3_TPU_TIER_RESOLVE=0) decoding
+    every 2m raw sample. Both sides run the same engine over the same
+    Database; the ratio isolates exactly what tier routing changes: the
+    sample count decoded (30x fewer at 2m->1h). Pairing discipline as
+    #11/#14 (interleaved pairs, median pair reported) and correctness-
+    gated before emission: label sets equal, NaN masks element-
+    identical, values within 1e-9 relative — the tiers hold LAST-at-
+    mark identical series so the instant-selector grids must agree
+    exactly."""
+    import tempfile
+
+    from m3_tpu.query.engine import Engine
+    from m3_tpu.storage.database import Database
+    from m3_tpu.storage.options import (
+        DatabaseOptions, NamespaceOptions, RetentionOptions,
+    )
+
+    NS = 10**9
+    MIN_NS = 60 * NS
+    HOUR = 3600 * NS
+    DAY = 24 * HOUR
+    SAMP = 2 * MIN_NS
+    DAYS = 30
+    S = max(int(200 * _scale()), 8)
+    T_RAW = DAYS * DAY // SAMP       # 21600 raw samples per series
+    T_AGG = DAYS * DAY // HOUR       # 720 aggregated samples per series
+    START = 1_600_000_000 * NS
+    END = START + DAYS * DAY
+    with tempfile.TemporaryDirectory() as root:
+        db = Database(root, DatabaseOptions(n_shards=8))
+        db.create_namespace("default", NamespaceOptions(
+            retention=RetentionOptions(retention_ns=40 * DAY,
+                                       block_size_ns=2 * DAY),
+            writes_to_commitlog=False, snapshot_enabled=False))
+        db.create_namespace("aggregated_1h_365d", NamespaceOptions(
+            retention=RetentionOptions(retention_ns=365 * DAY,
+                                       block_size_ns=7 * DAY),
+            aggregated_resolution_ns=HOUR, aggregated_complete=True,
+            writes_to_commitlog=False, snapshot_enabled=False))
+        db.open(now_ns=START)
+
+        def value(i, t):
+            # deterministic + LAST-at-mark: the raw value AT each hour
+            # mark IS the tier's aggregate there, so both grids agree
+            return float((t // SAMP + i * 37) % 1000)
+
+        for ns_name, step_w in (("default", SAMP),
+                                ("aggregated_1h_365d", HOUR)):
+            entries = []
+            for i in range(S):
+                tags = [(b"host", b"h%04d" % i)]
+                entries.extend(
+                    (b"reqs", tags, t, value(i, t))
+                    for t in range(START, END + 1, step_w))
+            for lo in range(0, len(entries), 65536):
+                db.write_batch(ns_name, entries[lo:lo + 65536])
+
+        eng = Engine(db, "default", now_fn=lambda: END)
+        n_dp = S * T_RAW  # raw samples the pinned path decodes
+
+        def run():
+            return eng.query_range("reqs", START + HOUR, END, HOUR)[0]
+
+        prev = os.environ.get("M3_TPU_TIER_RESOLVE")
+        try:
+            os.environ.pop("M3_TPU_TIER_RESOLVE", None)
+            v_t = run()  # tier-routed (warm)
+            os.environ["M3_TPU_TIER_RESOLVE"] = "0"
+            v_r = run()  # raw-pinned (warm)
+            key = lambda d: sorted(d.items())  # noqa: E731
+            ot = np.argsort([str(key(d)) for d in v_t.labels])
+            orr = np.argsort([str(key(d)) for d in v_r.labels])
+            tv, rv = v_t.values[ot], v_r.values[orr]
+            ok = ([key(v_t.labels[i]) for i in ot]
+                  == [key(v_r.labels[i]) for i in orr]
+                  and np.array_equal(np.isnan(tv), np.isnan(rv))
+                  and np.allclose(tv, rv, rtol=1e-9, atol=0,
+                                  equal_nan=True))
+            pairs: list[tuple[float, float, float]] = []
+            for _ in range(5):
+                os.environ.pop("M3_TPU_TIER_RESOLVE", None)
+                t0 = time.perf_counter()
+                run()
+                dt_t = time.perf_counter() - t0
+                os.environ["M3_TPU_TIER_RESOLVE"] = "0"
+                t0 = time.perf_counter()
+                run()
+                dt_r = time.perf_counter() - t0
+                pairs.append((dt_r / dt_t, n_dp / dt_t, n_dp / dt_r))
+            pairs.sort(key=lambda p: p[0])
+            _ratio, thr_t, thr_r = pairs[len(pairs) // 2]
+            _emit(f"#15 tier-resolved 30d query_range @1h step, {S} series "
+                  f"[aggregated 1h tier ({T_AGG}/series) vs raw 2m decode "
+                  f"({T_RAW}/series)]"
+                  + ("" if ok else " (CORRECTNESS FAILED)"),
+                  thr_t, thr_r)
+        finally:
+            if prev is None:
+                os.environ.pop("M3_TPU_TIER_RESOLVE", None)
+            else:
+                os.environ["M3_TPU_TIER_RESOLVE"] = prev
+
+
 def main(argv=None) -> None:
     global _ACCEL
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13,14")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15")
     ap.add_argument("--record", default=None,
                     help="also append the JSON lines to this file")
     args = ap.parse_args(argv)
@@ -1488,7 +1595,8 @@ def main(argv=None) -> None:
            "7": config7_tracing_overhead, "8": config8_write_batch,
            "9": config9_query_compile, "10": config10_profiler_overhead,
            "11": config11_sharded_query, "12": config12_pipelined_read,
-           "13": config13_paged_memory, "14": config14_matcher_postings}
+           "13": config13_paged_memory, "14": config14_matcher_postings,
+           "15": config15_tier_resolution}
     for c in args.configs.split(","):
         c = c.strip()
         try:

@@ -639,6 +639,46 @@ def scrape_metrics(port: int, timeout_s: float = 10.0) -> str:
         return r.read().decode()
 
 
+def parse_counters(text: str, family: str) -> dict:
+    """{sorted-label-tuple: value} for one Prometheus counter/gauge
+    family (un-labelled samples key on the empty tuple)."""
+    import re as _re
+
+    out: dict = {}
+    for line in text.splitlines():
+        if not line.startswith(family):
+            continue
+        m = _re.match(r"^([\w:]+)(?:\{(.*)\})?\s+(\S+)$", line)
+        if not m or m.group(1) != family:
+            continue
+        labels = tuple(sorted(_re.findall(
+            r'([\w.]+)="((?:[^"\\]|\\.)*)"', m.group(2) or "")))
+        out[labels] = out.get(labels, 0.0) + float(m.group(3))
+    return out
+
+
+def _series_points(doc) -> dict:
+    """{label-key-tuple: {ts_s: value}} from a query_range matrix doc,
+    NaN points dropped (grid slots the engine left unfilled)."""
+    out: dict = {}
+    try:
+        result = doc["data"]["result"]
+    except (TypeError, KeyError):
+        return out
+    for series in result:
+        key = tuple(sorted(series.get("metric", {}).items()))
+        vals = {}
+        for ts, v in series.get("values", []):
+            try:
+                fv = float(v)
+            except (TypeError, ValueError):
+                continue
+            if not math.isnan(fv):
+                vals[float(ts)] = fv
+        out[key] = vals
+    return out
+
+
 def windowed_p99s_ms(scrape_fn, family: str, labels: dict,
                      run_window_fn, n_windows: int) -> list:
     """Per-window p99s from a CUMULATIVE server histogram: scrape at
@@ -1648,17 +1688,270 @@ def run_elasticity_episode(workdir: str, seconds: float = 20.0,
     return report
 
 
+def run_standing_rules_episode(workdir: str, seconds: float = 20.0,
+                               seed: int = 11,
+                               slo_p99_ms: float = 5000.0) -> dict:
+    """ISSUE-18's standing-query episode: a standing-rules-only ruleset
+    lands in KV mid-load; the coordinator's flush loop evaluates the
+    rules against the quorum cluster while a seeded chaos schedule kills
+    dbnodes, a kvd replica and the aggregator (the coordinator — the
+    evaluation host — stays up, as in the production episode). Proven at
+    the end: zero acked-write loss for the raw load, registry-sync of
+    the rule-created namespace, rollup convergence over the tenants AND
+    that namespace, standing outputs present and EQUAL across their
+    aggregated/raw dual-write legs, every rule recovered to an
+    error-free caught-up state (via /debug/standing — a flush that
+    failed its output quorum holds the watermark and retries), bounded
+    rule-eval lag (p99 of aggregator_standing_rule_eval_lag_seconds,
+    annotated onto the trajectory per slice), and the misrouting
+    honesty gate: standing rules alone never mark a tier complete, so
+    cheapest-tier resolution must keep EVERY query of the episode on
+    raw."""
+    from m3_tpu.metrics import rules_store
+    from m3_tpu.query.admin import load_namespace_registry
+    from m3_tpu.tools.em import ClusterEnv
+
+    tenants = ("rules0", "rules1")
+    out_ns = "aggregated_1s_10m"  # StoragePolicy("1s:10m").namespace_name
+    lag_bound_s = 30.0
+    lag_family = "aggregator_standing_rule_eval_lag_seconds"
+    ruleset_doc = {"standing": [
+        # scalar aggregate over a hot metric
+        {"name": "std:rig0:sum", "expr": "sum(rig_metric_0)",
+         "policy": "1s:10m"},
+        # grouped aggregate: the sid grouping label rides the output
+        {"name": "std:rig1:by_sid", "expr": "sum by (sid) (rig_metric_1)",
+         "policy": "1s:10m"},
+        # avg + static rule labels on every output series
+        {"name": "std:rig2:avg", "expr": "avg(rig_metric_2)",
+         "policy": "1s:10m", "labels": {"plane": "standing"}},
+        # absent input: must evaluate cleanly forever, writing nothing
+        {"name": "std:absent", "expr": "sum(rig_metric_never)",
+         "policy": "1s:10m"},
+    ]}
+    cluster = RigCluster(workdir, tenants, n_dbnodes=2, n_shards=4,
+                         seed=seed)
+    report: dict = {"seed": seed, "seconds": seconds, "out_ns": out_ns,
+                    "lag_bound_s": lag_bound_s}
+    recorder = None
+    try:
+        cluster.deploy()
+        session = cluster.session()
+        ledger = WriteLedger()
+        chaos_s = max(8.0, seconds)
+        cfg = RigConfig(seed=seed, tenants=tenants, duration_s=chaos_s,
+                        slo_p99_ms=slo_p99_ms)
+        rig = Rig(cfg, session_write_fn(session),
+                  http_query_fn(cluster.coord_port), ledger=ledger)
+        recorder = TrajectoryRecorder(cluster.coord_port,
+                                      cluster.profile_ports(), rig=rig)
+        recorder.start()
+        # the ruleset lands through the same KV watch a live operator
+        # uses; the coordinator builds its downsampler from the update
+        version = rules_store.store_ruleset_doc(cluster._kv, ruleset_doc)
+        report["ruleset_version"] = version
+        recorder.annotate("ruleset_stored", version=version,
+                          rules=len(ruleset_doc["standing"]))
+        schedule = ChaosSchedule.generate(seed, chaos_s,
+                                          cluster.chaos_targets())
+        report["schedule"] = [e.to_doc() for e in schedule]
+        runner = ChaosRunner(cluster.agents, schedule,
+                             base_env={s: cluster.base_service_env
+                                       for _a, s, _k in
+                                       cluster.chaos_targets()},
+                             seed=seed)
+        writer = threading.Thread(target=rig._writer_loop, daemon=True)
+        querier = threading.Thread(target=rig._query_loop, daemon=True)
+        writer.start()
+        querier.start()
+        runner.start()
+
+        # registry-sync leg: the first evaluation creates out_ns and the
+        # coordinator lands it in the KV namespace registry, where the
+        # dbnodes' sync_namespaces tick picks it up before quorum writes
+        # can land — so chaos or not, the namespace must appear
+        ClusterEnv.wait_until(
+            lambda: out_ns in load_namespace_registry(cluster._kv),
+            timeout_s=60, desc=f"{out_ns} in KV namespace registry")
+        recorder.annotate("tier_namespace_registered", namespace=out_ns)
+        report["registry_entry"] = \
+            load_namespace_registry(cluster._kv).get(out_ns)
+
+        # eval-lag trajectory: per-slice p99 of the coordinator's
+        # rule-eval-lag histogram, annotated onto the soak trajectory
+        slice_s = max(2.0, chaos_s / 4.0)
+        prev = parse_histogram(scrape_metrics(cluster.coord_port),
+                               lag_family)
+        lag_slices = []
+        deadline = time.monotonic() + chaos_s
+        while time.monotonic() < deadline:
+            time.sleep(min(slice_s, max(0.1, deadline - time.monotonic())))
+            try:
+                cur = parse_histogram(scrape_metrics(cluster.coord_port),
+                                      lag_family)
+            except Exception:  # noqa: BLE001 - scrape raced a fault
+                continue
+            p99_ms = hist_p99_ms(hist_delta(prev, cur))
+            prev = cur
+            p99_s = None if p99_ms is None else round(p99_ms / 1e3, 3)
+            lag_slices.append(p99_s)
+            recorder.annotate("rule_eval_lag", p99_s=p99_s)
+        runner.join(60.0)
+        rig._stop.set()
+        writer.join(10.0)
+        querier.join(10.0)
+        report["phase"] = rig.report()
+        report["chaos_executed"] = runner.executed
+        report["chaos_errors"] = runner.errors
+        report["rule_eval_lag_slices_s"] = lag_slices
+
+        # ---- recovery: heal, then the standing plane must go clean ----
+        cluster.wait_all_healthy()
+        verify_session = cluster.session()
+
+        def _readable():
+            try:
+                for t in (*tenants, out_ns):
+                    verify_session.fetch(t, b"rig-readiness-probe", 0, 1)
+                return True
+            except Exception:  # noqa: BLE001 - not ready yet
+                return False
+
+        ClusterEnv.wait_until(_readable, timeout_s=90,
+                              desc="tenants + tier readable after chaos")
+        report["verify"] = ledger.verify(session_fetch_fn(verify_session))
+
+        def _standing_status():
+            url = (f"http://127.0.0.1:{cluster.coord_port}"
+                   "/debug/standing")
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read().decode())
+
+        def _standing_clean():
+            # every rule error-free, evaluated at least once, watermark
+            # within the lag bound of now: an output write that failed
+            # its quorum during chaos held last_end and retried — the
+            # plane must close back up on its own after the heal
+            try:
+                doc = _standing_status()
+            except Exception:  # noqa: BLE001 - surface racing the heal
+                return False
+            rules = doc.get("rules", {})
+            if set(rules) != {r["name"] for r in ruleset_doc["standing"]}:
+                return False
+            now_ns = time.time_ns()
+            return all(
+                st["error"] is None and st["evals"] > 0
+                and now_ns - st["last_end_ns"] <= lag_bound_s * 1e9
+                for st in rules.values())
+
+        ClusterEnv.wait_until(_standing_clean, timeout_s=90,
+                              desc="standing rules error-free + caught up")
+        report["standing_status"] = _standing_status()
+
+        # convergence over the tenants AND the rule-created namespace:
+        # standing outputs are replicated quorum writes like any other —
+        # the repair daemons must converge them too
+        report["convergence"] = convergence_audit(
+            cluster, (*tenants, out_ns), budget_cycles=10, interval_s=1.0)
+
+        # ---- output audit: presence + dual-write leg parity ----
+        # each concrete rule's outputs, read back through the full query
+        # path from BOTH legs: the aggregated namespace and the raw
+        # write_raw leg in the source tenant. Values at common grid
+        # points must be bitwise equal — the legs are one entries batch
+        qfn = http_query_fn(cluster.coord_port)
+        end_s = int(time.time())
+        start_s = end_s - int(chaos_s) - 30
+        audit = {}
+        parity_ok = True
+        total_points = 0
+        for rule in ruleset_doc["standing"][:3]:
+            name = rule["name"]
+            agg = _series_points(qfn(out_ns, name, start_s, end_s, 1)[1])
+            raw = _series_points(
+                qfn(tenants[0], name, start_s, end_s, 1)[1])
+            pts = sum(len(v) for v in agg.values())
+            total_points += pts
+            common = mismatched = 0
+            for key, a_vals in agg.items():
+                r_vals = raw.get(key, {})
+                for ts, av in a_vals.items():
+                    rv = r_vals.get(ts)
+                    if rv is None:
+                        continue
+                    common += 1
+                    if av != rv:
+                        mismatched += 1
+            if mismatched or not common or not pts:
+                parity_ok = False
+            audit[name] = {"agg_series": len(agg), "agg_points": pts,
+                           "raw_series": len(raw),
+                           "common_points": common,
+                           "mismatched": mismatched}
+        report["output_audit"] = audit
+        report["output_points"] = total_points
+        report["leg_parity_ok"] = parity_ok
+
+        # ---- misrouting honesty gate ----
+        text = scrape_metrics(cluster.coord_port)
+        tier_reads = {dict(k).get("tier", "?"): v for k, v in
+                      parse_counters(text, "query_tier_reads").items()}
+        report["tier_reads"] = tier_reads
+        report["no_misrouted_reads"] = not any(
+            t.startswith("aggregated") for t in tier_reads)
+        report["standing_counters"] = {
+            leaf: sum(parse_counters(
+                text, f"aggregator_standing_rules_{leaf}").values())
+            for leaf in ("evaluated", "invalidated", "skipped", "errors")}
+        p99 = hist_p99_ms(parse_histogram(text, lag_family))
+        report["rule_eval_lag_p99_s"] = (None if p99 is None
+                                         else round(p99 / 1e3, 3))
+        recorder.stop()
+        report["trajectory"] = recorder.artifact()
+        try:
+            import os as _os
+
+            with open(_os.path.join(workdir, "standing_rules.json"),
+                      "w") as f:
+                json.dump(report["trajectory"], f, indent=2, default=str)
+        except OSError:
+            pass
+    finally:
+        if recorder is not None:
+            recorder.stop()
+        cluster.teardown()
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="production chaos/load rig")
     ap.add_argument("--workdir", required=True)
     ap.add_argument("--seconds", type=float, default=20.0)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--slo-p99-ms", type=float, default=5000.0)
-    ap.add_argument("--episode", choices=("production", "elasticity"),
+    ap.add_argument("--episode",
+                    choices=("production", "elasticity", "standing_rules"),
                     default="production",
                     help="production = kill/partition schedule; "
-                         "elasticity = add/drain/restart under load")
+                         "elasticity = add/drain/restart under load; "
+                         "standing_rules = recording rules + retention "
+                         "tiers under chaos")
     args = ap.parse_args(argv)
+    if args.episode == "standing_rules":
+        report = run_standing_rules_episode(args.workdir, args.seconds,
+                                            args.seed, args.slo_p99_ms)
+        print(json.dumps(report, indent=2, default=str))
+        lag = report.get("rule_eval_lag_p99_s")
+        ok = (not report.get("verify", {}).get("missing")
+              and report.get("convergence", {}).get("converged", False)
+              and not report.get("chaos_errors")
+              and report.get("output_points", 0) > 0
+              and report.get("leg_parity_ok", False)
+              and report.get("no_misrouted_reads", False)
+              and lag is not None
+              and lag <= report.get("lag_bound_s", 30.0))
+        return 0 if ok else 1
     if args.episode == "elasticity":
         report = run_elasticity_episode(args.workdir, args.seconds,
                                         args.seed, args.slo_p99_ms)

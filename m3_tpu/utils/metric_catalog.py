@@ -63,6 +63,12 @@ HISTOGRAMS = {
     #                             a shape-cache miss includes compile —
     #                             compute.jit{op=postings_program} splits
     #                             hit/miss and compile time out)
+    # standing-query plane (ROADMAP #2, query/standing.py)
+    "rule_eval_lag_seconds",    # aggregator.standing: how far behind
+    #                             real time a rule's last evaluated grid
+    #                             point was when its re-evaluation
+    #                             started (bounded-lag contract the
+    #                             standing_rules rig episode audits)
 }
 
 TIMERS = {
@@ -128,3 +134,24 @@ TIMERS = {
 #   session_topology_version                   gauge: the placement KV
 #       version the client session's TopologyMap was last hot-swapped
 #       to; lag against the KV's own version is swap latency
+#
+# Standing-query plane (ROADMAP #2), aggregator.standing scope — one
+# counter bump per rule per flush pass (query/standing.py evaluate):
+#   aggregator_standing_rules_evaluated        rules whose invalidated
+#       grid actually re-evaluated (compiled plan ran, outputs written)
+#   aggregator_standing_rules_invalidated      rules whose input shards'
+#       data_version bumps (or bootstrap/placement change) invalidated
+#       their last evaluation key
+#   aggregator_standing_rules_skipped          rules whose (data_version,
+#       selector, grid) identity was unchanged — no sample reads, no
+#       evaluation (the steady-state incremental win)
+#   aggregator_standing_rules_errors           rule evaluations aborted
+#       on an error (bad out-of-band expr, storage failure); the rule
+#       retries next flush
+#
+# Tier-resolution read routing (query/resolver.resolve_read), query.tier
+# scope with a {tier=...} label (raw / stitched / pinned_raw /
+# aggregated_<res>s — bounded by distinct tier resolutions):
+#   query_tier_reads {tier=...}                selector fetches served
+#       by each tier choice; the same decision rides ?explain=analyze
+#       as the per-fetch `tiers` block

@@ -1,0 +1,42 @@
+"""MUST-FLAG: the naive standing-rule evaluator — what the standing
+query plane (query/standing.py) must NOT look like. An evaluator that
+builds ``jax.jit`` inside its per-flush rule loop pays one trace+XLA
+compile PER RULE PER FLUSH (the aggregator flushes every tick, so the
+recompile storm is continuous, not per-query), and feeding a jitted
+aggregate the exact evaluation-window shape turns every new watermark
+into a fresh executable on top."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _sum_stage(v):
+    return jnp.sum(v, axis=-1)
+
+
+class NaiveStandingEvaluator:
+    """Per-flush jit construction in the rule evaluation loop."""
+
+    def __init__(self, rules):
+        self.rules = rules
+
+    def evaluate(self, windows):
+        out = {}
+        for rule, window in zip(self.rules, windows):
+            # jax-jit-per-call: a fresh traced callable (and compile)
+            # for every rule at every flush — no lru_cache factory, no
+            # keyed rule-plan cache around it
+            program = jax.jit(_sum_stage)
+            out[rule] = program(window)
+        return out
+
+    def evaluate_incremental(self, window):
+        out = []
+        for end in range(1, len(window)):
+            # jax-varying-static: the growing watermark slice = a new
+            # shape bucket = one compile per flush, unbounded
+            out.append(agg_stage(window[:end]))
+        return out
+
+
+agg_stage = jax.jit(_sum_stage)

@@ -144,6 +144,25 @@ class TestJaxRules:
         # committed once per immutable segment
         assert run_lint("jax_postings_pass.py", select=("jax-",)) == []
 
+    def test_naive_standing_evaluator_flags(self):
+        """The standing-query hazard (ISSUE 18 / ROADMAP #2): jit built
+        inside the per-flush rule evaluation loop, and a jitted
+        aggregate fed the exact (growing) watermark window shape, must
+        both fail the gate — the aggregator flushes every tick, so this
+        recompile storm is continuous, not per-query."""
+        fs = run_lint("jax_rules_flag.py", select=("jax-",))
+        assert rules_of(fs) == {"jax-jit-per-call", "jax-varying-static"}
+        msgs = "\n".join(f.message for f in fs)
+        assert "evaluate" in msgs  # the per-flush construction site
+        assert "agg_stage" in msgs  # the per-watermark shape bucket
+
+    def test_blessed_standing_evaluator_passes(self):
+        # the query/standing.py shape: one lru_cache program per rule
+        # signature (rules compile through the same plan path as ad-hoc
+        # queries), a bounded keyed (data_version, selector, grid) state
+        # store deciding skip-vs-evaluate, pow2-bucketed windows
+        assert run_lint("jax_rules_pass.py", select=("jax-",)) == []
+
     def test_per_eval_sharding_construction_flags(self):
         """The sharded compute plane's twin hazard (ROADMAP #1): a Mesh
         or NamedSharding constructed inside an eval path is a fresh

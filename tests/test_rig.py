@@ -485,6 +485,62 @@ class TestProcessRig:
         assert conv["converged"], conv
         assert conv["replica_pairs"] > 0, conv
 
+    def test_standing_rules_episode(self, tmp_path):
+        """ISSUE-18: standing recording rules + retention tiers under
+        the full chaos schedule. The ruleset lands through KV mid-load;
+        the coordinator evaluates against the quorum cluster while
+        dbnodes, a kvd replica and the aggregator die and heal."""
+        seconds = float(os.environ.get("M3_TPU_RIG_SECONDS", "20"))
+        seed = int(os.environ.get("M3_TPU_RIG_SEED", "11"))
+        report = rigmod.run_standing_rules_episode(
+            str(tmp_path / "rig"), seconds=max(10.0, seconds), seed=seed,
+            slo_p99_ms=5000.0)
+
+        assert report["chaos_executed"], report.get("chaos_errors")
+        assert not report["chaos_errors"], report["chaos_errors"]
+
+        # zero acked-write loss for the raw load under chaos
+        assert report["verify"]["acked"] > 0
+        assert report["verify"]["missing"] == [], report["verify"]
+        assert report["verify"]["checked"] == report["verify"]["acked"]
+
+        # registry-sync: the rule-created tier namespace landed in KV
+        # with its resolution (and WAL-replayable retention) recorded
+        entry = report["registry_entry"]
+        assert entry and entry["resolution"] == "1s", entry
+        assert "complete" not in entry, entry  # standing-only: never
+
+        # every rule recovered error-free with a caught-up watermark,
+        # including the absent-input rule (evaluates, writes nothing)
+        rules = report["standing_status"]["rules"]
+        assert set(rules) == {"std:rig0:sum", "std:rig1:by_sid",
+                              "std:rig2:avg", "std:absent"}, rules
+        assert all(st["error"] is None and st["evals"] > 0
+                   for st in rules.values()), rules
+
+        # outputs exist and the aggregated/raw dual-write legs agree
+        # point-for-point after the repair daemons converged
+        assert report["output_points"] > 0, report["output_audit"]
+        assert report["leg_parity_ok"], report["output_audit"]
+        by_sid = report["output_audit"]["std:rig1:by_sid"]
+        assert by_sid["agg_series"] >= 1, by_sid
+
+        # convergence covered the tenants AND the rule-created namespace
+        conv = report["convergence"]
+        assert conv["converged"], conv
+        assert conv["replica_pairs"] > 0, conv
+
+        # bounded rule-eval lag, annotated onto the trajectory
+        assert report["rule_eval_lag_p99_s"] is not None
+        assert report["rule_eval_lag_p99_s"] <= report["lag_bound_s"]
+        lag_events = [e for e in report["trajectory"]["topology_events"]
+                      if e["action"] == "rule_eval_lag"]
+        assert lag_events, report["trajectory"]["topology_events"]
+
+        # misrouting honesty gate: an incomplete tier is never read
+        assert report["no_misrouted_reads"], report["tier_reads"]
+        assert report["tier_reads"], "no tier-routing decisions recorded"
+
     def test_crash_rule_kills_real_process(self, tmp_path):
         """The M3_TPU_FAULTS_EXIT satellite end to end: a crash-mode
         fault rule firing inside a REAL dbnode makes the process exit

@@ -1,0 +1,411 @@
+"""Standing-query engine (query/standing.py): incremental recording
+rules hosted in the downsampler's flush loop.
+
+Pins the ISSUE-18 contracts:
+- incremental-invalidation EXACTNESS: a batch touching shard S
+  invalidates exactly the rules whose selectors match series living in
+  S (property-style sweep over seeded random write patterns), and
+  steady-state passes skip with ``rules_skipped`` counted — no sample
+  reads, no evaluation;
+- new-series detection: a matching series landing in a shard the rule
+  never matched before re-fires the rule via the index probe;
+- rule outputs land in the policy's aggregated namespace AND (by
+  default) the raw namespace, and read back identically after a full
+  close/reopen (WAL replay of the rule-created namespace);
+- registry sync: an on-demand tier namespace also lands in the KV
+  namespace registry so restarted nodes re-create it before open;
+- the standing-rule doc codec round-trips through the KV rules store
+  and validation rejects malformed exprs at store time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from m3_tpu.aggregator.downsample import Downsampler
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.metrics import rules_store as rstore
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.rules import RuleSet, StandingRule
+from m3_tpu.query.engine import Engine
+from m3_tpu.query.standing import StandingEvaluator
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import (
+    DatabaseOptions,
+    NamespaceOptions,
+    RetentionOptions,
+)
+from m3_tpu.utils.ident import tags_to_id
+from m3_tpu.utils.instrument import default_registry
+
+SEC = 10**9
+MIN = 60 * SEC
+HOUR = 3600 * SEC
+DAY = 24 * HOUR
+
+POLICY = StoragePolicy.parse("1m:2d")
+
+
+def _mk_db(tmp_path, n_shards=8):
+    db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=n_shards))
+    db.create_namespace(
+        "default",
+        NamespaceOptions(retention=RetentionOptions(retention_ns=7 * DAY)),
+    )
+    db.open(now_ns=0)
+    return db
+
+
+def _downsampler(db, standing, register=None):
+    return Downsampler(db, RuleSet(standing_rules=tuple(standing)),
+                       register_namespace=register)
+
+
+# -- incremental invalidation exactness -------------------------------------
+
+
+class TestInvalidationExactness:
+    N_METRICS = 10
+
+    def _setup(self, tmp_path):
+        db = _mk_db(tmp_path)
+        rules = [StandingRule(name=f"std:m{i}", expr=f"sum(m{i})",
+                              policy=POLICY)
+                 for i in range(self.N_METRICS)]
+        ds = _downsampler(db, rules)
+        ev = ds.standing
+        assert isinstance(ev, StandingEvaluator)
+        return db, ds, ev
+
+    def _write(self, db, i, t, tags=((b"job", b"a"),)):
+        db.write_tagged("default", f"m{i}".encode(), list(tags), t, float(i))
+
+    def _shard_of(self, db, i, tags=((b"job", b"a"),)):
+        ns = db.namespaces["default"]
+        sid = tags_to_id(f"m{i}".encode(), sorted(tags))
+        return int(ns.shard_set.lookup_many([sid])[0])
+
+    def test_property_sweep_exact_invalidation_set(self, tmp_path):
+        """Seeded random write patterns: each pass invalidates EXACTLY
+        the rules whose matched series live in a bumped shard."""
+        db, ds, ev = self._setup(tmp_path)
+        rng = np.random.default_rng(18)
+        t = 2 * HOUR
+        for i in range(self.N_METRICS):
+            self._write(db, i, t)
+        summary = ev.evaluate(t + MIN)  # bootstrap: everything fires
+        assert summary["invalidated"] == self.N_METRICS
+        assert summary["errors"] == 0
+        shard_of = {i: self._shard_of(db, i) for i in range(self.N_METRICS)}
+        for trial in range(12):
+            t += MIN
+            touched = [i for i in range(self.N_METRICS)
+                       if rng.random() < 0.3]
+            for i in touched:
+                self._write(db, i, t)
+            bumped = {shard_of[i] for i in touched}
+            expected = {f"std:m{i}" for i in range(self.N_METRICS)
+                        if shard_of[i] in bumped}
+            summary = ev.evaluate(t + MIN)
+            assert ev.last_invalidated == expected, (
+                f"trial {trial}: wrote {touched}, bumped shards {bumped}")
+            assert summary["invalidated"] == len(expected)
+            assert summary["skipped"] == self.N_METRICS - len(expected)
+            assert summary["errors"] == 0
+
+    def test_steady_state_skips_and_counts(self, tmp_path):
+        """No writes between passes -> every rule skips; the registry
+        counter and the local mirror both advance (acceptance pin:
+        ``rules_skipped`` > 0)."""
+        db, ds, ev = self._setup(tmp_path)
+        t = 2 * HOUR
+        for i in range(self.N_METRICS):
+            self._write(db, i, t)
+        ev.evaluate(t + MIN)
+        key = ("aggregator.standing.rules_skipped", ())
+        before = default_registry().snapshot()[0].get(key, 0)
+        summary = ev.evaluate(t + MIN)  # same watermark, same versions
+        assert summary["skipped"] == self.N_METRICS
+        assert summary["evaluated"] == summary["invalidated"] == 0
+        assert ev.counts["skipped"] >= self.N_METRICS
+        after = default_registry().snapshot()[0].get(key, 0)
+        assert after - before >= self.N_METRICS
+        # advancing the watermark with NO input change still skips for
+        # rules whose shards were untouched (version truth, not time)
+        summary = ev.evaluate(t + 5 * MIN)
+        assert summary["skipped"] == self.N_METRICS
+
+    def test_new_series_in_unmatched_shard_refires(self, tmp_path):
+        """A matching series landing in a shard the rule never matched
+        is caught by the index probe, not missed by the cached set."""
+        db, ds, ev = self._setup(tmp_path)
+        t = 2 * HOUR
+        self._write(db, 0, t)
+        ev.evaluate(t + MIN)
+        st = ev._states["std:m0"]
+        shards0 = set(st.shards)
+        # find tags routing m0 to a shard OUTSIDE the cached set
+        ns = db.namespaces["default"]
+        for salt in range(256):
+            tags = ((b"job", f"b{salt}".encode()),)
+            sid = tags_to_id(b"m0", sorted(tags))
+            if int(ns.shard_set.lookup_many([sid])[0]) not in shards0:
+                break
+        else:
+            pytest.skip("hash never left the cached shard set")
+        t += MIN
+        self._write(db, 0, t, tags=tags)
+        summary = ev.evaluate(t + MIN)
+        assert "std:m0" in ev.last_invalidated
+        assert summary["skipped"] == self.N_METRICS - 1
+
+    def test_self_writes_do_not_reinvalidate(self, tmp_path):
+        """The evaluator's own raw-namespace output writes must not
+        invalidate rules on the next pass (absorbed post-write)."""
+        db, ds, ev = self._setup(tmp_path)
+        t = 2 * HOUR
+        for i in range(self.N_METRICS):
+            self._write(db, i, t)
+        s1 = ev.evaluate(t + MIN)
+        assert s1["points"] > 0, "outputs were written"
+        s2 = ev.evaluate(t + MIN)
+        assert s2["skipped"] == self.N_METRICS
+        assert ev.last_invalidated == set()
+
+    def test_bad_expr_counts_error_and_spares_rest(self, tmp_path):
+        db = _mk_db(tmp_path)
+        rules = [
+            StandingRule(name="ok", expr="sum(m0)", policy=POLICY),
+            StandingRule(name="broken", expr="sum(((", policy=POLICY),
+        ]
+        ds = _downsampler(db, rules)
+        db.write_tagged("default", b"m0", [(b"job", b"a")], 2 * HOUR, 1.0)
+        summary = ds.standing.evaluate(2 * HOUR + MIN)
+        assert summary["errors"] == 1
+        assert summary["invalidated"] == 1  # the healthy rule still ran
+        assert ds.standing.status()["rules"]["broken"]["error"]
+
+
+# -- output write/read parity through restart --------------------------------
+
+
+class TestRestartParity:
+    RULE = StandingRule(name="job:reqs:sum",
+                        expr="sum by (job) (reqs)", policy=POLICY)
+
+    def _seed(self, db):
+        t0 = 2 * HOUR
+        for k in range(30):
+            for job in (b"api", b"web"):
+                db.write_tagged("default", b"reqs", [(b"job", job)],
+                                t0 + k * MIN, float(k))
+        return t0, t0 + 29 * MIN
+
+    def _read(self, db, ns_name, t0, t1, name="job:reqs:sum"):
+        eng = Engine(db, ns_name, resolve_tiers=False,
+                     now_fn=lambda: t1 + MIN)
+        out, ts = eng.query_range('{__name__="%s"}' % name, t0, t1, MIN)
+        order = np.argsort([str(sorted(d.items())) for d in out.labels])
+        return ([out.labels[i] for i in order], out.values[order], ts)
+
+    def test_outputs_survive_restart(self, tmp_path):
+        db = _mk_db(tmp_path, n_shards=4)
+        ds = _downsampler(db, [self.RULE])
+        t0, t1 = self._seed(db)
+        summary = ds.standing.evaluate(t1 + MIN)
+        assert summary["points"] > 0
+        agg_ns = POLICY.namespace_name
+        assert agg_ns in db.namespaces
+        agg_opts = db.namespaces[agg_ns].opts
+        before_raw = self._read(db, "default", t0, t1 + MIN)
+        before_agg = self._read(db, agg_ns, t0, t1 + MIN)
+        assert len(before_raw[0]) == 2  # one output series per job
+        assert len(before_agg[0]) == 2
+        db.close()
+        # restart: registry sync re-creates the rule-created namespace
+        # BEFORE open, so its commitlog replays instead of being orphaned
+        db2 = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+        db2.create_namespace("default", NamespaceOptions(
+            retention=RetentionOptions(retention_ns=7 * DAY)))
+        db2.create_namespace(agg_ns, agg_opts)
+        db2.open(now_ns=0)
+        try:
+            after_raw = self._read(db2, "default", t0, t1 + MIN)
+            after_agg = self._read(db2, agg_ns, t0, t1 + MIN)
+            for before, after in ((before_raw, after_raw),
+                                  (before_agg, after_agg)):
+                assert before[0] == after[0]
+                assert np.array_equal(np.isnan(before[1]),
+                                      np.isnan(after[1]))
+                assert np.allclose(before[1], after[1], rtol=1e-9, atol=0,
+                                   equal_nan=True)
+        finally:
+            db2.close()
+
+    def test_write_raw_false_skips_raw_namespace(self, tmp_path):
+        db = _mk_db(tmp_path, n_shards=4)
+        rule = StandingRule(name="agg:only", expr="sum(reqs)",
+                            policy=POLICY, write_raw=False)
+        ds = _downsampler(db, [rule])
+        t0, t1 = self._seed(db)
+        ds.standing.evaluate(t1 + MIN)
+        eng = Engine(db, "default", resolve_tiers=False,
+                     now_fn=lambda: t1 + MIN)
+        out, _ = eng.query_range('{__name__="agg:only"}', t0, t1, MIN)
+        assert len(out.labels) == 0  # raw namespace untouched
+        agg = self._read(db, POLICY.namespace_name, t0, t1 + MIN,
+                         name="agg:only")
+        assert len(agg[0]) == 1
+
+    def test_extra_labels_ride_outputs(self, tmp_path):
+        db = _mk_db(tmp_path, n_shards=4)
+        rule = StandingRule(name="tot", expr="sum(reqs)", policy=POLICY,
+                            labels=((b"tier", b"gold"),))
+        ds = _downsampler(db, [rule])
+        t0, t1 = self._seed(db)
+        ds.standing.evaluate(t1 + MIN)
+        labels, _vals, _ts = self._read(db, "default", t0, t1 + MIN,
+                                        name="tot")
+        assert labels and labels[0][b"tier"] == b"gold"
+
+
+# -- registry sync (satellite 1) --------------------------------------------
+
+
+class TestRegistrySync:
+    def test_downsampler_registers_created_namespace_once(self, tmp_path):
+        db = _mk_db(tmp_path, n_shards=2)
+        calls = []
+        ds = _downsampler(
+            db, [TestRestartParity.RULE],
+            register=lambda name, policy, complete:
+                calls.append((name, str(policy), complete)))
+        db.write_tagged("default", b"reqs", [(b"job", b"a")], 2 * HOUR, 1.0)
+        ds.standing.evaluate(2 * HOUR + MIN)
+        ds.standing.evaluate(2 * HOUR + 2 * MIN)
+        assert calls == [(POLICY.namespace_name, str(POLICY), False)]
+
+    def test_coordinator_registry_sync_and_dbnode_pickup(self, tmp_path):
+        """End to end: a standing rule stored in KV makes the
+        coordinator create the tier namespace AND register it; a dbnode
+        sharing the KV re-creates it from the registry (so a restart
+        replays its WAL instead of abandoning it)."""
+        from m3_tpu.query.admin import load_namespace_registry
+        from m3_tpu.services.coordinator import (
+            CoordinatorService,
+            namespace_options,
+        )
+
+        kv = KVStore()
+        svc = CoordinatorService({
+            "db": {"path": str(tmp_path / "db"), "n_shards": 2,
+                   "namespace": "default"},
+            "http": {"port": 0},
+        }, kv=kv)
+        try:
+            rstore.store_ruleset_doc(kv, {
+                "mapping": [{"name": "all", "filter": "__name__:*",
+                             "policies": ["1m:2d"]}],
+                "standing": [{"name": "job:reqs:sum",
+                              "expr": "sum by (job) (reqs)",
+                              "policy": "1m:2d"}],
+            })
+            assert svc.downsampler is not None
+            from m3_tpu.metrics.aggregation import MetricType
+
+            t0 = 1_600_000_000_000_000_000
+            for k in range(5):
+                svc.writer.write(MetricType.GAUGE, b"reqs",
+                                 [(b"job", b"api")], t0 + k * MIN, float(k))
+            svc.downsampler.flush(t0 + 10 * MIN)
+            name = POLICY.namespace_name
+            assert name in svc.db.namespaces
+            registry = load_namespace_registry(kv)
+            assert name in registry, "tier namespace must reach the registry"
+            # the registry doc round-trips to equivalent options —
+            # including the completeness marker (downsample-all fed)
+            opts = namespace_options(registry[name])
+            assert opts.aggregated_resolution_ns == MIN
+            assert opts.aggregated_complete is True
+            assert opts.retention.retention_ns == 2 * DAY
+        finally:
+            svc.shutdown()
+
+
+# -- downsampler hosting + ruleset swap --------------------------------------
+
+
+class TestDownsamplerHosting:
+    def test_flush_drives_evaluation(self, tmp_path):
+        db = _mk_db(tmp_path, n_shards=2)
+        ds = _downsampler(db, [TestRestartParity.RULE])
+        db.write_tagged("default", b"reqs", [(b"job", b"a")], 2 * HOUR, 1.0)
+        ds.flush(now_ns=2 * HOUR + MIN)
+        assert ds.standing.counts["evaluated"] == 1
+
+    def test_non_leader_does_not_evaluate(self, tmp_path):
+        db = _mk_db(tmp_path, n_shards=2)
+        ds = Downsampler(db, RuleSet(standing_rules=(TestRestartParity.RULE,)),
+                         local_leader=False)
+        db.write_tagged("default", b"reqs", [(b"job", b"a")], 2 * HOUR, 1.0)
+        ds.flush(now_ns=2 * HOUR + MIN)
+        assert ds.standing.counts["evaluated"] == 0
+
+    def test_set_ruleset_keeps_surviving_state(self, tmp_path):
+        db = _mk_db(tmp_path, n_shards=2)
+        keep = StandingRule(name="keep", expr="sum(m0)", policy=POLICY)
+        drop = StandingRule(name="drop", expr="sum(m1)", policy=POLICY)
+        ds = _downsampler(db, [keep, drop])
+        for i in range(2):
+            db.write_tagged("default", f"m{i}".encode(), [(b"job", b"a")],
+                            2 * HOUR, 1.0)
+        ds.standing.evaluate(2 * HOUR + MIN)
+        new = StandingRule(name="new", expr="sum(m0)", policy=POLICY)
+        ds.set_ruleset(RuleSet(standing_rules=(keep, new)))
+        summary = ds.standing.evaluate(2 * HOUR + MIN)
+        # surviving rule kept its state (skips); the new one bootstraps
+        assert summary["skipped"] == 1
+        assert ds.standing.last_invalidated == {"new"}
+        assert "drop" not in ds.standing.status()["rules"]
+
+
+# -- doc codec / KV store ----------------------------------------------------
+
+
+class TestStandingRuleDocs:
+    def test_round_trip(self):
+        rule = StandingRule(name="job:reqs:rate5m",
+                            expr="sum by (job) (rate(reqs[5m]))",
+                            policy=StoragePolicy.parse("30s:7d"),
+                            labels=((b"team", b"infra"),), write_raw=False)
+        rs = RuleSet(standing_rules=(rule,))
+        doc = rstore.ruleset_to_doc(rs)
+        assert StoragePolicy.parse(doc["standing"][0]["policy"]) == rule.policy
+        back = rstore.ruleset_from_doc(doc)
+        assert back.standing_rules == [rule]
+
+    def test_validation_rejects_bad_expr(self):
+        with pytest.raises(ValueError, match="bad expr"):
+            rstore.validate_doc({"standing": [
+                {"name": "x", "expr": "sum((", "policy": "1m:2d"}]})
+
+    def test_validation_rejects_duplicate_names(self):
+        with pytest.raises(ValueError, match="duplicate standing"):
+            rstore.validate_doc({"standing": [
+                {"name": "x", "expr": "sum(a)", "policy": "1m:2d"},
+                {"name": "x", "expr": "sum(b)", "policy": "1m:2d"}]})
+
+    def test_kv_watch_skips_malformed_keeps_last_good(self):
+        kv = KVStore()
+        seen = []
+        unwatch = rstore.watch_ruleset(kv, lambda rs: seen.append(rs))
+        rstore.store_ruleset_doc(kv, {"standing": [
+            {"name": "x", "expr": "sum(a)", "policy": "1m:2d"}]})
+        assert seen and seen[-1].standing_rules[0].name == "x"
+        n = len(seen)
+        # a raw writer bypassing validation: the watcher must NOT
+        # deliver the malformed payload (last good ruleset stands)
+        kv.set(rstore.RULES_KEY, b'{"standing": [{"name": "y"}]}')
+        assert len(seen) == n
+        unwatch()

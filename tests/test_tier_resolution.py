@@ -1,0 +1,242 @@
+"""Cheapest-tier read resolution (query/resolver.resolve_read): a
+coarse-step query routes to the coarsest COMPLETE aggregated namespace
+that covers its grid, window and range — long-range dashboards decode
+pre-aggregated series instead of raw samples.
+
+Pins the ISSUE-18 choice matrix: candidate filtering (completeness,
+resolution <= step, 2*resolution <= range, retention coverage),
+coarsest-wins preference with deterministic tie-breaks, fallback to the
+retention-driven fanout, the M3_TPU_TIER_RESOLVE=0 pin hatch, the
+?explain=analyze `tiers` block and the query.tier read counters — and
+end-to-end raw/aggregated parity through the engine.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from m3_tpu.query import explain as explain_mod
+from m3_tpu.query import resolver
+from m3_tpu.query.engine import Engine
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import (
+    DatabaseOptions,
+    NamespaceOptions,
+    RetentionOptions,
+)
+from m3_tpu.utils.instrument import default_registry
+
+SEC = 10**9
+MIN = 60 * SEC
+HOUR = 3600 * SEC
+DAY = 24 * HOUR
+
+NOW = 40 * DAY
+
+
+def _mk_ns(db, name, retention_ns, resolution_ns=0, complete=False):
+    db.create_namespace(
+        name,
+        NamespaceOptions(
+            retention=RetentionOptions(
+                retention_ns=retention_ns,
+                block_size_ns=max(2 * HOUR, resolution_ns * 720),
+            ),
+            aggregated_resolution_ns=resolution_ns,
+            aggregated_complete=complete,
+        ),
+    )
+
+
+@pytest.fixture
+def tiered(tmp_path):
+    """Raw 2d + complete 1m/30d + complete 1h/365d + INCOMPLETE 10m/90d."""
+    db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+    _mk_ns(db, "default", 2 * DAY)
+    _mk_ns(db, "agg_1m", 30 * DAY, MIN, complete=True)
+    _mk_ns(db, "agg_1h", 365 * DAY, HOUR, complete=True)
+    _mk_ns(db, "agg_10m_partial", 90 * DAY, 10 * MIN, complete=False)
+    db.open(now_ns=0)
+    yield db
+    db.close()
+
+
+# -- choice matrix ----------------------------------------------------------
+
+
+def test_fine_step_stays_raw(tiered):
+    t0, t1 = NOW - 12 * HOUR, NOW
+    ns, info = resolver.resolve_read(tiered, "default", t0, t1, 30 * SEC,
+                                     0, NOW)
+    assert ns == ["default"]
+    assert info["mode"] == "raw"
+
+
+def test_coarse_step_picks_coarsest_covering(tiered):
+    t0, t1 = NOW - 12 * HOUR, NOW
+    # 1h step: both complete tiers cover; the COARSEST (fewest samples
+    # decoded) wins
+    ns, info = resolver.resolve_read(tiered, "default", t0, t1, HOUR, 0, NOW)
+    assert ns == ["agg_1h"]
+    assert info["mode"] == "aggregated"
+    assert info["resolution_ns"] == HOUR
+    # 5m step: 1h no longer fits the grid; 1m does
+    ns, info = resolver.resolve_read(tiered, "default", t0, t1, 5 * MIN,
+                                     0, NOW)
+    assert ns == ["agg_1m"]
+    assert info["resolution_ns"] == MIN
+
+
+def test_range_selector_needs_two_samples_per_window(tiered):
+    t0, t1 = NOW - 12 * HOUR, NOW
+    # rate(x[90m]) @ 1h step: the 1h tier offers < 2 samples per window,
+    # so the finer complete tier serves it
+    ns, info = resolver.resolve_read(tiered, "default", t0, t1, HOUR,
+                                     90 * MIN, NOW)
+    assert ns == ["agg_1m"]
+    # a 3h window fits >= 2 one-hour samples again
+    ns, info = resolver.resolve_read(tiered, "default", t0, t1, HOUR,
+                                     3 * HOUR, NOW)
+    assert ns == ["agg_1h"]
+
+
+def test_incomplete_tier_never_chosen(tiered):
+    # 10m step: the ONLY tier fitting the grid bound res<=step besides
+    # 1m is the partial 10m tier — partial tiers silently drop series,
+    # so the complete 1m tier must win
+    ns, info = resolver.resolve_read(tiered, "default", NOW - 12 * HOUR,
+                                     NOW, 10 * MIN, 0, NOW)
+    assert ns == ["agg_1m"]
+    assert info["resolution_ns"] == MIN
+
+
+def test_retention_gates_candidacy(tiered):
+    # range starting 35d ago: the 30d 1m tier can no longer cover it;
+    # 1h/365d still does
+    t0 = NOW - 35 * DAY
+    ns, info = resolver.resolve_read(tiered, "default", t0, NOW, 5 * MIN,
+                                     0, NOW)
+    assert info["mode"] in ("raw", "stitched", "aggregated")
+    assert ns != ["agg_1m"]
+    # at a step the 1h tier fits, it takes the whole range
+    ns, info = resolver.resolve_read(tiered, "default", t0, NOW, HOUR, 0, NOW)
+    assert ns == ["agg_1h"]
+
+
+def test_tie_breaks_are_deterministic(tmp_path):
+    db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=2))
+    _mk_ns(db, "default", 2 * DAY)
+    # same resolution, different retention: longer retention preferred
+    _mk_ns(db, "agg_a", 30 * DAY, MIN, complete=True)
+    _mk_ns(db, "agg_b", 60 * DAY, MIN, complete=True)
+    # same resolution AND retention: lexically smaller name
+    _mk_ns(db, "agg_c", 60 * DAY, MIN, complete=True)
+    db.open(now_ns=0)
+    try:
+        ns, _ = resolver.resolve_read(db, "default", NOW - DAY, NOW,
+                                      5 * MIN, 0, NOW)
+        assert ns == ["agg_b"]  # 60d > 30d; "agg_b" < "agg_c"
+    finally:
+        db.close()
+
+
+def test_hatch_pins_raw(tiered, monkeypatch):
+    monkeypatch.setenv("M3_TPU_TIER_RESOLVE", "0")
+    ns, info = resolver.resolve_read(tiered, "default", NOW - 12 * HOUR,
+                                     NOW, HOUR, 0, NOW)
+    assert ns == ["default"]
+    assert info["mode"] == "pinned_raw"
+
+
+def test_uncovered_range_falls_back_to_fanout(tiered):
+    # instant query (step 0) past raw retention: no grid to fit a tier
+    # to — the retention-driven stitch fanout serves it, old behavior
+    t0 = NOW - 10 * DAY
+    ns, info = resolver.resolve_read(tiered, "default", t0, t0 + DAY, 0,
+                                     0, NOW)
+    assert info["mode"] == "stitched"
+    assert "agg_1m" in ns
+
+
+# -- engine integration -----------------------------------------------------
+
+
+def _seed_parity_data(db):
+    """Same LAST-at-mark series in raw + both aggregated tiers: the raw
+    value at each aggregation mark IS the tier's LAST aggregate, so any
+    step that lands on marks reads identical values from every tier."""
+    t0, t1 = NOW - 12 * HOUR, NOW
+    for t in range(t0, t1 + 1, MIN):
+        v = float(t // MIN % 997)
+        db.write_tagged("default", b"reqs", [(b"job", b"api")], t, v)
+        db.write_tagged("agg_1m", b"reqs", [(b"job", b"api")], t, v)
+        if t % HOUR == 0:
+            db.write_tagged("agg_1h", b"reqs", [(b"job", b"api")], t, v)
+
+
+def test_engine_parity_raw_vs_aggregated(tiered, monkeypatch):
+    _seed_parity_data(tiered)
+    eng = Engine(tiered, "default", now_fn=lambda: NOW)
+    t0, t1 = NOW - 6 * HOUR, NOW
+    out_tier, ts_tier = eng.query_range("reqs", t0, t1, HOUR)
+    monkeypatch.setenv("M3_TPU_TIER_RESOLVE", "0")
+    out_raw, ts_raw = eng.query_range("reqs", t0, t1, HOUR)
+    monkeypatch.delenv("M3_TPU_TIER_RESOLVE")
+    assert out_tier.labels == out_raw.labels
+    assert np.array_equal(ts_tier, ts_raw)
+    assert np.array_equal(np.isnan(out_tier.values),
+                          np.isnan(out_raw.values))
+    assert np.allclose(out_tier.values, out_raw.values, rtol=1e-9, atol=0,
+                       equal_nan=True)
+
+
+def test_engine_resolve_tiers_off_bypasses_routing(tiered):
+    _seed_parity_data(tiered)
+    eng = Engine(tiered, "default", resolve_tiers=False, now_fn=lambda: NOW)
+    snap0 = default_registry().snapshot()[0]
+    out, _ = eng.query_range("reqs", NOW - 2 * HOUR, NOW, HOUR)
+    assert len(out.labels) == 1
+    snap1 = default_registry().snapshot()[0]
+    tier_keys = [k for k in snap1 if k[0] == "query.tier.reads"]
+    for k in tier_keys:
+        assert snap1[k] == snap0.get(k, 0), "no tier counter off-path"
+
+
+def test_explain_reports_tier_choice_and_counter(tiered):
+    _seed_parity_data(tiered)
+    eng = Engine(tiered, "default", now_fn=lambda: NOW)
+    key = ("query.tier.reads", (("tier", "aggregated_3600s"),))
+    before = default_registry().snapshot()[0].get(key, 0)
+    with explain_mod.collect(analyze=True) as col:
+        eng.query_range("reqs", NOW - 6 * HOUR, NOW, HOUR)
+    doc = col.to_dict()
+    assert doc.get("tiers"), "explain must carry the tier-choice block"
+    modes = {t["mode"] for t in doc["tiers"]}
+    assert modes == {"aggregated"}
+    assert doc["tiers"][0]["namespaces"] == ["agg_1h"]
+    after = default_registry().snapshot()[0].get(key, 0)
+    assert after == before + 1
+
+
+def test_aggregated_tier_serves_fewer_samples(tiered, monkeypatch):
+    """The point of the feature: the tier read fetches ~60x fewer
+    samples for an hour-step query than the raw path."""
+    _seed_parity_data(tiered)
+    t0, t1 = NOW - 12 * HOUR, NOW
+
+    def samples(ns_name):
+        ns = tiered.namespaces[ns_name]
+        from m3_tpu.index.query import matchers_to_query
+        from m3_tpu.query.promql import parse
+
+        sel = parse("reqs")
+        docs = ns.query_ids(matchers_to_query(sel.matchers), t0, t1 + 1)
+        times, _v, offsets = ns.read_many_ragged(
+            [d.series_id for d in docs], t0, t1 + 1)
+        return int(offsets[-1])
+
+    ns_tier, _ = resolver.resolve_read(tiered, "default", t0, t1, HOUR,
+                                       0, NOW)
+    assert ns_tier == ["agg_1h"]
+    assert samples("agg_1h") * 10 < samples("default")
