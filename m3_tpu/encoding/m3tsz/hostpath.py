@@ -69,8 +69,11 @@ def encode_blocks(times, vbits, starts, n_points,
         jitted = m3tsz_tpu._encode_bits_jit
     dispatch.counters["m3tsz_encode_device"] += 1
     # plan-cache attribution: did this shape bucket hit the jit cache or
-    # pay a trace+compile? (compute.jit_* on /metrics)
-    with dispatch.jit_tracker("m3tsz_encode", jitted):
+    # pay a trace+compile? (compute.jit_* on /metrics); the sig keys the
+    # per-program execute histogram on the batch rectangle
+    sig = f"B{times.shape[0]}xT{times.shape[1]}" + \
+        ("|int" if int_optimized else "")
+    with dispatch.jit_tracker("m3tsz_encode", jitted, sig=sig):
         blocks = encode_fn(
             jnp.asarray(times), jnp.asarray(vbits),
             jnp.asarray(starts), jnp.asarray(n_points), unit,
@@ -98,11 +101,16 @@ def encode_blocks_ragged(times, vbits, offsets, starts,
     starts = np.asarray(starts)
     lens = np.diff(offsets)
     out: list[bytes] = [b""] * len(lens)
+    from m3_tpu.utils import compute_stats
+
     for rows in ragged.length_buckets(lens):
         if lens[rows[0]] == 0:
             continue
         sub_t, sub_v, sub_n = ragged.csr_to_padded(
             np.asarray(times), np.asarray(vbits), offsets, rows)
+        # padding-waste ledger: real points vs this bucket's rectangle
+        compute_stats.record_waste("encode_ragged", "samples",
+                                   int(lens[rows].sum()), sub_t.size)
         streams = encode_blocks(sub_t, sub_v, starts[rows], sub_n,
                                 unit, int_optimized)
         for r, s in zip(rows.tolist(), streams):
@@ -161,20 +169,30 @@ def _decode_streams_device(streams: list[bytes], unit: TimeUnit,
 
     from m3_tpu.encoding.m3tsz import tpu as m3tsz_tpu
 
+    from m3_tpu.utils import compute_stats
+
     maxlen = max(len(s) for s in streams)
     words = m3tsz_tpu.bytes_to_words(
         streams, dispatch.next_pow2((maxlen + 7) // 8))
     # a datapoint costs >= 2 bits, so the longest stream bounds the points
     max_points = dispatch.next_pow2(maxlen * 4 + 16)
+    # padding-waste ledger: real stream words vs the pow2 word rectangle
+    compute_stats.record_waste(
+        "decode_batch", "words",
+        sum((len(s) + 7) // 8 for s in streams), int(words.size))
+    sig = f"B{words.shape[0]}xW{words.shape[1]}xP{max_points}" + \
+        ("|int" if int_optimized else "")
     if int_optimized:
         from m3_tpu.encoding.m3tsz import tpu_int
 
-        with dispatch.jit_tracker("m3tsz_decode", tpu_int.decode_int):
+        with dispatch.jit_tracker("m3tsz_decode", tpu_int.decode_int,
+                                  sig=sig):
             dec = tpu_int.decode_int(words, unit, max_points=max_points)
         vals = _np.asarray(dec.values, _np.float64)
         vbits = vals.view(_np.uint64)
     else:
-        with dispatch.jit_tracker("m3tsz_decode", m3tsz_tpu._decode_jit):
+        with dispatch.jit_tracker("m3tsz_decode", m3tsz_tpu._decode_jit,
+                                  sig=sig):
             dec = m3tsz_tpu.decode(words, unit, max_points=max_points)
         vbits = _np.asarray(dec.value_bits, _np.uint64)
     times = _np.asarray(dec.times, _np.int64)

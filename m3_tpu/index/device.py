@@ -241,10 +241,23 @@ def match(seg, query):
 
     col = seg.device_postings()
     prog = _program(n_pos, M - n_pos, conjunction, mesh)
+    # padding-waste ledger: selected CSR rows vs the kb bucket, postings
+    # lanes vs lb, doc-space bits vs the word-aligned npad
+    from m3_tpu.utils import compute_stats
+
+    compute_stats.record_waste("postings", "terms",
+                               sum(len(s) for s in sels), M * kb)
+    compute_stats.record_waste("postings", "lanes", sum(totals), M * lb)
+    compute_stats.record_waste("postings", "docs", seg.n_docs + 1, npad)
+    sig = (f"P{n_pos}N{M - n_pos}{'&' if conjunction else '|'}"
+           f"|K{kb}|L{lb}|D{npad}" + (f"|M{n_dev}" if mesh else ""))
+    starts_d, lens_d = jnp.asarray(starts), jnp.asarray(lens)
     t0 = time.perf_counter()
-    with dispatch.jit_tracker("postings_program", prog):
-        words = prog(col, jnp.asarray(starts), jnp.asarray(lens),
-                     lb=lb, npad=npad)
+    with dispatch.jit_tracker(
+            "postings_program", prog, sig=sig,
+            lower=lambda: prog.lower(col, starts_d, lens_d,
+                                     lb=lb, npad=npad)):
+        words = prog(col, starts_d, lens_d, lb=lb, npad=npad)
     dispatch.record("index.postings", True)
     sc = default_registry().root_scope("compute").subscope("index")
     sc.counter("device")

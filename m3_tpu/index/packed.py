@@ -32,6 +32,8 @@ from __future__ import annotations
 
 import re
 import struct
+import threading
+import weakref
 from collections import OrderedDict
 
 import numpy as np
@@ -444,18 +446,51 @@ class PackedSegment:
         if col is None:
             import jax.numpy as jnp
 
-            from m3_tpu.utils import dispatch
+            from m3_tpu.utils import compute_stats, dispatch
 
             n = len(self._postings)
             host = np.zeros(dispatch.next_bucket(max(n, 64)), np.int32)
             host[:n] = self._postings
             col = self._device_postings = jnp.asarray(host)
+            # device-cache ledger: committed column bytes live as long
+            # as the segment; a GC'd segment releases its share
+            _track_device_column(self, int(col.nbytes))
+            compute_stats.record_waste("postings", "column", n, host.size)
         return col
 
     # -- persistence --
 
     def to_bytes(self) -> bytes:
         return bytes(memoryview(self._buf)[: self._payload_len])
+
+
+# -- device postings-column ledger ------------------------------------------
+#
+# Committed columns are cached forever on their (immutable) segment, so
+# the only honest byte accounting is segment-lifetime: commit adds,
+# segment GC subtracts (weakref.finalize). Registered as a
+# compute_stats device-cache provider so /debug/compute and the soak
+# trajectory see index device-memory pressure next to the hot tier's.
+
+_dev_cols_lock = threading.Lock()
+_dev_cols = {"entries": 0, "bytes": 0}
+
+
+def _untrack_device_column(nbytes: int) -> None:
+    with _dev_cols_lock:
+        _dev_cols["entries"] -= 1
+        _dev_cols["bytes"] -= nbytes
+
+
+def _track_device_column(seg, nbytes: int) -> None:
+    from m3_tpu.utils import compute_stats
+
+    with _dev_cols_lock:
+        _dev_cols["entries"] += 1
+        _dev_cols["bytes"] += nbytes
+    weakref.finalize(seg, _untrack_device_column, nbytes)
+    compute_stats.register_device_cache(
+        "postings_columns", lambda: dict(_dev_cols))
 
 
 def build(docs) -> PackedSegment:

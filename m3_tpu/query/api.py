@@ -406,6 +406,15 @@ class CoordinatorAPI:
             status, payload, ctype = profiler.handle_debug_profile(
                 method, q, body)
             return status, ctype, payload
+        if path == "/debug/compute":
+            # the device-compute observability plane: top-N programs by
+            # device time, plan-cache occupancy, padding-waste ledger,
+            # device-resident cache bytes (utils/compute_stats)
+            from m3_tpu.utils import compute_stats
+
+            status, payload, ctype = compute_stats.handle_debug_compute(
+                method, q, body)
+            return status, ctype, payload
         if path == "/debug/traces":
             return self._debug_traces(method, q, body)
         if path == "/debug/explain":

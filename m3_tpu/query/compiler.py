@@ -391,6 +391,7 @@ def _program(sig: tuple, mesh=None):
 
 _plan_lock = threading.Lock()
 _plan_cache: OrderedDict = OrderedDict()  # key -> {"hits": n, "misses": n}
+_plan_cache_evictions = 0
 
 # metric-label guard: registry counters persist forever, so the shape=
 # label set must be bounded even though the signature space is user-
@@ -417,12 +418,14 @@ def _plan_cache_record(key: tuple, miss: bool) -> None:
     not this LRU's own membership — so an eviction here can never relabel
     a still-compiled plan as a miss, nor a real recompile after program-
     factory eviction as a hit."""
+    global _plan_cache_evictions
     with _plan_lock:
         rec = _plan_cache.get(key)
         if rec is None:
             rec = _plan_cache[key] = {"hits": 0, "misses": 0}
             while len(_plan_cache) > _PLAN_CACHE_CAP:
                 _plan_cache.popitem(last=False)
+                _plan_cache_evictions += 1
         else:
             _plan_cache.move_to_end(key)
         rec["misses" if miss else "hits"] += 1
@@ -433,6 +436,17 @@ def plan_cache_info() -> dict:
     with _plan_lock:
         return {"|".join(str(p) for p in k): dict(v)
                 for k, v in _plan_cache.items()}
+
+
+def plan_cache_stats() -> dict:
+    """Occupancy summary for /debug/compute: bookkeeping entries, cap,
+    LRU evictions, and cumulative hit/miss totals across live keys."""
+    with _plan_lock:
+        hits = sum(r["hits"] for r in _plan_cache.values())
+        misses = sum(r["misses"] for r in _plan_cache.values())
+        return {"entries": len(_plan_cache), "cap": _PLAN_CACHE_CAP,
+                "evictions": _plan_cache_evictions,
+                "hits": hits, "misses": misses}
 
 
 def clear_plan_cache() -> None:
@@ -919,12 +933,15 @@ def _execute(engine, spec: PlanSpec, labels, raws, eval_ts, col):
         default_registry().root_scope("compute").subscope(
             "mesh", devices=str(n_dev)).counter("dispatch")
     t0 = time.perf_counter()
-    tracker = dispatch.jit_tracker("query_plan", program)
+    prog_args = (vs, adjs, ts, csums, bmat, lo_p, hi_p,
+                 eval_pad, np.int64(spec.range_ns), seg_pad,
+                 np.float64(phi if phi is not None else 0.0), scalars)
+    tracker = dispatch.jit_tracker(
+        "query_plan", program, sig=key_str,
+        lower=lambda: program.lower(*prog_args, num_groups=Gp,
+                                    mm_levels=mm_levels))
     with tracker:
-        out = program(vs, adjs, ts, csums, bmat, lo_p, hi_p,
-                      eval_pad, np.int64(spec.range_ns), seg_pad,
-                      np.float64(phi if phi is not None else 0.0),
-                      scalars, num_groups=Gp, mm_levels=mm_levels)
+        out = program(*prog_args, num_groups=Gp, mm_levels=mm_levels)
     hit = not tracker.miss
     _plan_cache_record(key, miss=tracker.miss)
     sc = default_registry().root_scope("compute").subscope(
@@ -949,9 +966,40 @@ def _execute(engine, spec: PlanSpec, labels, raws, eval_ts, col):
                           for lb in labels]
         else:
             out_labels = [dict(lb) for lb in labels]
+    # padding-waste ledger: logical vs half-octave-padded elements per
+    # program axis, for THIS query's slabs (warm hot-tier entries count
+    # too — the padded cells re-run every call, not just at prep)
+    from m3_tpu.utils import compute_stats
+
+    n_samples = len(raws.values)
+    compute_stats.record_waste("query_slabs", "series", S, Sp)
+    compute_stats.record_waste("query_slabs", "time", T, Tp)
+    if agg is not None:
+        compute_stats.record_waste("query_slabs", "groups", G + 1, Gp)
+    compute_stats.record_waste("query_slabs", "samples", n_samples,
+                               n_dev * cap)
+
     if col is not None:
         info = {"ran": True, "cache_key": key_str,
                 "cache": "hit" if hit else "miss"}
+        # the ?explain=analyze device block: what this query cost on the
+        # compute plane — execute/compile wall, static FLOP/byte profile
+        # (captured once per compile), padding waste, mesh width
+        padding = {"series": {"logical": S, "padded": Sp},
+                   "time": {"logical": T, "padded": Tp}}
+        if agg is not None:
+            padding["groups"] = {"logical": G + 1, "padded": Gp}
+        device = {"program": "query_plan", "sig": key_str,
+                  "cache": "hit" if hit else "miss",
+                  ("compile_seconds" if not hit else "execute_seconds"):
+                      tracker.seconds,
+                  "padding": padding,
+                  "waste_ratio": round(1.0 - (S * T) / (Sp * Tp), 6),
+                  "mesh_devices": n_dev}
+        prof = compute_stats.profile_for("query_plan", key_str)
+        if prof:
+            device.update(prof)
+        info["device"] = device
         if hot_state is not None:
             # the ?explain=analyze hot_tier block: did warm device pages
             # serve this query's slabs, and at what precision

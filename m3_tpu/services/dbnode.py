@@ -37,7 +37,8 @@ class NodeAPI:
         "/label_names", "/label_values", "/blocks/starts",
         "/blocks/metadata", "/blocks/stream", "/blocks/rollup",
         "/debug/repair", "/repair/enqueue", "/debug/flush",
-        "/debug/profile", "/debug/placement", "/shards/flush",
+        "/debug/profile", "/debug/compute", "/debug/placement",
+        "/shards/flush",
     })
 
     def __init__(self, db: Database):
@@ -90,6 +91,14 @@ class NodeAPI:
                 from m3_tpu.utils import profiler
 
                 status, payload, ctype = profiler.handle_debug_profile(
+                    method, q, body)
+                return status, payload, ctype
+            if path == "/debug/compute":
+                # same exemption: the compute-plane ledger must stay
+                # readable while a fault plan sickens the node
+                from m3_tpu.utils import compute_stats
+
+                status, payload, ctype = compute_stats.handle_debug_compute(
                     method, q, body)
                 return status, payload, ctype
             # node-level request faults: clients see a 5xx, driving their

@@ -34,6 +34,7 @@ import threading
 from collections import OrderedDict
 from contextlib import contextmanager
 
+from m3_tpu.utils import compute_stats
 from m3_tpu.utils.instrument import monitor_queue
 
 
@@ -45,6 +46,10 @@ class HotTier:
         self._lock = threading.Lock()
         self._entries: OrderedDict = OrderedDict()  # key -> (entry, nbytes)
         self.bytes_used = 0
+        # resident bytes of the reduced-precision mirror alone (entries
+        # prepared under a bf16 grant) — the device-memory gauges split
+        # it out so operators can see what the opt-in actually saves
+        self.bytes_bf16 = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -59,6 +64,13 @@ class HotTier:
             self.hits += 1
             return hit[0]
 
+    @staticmethod
+    def _is_bf16(entry) -> bool:
+        try:
+            return entry.get("precision") == "bf16"
+        except AttributeError:
+            return False
+
     def put(self, key, entry: dict, nbytes: int) -> None:
         if nbytes > self.max_bytes:
             return  # one oversized query must not wipe the working set
@@ -66,17 +78,34 @@ class HotTier:
             old = self._entries.pop(key, None)
             if old is not None:
                 self.bytes_used -= old[1]
+                if self._is_bf16(old[0]):
+                    self.bytes_bf16 -= old[1]
             self._entries[key] = (entry, nbytes)
             self.bytes_used += nbytes
+            if self._is_bf16(entry):
+                self.bytes_bf16 += nbytes
             while self.bytes_used > self.max_bytes and self._entries:
-                _k, (_e, nb) = self._entries.popitem(last=False)
+                _k, (e, nb) = self._entries.popitem(last=False)
                 self.bytes_used -= nb
+                if self._is_bf16(e):
+                    self.bytes_bf16 -= nb
                 self.evictions += 1
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
             self.bytes_used = 0
+            self.bytes_bf16 = 0
+
+    def stats(self) -> dict:
+        """Entries + device bytes (total and bf16-mirror share) for the
+        compute_stats device-cache gauges and /debug/compute."""
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "bytes": self.bytes_used,
+                    "bf16_bytes": self.bytes_bf16,
+                    "evictions": self.evictions,
+                    "hits": self.hits, "misses": self.misses}
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -122,6 +151,15 @@ monitor_queue("hot_tier",
               if _default is not None else 0,
               drops_fn=lambda: _default.evictions
               if _default is not None else 0)
+
+# device-cache ledger registration: entries + device bytes (incl. the
+# bf16-mirror share) ride the compute.device_cache{cache=hot_tier}
+# gauges and the /debug/compute payload (utils/compute_stats reads,
+# never imports storage)
+compute_stats.register_device_cache(
+    "hot_tier",
+    lambda: _default.stats() if _default is not None
+    else {"entries": 0, "bytes": 0, "bf16_bytes": 0})
 
 
 # ---------------------------------------------------------------------------

@@ -399,6 +399,9 @@ def config5_sharded_quantile():
     # out scheduler noise (at 3 iters the run-to-run spread exceeded the
     # device/host gap on shared-CPU hosts)
     iters = 15
+    # bench-only: the timed region measures raw kernel dispatch — a
+    # tracker would add exactly the overhead config #16 bounds
+    # m3lint: disable=inv-jit-tracked
     dt = _time(lambda: quantile_rollup(jv, joh, jc), iters=iters)
 
     # host numpy baseline of the same computation
@@ -412,7 +415,9 @@ def config5_sharded_quantile():
     for _ in range(iters):
         host()
     dt_host = (time.perf_counter() - t0) / iters
-    # correctness: device result == host result
+    # correctness: device result == host result (bench-only, same raw
+    # dispatch as the timed region)
+    # m3lint: disable=inv-jit-tracked
     dev = np.asarray(quantile_rollup(jv, joh, jc))
     ok = np.allclose(dev, host() / np.maximum(cnt_host, 1), rtol=1e-9)
     _emit(f"#5 {n_dev}-shard timer quantile rollup {S}x{T}"
@@ -1563,10 +1568,124 @@ def config15_tier_resolution():
                 os.environ["M3_TPU_TIER_RESOLVE"] = prev
 
 
+def config16_compute_overhead():
+    """Device-compute observability overhead guard (this PR): the
+    write+query hot path with the execute-telemetry ledger ARMED
+    (every tracked jit_tracker exit attributing wall time into
+    compute_stats — per-program execute histograms, the ranked program
+    table, padding-waste records, eviction ground-truth bookkeeping)
+    vs DISARMED (``compute_stats.arm(False)``: every record_* returns
+    at the flag check — the seed-equivalent cost). Same pairing
+    discipline as #7/#10: interleaved on/off pairs, median of per-pair
+    ratios, flagged below 0.85.
+
+    The workload is one run_once = a hot-buffer write burst (the
+    ingest side the tracker must never tax) followed by compiled
+    query_range evaluations on the cache-HIT path — the exact site
+    where record_execute/record_waste fire per call."""
+    import tempfile
+
+    from m3_tpu.encoding.m3tsz import hostpath
+    from m3_tpu.query.engine import Engine
+    from m3_tpu.storage.database import Database
+    from m3_tpu.storage.fileset import FilesetWriter
+    from m3_tpu.storage.options import (
+        DatabaseOptions, IndexOptions, NamespaceOptions, RetentionOptions,
+    )
+    from m3_tpu.utils import compute_stats
+    from m3_tpu.utils.xtime import TimeUnit
+
+    NS = 10**9
+    BLOCK = 24 * 3600 * NS
+    START = 1_600_000_000 * NS
+    S = max(int(2_000 * _scale()), 200)
+    SAMP = 300 * NS
+    T = BLOCK // SAMP              # 288 samples per series
+    W = max(int(60_000 * _scale()), 6_000)   # write burst per run
+    with tempfile.TemporaryDirectory() as root:
+        db = Database(root, DatabaseOptions(
+            n_shards=4, block_cache_entries=100_000))
+        ns = db.create_namespace("default", NamespaceOptions(
+            retention=RetentionOptions(retention_ns=1000 * BLOCK,
+                                       block_size_ns=BLOCK),
+            index=IndexOptions(enabled=True, block_size_ns=BLOCK),
+            writes_to_commitlog=False, snapshot_enabled=False))
+        ids = [b"reqs,host=h%03d,i=%05d" % (i % 50, i) for i in range(S)]
+        fields = [[(b"__name__", b"reqs"), (b"host", b"h%03d" % (i % 50)),
+                   (b"i", b"%05d" % i)] for i in range(S)]
+        by_shard: dict[int, list[int]] = {}
+        for j, sid in enumerate(ids):
+            by_shard.setdefault(ns.shard_set.lookup(sid), []).append(j)
+        rng = np.random.default_rng(0)
+        for shard_id, rows in by_shard.items():
+            nb = len(rows)
+            times = np.broadcast_to(
+                START + np.arange(T, dtype=np.int64) * SAMP, (nb, T)).copy()
+            vals = rng.integers(1, 10, (nb, T)).astype(np.float64) \
+                .cumsum(axis=1)
+            streams = hostpath.encode_blocks(
+                times, vals.view(np.uint64), np.full(nb, START, np.int64),
+                np.full(nb, T, np.int32), TimeUnit.SECOND, False)
+            w = FilesetWriter(db.fs_root, "default", shard_id, START,
+                              BLOCK, 0)
+            for j, stream in zip(rows, streams):
+                w.write_series(ids[j], b"", stream)
+            w.close()
+        db.open(START + BLOCK)
+        ns.index.insert_many(ids, fields, np.full(S, START, np.int64))
+        eng = Engine(db, resolve_tiers=False)
+        qstart = START + 30 * 60 * NS
+        qend = START + BLOCK - SAMP
+        step = 2 * 60 * NS
+        q = "max by (host) (irate(reqs[30m]))"
+        wtags = [(b"k", b"v")]
+        wnames = [b"w%04d" % i for i in range(500)]
+        n_dp = S * T  # samples each query reads
+
+        def run_once() -> float:
+            t0 = time.perf_counter()
+            for i in range(W):  # hot-buffer ingest leg (active block)
+                db.write_tagged("default", wnames[i % 500], wtags,
+                                START + BLOCK + (i % 3600) * NS, float(i))
+            for _ in range(2):  # compiled cache-HIT query leg
+                eng.query_range(q, qstart, qend, step)
+            return (W + 2 * n_dp) / (time.perf_counter() - t0)
+
+        prev = os.environ.get("M3_TPU_QUERY_COMPILE")
+        os.environ["M3_TPU_QUERY_COMPILE"] = "1"
+        ratios: list[float] = []
+        rate_on = rate_off = 0.0
+        try:
+            compute_stats.arm(True)
+            run_once()  # warm: pays the plan + postings compiles once
+            for _ in range(5):
+                compute_stats.arm(True)
+                on = run_once()
+                compute_stats.arm(False)
+                off = run_once()
+                ratios.append(on / off)
+                rate_on, rate_off = max(rate_on, on), max(rate_off, off)
+        finally:
+            compute_stats.arm(
+                os.environ.get("M3_TPU_COMPUTE_STATS", "1") != "0")
+            if prev is None:
+                os.environ.pop("M3_TPU_QUERY_COMPILE", None)
+            else:
+                os.environ["M3_TPU_QUERY_COMPILE"] = prev
+        db.close()
+    ratios.sort()
+    ratio = ratios[len(ratios) // 2]
+    _emit("#16 write+query hot path w/ device-compute telemetry armed "
+          "vs disarmed"
+          + ("" if ratio >= 0.85 else " (OVERHEAD EXCEEDED)"),
+          ratio * rate_off, rate_off)
+
+
 def main(argv=None) -> None:
     global _ACCEL
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15")
+    ap.add_argument("--configs",
+                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16")
     ap.add_argument("--record", default=None,
                     help="also append the JSON lines to this file")
     args = ap.parse_args(argv)
@@ -1596,7 +1715,8 @@ def main(argv=None) -> None:
            "9": config9_query_compile, "10": config10_profiler_overhead,
            "11": config11_sharded_query, "12": config12_pipelined_read,
            "13": config13_paged_memory, "14": config14_matcher_postings,
-           "15": config15_tier_resolution}
+           "15": config15_tier_resolution,
+           "16": config16_compute_overhead}
     for c in args.configs.split(","):
         c = c.strip()
         try:

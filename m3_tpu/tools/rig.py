@@ -829,6 +829,7 @@ class TrajectoryRecorder:
         self.topology_events: list[dict] = []       # annotate() rows
         self._events: dict[tuple, dict] = {}        # dedup key -> event
         self._locks: dict[tuple, dict] = {}         # (svc, site) -> doc
+        self._compute_tops: dict[str, list] = {}    # svc -> top programs
         self._prev_hist = None
         self._prev_writes = 0
         self._prev_queries = 0
@@ -845,6 +846,12 @@ class TrajectoryRecorder:
                 f"http://127.0.0.1:{port}/debug/profile", timeout=3.0) as r:
             return json.loads(r.read().decode())
 
+    def _fetch_compute(self, port: int) -> dict:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/compute?top=5",
+                timeout=3.0) as r:
+            return json.loads(r.read().decode())
+
     def _rig_totals(self) -> tuple[int, int]:
         if self.rig is None:
             return 0, 0
@@ -859,7 +866,8 @@ class TrajectoryRecorder:
         now_s = round(time.monotonic() - self._t0, 3)
         row: dict = {"t_s": now_s, "p99_ms": None,
                      "qps_writes": 0.0, "qps_queries": 0.0,
-                     "rss_bytes": {}, "stalls": {}}
+                     "rss_bytes": {}, "stalls": {},
+                     "device_compute": {}}
         writes, queries = self._rig_totals()
         row["qps_writes"] = round((writes - self._prev_writes)
                                   / max(self.sample_s, 1e-6), 1)
@@ -891,6 +899,35 @@ class TrajectoryRecorder:
                                                "rig_t_s": now_s})
             for cls in (doc.get("locks", {}) or {}).get("classes", ()):
                 self._locks[(svc, cls.get("site"))] = {**cls, "service": svc}
+            # device-compute columns (fault-exempt /debug/compute): per-
+            # service device time, device-resident cache bytes, padding
+            # waste — the soak's view of compute-plane pressure
+            try:
+                comp = self._fetch_compute(port)
+            except Exception:  # noqa: BLE001 - pre-upgrade node or
+                continue       # killed process: gap, never a crash
+            progs = comp.get("programs", ()) or ()
+            caches = comp.get("device_caches", {}) or {}
+            self._compute_tops[svc] = [
+                {"op": p.get("op"), "sig": p.get("sig"),
+                 "execute_seconds_total":
+                     round(p.get("execute_seconds_total", 0.0), 6)}
+                for p in progs[:5]]
+            row["device_compute"][svc] = {
+                "execute_seconds_total": round(sum(
+                    p.get("execute_seconds_total", 0.0)
+                    for p in progs), 6),
+                "compile_seconds_total": round(sum(
+                    p.get("compile_seconds_total", 0.0)
+                    for p in progs), 6),
+                "jit_evictions": sum(
+                    (comp.get("jit_evictions", {}) or {}).values()),
+                "device_cache_bytes": sum(
+                    int(c.get("bytes", 0)) for c in caches.values()),
+                "device_mem_bytes": sum(
+                    int(d.get("bytes_in_use", 0))
+                    for d in comp.get("device_memory", ()) or ()),
+            }
         self.samples.append(row)
         return row
 
@@ -915,6 +952,7 @@ class TrajectoryRecorder:
             "topology_events": list(self.topology_events),
             "stall_events": events,
             "contended_locks": locks[:32],
+            "device_compute_top": dict(self._compute_tops),
         }
 
     def start(self) -> None:

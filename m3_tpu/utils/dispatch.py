@@ -19,6 +19,7 @@ was device kernels that only tests invoked.
 from __future__ import annotations
 
 import os
+import weakref
 from collections import Counter
 
 counters: Counter = Counter()
@@ -146,41 +147,90 @@ def _jit_scope(op: str, result: str):
     return sc
 
 
-class jit_tracker:
-    """`with jit_tracker("m3tsz_decode", jitted_fn): jitted_fn(...)` —
-    records compute.jit_calls{op,result=hit|miss} and, on a miss, the
-    trace+compile wall time into compute.jit_compile_seconds{op}. The
-    jitted function's private plan cache (`_cache_size`) is the ground
-    truth; a jax build without it records every call as a hit with no
-    compile histogram (counters stay meaningful, never wrong)."""
+# per-jitted-function last-seen executable-cache size: the eviction
+# ground truth. An entry that disappears between tracked calls
+# (jax.clear_caches(), a donated/evicted executable) shrinks the cache,
+# which would make the next call's size diff under-report a re-trace as
+# a hit — comparing against the LAST SEEN size catches both the
+# eviction (compute_jit_evictions{op}) and the subsequent re-compile.
+# Weak keys: a dropped program factory must not pin its executables.
+_last_sizes: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
-    def __init__(self, op: str, jitted_fn):
+
+class jit_tracker:
+    """`with jit_tracker("m3tsz_decode", jitted_fn, sig="..."): ...` —
+    records compute.jit_calls{op,result=hit|miss}; on a miss, the
+    trace+compile wall time into compute.jit_compile_seconds{op}; on a
+    hit (with a ``sig``), the execute wall into
+    compute.execute_seconds{op,sig} and the per-program ledger
+    (utils/compute_stats). The jitted function's private executable
+    cache (`_cache_size`) is the ground truth; entries that vanished
+    since the last tracked call bump compute_jit_evictions{op}. A jax
+    build without `_cache_size` records every call as a hit with no
+    compile histogram (counters stay meaningful, never wrong).
+
+    ``lower`` (zero-arg callable returning a ``jax.stages.Lowered``,
+    closing over the call's args) lets a miss capture the program's
+    static cost profile once per compile."""
+
+    def __init__(self, op: str, jitted_fn, sig: str | None = None,
+                 lower=None):
         self.op = op
+        self.sig = sig
+        self._lower = lower
+        self._fn = jitted_fn
         self._size_fn = getattr(jitted_fn, "_cache_size", None)
         # ground-truth compile outcome of the wrapped call, readable after
         # the with-block (the whole-query compiler keys its plan-cache
         # hit/miss accounting off this rather than guessing)
         self.miss = False
+        # wrapped-call wall time, readable after the with-block (the
+        # explain `device` block attributes it per query)
+        self.seconds = 0.0
 
     def __enter__(self):
         import time
 
         self._before = self._size_fn() if self._size_fn is not None else None
+        if self._before is not None:
+            try:
+                last = _last_sizes.get(self._fn)
+            except TypeError:  # non-weakref-able callable
+                last = None
+            if last is not None and self._before < last:
+                from m3_tpu.utils import compute_stats
+
+                compute_stats.record_evictions(self.op, last - self._before)
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         import time
 
-        dt = time.perf_counter() - self._t0
-        miss = self.miss = self._before is not None and \
-            self._size_fn() > self._before
+        dt = self.seconds = time.perf_counter() - self._t0
+        after = self._size_fn() if self._size_fn is not None else None
+        miss = self.miss = self._before is not None and after > self._before
+        if after is not None:
+            try:
+                _last_sizes[self._fn] = after
+            except TypeError:
+                pass
         result = "miss" if miss else "hit"
         counters[f"jit_{self.op}[{result}]"] += 1
         sc = _jit_scope(self.op, result)
         sc.counter("calls")
+        if exc and exc[0] is not None:
+            return False  # the call raised: no execute/compile attribution
+        from m3_tpu.utils import compute_stats
+
         if miss:
             # the whole call IS the compile on a miss (execution time is
             # noise next to trace+lower+compile)
             sc.observe("compile_seconds", dt)
+            compute_stats.record_compile(self.op, self.sig or "default", dt)
+            if self._lower is not None:
+                compute_stats.capture_profile(
+                    self.op, self.sig or "default", self._lower)
+        elif self._before is not None:
+            compute_stats.record_execute(self.op, self.sig or "default", dt)
         return False

@@ -142,6 +142,23 @@ class TelemetryExporter:
         }
         if prof is not None:
             payload["scopeProfile"] = prof
+        # device-compute attribution rides the same payload (the
+        # histogram/gauge families above carry the rates; this block
+        # carries the per-program ranking a dashboard can't rebuild
+        # from bucketed data): top programs by device time + the
+        # padding-waste ledger
+        try:
+            from m3_tpu.utils import compute_stats
+
+            comp = compute_stats.debug_payload(top_n=10)
+            if comp["programs"] or comp["waste"]:
+                payload["scopeCompute"] = {
+                    "programs": comp["programs"],
+                    "waste": comp["waste"],
+                    "jit_evictions": comp["jit_evictions"],
+                }
+        except Exception:  # noqa: BLE001 - telemetry must never break
+            pass           # the export loop
         return payload
 
     # -- queue + ship --

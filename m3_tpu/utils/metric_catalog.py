@@ -25,7 +25,14 @@ HISTOGRAMS = {
     "commitlog_fsync_seconds",  # WAL fsync wall time
     "persist_seconds",          # fileset/index/kv persist (per-scope)
     # compute plane
-    "seconds",                  # decode/encode + rpc legs (per-scope)
+    "seconds",                  # decode/encode + rpc legs (per-scope);
+    #                             also compute.execute{op,sig} — the
+    #                             compute_execute_seconds exposition
+    #                             family: wall time of one tracked
+    #                             cache-HIT program call (dispatch +
+    #                             device execution; sig is the
+    #                             shape-bucket signature, <=64 distinct
+    #                             labels then "other")
     "batch_size",               # decode.batch per-rung batch size
     "compile_seconds",          # compute.jit trace+compile on cache miss
     "plan_compile_seconds",     # compute.query_plan whole-plan compile
@@ -148,6 +155,31 @@ TIMERS = {
 #   aggregator_standing_rules_errors           rule evaluations aborted
 #       on an error (bad out-of-band expr, storage failure); the rule
 #       retries next flush
+#
+# Device-compute observability plane (utils/compute_stats +
+# dispatch.jit_tracker; the /debug/compute payload renders the same
+# ledger as JSON on all four services):
+#   compute_execute_seconds {op,sig}           histogram (the cataloged
+#       "seconds" leaf under compute.execute) — the per-program
+#       device-time attribution
+#   compute_jit_cache_evictions {op}           counter: executable-cache
+#       entries that vanished between tracked calls (clear_caches,
+#       donated/evicted executables) — the miss-accounting ground truth
+#   compute_waste_logical_elements /
+#   compute_waste_padded_elements /
+#   compute_waste_waste_ratio {site,axis}      gauges refreshed by the
+#       compute_stats snapshot hook: real vs half-octave/slab-padded
+#       elements at every padding seam (site in query_slabs / postings /
+#       encode_ragged / decode_batch / windowed_agg)
+#   compute_device_cache_* {cache=...}         gauges (entries, bytes,
+#       bf16_bytes, ...) from registered device-resident cache
+#       providers: the hot tier (storage/hottier) and the per-segment
+#       postings columns (index/packed)
+#   compute_profile_degraded {reason=...}      counter: static program
+#       profile capture (lowered cost_analysis / memory_analysis)
+#       unavailable on this backend — counted, never fatal; reason is
+#       one of lower_failed / cost_failed / cost_unavailable /
+#       memory_unavailable / profile_failed
 #
 # Tier-resolution read routing (query/resolver.resolve_read), query.tier
 # scope with a {tier=...} label (raw / stitched / pinned_raw /

@@ -865,6 +865,10 @@ class DebugServer:
     def _route(self, method, path, q, body):
         if path == "/debug/profile":
             return handle_debug_profile(method, q, body)
+        if path == "/debug/compute":
+            from m3_tpu.utils import compute_stats
+
+            return compute_stats.handle_debug_compute(method, q, body)
         if path == "/metrics":
             return (200, default_registry().render_prometheus(),
                     "text/plain; version=0.0.4")

@@ -1,0 +1,43 @@
+"""MUST-FLAG: jitted programs dispatched OUTSIDE a jit_tracker — the
+compute plane cannot attribute their cache behaviour (hit/miss/evict),
+compile time, or execute wall time. Every shape here is a real
+anti-pattern the inv-jit-tracked rule exists to catch: a
+factory-fetched program called bare, a local ``jax.jit`` called bare,
+a direct ``factory(...)(args)`` chain, and a bare call hiding inside an
+UNRELATED with-statement (a non-tracker context manager blesses
+nothing)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=64)
+def _program(sig: tuple):
+    """Factory: ONE jit'd callable per signature (itself blessed)."""
+
+    def run(v):
+        return jnp.cumsum(v) * float(len(sig))
+
+    return jax.jit(run)
+
+
+def eval_fetched(sig, padded):
+    prog = _program(sig)
+    return prog(padded)          # FLAG: fetched program, no tracker
+
+
+def eval_local_jit(padded):
+    g = jax.jit(lambda v: v * 2.0)
+    return g(padded)             # FLAG: local jit, no tracker
+
+
+def eval_chained(sig, padded):
+    return _program(sig)(padded)  # FLAG: direct factory(...)(args)
+
+
+def eval_in_plain_with(sig, padded, lock):
+    prog = _program(sig)
+    with lock:                   # a lock is not a tracker
+        return prog(padded)      # FLAG: unblessed with-block

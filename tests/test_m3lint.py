@@ -226,6 +226,24 @@ class TestInvariantRules:
         # call) and a module-level monitor_queue both bless their scopes
         assert run_lint("pagepool_pass.py", select=("inv-pagepool",)) == []
 
+    def test_untracked_program_dispatch_flags(self):
+        # ISSUE 19: every fetched-program call runs under jit_tracker.
+        # All four anti-pattern shapes land: factory-fetched local,
+        # local jax.jit, direct factory(...)(args) chain, and a bare
+        # call inside an UNRELATED with-statement (a lock blesses
+        # nothing — the yield-from regression this pins)
+        fs = run_lint("jit_tracked_flag.py", select=("inv-jit-tracked",))
+        assert rules_of(fs) == {"inv-jit-tracked"}
+        assert len(fs) == 4, fs
+
+    def test_tracked_dispatch_idioms_pass(self):
+        # inline tracker with-item, tracker-bound-to-a-Name
+        # (compiler reads tracker.seconds after the block), the factory
+        # itself, the traced set, and a decorated kernel called by its
+        # own host wrapper (out of rule scope) — zero findings
+        assert run_lint("jit_tracked_pass.py",
+                        select=("inv-jit-tracked",)) == []
+
 
 class TestWaivers:
     def test_waived_finding_is_suppressed(self):
@@ -316,7 +334,7 @@ class TestWholeTree:
                      "lock-guarded-mutation", "jax-impure-call",
                      "jax-jit-per-call", "inv-fault-point-unique",
                      "inv-crash-swallow", "inv-histogram-catalog",
-                     "lint-unused-waiver"):
+                     "inv-jit-tracked", "lint-unused-waiver"):
             assert rule in r.stdout
 
     def test_rule_registry_complete(self):

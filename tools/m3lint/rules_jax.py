@@ -56,6 +56,7 @@ RULES = {
     "jax-host-materialize": "numpy/host materialization of a traced value",
     "jax-jit-per-call": "jit/vmap constructed per call (recompile storm)",
     "jax-varying-static": "jitted call with per-iteration shape/static args",
+    "inv-jit-tracked": "jitted program called outside a jit_tracker",
 }
 
 _IMPURE_CALLS = {
@@ -295,6 +296,7 @@ def _check_module(mod: Module):
 
     yield from _check_jit_per_call(mod, col, traced)
     yield from _check_varying_static(mod, col)
+    yield from _check_jit_tracked(mod, col, traced)
 
 
 _PY_SCALAR_ANNOTATIONS = {"int", "float", "bool", "str", "bytes",
@@ -505,3 +507,129 @@ def _varying_shape_expr(expr: ast.AST, loop_vars: set[str]) -> str | None:
                     if isinstance(n, ast.Name) and n.id in loop_vars:
                         return "a per-iteration len()"
     return None
+
+
+# ---------------------------------------------------------------------------
+# inv-jit-tracked: every fetched program call runs under a jit_tracker
+# ---------------------------------------------------------------------------
+#
+# The serving-path discipline (utils/dispatch): a jitted program fetched
+# from a factory (`prog = _program(sig, mesh)` where the factory returns
+# `jax.jit(...)`) or built locally (`g = jax.jit(f)`) is EXECUTED inside
+# `with dispatch.jit_tracker(op, prog, sig=...)` so the compute plane
+# can attribute cache hits/misses, compile time, execute time and
+# evictions. Blessed scopes that never flag: the traced set (calls
+# during tracing are one program, not dispatches), the factories
+# themselves, and the tracker with-block. Module-level decorated kernels
+# called by their own host wrappers (encoding/m3tsz/tpu.py style) are
+# out of scope — the wrapper IS the tracked unit, one level up.
+
+_TRACKER_CHAINS = ("jit_tracker", "dispatch.jit_tracker")
+
+
+def _is_tracker_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and \
+        _attr_chain(node.func) in _TRACKER_CHAINS
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk a function body WITHOUT descending into nested defs (nested
+    functions are separate scopes with their own _FnRec)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _factory_quals(col: _DefCollector) -> set[str]:
+    """Functions that RETURN a jitted callable: `return jax.jit(run)` or
+    `return kernel` where kernel is a nested jit root."""
+    out: set[str] = set()
+    for qual, rec in col.fns.items():
+        nested_roots = {r.node.name for r in col.fns.values()
+                        if r.parent == qual and r.is_root}
+        for node in _own_nodes(rec.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            v = node.value
+            if isinstance(v, ast.Call):
+                chain = _attr_chain(v.func)
+                if _is_jit_name(chain) or _is_vmap_name(chain):
+                    out.add(qual)
+                    break
+            if isinstance(v, ast.Name) and v.id in nested_roots:
+                out.add(qual)
+                break
+    return out
+
+
+def _check_jit_tracked(mod: Module, col: _DefCollector, traced: set[str]):
+    factories = _factory_quals(col)
+    factory_leaves = {q.rsplit(".", 1)[-1] for q in factories}
+
+    def is_factory_chain(chain: str | None) -> bool:
+        return chain is not None and \
+            chain.rsplit(".", 1)[-1] in factory_leaves
+
+    for qual, rec in col.fns.items():
+        if qual in traced or qual in factories:
+            continue
+        jitted: set[str] = set()      # locals bound to jitted callables
+        trackers: set[str] = set()    # locals bound to a jit_tracker
+
+        def bless_names(item_expr: ast.AST) -> bool:
+            if _is_tracker_call(item_expr):
+                return True
+            return isinstance(item_expr, ast.Name) and \
+                item_expr.id in trackers
+
+        def visit(node: ast.AST, blessed: bool):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return  # separate scope, checked on its own _FnRec
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                chain = _attr_chain(node.value.func)
+                kind = None
+                if _is_jit_name(chain) or _is_vmap_name(chain) or \
+                        is_factory_chain(chain):
+                    kind = jitted
+                elif chain in _TRACKER_CHAINS:
+                    kind = trackers
+                if kind is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            kind.add(t.id)
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = blessed or any(
+                    bless_names(item.context_expr) for item in node.items)
+                for item in node.items:
+                    yield from visit(item.context_expr, blessed)
+                for child in node.body:
+                    yield from visit(child, inner)
+                return
+            if isinstance(node, ast.Call) and not blessed:
+                chain = _attr_chain(node.func)
+                callee = None
+                if chain is not None and \
+                        chain.rsplit(".", 1)[-1] in jitted:
+                    callee = chain
+                elif isinstance(node.func, ast.Call) and \
+                        is_factory_chain(_attr_chain(node.func.func)):
+                    callee = (_attr_chain(node.func.func) or "factory") \
+                        + "(...)"
+                if callee is not None:
+                    yield Finding(
+                        "inv-jit-tracked", mod.path, node.lineno,
+                        f"{qual} calls jitted program {callee} outside a "
+                        f"dispatch.jit_tracker — the compute plane cannot "
+                        f"attribute its cache behaviour or device time; "
+                        f"wrap the call: `with dispatch.jit_tracker(op, "
+                        f"fn, sig=...): fn(...)`")
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, blessed)
+
+        for stmt in rec.node.body:
+            yield from visit(stmt, False)
