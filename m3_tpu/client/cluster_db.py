@@ -72,6 +72,28 @@ class ClusterNamespace:
             self.limits.add_datapoints(sum(len(t) for t, _ in out))
         return out
 
+    # the resolver's single-tier CSR fast path (fetch_tagged_ragged)
+    # probes this marker explicitly — True here means cluster reads land
+    # ONE ragged column set straight from the session's replica merge
+    # (binary wire legs included) into RaggedSeries/slab prep, with zero
+    # per-series tuple re-assembly at the coordinator
+    supports_ragged_read = True
+
+    def read_many_ragged(self, series_ids: list[bytes], start_ns: int,
+                         end_ns: int, warnings: list | None = None):
+        """read_many keeping the session's merged (times, vbits,
+        offsets) CSR intact — same results, warnings and limits
+        accounting; per-row slices are element-identical."""
+        warns: list = []
+        times, vbits, offsets = self._cdb.session.fetch_many_csr(
+            self.name, series_ids, start_ns, end_ns, warnings=warns)
+        self.last_warnings = warns
+        if warnings is not None:
+            warnings.extend(warns)
+        if self.limits is not None:
+            self.limits.add_datapoints(int(len(times)))
+        return times, vbits, offsets
+
     # label APIs used by /labels and /label/<name>/values
     class _IndexFacade:
         def __init__(self, ns: "ClusterNamespace"):

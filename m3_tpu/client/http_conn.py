@@ -59,11 +59,21 @@ class HTTPNodeConnection:
         return c
 
     def _request(self, method: str, path: str, body: bytes | None = None):
+        _ctype, payload = self._request_raw(method, path, body)
+        return json.loads(payload) if payload else None
+
+    def _request_raw(self, method: str, path: str, body: bytes | None = None,
+                     accept: str | None = None):
+        """(content_type, payload) of one node RPC — the raw transport
+        under _request, kept separate so binary-framed responses
+        (utils/wire) never round-trip through a JSON parse."""
         from m3_tpu.utils import trace
 
         # the active trace context rides every node RPC as a W3C-style
         # traceparent header, so node-side spans join the caller's trace
         headers = trace.inject_headers({"Content-Type": "application/json"})
+        if accept is not None:
+            headers["Accept"] = accept
         last_err: Exception | None = None
         for attempt in range(2):  # one transparent reconnect for stale conns
             c = self._conn()
@@ -87,7 +97,7 @@ class HTTPNodeConnection:
                         f"{self.host}:{self.port}{path} -> {r.status} "
                         f"{payload[:200]!r}"
                     )
-                return json.loads(payload) if payload else None
+                return r.getheader("Content-Type"), payload
             except NodeUnavailableError:
                 raise
             except Exception as e:  # noqa: BLE001 - socket-level failure
@@ -170,6 +180,61 @@ class HTTPNodeConnection:
         else:
             rows = doc
         return [[Datapoint(int(t), float(v)) for t, v in row] for row in rows]
+
+    def read_batch_csr(self, namespace: str, series_ids: list[bytes],
+                       start_ns: int, end_ns: int,
+                       precision: str | None = None):
+        """read_batch landing a ragged (times, vbits, offsets) CSR — the
+        binary wire fast path (utils/wire).  With the packed wire armed
+        the request offers Accept: application/x-m3wire and a capable
+        node answers a sample frame (m3tsz-re-encoded columns, or bf16
+        value columns under the negotiated ?precision=bf16 grant); a
+        JSON answer — mixed-version node, M3_TPU_WIRE=json on either
+        side — parses transparently with the fallback counted, never an
+        error.  Rows align to series_ids; node storage counters merge
+        onto the calling thread's QueryStats record either way."""
+        import numpy as np
+
+        from m3_tpu.utils import querystats, wire
+
+        packed = wire.packed_enabled()
+        doc = {
+            "namespace": namespace,
+            "series_ids": [base64.b64encode(s).decode() for s in series_ids],
+            "start_ns": int(start_ns),
+            "end_ns": int(end_ns),
+        }
+        if precision is not None:
+            doc["precision"] = precision
+        body = json.dumps(doc).encode()
+        ctype, payload = self._request_raw(
+            "POST", "/read_batch", body,
+            accept=wire.CONTENT_TYPE if packed else None)
+        wire.account("read_batch", sent=len(body), recv=len(payload))
+        if wire.is_packed(ctype):
+            times, vbits, offsets, stats = wire.unpack_samples(payload)
+            querystats.merge_storage(stats)
+            return times, vbits, offsets
+        if packed:
+            # capability probe result: this node speaks JSON only
+            wire.count_fallback("server_json")
+        envelope = json.loads(payload) if payload else []
+        if isinstance(envelope, dict):
+            querystats.merge_storage(envelope.get("stats"))
+            rows = envelope.get("rows") or []
+        else:
+            rows = envelope
+        from m3_tpu.ops import ragged
+
+        pairs = []
+        for row in rows:
+            # int(t) per element: a float64 lane would shave ns epochs
+            n = len(row)
+            t = np.fromiter((int(p[0]) for p in row), np.int64, n)
+            v = np.fromiter((float(p[1]) for p in row), np.float64,
+                            n).view(np.uint64)
+            pairs.append((t, v))
+        return ragged.pairs_to_csr(pairs)
 
     # -- index query surface --
 

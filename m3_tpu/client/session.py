@@ -450,6 +450,24 @@ class Session:
         some series below the read consistency level; otherwise the batch
         succeeds and each failed leg is reported as a ReadWarning via
         self.last_warnings / the warnings out-param."""
+        from m3_tpu.ops import ragged
+
+        times, vbits, offsets = self.fetch_many_csr(
+            namespace, series_ids, start_ns, end_ns, warnings)
+        return ragged.split_csr(times, vbits, offsets)
+
+    def fetch_many_csr(self, namespace: str, series_ids: list[bytes],
+                       start_ns: int, end_ns: int,
+                       warnings: list | None = None):
+        """fetch_many landing ONE ragged (times, vbits, offsets) CSR
+        aligned to series_ids — the row layout `RaggedSeries` and the
+        whole-query compiler's slab prep consume directly.  Replica legs
+        that speak the binary wire (read_batch_csr) contribute CSR row
+        slices with no per-sample object materialization; the replica
+        merge itself is the batched ``ragged.assemble_rows`` (row
+        semantics identical to the per-series merge_dedup).  Same
+        consistency, warnings and divergence-probe contract as
+        fetch_many."""
         with trace.span(trace.SESSION_FETCH, series=len(series_ids)), \
                 _scope.histogram("fetch_many_seconds"):
             return self._fetch_many_traced(namespace, series_ids, start_ns,
@@ -479,20 +497,30 @@ class Session:
         # closed, when ANY connection lacks read_batch (minimal test
         # doubles), or when fault injection is armed — the per-host
         # injection schedule must stay deterministic under seeded chaos.
+        # the query's negotiated precision grant (?precision=bf16 via
+        # storage/hottier) propagates coordinator->node on the binary
+        # wire legs: captured HERE so overlapped legs on pipeline worker
+        # threads see the calling thread's grant
+        from m3_tpu.storage import hottier
+
+        precision = hottier.query_precision()
         legs = []
         for host, conn in self.connections.items():
             readable = self._readable_shards_of(host, topo)
             want = [sid for sid in series_ids if shard_of[sid] in readable]
             if want:
                 legs.append((host, conn, want,
-                             getattr(conn, "read_batch", None)))
+                             getattr(conn, "read_batch", None),
+                             getattr(conn, "read_batch_csr", None)))
         from m3_tpu.storage import pipeline
 
         overlapped = len(legs) > 1 and pipeline.active() \
             and not faults.enabled() \
-            and all(batch is not None for _h, _c, _w, batch in legs)
+            and all(batch is not None or csr is not None
+                    for _h, _c, _w, batch, csr in legs)
         if overlapped:
-            leg_results = self._fly_legs(legs, namespace, start_ns, end_ns)
+            leg_results = self._fly_legs(legs, namespace, start_ns, end_ns,
+                                         precision)
         else:
             leg_results = None
         def leg_failed(host, err, leg_dt):
@@ -508,7 +536,7 @@ class Session:
             errors.append((host, err))
             querystats.record_node_leg(host, leg_dt)
 
-        for k, (host, conn, want, batch) in enumerate(legs):
+        for k, (host, conn, want, batch, csr) in enumerate(legs):
             if leg_results is not None:
                 result, err, leg_dt = leg_results[k].result()
                 if err is not None:
@@ -523,8 +551,14 @@ class Session:
                     # in-process Databases expose read_batch (the storage
                     # side fuses the whole batch into one decode per
                     # (shard, block, volume) group); only minimal test
-                    # doubles still expose read() only
-                    if batch is not None:
+                    # doubles still expose read() only. CSR-capable
+                    # conns (read_batch_csr — the binary wire path)
+                    # return the leg as one ragged column set instead of
+                    # per-sample Datapoint objects.
+                    if csr is not None:
+                        rows = self._host_call(host, csr, namespace, want,
+                                               start_ns, end_ns, precision)
+                    elif batch is not None:
                         rows = self._host_call(host, batch, namespace, want,
                                                start_ns, end_ns)
                     else:
@@ -541,6 +575,20 @@ class Session:
             # per-node share of this fan-out read, onto the active
             # query record (EXPLAIN ANALYZE renders one leg per node)
             querystats.record_node_leg(host, leg_dt, rows=len(want))
+            if isinstance(rows, tuple):
+                # CSR leg: per-series views are zero-copy row slices
+                leg_t, leg_v, leg_o = rows
+                for j, sid in enumerate(want):
+                    successes[sid] += 1
+                    a, b = int(leg_o[j]), int(leg_o[j + 1])
+                    if b > a:
+                        t_arr, v_arr = leg_t[a:b], leg_v[a:b]
+                        parts[sid].append((t_arr, v_arr))
+                        replica_sums.setdefault(sid, set()).add(
+                            _result_checksum(t_arr, v_arr))
+                    else:
+                        replica_sums.setdefault(sid, set()).add(0)
+                continue
             for sid, dps in zip(want, rows):
                 successes[sid] += 1
                 if dps:
@@ -569,19 +617,15 @@ class Session:
             self._note_divergence(
                 namespace, {shard_of[sid] for sid in divergent},
                 start_ns, end_ns, len(divergent))
-        out = []
-        for sid in series_ids:
-            if not parts[sid]:
-                out.append((np.empty(0, np.int64), np.empty(0, np.uint64)))
-                continue
-            t, v = merge_dedup(
-                np.concatenate([p[0] for p in parts[sid]]),
-                np.concatenate([p[1] for p in parts[sid]]),
-            )
-            out.append((t, v))
-        return out
+        # ONE batched merge for the whole result set (ragged.assemble_rows
+        # -> merge_csr): row semantics identical to per-series
+        # merge_dedup over the same part order, without the per-series
+        # concatenate objects
+        from m3_tpu.ops import ragged
 
-    def _fly_legs(self, legs, namespace, start_ns, end_ns):
+        return ragged.assemble_rows([parts[sid] for sid in series_ids])
+
+    def _fly_legs(self, legs, namespace, start_ns, end_ns, precision=None):
         """Put every node's read_batch RPC in flight at once through the
         shared leg policy (pipeline.submit_client_leg: trace context
         re-activated per worker, timed, exceptions as values). Each leg
@@ -595,11 +639,15 @@ class Session:
         tracer = trace.default_tracer()
         ctx = tracer.current()
         futs = []
-        for host, _conn, want, batch in legs:
-            def leg(host=host, want=want, batch=batch):
+        for host, _conn, want, batch, csr in legs:
+            def leg(host=host, want=want, batch=batch, csr=csr):
                 with querystats.collect() as st:
-                    rows = self._host_call(host, batch, namespace, want,
-                                           start_ns, end_ns)
+                    if csr is not None:
+                        rows = self._host_call(host, csr, namespace, want,
+                                               start_ns, end_ns, precision)
+                    else:
+                        rows = self._host_call(host, batch, namespace, want,
+                                               start_ns, end_ns)
                 return rows, querystats.storage_counters(st)
 
             futs.append(pipeline.submit_client_leg(

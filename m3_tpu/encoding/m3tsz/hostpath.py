@@ -84,7 +84,8 @@ def encode_blocks(times, vbits, starts, n_points,
 
 
 def encode_blocks_ragged(times, vbits, offsets, starts,
-                         unit: TimeUnit, int_optimized: bool) -> list[bytes]:
+                         unit: TimeUnit, int_optimized: bool,
+                         waste_site: str = "encode_ragged") -> list[bytes]:
     """Encode a RAGGED (CSR) sealed window to per-series streams without
     one global [B, max_T] rectangle (ROADMAP #3, the ingest-side padding
     tax): rows bucket by geometric length (ops.ragged.length_buckets) and
@@ -94,7 +95,12 @@ def encode_blocks_ragged(times, vbits, offsets, starts,
     byte-identical to encode_blocks over the fully-padded window (the
     encoder reads exactly n_points lanes per row; the pad rule matches
     seal's monotone-tail rule), pinned by the seeded parity sweep in
-    tests/test_paged_memory.py.  Zero-length rows return b""."""
+    tests/test_paged_memory.py.  Zero-length rows return b"".
+
+    ``waste_site`` names the padding-waste ledger row: the ingest seal
+    keeps the default, while the binary wire codec (utils/wire) passes
+    its own site so compute_stats tells re-encode rectangles on the
+    serving path apart from sealed-window encode rectangles."""
     from m3_tpu.ops import ragged
 
     offsets = np.asarray(offsets, np.int64)
@@ -109,7 +115,7 @@ def encode_blocks_ragged(times, vbits, offsets, starts,
         sub_t, sub_v, sub_n = ragged.csr_to_padded(
             np.asarray(times), np.asarray(vbits), offsets, rows)
         # padding-waste ledger: real points vs this bucket's rectangle
-        compute_stats.record_waste("encode_ragged", "samples",
+        compute_stats.record_waste(waste_site, "samples",
                                    int(lens[rows].sum()), sub_t.size)
         streams = encode_blocks(sub_t, sub_v, starts[rows], sub_n,
                                 unit, int_optimized)

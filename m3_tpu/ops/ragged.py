@@ -166,6 +166,19 @@ def pairs_to_csr(pairs: list[tuple[np.ndarray, np.ndarray]]):
     return times, vbits.astype(np.uint64, copy=False), offsets
 
 
+def split_csr(times: np.ndarray, vbits: np.ndarray, offsets: np.ndarray
+              ) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Per-row (times, vbits) views of a CSR — the inverse ramp of
+    ``pairs_to_csr`` for callers that still consume per-series pairs
+    (Session.fetch_many, the read_many facades).  Rows are zero-copy
+    slices of the CSR columns, so a wire frame landed by
+    ``utils/wire.unpack_samples`` fans out to per-series consumers
+    without duplicating the sample volume."""
+    return [(times[offsets[i]:offsets[i + 1]],
+             vbits[offsets[i]:offsets[i + 1]])
+            for i in range(len(offsets) - 1)]
+
+
 def combine_fragments(frags: list, n_rows: int):
     """Combine already-merged CSR fragments into one CSR ordered by
     target row id — the namespace-level combine: each shard's finalize
@@ -265,7 +278,8 @@ def bf16_pack(values: np.ndarray) -> np.ndarray:
     the host-side codec seam ROADMAP #4's quantized wire format adopts;
     tests/test_paged_memory.py pins the two conversions value-equal so
     they cannot drift."""
-    f32 = np.asarray(values, np.float64).astype(np.float32)
+    with np.errstate(over="ignore"):  # finite > f32 max rounds to inf
+        f32 = np.asarray(values, np.float64).astype(np.float32)
     u32 = f32.view(np.uint32)
     rounded = u32 + 0x7FFF + ((u32 >> 16) & 1)
     out = (rounded >> 16).astype(np.uint16)

@@ -310,12 +310,20 @@ class CoordinatorAPI:
         finally:
             if limits is not None:
                 limits.end_query()
-        if self.admission is not None and (
-                path.startswith("/api/v1/") or path == "/render"):
-            # only tenant-billable routes feed the per-tenant latency
-            # histogram: /metrics scrapes, health polls and /debug would
-            # dilute the p99 the isolation SLO is asserted against
-            self._observe_tenant(query, _time.perf_counter() - t0)
+        if path.startswith("/api/v1/") or path == "/render":
+            # bytes-on-wire ledger for the coordinator's egress (the
+            # `response` flow of net_bytes_{sent,recv}): only query-serving
+            # routes — a /metrics scrape reporting its own response bytes
+            # would feed back into itself
+            from m3_tpu.utils import wire
+
+            wire.account("response", sent=len(payload),
+                         recv=len(body) if body else 0)
+            if self.admission is not None:
+                # only tenant-billable routes feed the per-tenant latency
+                # histogram: /metrics scrapes, health polls and /debug would
+                # dilute the p99 the isolation SLO is asserted against
+                self._observe_tenant(query, _time.perf_counter() - t0)
         if trace.default_tracer().enabled:
             hdrs = {**hdrs, "M3-Trace-Id": ctx.trace_id}
         return status, ctype, payload, hdrs

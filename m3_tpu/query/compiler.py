@@ -658,7 +658,14 @@ def _slab_cuts(offsets: np.ndarray, S: int, Sp: int, n_dev: int):
     row block [d*Sp/n, (d+1)*Sp/n) and — CSR rows being contiguous —
     exactly one sample slice. Returns (sample cut [n+1], per-row slab
     base offset [S]); padded rows (S..Sp) keep their zero bounds and
-    never rebase."""
+    never rebase.
+
+    ``offsets`` may come straight off a binary wire frame
+    (utils/wire.unpack_samples -> session CSR merge -> RaggedSeries):
+    the frame codec lands int64 row offsets in exactly this layout, so
+    a cluster fanout read reaches slab prep with zero per-series
+    re-assembly between the HTTP socket and the device slabs."""
+    offsets = np.ascontiguousarray(offsets, np.int64)
     rows_per = Sp // n_dev
     row_cut = np.minimum(np.arange(n_dev + 1) * rows_per, S)
     cut = offsets[row_cut]

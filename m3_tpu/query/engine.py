@@ -337,10 +337,12 @@ class Engine:
                 ns = self.db.namespaces[name]
             except Exception:  # noqa: BLE001 - facade without the map
                 return None
-            if not getattr(ns, "supports_ragged_read", False):
+            if not getattr(ns, "has_version_truth", False):
                 # facades (cluster, fanout) have no local version truth;
                 # fanout would even DELEGATE data_version to its local
                 # namespace, keying out remote-zone changes — no hot tier
+                # (cluster facades still serve ragged reads, which is why
+                # this is a separate marker from supports_ragged_read)
                 return None
             parts.append((name, ns.ns_uid, ns.data_version()))
         mk = tuple(sorted((m.name, getattr(m.match_type, "value",

@@ -50,6 +50,7 @@ class FanoutNamespace:
     # ragged fast path / hot-tier version probes would resolve to the
     # local namespace's methods and silently skip the remote zones
     supports_ragged_read = False
+    has_version_truth = False
 
     def __init__(self, fdb: "FanoutDatabase", name: str):
         self._fdb = fdb

@@ -169,7 +169,15 @@ def fetch_tagged_ragged(db, namespaces: list[str], index_query, t_min: int,
     querystats.record(series_matched=len(docs))
     ids = [d.series_id for d in docs]
     with querystats.stage("read_many"):
-        times, vbits, offsets = ns.read_many_ragged(ids, t_min, t_max)
+        if warnings is not None and getattr(ns, "supports_read_warnings",
+                                            False):
+            # cluster facade on the CSR path: its partial-read warnings
+            # thread through the same per-call out-param fetch_tagged
+            # uses (never read back from shared facade state)
+            times, vbits, offsets = ns.read_many_ragged(
+                ids, t_min, t_max, warnings=warnings)
+        else:
+            times, vbits, offsets = ns.read_many_ragged(ids, t_min, t_max)
     lens = np.diff(offsets)
     if not (lens == 0).any():
         return docs, times, vbits, offsets

@@ -146,7 +146,7 @@ class StandingEvaluator:
             return None
         # same capability marker as the engine's fetch key: facades have
         # no local version truth, so incremental skip cannot apply
-        if not getattr(ns, "supports_ragged_read", False):
+        if not getattr(ns, "has_version_truth", False):
             return None
         return ns
 

@@ -170,7 +170,7 @@ class NodeAPI:
                            for k in range(len(entries))]
                 return 200, json.dumps({"results": results}).encode()
             if path == "/read_batch" and method == "POST":
-                from m3_tpu.utils import querystats
+                from m3_tpu.utils import querystats, wire
 
                 doc = json.loads(body)
                 # one batched storage read for the whole request: a single
@@ -180,6 +180,31 @@ class NodeAPI:
                 # ride the response envelope back to the coordinator's
                 # QueryStats record — in cluster mode they live HERE, and
                 # without the envelope the coordinator reports zeros.
+                packed = wire.packed_enabled()
+                if packed and wire.accepts_packed(headers):
+                    # binary sample frame (utils/wire): the rows go out
+                    # as a ragged CSR with m3tsz-re-encoded columns —
+                    # or bf16 value columns under the client's
+                    # propagated ?precision=bf16 grant — never as
+                    # per-sample JSON text
+                    from m3_tpu.ops import ragged
+
+                    ns = self.db.namespaces[doc.get("namespace", "default")]
+                    with querystats.collect() as st:
+                        results = ns.read_many(
+                            [base64.b64decode(s)
+                             for s in doc["series_ids"]],
+                            int(doc["start_ns"]), int(doc["end_ns"]))
+                    times, vbits, offsets = ragged.pairs_to_csr(results)
+                    frame = wire.pack_samples(
+                        times, vbits, offsets,
+                        precision=doc.get("precision"),
+                        stats=querystats.storage_counters(st))
+                    return 200, frame, wire.CONTENT_TYPE
+                if packed:
+                    # packed-capable node, JSON-only client (mixed-
+                    # version fleet): counted, served transparently
+                    wire.count_fallback("client_json")
                 with querystats.collect() as st:
                     rows = self.db.read_batch(
                         doc.get("namespace", "default"),
@@ -260,18 +285,26 @@ class NodeAPI:
                         }
                 return 200, json.dumps(out).encode()
             if path == "/blocks/stream":
+                from m3_tpu.utils import wire
+
                 ns = self.db.namespaces[q["namespace"][0]]
                 shard = ns.shards[int(q["shard"][0])]
                 bs = int(q["block_start"][0])
                 sid = base64.b64decode(q["series_id"][0])
                 reader = shard._filesets.get(bs)
                 stream = reader.read(sid) if reader else None
+                tags = (reader.tags_of(sid) or b"") if reader else b""
+                if wire.packed_enabled() and wire.accepts_packed(headers):
+                    # the stream is ALREADY m3tsz-compressed — the frame
+                    # just drops the base64+JSON wrapping (~33% + quotes)
+                    return (200,
+                            wire.pack_blobs(wire.KIND_BLOCK,
+                                            [stream or b"", tags]),
+                            wire.CONTENT_TYPE)
                 return 200, json.dumps(
                     {
                         "stream": base64.b64encode(stream or b"").decode(),
-                        "tags": base64.b64encode(
-                            (reader.tags_of(sid) or b"") if reader else b""
-                        ).decode(),
+                        "tags": base64.b64encode(tags).decode(),
                     }
                 ).encode()
             if path == "/blocks/rollup":
@@ -286,6 +319,13 @@ class NodeAPI:
 
                 digests = local_rollup_digests(
                     self.db, q["namespace"][0], int(q["shard"][0]))
+                from m3_tpu.utils import wire
+
+                if wire.packed_enabled() and wire.accepts_packed(headers):
+                    return (200,
+                            wire.pack_blobs(wire.KIND_ROLLUP,
+                                            [pack_rollup(digests)]),
+                            wire.CONTENT_TYPE)
                 return 200, json.dumps({
                     "rollup_b64": base64.b64encode(
                         pack_rollup(digests)).decode(),

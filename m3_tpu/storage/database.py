@@ -722,6 +722,19 @@ class Database:
             for times, vbits in results
         ]
 
+    def read_batch_csr(self, namespace: str, series_ids: list[bytes],
+                       start_ns: int, end_ns: int,
+                       precision: str | None = None):
+        """read_batch landing the ragged (times, vbits, offsets) CSR —
+        the NodeConnection fast path a Session prefers over read_batch:
+        an in-process leg never materializes per-sample Datapoints at
+        all.  ``precision`` is the wire-quantization grant; in-process
+        there is no wire, so results stay exact (quantization is a
+        transport measure, not a rounding contract)."""
+        del precision  # no wire to quantize in-process
+        ns = self.namespaces[namespace]
+        return ns.read_many_ragged(series_ids, start_ns, end_ns)
+
     # -- maintenance --
 
     def apply_runtime(self, manager) -> None:

@@ -28,6 +28,12 @@ class Namespace:
     # hasattr probes would otherwise resolve through their __getattr__
     # and silently bypass the facade's own read path
     supports_ragged_read = True
+    # local version truth (ns_uid + shard data_version counters): the
+    # hot tier's fetch keys and the standing engine's incremental skip
+    # require it. Split from supports_ragged_read because cluster
+    # facades DO serve ragged CSR reads (over the binary wire) while
+    # holding no version truth of their own
+    has_version_truth = True
 
     def __init__(
         self,

@@ -200,10 +200,17 @@ class HTTPPeer:
     def _get(self, path: str):
         return self.policy.call(self._fetch, path)
 
+    def _get_raw(self, path: str, accept: str):
+        """GET returning (content_type, raw_payload) — the binary-frame
+        negotiation seam (utils/wire.py): Accept advertises the frame
+        codec; the caller dispatches on the Content-Type that came back."""
+        return self.policy.call(self._fetch, path, None, accept)
+
     def _post(self, path: str, doc: dict):
         return self.policy.call(self._fetch, path, json.dumps(doc).encode())
 
-    def _fetch(self, path: str, body: bytes | None = None):
+    def _fetch(self, path: str, body: bytes | None = None,
+               accept: str | None = None):
         import urllib.error
 
         from m3_tpu.utils import trace
@@ -213,10 +220,16 @@ class HTTPPeer:
                 default_registry().root_scope("peer").histogram(
                     "http_seconds"):
             faults.check("peer.http", url=self.base + path)
+            headers = trace.inject_headers()
+            if accept is not None:
+                headers["Accept"] = accept
             req = urllib.request.Request(self.base + path, data=body,
-                                         headers=trace.inject_headers())
+                                         headers=headers)
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    if accept is not None:
+                        return (r.getheader("Content-Type") or
+                                "application/json"), r.read()
                     return json.loads(r.read())
             except urllib.error.HTTPError as e:
                 if e.code == 429:
@@ -258,21 +271,43 @@ class HTTPPeer:
     def stream_block(self, namespace, shard, block_start, series_id):
         from urllib.parse import quote
 
+        from m3_tpu.utils import wire
+
         # URL-encode the base64: '+' would decode as a space in query strings
         sid = quote(base64.b64encode(series_id).decode(), safe="")
-        doc = self._get(
-            f"/blocks/stream?namespace={quote(namespace, safe='')}"
-            f"&shard={shard}&block_start={block_start}&series_id={sid}"
-        )
+        path = (f"/blocks/stream?namespace={quote(namespace, safe='')}"
+                f"&shard={shard}&block_start={block_start}&series_id={sid}")
+        if wire.packed_enabled():
+            ctype, payload = self._get_raw(path, wire.CONTENT_TYPE)
+            wire.account("stream_block", recv=len(payload))
+            if wire.is_packed(ctype):
+                stream, tags = wire.unpack_blobs(payload, wire.KIND_BLOCK)
+                return stream, tags
+            # mixed-version fleet: older peer answered JSON — parse it,
+            # never fail the repair/bootstrap pull
+            wire.count_fallback("server_json")
+            doc = json.loads(payload)
+        else:
+            doc = self._get(path)
         return (base64.b64decode(doc["stream"]), base64.b64decode(doc["tags"]))
 
     def rollup_digests(self, namespace, shard):
         from urllib.parse import quote
 
-        doc = self._get(
-            f"/blocks/rollup?namespace={quote(namespace, safe='')}"
-            f"&shard={shard}"
-        )
+        from m3_tpu.utils import wire
+
+        path = (f"/blocks/rollup?namespace={quote(namespace, safe='')}"
+                f"&shard={shard}")
+        if wire.packed_enabled():
+            ctype, payload = self._get_raw(path, wire.CONTENT_TYPE)
+            wire.account("rollup", recv=len(payload))
+            if wire.is_packed(ctype):
+                (packed,) = wire.unpack_blobs(payload, wire.KIND_ROLLUP)
+                return unpack_rollup(packed)
+            wire.count_fallback("server_json")
+            doc = json.loads(payload)
+        else:
+            doc = self._get(path)
         return unpack_rollup(base64.b64decode(doc.get("rollup_b64", "")))
 
     def flush_shard(self, shard):

@@ -1681,11 +1681,135 @@ def config16_compute_overhead():
           ratio * rate_off, rate_off)
 
 
+def config17_wire_read():
+    """Binary wire format (ISSUE 20 / ROADMAP #1): coordinator fanout
+    read over real HTTP sockets — the packed read_batch frame (ragged
+    CSR offsets + m3tsz-re-encoded sample columns, utils/wire) vs the
+    legacy float64-JSON rows the M3_TPU_WIRE=json hatch pins. Bytes on
+    the wire are read off the client-side net.bytes.{sent,recv}
+    {flow=read_batch} counters (the satellite accounting this PR adds),
+    so the ratio measures exactly what a fleet's NIC sees. Correctness
+    is gated on EXACT sample equality (default precision is exact —
+    m3tsz re-encode round-trips bit-identical float64) before anything
+    is emitted; the emitted line carries the bytes reduction in the
+    metric name and packed-vs-json fetch throughput as value/baseline,
+    so both acceptance axes (>=3x fewer bytes, QPS no worse) live in
+    one recorded line."""
+    import tempfile
+
+    from m3_tpu.client.http_conn import HTTPNodeConnection
+    from m3_tpu.client.session import Session
+    from m3_tpu.cluster import placement as pl
+    from m3_tpu.cluster.kv import KVStore
+    from m3_tpu.cluster.placement import Instance, initial_placement
+    from m3_tpu.cluster.topology import ConsistencyLevel, TopologyMap
+    from m3_tpu.services.dbnode import DBNodeService
+    from m3_tpu.utils.ident import tags_to_id
+    from m3_tpu.utils.instrument import default_registry
+
+    NS = 10**9
+    START = 1_600_000_000 * NS
+    S = max(int(1_000 * _scale()), 100)
+    T = 360  # one hour at 10s resolution
+    n_dp = S * T
+
+    reg = default_registry()
+
+    def net_bytes() -> float:
+        total = 0.0
+        for d in ("sent", "recv"):
+            c = reg.counters.get(
+                (f"net.bytes.{d}", (("flow", "read_batch"),)))
+            total += c.value if c is not None else 0.0
+        return total
+
+    prev = os.environ.get("M3_TPU_WIRE")
+    with tempfile.TemporaryDirectory() as root:
+        kv = KVStore()
+        p = initial_placement([Instance("n0", isolation_group="g0")],
+                              n_shards=4, replica_factor=1)
+        p = pl.mark_available(p, "n0")
+        pl.store_placement(kv, p)
+        svc = DBNodeService(
+            {"db": {"path": root, "n_shards": 4,
+                    "namespaces": [{"name": "default"}]},
+             "cluster": {"instance_id": "n0"}}, kv=kv)
+        svc.db.open(START)
+        svc.sync_placement()
+        port = svc.api.serve(host="127.0.0.1", port=0)
+
+        def set_endpoint(cur):
+            cur.instances["n0"].endpoint = f"http://127.0.0.1:{port}"
+            return cur
+
+        pl.cas_update_placement(kv, set_endpoint)
+        p, _ = pl.load_placement(kv)
+        sess = Session(
+            TopologyMap(p),
+            {iid: HTTPNodeConnection(inst.endpoint)
+             for iid, inst in p.instances.items()},
+            write_consistency=ConsistencyLevel.ALL,
+            read_consistency=ConsistencyLevel.ONE)
+        # counter-style series: regular 10s cadence, small integer-ish
+        # increments — the fleet shape m3tsz was built for
+        sids = []
+        for i in range(S):
+            tags = [(b"host", b"h%04d" % i)]
+            sids.append(tags_to_id(b"reqs", tags))
+            for k in range(T):
+                svc.db.write_tagged(
+                    "default", b"reqs", tags, START + k * 10 * NS,
+                    float((k * 7 + i) % 120))
+
+        def fetch():
+            return sess.fetch_many("default", sids, START,
+                                   START + 3600 * NS)
+
+        try:
+            os.environ.pop("M3_TPU_WIRE", None)  # default: packed
+            packed = fetch()  # warm
+            b0 = net_bytes()
+            packed = fetch()
+            bytes_packed = net_bytes() - b0
+            t0 = time.perf_counter()
+            for _ in range(3):
+                fetch()
+            dt_packed = (time.perf_counter() - t0) / 3
+
+            os.environ["M3_TPU_WIRE"] = "json"
+            legacy = fetch()  # warm
+            b0 = net_bytes()
+            legacy = fetch()
+            bytes_json = net_bytes() - b0
+            t0 = time.perf_counter()
+            for _ in range(3):
+                fetch()
+            dt_json = (time.perf_counter() - t0) / 3
+        finally:
+            if prev is None:
+                os.environ.pop("M3_TPU_WIRE", None)
+            else:
+                os.environ["M3_TPU_WIRE"] = prev
+            svc.api.shutdown()
+            svc.db.close()
+
+    ok = (len(packed) == len(legacy) == S
+          and sum(len(t) for t, _ in packed) == n_dp
+          and all(np.array_equal(ta, tb) and np.array_equal(va, vb)
+                  for (ta, va), (tb, vb) in zip(packed, legacy)))
+    bratio = bytes_json / bytes_packed if bytes_packed else 0.0
+    _emit(f"#17 wire read_batch {S} series x {T} pts over HTTP "
+          f"[packed CSR+m3tsz vs json, {bratio:.1f}x fewer bytes]"
+          + ("" if ok else " (CORRECTNESS FAILED)")
+          + ("" if bratio >= 3.0 else " (BYTES TARGET MISSED)"),
+          n_dp / dt_packed, n_dp / dt_json)
+
+
 def main(argv=None) -> None:
     global _ACCEL
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs",
-                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16")
+                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17")
     ap.add_argument("--record", default=None,
                     help="also append the JSON lines to this file")
     args = ap.parse_args(argv)
@@ -1716,7 +1840,7 @@ def main(argv=None) -> None:
            "11": config11_sharded_query, "12": config12_pipelined_read,
            "13": config13_paged_memory, "14": config14_matcher_postings,
            "15": config15_tier_resolution,
-           "16": config16_compute_overhead}
+           "16": config16_compute_overhead, "17": config17_wire_read}
     for c in args.configs.split(","):
         c = c.strip()
         try:

@@ -867,7 +867,7 @@ class TrajectoryRecorder:
         row: dict = {"t_s": now_s, "p99_ms": None,
                      "qps_writes": 0.0, "qps_queries": 0.0,
                      "rss_bytes": {}, "stalls": {},
-                     "device_compute": {}}
+                     "net_bytes": {}, "device_compute": {}}
         writes, queries = self._rig_totals()
         row["qps_writes"] = round((writes - self._prev_writes)
                                   / max(self.sample_s, 1e-6), 1)
@@ -880,6 +880,15 @@ class TrajectoryRecorder:
             if self._prev_hist is not None:
                 row["p99_ms"] = hist_p99_ms(hist_delta(self._prev_hist, cur))
             self._prev_hist = cur
+            # bytes-on-wire ledger (utils/wire, ROADMAP #1): cumulative
+            # per-flow totals off the coordinator scrape — a first-class
+            # soak column, so a wire-format regression shows up as a
+            # bytes/row slope change against the same QPS
+            for direction in ("sent", "recv"):
+                for labels, val in parse_counters(
+                        text, f"net_bytes_{direction}").items():
+                    flow = dict(labels).get("flow", "?")
+                    row["net_bytes"][f"{flow}_{direction}"] = int(val)
         except Exception:  # noqa: BLE001 - coordinator briefly unreachable
             pass
         for svc, port in self.profile_ports.items():

@@ -187,3 +187,17 @@ TIMERS = {
 #   query_tier_reads {tier=...}                selector fetches served
 #       by each tier choice; the same decision rides ?explain=analyze
 #       as the per-fetch `tiers` block
+#
+# Binary wire plane (utils/wire, ROADMAP #1) — the bytes-on-wire ledger
+# for the fat inter-node flows, counted by the CLIENT side of each flow
+# (one unambiguous owner per counter: the coordinator accounts
+# read_batch + response, a repairing/bootstrapping dbnode accounts
+# stream_block + rollup); the rig surfaces the sums as the
+# net_bytes_total trajectory column:
+#   net_bytes_sent {flow=read_batch|stream_block|rollup|response}
+#       request/response bytes written to the wire for that flow
+#   net_bytes_recv {flow=...}                  bytes read off the wire
+#   net_wire_fallback {reason=server_json|client_json}
+#       a packed-capable side served/parsed legacy JSON instead
+#       (mixed-version fleet); every bump also emits the wire.fallback
+#       tracepoint — counted, never an error

@@ -63,6 +63,7 @@ REPAIR_CYCLE = "storage.repair.cycle"
 QUERY_COMPILE_FALLBACK = "query.compile.fallback"
 WATCHDOG_STALL = "watchdog.stall"
 PLACEMENT_SYNC_DEFER = "placement.sync.defer"
+WIRE_FALLBACK = "wire.fallback"
 
 _ZERO_SPAN_ID = "0" * 16
 # placeholder trace id carried by a negative head decision's context —

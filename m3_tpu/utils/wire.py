@@ -1,0 +1,315 @@
+"""Lean binary wire frames for the fat inter-node flows (ROADMAP #1).
+
+Every fat coordinator<->dbnode<->peer flow used to ship float64 JSON:
+`/read_batch` repeated every decoded sample as a `[t, v]` text pair,
+`/blocks/stream` wrapped the already-compact m3tsz stream in base64 +
+JSON, and `/blocks/rollup` base64'd the packed ROLLUP_DTYPE table.  This
+module is the shared frame codec that lifts the in-tree codecs onto the
+wire instead:
+
+- ``pack_samples``/``unpack_samples`` frame a ragged ``(offsets,
+  lengths, samples)`` CSR for the read_batch rows.  The default column
+  mode re-encodes the samples with the m3tsz delta-of-delta/XOR codec
+  (``encoding/m3tsz/hostpath`` — native + device rungs, exact bit
+  round-trip); under the client's negotiated ``?precision=bf16`` grant
+  (storage/hottier) the value column rides ``ops/ragged.bf16_pack``
+  instead (half the bytes of raw float64, quantized).  The receiver
+  lands the CSR directly into ``RaggedSeries`` / the whole-query
+  compiler's ``_slab_cuts`` host prep — zero JSON re-assembly.
+- ``pack_blobs``/``unpack_blobs`` frame length-prefixed raw byte
+  columns for the peer ``stream_block`` and ``rollup`` flows (no
+  base64, no JSON envelope).
+
+Negotiation is per connection, Accept/Content-Type style: a capable
+client sends ``Accept: application/x-m3wire``; a capable server answers
+with that Content-Type and a frame, anything else answers JSON and the
+client parses it transparently (``count_fallback`` keeps the ledger —
+mixed-version fleets degrade to JSON, never to an error).  The
+``M3_TPU_WIRE=json`` hatch pins either side back to the legacy JSON
+wire byte-identically.
+
+Frame codec idiom (the PR-9 ``peers.ROLLUP_DTYPE`` template, pinned by
+m3lint ``inv-wire-frame-scope``): every struct/dtype below is built
+ONCE at module scope, never per request.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+
+# the negotiated binary media type (Accept on requests, Content-Type on
+# framed responses); anything else on the wire is the legacy JSON plane
+CONTENT_TYPE = "application/x-m3wire"
+
+MAGIC = b"M3WF"
+VERSION = 1
+
+# frame kinds
+KIND_SAMPLES = 1   # read_batch rows: ragged CSR sample columns
+KIND_BLOCK = 2     # peer stream_block: [m3tsz stream, encoded tags]
+KIND_ROLLUP = 3    # peer rollup digests: [packed ROLLUP_DTYPE table]
+
+# sample column modes (KIND_SAMPLES)
+MODE_F64 = 0       # exact: raw <i8 times + <u8 value-bit columns
+MODE_M3TSZ = 1     # exact: per-row m3tsz delta-of-delta/XOR streams
+MODE_BF16 = 2      # quantized: raw <i8 times + bf16 <u2 value column
+                   # (only under the explicit ?precision=bf16 grant)
+
+# module-scope codec objects — the whole point of the frame idiom: one
+# header Struct and one dtype per column for the life of the process
+_HEADER = struct.Struct("<4sBBBxI")   # magic, version, kind, mode, n_rows
+_U32 = np.dtype("<u4")                # per-row lengths column
+_I64 = np.dtype("<i8")                # timestamp column
+_U64 = np.dtype("<u8")                # float64 value-bit column
+_U16 = np.dtype("<u2")                # bf16 value column
+
+
+class WireError(ValueError):
+    """A frame that does not parse (bad magic/version/length)."""
+
+
+def wire_mode() -> str:
+    """The M3_TPU_WIRE hatch: 'packed' (default) arms the binary frames,
+    'json' pins this side to the legacy JSON wire byte-identically."""
+    return "json" if os.environ.get("M3_TPU_WIRE", "").strip().lower() \
+        == "json" else "packed"
+
+
+def packed_enabled() -> bool:
+    return wire_mode() == "packed"
+
+
+def accepts_packed(headers) -> bool:
+    """Server-side capability probe: did the client's Accept header
+    offer the binary media type? (dict or http.server Message, absent on
+    legacy/mixed-version clients)."""
+    if headers is None:
+        return False
+    try:
+        accept = headers.get("Accept") or ""
+    except AttributeError:
+        return False
+    return CONTENT_TYPE in accept
+
+
+def is_packed(ctype: str | None) -> bool:
+    """Client-side: did the server answer with a binary frame?"""
+    return bool(ctype) and ctype.split(";")[0].strip() == CONTENT_TYPE
+
+
+# ---------------------------------------------------------------------------
+# per-flow wire accounting + the counted JSON fallback
+# ---------------------------------------------------------------------------
+
+
+_byte_scopes: dict = {}
+_fallback_scopes: dict = {}
+
+
+def account(flow: str, *, sent: int = 0, recv: int = 0) -> None:
+    """net_bytes_{sent,recv}{flow=} — the bytes-on-wire ledger, counted
+    by the CLIENT side of each flow (one unambiguous owner per counter:
+    the coordinator accounts read_batch + response, a repairing dbnode
+    accounts stream_block + rollup)."""
+    sc = _byte_scopes.get(flow)
+    if sc is None:
+        from m3_tpu.utils.instrument import default_registry
+
+        sc = default_registry().root_scope("net").subscope("bytes",
+                                                           flow=flow)
+        _byte_scopes[flow] = sc
+    if sent:
+        sc.counter("sent", sent)
+    if recv:
+        sc.counter("recv", recv)
+
+
+def count_fallback(reason: str) -> None:
+    """wire.fallback{reason=} tracepoint + counter: a packed-capable
+    side served/parsed legacy JSON instead (mixed-version fleet, or a
+    payload the frame codec declined).  Counted, never an error."""
+    from m3_tpu.utils import trace
+
+    sc = _fallback_scopes.get(reason)
+    if sc is None:
+        from m3_tpu.utils.instrument import default_registry
+
+        sc = default_registry().root_scope("net").subscope("wire",
+                                                           reason=reason)
+        _fallback_scopes[reason] = sc
+    sc.counter("fallback")
+    with trace.span(trace.WIRE_FALLBACK, reason=reason):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# KIND_SAMPLES: the ragged CSR sample frame (read_batch rows)
+# ---------------------------------------------------------------------------
+
+
+def _pack_frame(kind: int, mode: int, n_rows: int, stats: dict | None,
+                columns: list[bytes]) -> bytes:
+    stats_blob = json.dumps(stats).encode() if stats else b""
+    parts = [_HEADER.pack(MAGIC, VERSION, kind, mode, n_rows),
+             struct.pack("<I", len(stats_blob)), stats_blob]
+    parts.extend(columns)
+    return b"".join(parts)
+
+
+def _unpack_frame(buf: bytes):
+    """(kind, mode, n_rows, stats, body) — shared header/stats parse."""
+    if len(buf) < _HEADER.size + 4:
+        raise WireError(f"frame too short: {len(buf)} bytes")
+    magic, version, kind, mode, n_rows = _HEADER.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise WireError(f"bad frame magic {magic!r}")
+    if version != VERSION:
+        raise WireError(f"unsupported frame version {version}")
+    off = _HEADER.size
+    (stats_len,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    if off + stats_len > len(buf):
+        raise WireError("stats blob overruns frame")
+    stats = json.loads(buf[off:off + stats_len]) if stats_len else None
+    return kind, mode, n_rows, stats, memoryview(buf)[off + stats_len:]
+
+
+def _column(body: memoryview, off: int, dtype: np.dtype, count: int):
+    """One fixed-width column copied out of the frame (writable — the
+    CSR lands in merge/sort paths that mutate)."""
+    nbytes = count * dtype.itemsize
+    if off + nbytes > len(body):
+        raise WireError("column overruns frame")
+    arr = np.frombuffer(body, dtype=dtype, count=count, offset=off).copy()
+    return arr, off + nbytes
+
+
+def pack_samples(times: np.ndarray, vbits: np.ndarray, offsets: np.ndarray,
+                 *, precision: str | None = None,
+                 stats: dict | None = None) -> bytes:
+    """Frame a ragged CSR of samples for the wire.
+
+    Default mode is the exact m3tsz re-encode (per-row delta-of-delta/
+    XOR streams at nanosecond unit — bit-exact round trip, typically a
+    small fraction of the raw column bytes).  ``precision='bf16'``
+    (the negotiated per-query grant) quantizes the VALUE column to bf16
+    instead; timestamps always stay exact.  A CSR the block codec
+    declines (encode overflow) degrades to the raw float64 columns —
+    still framed, still exact, never JSON."""
+    offsets = np.ascontiguousarray(offsets, _I64)
+    times = np.ascontiguousarray(times, _I64)
+    vbits = np.ascontiguousarray(np.asarray(vbits).view(np.uint64), _U64)
+    n_rows = len(offsets) - 1
+    lens = np.diff(offsets)
+    if precision == "bf16":
+        from m3_tpu.ops import ragged
+
+        packed = ragged.bf16_pack(vbits.view(np.float64))
+        cols = [lens.astype(_U32).tobytes(), times.tobytes(),
+                np.ascontiguousarray(packed, _U16).tobytes()]
+        return _pack_frame(KIND_SAMPLES, MODE_BF16, n_rows, stats, cols)
+    try:
+        streams = _encode_rows(times, vbits, offsets)
+    except (OverflowError, ValueError):
+        streams = None
+    if streams is None or sum(map(len, streams)) >= times.nbytes \
+            + vbits.nbytes:
+        # encode declined, or the samples are incompressible (random
+        # bits XOR to full width): raw columns are exact AND smaller
+        cols = [lens.astype(_U32).tobytes(), times.tobytes(),
+                vbits.tobytes()]
+        return _pack_frame(KIND_SAMPLES, MODE_F64, n_rows, stats, cols)
+    stream_lens = np.fromiter((len(s) for s in streams), np.int64, n_rows)
+    cols = [stream_lens.astype(_U32).tobytes()]
+    cols.extend(streams)
+    return _pack_frame(KIND_SAMPLES, MODE_M3TSZ, n_rows, stats, cols)
+
+
+def _encode_rows(times, vbits, offsets) -> list[bytes]:
+    """Per-row m3tsz streams for a CSR: each row's block start is its
+    own first timestamp (the encoder writes the first time as raw 64-bit
+    nanos, so arbitrary starts round-trip exactly at ns unit); empty
+    rows frame as zero-length streams."""
+    from m3_tpu.encoding.m3tsz import hostpath
+    from m3_tpu.utils.xtime import TimeUnit
+
+    n_rows = len(offsets) - 1
+    starts = np.zeros(n_rows, np.int64)
+    nonempty = np.diff(offsets) > 0
+    if nonempty.any():
+        starts[nonempty] = times[offsets[:-1][nonempty]]
+    return hostpath.encode_blocks_ragged(times, vbits, offsets, starts,
+                                         TimeUnit.NANOSECOND, False,
+                                         waste_site="wire_encode")
+
+
+def unpack_samples(buf: bytes):
+    """(times int64, vbits uint64, offsets int64, stats dict | None)
+    from a KIND_SAMPLES frame — the CSR the receiver hands straight to
+    RaggedSeries / the compiler's slab prep."""
+    kind, mode, n_rows, stats, body = _unpack_frame(buf)
+    if kind != KIND_SAMPLES:
+        raise WireError(f"expected samples frame, got kind {kind}")
+    lens32, off = _column(body, 0, _U32, n_rows)
+    if mode == MODE_M3TSZ:
+        from m3_tpu.encoding.m3tsz import hostpath
+        from m3_tpu.ops import ragged
+        from m3_tpu.utils.xtime import TimeUnit
+
+        streams = []
+        for n in lens32.astype(np.int64).tolist():
+            if off + n > len(body):
+                raise WireError("stream column overruns frame")
+            streams.append(bytes(body[off:off + n]))
+            off += n
+        pairs = hostpath.decode_streams_batch(streams, TimeUnit.NANOSECOND,
+                                              False)
+        times, vbits, offsets = ragged.pairs_to_csr(pairs)
+        return times, vbits, offsets, stats
+    counts = lens32.astype(np.int64)
+    offsets = np.zeros(n_rows + 1, np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    n = int(offsets[-1])
+    times, off = _column(body, off, _I64, n)
+    if mode == MODE_F64:
+        vbits, off = _column(body, off, _U64, n)
+    elif mode == MODE_BF16:
+        from m3_tpu.ops import ragged
+
+        packed, off = _column(body, off, _U16, n)
+        vbits = ragged.bf16_unpack(packed).view(np.uint64)
+    else:
+        raise WireError(f"unknown sample column mode {mode}")
+    return times.astype(np.int64, copy=False), vbits, offsets, stats
+
+
+# ---------------------------------------------------------------------------
+# KIND_BLOCK / KIND_ROLLUP: length-prefixed raw byte columns
+# ---------------------------------------------------------------------------
+
+
+def pack_blobs(kind: int, blobs: list[bytes]) -> bytes:
+    """Frame raw byte strings (an m3tsz block stream + its encoded tags,
+    a packed rollup table) without base64 or a JSON envelope."""
+    lens = np.fromiter((len(b) for b in blobs), np.int64, len(blobs))
+    cols = [lens.astype(_U32).tobytes()]
+    cols.extend(blobs)
+    return _pack_frame(kind, 0, len(blobs), None, cols)
+
+
+def unpack_blobs(buf: bytes, kind: int) -> list[bytes]:
+    got_kind, _mode, n_rows, _stats, body = _unpack_frame(buf)
+    if got_kind != kind:
+        raise WireError(f"expected kind {kind} frame, got {got_kind}")
+    lens32, off = _column(body, 0, _U32, n_rows)
+    out = []
+    for n in lens32.astype(np.int64).tolist():
+        if off + n > len(body):
+            raise WireError("blob column overruns frame")
+        out.append(bytes(body[off:off + n]))
+        off += n
+    return out

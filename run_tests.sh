@@ -13,7 +13,13 @@
 #                         schedule, and the consensus sweep runs the same
 #                         number of partition/leader-kill/heal rounds
 #                         against the raft-lite metadata plane under a
-#                         virtual clock; never part of tier-1
+#                         virtual clock; never part of tier-1. (PR 20)
+#                         The lane arms M3_TPU_WIRE=packed so every
+#                         inter-node RPC the schedules drive rides the
+#                         binary frames; export M3_TPU_WIRE=json to rerun
+#                         the identical schedules over the legacy JSON
+#                         hatch (byte-identical results — the fallback
+#                         contract tests/test_wire.py pins)
 #   run_tests.sh rig    — opt-in PROCESS-LEVEL production rig: real
 #                         spawned dbnodes + 3-replica quorum kvd +
 #                         coordinator + aggregator under seeded
@@ -32,7 +38,11 @@
 #                         acked-write loss through every handoff, and the
 #                         post-episode convergence audit. Both episodes
 #                         share the M3_TPU_RIG_SECONDS budget; never
-#                         tier-1
+#                         tier-1. (PR 20) Like the chaos lane, the rig
+#                         runs with M3_TPU_WIRE=packed armed, so repair
+#                         streams, rollup digests, and coordinator reads
+#                         all ride the binary frames under kill/partition
+#                         chaos
 #   run_tests.sh tsan   — opt-in ThreadSanitizer stage for the native
 #                         layer: (1) pytest tests/test_race_native.py
 #                         (uninstrumented pytest; its tests spawn their
@@ -68,6 +78,7 @@ elif [ "${1:-}" = "chaos" ]; then
   exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     M3_TPU_CHAOS_ITERS="${M3_TPU_CHAOS_ITERS:-200}" \
+    M3_TPU_WIRE="${M3_TPU_WIRE:-packed}" \
     python -m pytest tests/test_crash_recovery.py tests/test_fault_injection.py \
     tests/test_consensus.py \
     -q -m chaos "$@"
@@ -76,6 +87,7 @@ elif [ "${1:-}" = "rig" ]; then
   exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     M3_TPU_RIG_SECONDS="${M3_TPU_RIG_SECONDS:-20}" \
+    M3_TPU_WIRE="${M3_TPU_WIRE:-packed}" \
     python -m pytest tests/test_rig.py -q -m chaos "$@"
 elif [ "${1:-}" = "tsan" ]; then
   shift

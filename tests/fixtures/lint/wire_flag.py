@@ -1,0 +1,19 @@
+"""MUST-FLAG: inv-wire-frame-scope — frame codec descriptors built per
+call inside handlers instead of once at module scope."""
+
+import struct
+
+import numpy as np
+
+
+def handle_read_batch(body):
+    # per-request header Struct: the format string re-parses on every
+    # request this handler serves
+    header = struct.Struct("<4sBBBxI")
+    return header.unpack_from(body, 0)
+
+
+def unpack_rollup(raw):
+    # per-call dtype compile of a fixed field spec
+    rollup = np.dtype([("block_start", "<i8"), ("digest", "<u8")])
+    return np.frombuffer(raw, rollup)

@@ -1,0 +1,27 @@
+"""MUST-PASS: the frame codec idiom — every struct/dtype descriptor is
+built ONCE at module scope; handlers only pack/unpack through them
+(struct.pack with a literal format is fine: the struct module caches
+compiled formats internally)."""
+
+import struct
+
+import numpy as np
+
+_HEADER = struct.Struct("<4sBBBxI")
+_ROLLUP = np.dtype([("block_start", "<i8"), ("digest", "<u8")])
+_U32 = np.dtype("<u4")
+
+
+def handle_read_batch(body):
+    magic, version, kind, mode, n_rows = _HEADER.unpack_from(body, 0)
+    lens = np.frombuffer(body, _U32, count=n_rows, offset=_HEADER.size)
+    return kind, mode, lens
+
+
+def pack_lengths(blobs):
+    # literal-format pack: cached by the struct module, not a descriptor
+    return struct.pack("<I", len(blobs)) + b"".join(blobs)
+
+
+def unpack_rollup(raw):
+    return np.frombuffer(raw, _ROLLUP)

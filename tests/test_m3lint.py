@@ -226,6 +226,20 @@ class TestInvariantRules:
         # call) and a module-level monitor_queue both bless their scopes
         assert run_lint("pagepool_pass.py", select=("inv-pagepool",)) == []
 
+    def test_wire_frame_per_call_construction_flags(self):
+        # ISSUE 20: frame codec descriptors (struct.Struct, np.dtype)
+        # built inside a handler re-parse the format per request — both
+        # the Struct and the dtype construction must land
+        fs = run_lint("wire_flag.py", select=("inv-wire",))
+        assert rules_of(fs) == {"inv-wire-frame-scope"}
+        assert len(fs) == 2, fs
+
+    def test_wire_frame_module_scope_passes(self):
+        # the utils/wire.py idiom: descriptors once at module scope;
+        # struct.pack with a literal format inside a function is fine
+        # (the struct module caches compiled formats)
+        assert run_lint("wire_pass.py", select=("inv-wire",)) == []
+
     def test_untracked_program_dispatch_flags(self):
         # ISSUE 19: every fetched-program call runs under jit_tracker.
         # All four anti-pattern shapes land: factory-fetched local,

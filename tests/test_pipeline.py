@@ -448,7 +448,8 @@ def quorum_session(tmp_path, n_nodes=3, n_shards=4):
 
 
 class _FailingConn:
-    """read_batch-capable conn that always fails (a down node)."""
+    """read_batch-capable conn that always fails (a down node — every
+    batched read surface fails, including the CSR wire path)."""
 
     def __init__(self, inner):
         self._inner = inner
@@ -457,6 +458,9 @@ class _FailingConn:
         return getattr(self._inner, name)
 
     def read_batch(self, *a, **kw):
+        raise ConnectionError("node is down")
+
+    def read_batch_csr(self, *a, **kw):
         raise ConnectionError("node is down")
 
 

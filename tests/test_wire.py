@@ -1,0 +1,519 @@
+"""Binary wire format (ISSUE 20): frame codec round-trips, the
+negotiated-precision bf16 column contract, Accept/Content-Type
+negotiation, mixed-version JSON fallback (both directions, counted and
+never an error), and the M3_TPU_WIRE=json hatch pinned byte-identical
+on the JSON side."""
+
+from __future__ import annotations
+
+import base64
+import json
+
+import numpy as np
+import pytest
+
+from m3_tpu.ops import ragged
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.options import (
+    DatabaseOptions,
+    IndexOptions,
+    NamespaceOptions,
+    RetentionOptions,
+)
+from m3_tpu.utils import wire
+from m3_tpu.utils.ident import tags_to_id
+from m3_tpu.utils.instrument import default_registry
+
+HOUR = 3600 * 10**9
+SEC = 10**9
+START = 1_599_998_400_000_000_000  # 2h-aligned block start
+
+
+def make_csr(rng, n_rows=8, max_len=40):
+    """A realistic ragged CSR: regular-ish timestamps, smooth values."""
+    pairs = []
+    for i in range(n_rows):
+        n = int(rng.integers(0, max_len))
+        t0 = START + int(rng.integers(0, HOUR))
+        times = t0 + np.arange(n, dtype=np.int64) * (10 * SEC)
+        vals = np.sin(np.arange(n) / 3.0) * 10 + i
+        pairs.append((times, vals.view(np.uint64)))
+    return ragged.pairs_to_csr(pairs)
+
+
+# ---------------------------------------------------------------------------
+# frame codec round-trips
+# ---------------------------------------------------------------------------
+
+
+class TestSampleFrames:
+    def test_m3tsz_mode_exact_roundtrip(self):
+        times, vbits, offsets = make_csr(np.random.default_rng(0))
+        buf = wire.pack_samples(times, vbits, offsets)
+        t2, v2, o2, stats = wire.unpack_samples(buf)
+        assert np.array_equal(t2, times)
+        assert np.array_equal(v2, vbits)
+        assert np.array_equal(o2, offsets)
+        assert stats is None
+
+    def test_m3tsz_mode_compresses_regular_samples(self):
+        # regular intervals + counter-like values: the delta-of-delta/XOR
+        # streams must be well under the raw 16 bytes/sample columns
+        pairs = []
+        for i in range(16):
+            n = 200
+            times = START + np.arange(n, dtype=np.int64) * (10 * SEC)
+            vals = (np.arange(n, dtype=np.float64) % 32) + i
+            pairs.append((times, vals.view(np.uint64)))
+        times, vbits, offsets = ragged.pairs_to_csr(pairs)
+        buf = wire.pack_samples(times, vbits, offsets)
+        assert len(buf) < (times.nbytes + vbits.nbytes) // 2
+
+    def test_incompressible_samples_fall_back_to_raw_columns(self):
+        # random bit patterns XOR to full width: m3tsz would EXPAND, so
+        # the codec degrades to the exact raw f64 columns — framed,
+        # exact, never JSON
+        rng = np.random.default_rng(2)
+        n = 64
+        times = np.sort(rng.integers(START, START + HOUR, n)).astype(np.int64)
+        vbits = rng.integers(0, 2**63, n, dtype=np.int64).view(np.uint64)
+        offsets = np.array([0, n], np.int64)
+        buf = wire.pack_samples(times, vbits, offsets)
+        t2, v2, o2, _ = wire.unpack_samples(buf)
+        assert np.array_equal(t2, times) and np.array_equal(v2, vbits)
+        assert np.array_equal(o2, offsets)
+        # still cheaper than the 2x expansion m3tsz would have produced
+        assert len(buf) <= times.nbytes + vbits.nbytes + 256
+
+    def test_empty_csr(self):
+        offsets = np.zeros(1, np.int64)
+        buf = wire.pack_samples(np.empty(0, np.int64),
+                                np.empty(0, np.uint64), offsets)
+        t2, v2, o2, _ = wire.unpack_samples(buf)
+        assert len(t2) == 0 and len(v2) == 0 and len(o2) == 1
+
+    def test_all_empty_rows(self):
+        offsets = np.zeros(5, np.int64)
+        buf = wire.pack_samples(np.empty(0, np.int64),
+                                np.empty(0, np.uint64), offsets)
+        t2, v2, o2, _ = wire.unpack_samples(buf)
+        assert len(o2) == 5 and np.array_equal(o2, offsets)
+
+    def test_stats_envelope_rides_the_frame(self):
+        times, vbits, offsets = make_csr(np.random.default_rng(3))
+        stats = {"blocks": 7, "bytes": 1234, "rungs": {"native": 2}}
+        buf = wire.pack_samples(times, vbits, offsets, stats=stats)
+        *_, got = wire.unpack_samples(buf)
+        assert got == stats
+
+    def test_bf16_mode_times_exact_values_quantized(self):
+        times, vbits, offsets = make_csr(np.random.default_rng(4))
+        buf = wire.pack_samples(times, vbits, offsets, precision="bf16")
+        t2, v2, o2, _ = wire.unpack_samples(buf)
+        assert np.array_equal(t2, times)          # timestamps stay exact
+        assert np.array_equal(o2, offsets)
+        vals = vbits.view(np.float64)
+        got = v2.view(np.float64)
+        nz = vals != 0
+        assert np.all(np.abs(got[nz] - vals[nz]) <=
+                      np.abs(vals[nz]) / 256 + 1e-300)
+
+    def test_frame_errors(self):
+        with pytest.raises(wire.WireError):
+            wire.unpack_samples(b"nope")
+        with pytest.raises(wire.WireError):
+            wire.unpack_samples(b"XXXX" + b"\x00" * 16)
+        times, vbits, offsets = make_csr(np.random.default_rng(5))
+        buf = wire.pack_samples(times, vbits, offsets)
+        with pytest.raises(wire.WireError):
+            wire.unpack_samples(buf[: len(buf) // 2])  # truncated column
+        with pytest.raises(wire.WireError):
+            wire.unpack_blobs(buf, wire.KIND_BLOCK)    # wrong kind
+
+
+class TestBlobFrames:
+    def test_roundtrip(self):
+        blobs = [b"m3tsz-stream-bytes", b"", b"\x00\xff" * 100]
+        buf = wire.pack_blobs(wire.KIND_BLOCK, blobs)
+        assert wire.unpack_blobs(buf, wire.KIND_BLOCK) == blobs
+
+    def test_no_base64_expansion(self):
+        stream = bytes(range(256)) * 8
+        buf = wire.pack_blobs(wire.KIND_BLOCK, [stream, b"tags"])
+        legacy = len(json.dumps({
+            "stream": base64.b64encode(stream).decode(),
+            "tags": base64.b64encode(b"tags").decode()}).encode())
+        assert len(buf) < legacy * 0.8
+
+
+# ---------------------------------------------------------------------------
+# bf16 pack/unpack edge cases (satellite: negotiated-precision contract)
+# ---------------------------------------------------------------------------
+
+
+class TestBF16EdgeCases:
+    def test_specials_roundtrip(self):
+        vals = np.array([np.nan, np.inf, -np.inf, 0.0, -0.0, 1.0, -1.0])
+        got = ragged.bf16_unpack(ragged.bf16_pack(vals))
+        assert np.isnan(got[0])
+        assert got[1] == np.inf and got[2] == -np.inf
+        assert got[3] == 0.0 and not np.signbit(got[3])
+        assert got[4] == 0.0 and np.signbit(got[4])  # -0.0 keeps its sign
+        assert got[5] == 1.0 and got[6] == -1.0
+
+    def test_nan_payloads_collapse_to_canonical_quiet_nan(self):
+        # every NaN payload lands as 0x7FC0 so downstream masks survive
+        weird = np.array([np.float64("nan"), -np.float64("nan")])
+        packed = ragged.bf16_pack(weird)
+        assert set(packed.tolist()) == {0x7FC0}
+
+    def test_negative_zero_bit_pattern(self):
+        assert ragged.bf16_pack(np.array([-0.0]))[0] == 0x8000
+
+    def test_float64_subnormals_flush_to_zero(self):
+        # doubles below float32 range underflow through the f32
+        # intermediate; sign survives
+        vals = np.array([5e-324, -5e-324, 1e-310])
+        got = ragged.bf16_unpack(ragged.bf16_pack(vals))
+        assert np.all(got == 0.0)
+        assert np.signbit(got[1]) and not np.signbit(got[0])
+
+    def test_overflow_to_infinity(self):
+        # finite doubles beyond bf16's max (~3.39e38) round to inf
+        got = ragged.bf16_unpack(ragged.bf16_pack(np.array([1e39, -1e39])))
+        assert got[0] == np.inf and got[1] == -np.inf
+
+    def test_empty(self):
+        assert len(ragged.bf16_unpack(ragged.bf16_pack(
+            np.empty(0, np.float64)))) == 0
+
+    def test_seeded_property_sweep_error_bounds(self):
+        # the negotiated-precision contract: for normal values,
+        # |unpack(pack(x)) - x| <= |x| * 2^-8 (8 explicit mantissa bits
+        # round-to-nearest-even => half-ulp 2^-9, bounded by 2^-8), and
+        # pack∘unpack is idempotent (bf16(bf16(x)) == bf16(x), which is
+        # what makes double quantization on the wire + hot tier safe)
+        rng = np.random.default_rng(1234)
+        mags = rng.uniform(-30, 30, 20_000)
+        vals = np.sign(rng.standard_normal(20_000)) * 10.0 ** mags
+        got = ragged.bf16_unpack(ragged.bf16_pack(vals))
+        rel = np.abs(got - vals) / np.abs(vals)
+        assert float(rel.max()) <= 2.0**-8
+        again = ragged.bf16_unpack(ragged.bf16_pack(got))
+        assert np.array_equal(got, again)
+
+
+# ---------------------------------------------------------------------------
+# negotiation matrix
+# ---------------------------------------------------------------------------
+
+
+class TestNegotiation:
+    def test_wire_mode_hatch(self, monkeypatch):
+        monkeypatch.delenv("M3_TPU_WIRE", raising=False)
+        assert wire.wire_mode() == "packed" and wire.packed_enabled()
+        monkeypatch.setenv("M3_TPU_WIRE", "json")
+        assert wire.wire_mode() == "json" and not wire.packed_enabled()
+        monkeypatch.setenv("M3_TPU_WIRE", "packed")
+        assert wire.packed_enabled()
+
+    def test_accepts_packed(self):
+        assert wire.accepts_packed({"Accept": wire.CONTENT_TYPE})
+        assert wire.accepts_packed(
+            {"Accept": f"application/json, {wire.CONTENT_TYPE}"})
+        assert not wire.accepts_packed({"Accept": "application/json"})
+        assert not wire.accepts_packed({})
+        assert not wire.accepts_packed(None)
+
+    def test_is_packed(self):
+        assert wire.is_packed(wire.CONTENT_TYPE)
+        assert wire.is_packed(f"{wire.CONTENT_TYPE}; charset=binary")
+        assert not wire.is_packed("application/json")
+        assert not wire.is_packed(None)
+
+
+# ---------------------------------------------------------------------------
+# dbnode handler: negotiation + the byte-identical JSON hatch
+# ---------------------------------------------------------------------------
+
+
+def small_opts() -> NamespaceOptions:
+    return NamespaceOptions(
+        retention=RetentionOptions(
+            retention_ns=24 * HOUR,
+            block_size_ns=2 * HOUR,
+            buffer_past_ns=10 * 60 * SEC,
+        ),
+        index=IndexOptions(enabled=True, block_size_ns=2 * HOUR),
+        snapshot_enabled=False,
+    )
+
+
+@pytest.fixture
+def node_api(tmp_path):
+    from m3_tpu.services.dbnode import NodeAPI
+
+    db = Database(str(tmp_path / "db"), DatabaseOptions(n_shards=4))
+    db.create_namespace("default", small_opts())
+    db.open(START)
+    sids = []
+    for i in range(6):
+        tags = [(b"host", b"h%d" % i)]
+        sids.append(tags_to_id(b"cpu", tags))
+        for k in range(30):
+            db.write_tagged("default", b"cpu", tags,
+                            START + k * 10 * SEC, float(np.sin(k / 3.0) + i))
+    yield NodeAPI(db), sids
+    db.close()
+
+
+def read_batch_body(sids):
+    return json.dumps({
+        "namespace": "default",
+        "series_ids": [base64.b64encode(s).decode() for s in sids],
+        "start_ns": START, "end_ns": START + HOUR,
+    }).encode()
+
+
+def counter_value(name: str, **tags) -> float:
+    key = (name, tuple(sorted(tags.items())))
+    c = default_registry().counters.get(key)
+    return c.value if c is not None else 0.0
+
+
+class TestNodeNegotiation:
+    def test_accept_header_gets_a_frame(self, node_api):
+        api, sids = node_api
+        res = api.handle("POST", "/read_batch", {}, read_batch_body(sids),
+                         headers={"Accept": wire.CONTENT_TYPE})
+        status, payload, ctype = res[0], res[1], res[2]
+        assert status == 200 and ctype == wire.CONTENT_TYPE
+        times, vbits, offsets, stats = wire.unpack_samples(payload)
+        assert len(offsets) == len(sids) + 1
+        assert int(offsets[-1]) == len(times) == 6 * 30
+        assert stats and stats.get("blocks", 0) >= 0
+
+    def test_no_accept_gets_json(self, node_api):
+        api, sids = node_api
+        res = api.handle("POST", "/read_batch", {}, read_batch_body(sids),
+                         headers={})
+        assert res[0] == 200
+        assert len(res) == 2 or res[2] == "application/json"
+        doc = json.loads(res[1])
+        assert len(doc["rows"]) == len(sids)
+
+    def test_frame_and_json_carry_identical_samples(self, node_api):
+        api, sids = node_api
+        body = read_batch_body(sids)
+        frame = api.handle("POST", "/read_batch", {}, body,
+                           headers={"Accept": wire.CONTENT_TYPE})[1]
+        times, vbits, offsets, _ = wire.unpack_samples(frame)
+        doc = json.loads(api.handle("POST", "/read_batch", {}, body,
+                                    headers={})[1])
+        for i, row in enumerate(doc["rows"]):
+            a, b = int(offsets[i]), int(offsets[i + 1])
+            assert [int(t) for t, _ in row] == times[a:b].tolist()
+            assert [float(v) for _, v in row] == \
+                vbits[a:b].view(np.float64).tolist()
+
+    def test_json_hatch_pins_legacy_bytes(self, node_api, monkeypatch):
+        # M3_TPU_WIRE=json must serve the EXACT legacy JSON bytes even
+        # to a client that advertised the binary codec
+        api, sids = node_api
+        body = read_batch_body(sids)
+        legacy = api.handle("POST", "/read_batch", {}, body, headers={})[1]
+        monkeypatch.setenv("M3_TPU_WIRE", "json")
+        pinned = api.handle("POST", "/read_batch", {}, body,
+                            headers={"Accept": wire.CONTENT_TYPE})[1]
+        assert pinned == legacy
+
+    def test_packed_capable_server_counts_legacy_clients(self, node_api):
+        api, sids = node_api
+        before = counter_value("net.wire.fallback", reason="client_json")
+        api.handle("POST", "/read_batch", {}, read_batch_body(sids),
+                   headers={})
+        after = counter_value("net.wire.fallback", reason="client_json")
+        assert after == before + 1
+
+
+# ---------------------------------------------------------------------------
+# session over real HTTP: packed/json parity + mixed-version fallback
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def http_cluster(tmp_path):
+    from m3_tpu.client.http_conn import HTTPNodeConnection
+    from m3_tpu.client.session import Session
+    from m3_tpu.cluster import placement as pl
+    from m3_tpu.cluster.kv import KVStore
+    from m3_tpu.cluster.placement import Instance, initial_placement
+    from m3_tpu.cluster.topology import ConsistencyLevel, TopologyMap
+    from m3_tpu.services.dbnode import DBNodeService
+
+    kv = KVStore()
+    p = initial_placement(
+        [Instance(f"n{i}", isolation_group=f"g{i}") for i in range(2)],
+        n_shards=4, replica_factor=2)
+    for inst in p.instances.values():
+        p = pl.mark_available(p, inst.id)
+    pl.store_placement(kv, p)
+    nodes = {}
+    for i in range(2):
+        nid = f"n{i}"
+        svc = DBNodeService(
+            {"db": {"path": str(tmp_path / nid), "n_shards": 4,
+                    "namespaces": [{"name": "default"}]},
+             "cluster": {"instance_id": nid}}, kv=kv)
+        svc.db.open(START)
+        svc.sync_placement()
+        port = svc.api.serve(host="127.0.0.1", port=0)
+
+        def set_endpoint(cur, nid=nid, port=port):
+            cur.instances[nid].endpoint = f"http://127.0.0.1:{port}"
+            return cur
+
+        pl.cas_update_placement(kv, set_endpoint)
+        nodes[nid] = svc
+    p, _ = pl.load_placement(kv)
+    conns = {iid: HTTPNodeConnection(inst.endpoint)
+             for iid, inst in p.instances.items()}
+    sess = Session(TopologyMap(p), conns,
+                   write_consistency=ConsistencyLevel.ALL,
+                   read_consistency=ConsistencyLevel.ONE)
+    sids = []
+    for i in range(10):
+        tags = [(b"host", b"h%d" % i)]
+        sids.append(tags_to_id(b"cpu", tags))
+        for k in range(25):
+            sess.write_tagged("default", b"cpu", tags,
+                              START + k * 10 * SEC,
+                              float(np.sin(k / 3.0) * 10 + i))
+    yield sess, sids, nodes
+    for svc in nodes.values():
+        svc.api.shutdown()
+        svc.db.close()
+
+
+class _JSONOnlyConn:
+    """A pre-upgrade client connection: no read_batch_csr surface."""
+
+    read_batch_csr = None  # session probes getattr(conn, ..., None)
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TestSessionWireParity:
+    def test_packed_and_json_fetch_identical(self, http_cluster,
+                                             monkeypatch):
+        sess, sids, _ = http_cluster
+        monkeypatch.delenv("M3_TPU_WIRE", raising=False)
+        packed = sess.fetch_many("default", sids, START, START + HOUR)
+        monkeypatch.setenv("M3_TPU_WIRE", "json")
+        legacy = sess.fetch_many("default", sids, START, START + HOUR)
+        assert len(packed) == len(legacy) == len(sids)
+        for (ta, va), (tb, vb) in zip(packed, legacy):
+            assert np.array_equal(ta, tb)
+            assert np.array_equal(va, vb)
+        assert sum(len(t) for t, _ in packed) == 10 * 25
+
+    def test_read_batch_bytes_accounted(self, http_cluster, monkeypatch):
+        sess, sids, _ = http_cluster
+        monkeypatch.delenv("M3_TPU_WIRE", raising=False)
+        sent0 = counter_value("net.bytes.sent", flow="read_batch")
+        recv0 = counter_value("net.bytes.recv", flow="read_batch")
+        sess.fetch_many("default", sids, START, START + HOUR)
+        assert counter_value("net.bytes.sent", flow="read_batch") > sent0
+        assert counter_value("net.bytes.recv", flow="read_batch") > recv0
+
+    def test_old_server_falls_back_to_json_counted(self, http_cluster,
+                                                   monkeypatch):
+        # a dbnode that never learned the codec: simulate by blinding
+        # the server's capability probe — the packed-requesting client
+        # must parse the JSON answer, count the fallback, and return
+        # identical results; never an error
+        sess, sids, _ = http_cluster
+        monkeypatch.delenv("M3_TPU_WIRE", raising=False)
+        want = sess.fetch_many("default", sids, START, START + HOUR)
+        monkeypatch.setattr(wire, "accepts_packed", lambda headers: False)
+        before = counter_value("net.wire.fallback", reason="server_json")
+        got = sess.fetch_many("default", sids, START, START + HOUR)
+        after = counter_value("net.wire.fallback", reason="server_json")
+        assert after > before
+        for (ta, va), (tb, vb) in zip(want, got):
+            assert np.array_equal(ta, tb) and np.array_equal(va, vb)
+
+    def test_old_client_json_against_packed_server(self, http_cluster,
+                                                   monkeypatch):
+        # the other direction: a pre-upgrade coordinator (no CSR/Accept
+        # surface) against binary-capable dbnodes — legacy JSON reads
+        # serve identical results, and the packed-capable server counts
+        # the legacy client
+        sess, sids, _ = http_cluster
+        monkeypatch.delenv("M3_TPU_WIRE", raising=False)
+        want = sess.fetch_many("default", sids, START, START + HOUR)
+        for host in list(sess.connections):
+            sess.connections[host] = _JSONOnlyConn(sess.connections[host])
+        before = counter_value("net.wire.fallback", reason="client_json")
+        got = sess.fetch_many("default", sids, START, START + HOUR)
+        after = counter_value("net.wire.fallback", reason="client_json")
+        assert after > before
+        for (ta, va), (tb, vb) in zip(want, got):
+            assert np.array_equal(ta, tb) and np.array_equal(va, vb)
+
+    def test_bf16_precision_grant_quantizes_within_bound(self, http_cluster,
+                                                         monkeypatch):
+        from m3_tpu.storage import hottier
+
+        sess, sids, _ = http_cluster
+        monkeypatch.delenv("M3_TPU_WIRE", raising=False)
+        exact = sess.fetch_many("default", sids, START, START + HOUR)
+        with hottier.negotiated_precision("bf16"):
+            quant = sess.fetch_many("default", sids, START, START + HOUR)
+        for (ta, va), (tb, vb) in zip(exact, quant):
+            assert np.array_equal(ta, tb)  # timestamps stay exact
+            a = va.view(np.float64)
+            b = vb.view(np.float64)
+            nz = a != 0
+            assert np.all(np.abs(b[nz] - a[nz]) <= np.abs(a[nz]) / 256)
+
+
+# ---------------------------------------------------------------------------
+# peer flows: stream_block / rollup over the packed wire
+# ---------------------------------------------------------------------------
+
+
+class TestPeerWire:
+    def test_stream_and_rollup_packed_vs_json(self, http_cluster,
+                                              monkeypatch):
+        from m3_tpu.storage.peers import HTTPPeer, reset_peer_policies
+
+        _sess, _sids, nodes = http_cluster
+        svc = nodes["n0"]
+        svc.db.flush_all()
+        ns = svc.db.namespaces["default"]
+        shard_id = next(sid for sid, s in ns.shards.items()
+                        if s.flushed_block_starts)
+        reset_peer_policies()
+        port = svc.api._server.server_address[1]
+        peer = HTTPPeer(f"http://127.0.0.1:{port}")
+        monkeypatch.delenv("M3_TPU_WIRE", raising=False)
+        starts = peer.block_starts("default", shard_id)
+        assert starts
+        meta = peer.block_metadata("default", shard_id, starts[0])
+        series_id = next(iter(meta))
+        recv0 = counter_value("net.bytes.recv", flow="stream_block")
+        stream_p, tags_p = peer.stream_block("default", shard_id,
+                                             starts[0], series_id)
+        assert counter_value("net.bytes.recv", flow="stream_block") > recv0
+        digests_p = peer.rollup_digests("default", shard_id)
+        monkeypatch.setenv("M3_TPU_WIRE", "json")
+        stream_j, tags_j = peer.stream_block("default", shard_id,
+                                             starts[0], series_id)
+        digests_j = peer.rollup_digests("default", shard_id)
+        assert stream_p == stream_j and tags_p == tags_j
+        assert digests_p == digests_j and digests_p

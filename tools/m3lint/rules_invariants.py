@@ -28,6 +28,11 @@ invariants) as rules 1-5 and adds three new ones:
                             calls (the peers.py bug class: the broad
                             except wraps an RPC helper whose
                             ``faults.check`` lives one call down)
+``inv-wire-frame-scope``    frame codec descriptors (``struct.Struct``,
+                            ``np.dtype``) built once at module scope,
+                            never per call — a per-request construction
+                            re-parses the format string on the hot
+                            handler path (the utils/wire.py idiom)
 
 The fixed-project-file rules (tracepoints, exemplars, exporter,
 admission) run in whole-tree mode only; the fault-seam, catalog, and
@@ -54,6 +59,7 @@ RULES = {
     "inv-queue-gauge": "bounded queue/ring without a monitor_queue registration",
     "inv-pagepool-gauge": "page pool/hot tier constructed without a "
                           "saturation-plane registration",
+    "inv-wire-frame-scope": "frame codec struct/dtype constructed per call",
 }
 
 # modules whose fault-point mentions are documentation or test scaffolding
@@ -569,12 +575,52 @@ def _check_queue_gauges(proj: Project):
                 "internals)")
 
 
+# ---------------------------------------------------------------------------
+# rule: frame codec objects built once at module scope
+# ---------------------------------------------------------------------------
+
+# constructor chains that COMPILE a wire-format descriptor: each call
+# parses a format string / field spec, so one per request on a hot
+# handler is pure re-parse overhead (the utils/wire.py + peers.py
+# ROLLUP_DTYPE idiom is module scope, once per process). struct.pack /
+# struct.unpack with a literal format are fine — the struct module
+# caches compiled formats internally.
+_FRAME_CTORS = {"struct.Struct", "np.dtype", "numpy.dtype"}
+
+
+def _check_wire_frame_scope(proj: Project):
+    """inv-wire-frame-scope: a ``struct.Struct(...)`` / ``np.dtype(...)``
+    constructed inside a function or method body — frame descriptors
+    belong at module scope (built once), not per call on a request
+    handler. Waive for genuinely dynamic descriptors (a dtype computed
+    from runtime shape)."""
+    for mod in proj.modules:
+        seen: set[int] = set()  # nested defs re-walk inner calls
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or node.lineno in seen:
+                    continue
+                chain = _attr_chain(node.func)
+                if chain not in _FRAME_CTORS:
+                    continue
+                seen.add(node.lineno)
+                yield Finding(
+                    "inv-wire-frame-scope", mod.path, node.lineno,
+                    f"{chain}(...) constructed inside {fn.name}() — frame "
+                    f"codec descriptors are parsed at construction; build "
+                    f"them ONCE at module scope (the utils/wire.py / "
+                    f"peers.ROLLUP_DTYPE idiom), not per call")
+
+
 def check(proj: Project):
     # per-module rules run in both whole-tree and explicit-paths mode
     yield from _check_fault_seams(proj)
     yield from _check_histogram_catalog(proj)
     yield from _check_crash_swallow(proj)
     yield from _check_queue_gauges(proj)
+    yield from _check_wire_frame_scope(proj)
     if not proj.whole_tree:
         return
     # project-level rules reference fixed files; whole-tree mode only
